@@ -1,0 +1,42 @@
+"""flow_updating_tpu — a TPU-native framework for large-scale gossip aggregation.
+
+A ground-up re-design of the capabilities of
+``AvilaAndre/simgrid-flow-updating-implementation`` (two Flow-Updating
+distributed-averaging protocols running on SimGrid's C++ discrete-event
+simulator) as an idiomatic JAX/XLA framework:
+
+* the per-actor event loop of the reference
+  (``flowupdating-collectall.py:66-85``) becomes a bulk-synchronous, fully
+  vectorized round over dense edge-index arrays, wrapped in ``jax.lax.scan``;
+* SimGrid's mailbox/rendezvous machinery becomes a per-edge in-flight message
+  ring buffer (delivery is an elementwise select, sending is one masked
+  scatter);
+* SimGrid's platform/deployment XML files are parsed into a :class:`Topology`
+  of ``(E,)`` edge arrays;
+* asynchrony (1 msg/sec drain, 50-tick timeouts, link latencies) is preserved
+  through static round-config knobs so the same kernel serves both a faithful
+  mode and a fast synchronous mode;
+* multi-chip scaling shards the node axis over a ``jax.sharding.Mesh`` with
+  halo exchange for cross-shard edges.
+"""
+
+__version__ = "0.1.0"
+
+from flow_updating_tpu.topology.graph import Topology, build_topology
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.state import FlowUpdatingState, init_state
+from flow_updating_tpu.models.rounds import round_step, run_rounds, node_estimates
+from flow_updating_tpu.engine import Engine
+
+__all__ = [
+    "Topology",
+    "build_topology",
+    "RoundConfig",
+    "FlowUpdatingState",
+    "init_state",
+    "round_step",
+    "run_rounds",
+    "node_estimates",
+    "Engine",
+    "__version__",
+]

@@ -1,0 +1,214 @@
+"""Engine façade — the S4U-shaped driver API over the vectorized kernel.
+
+Mirrors the verbs a user of the reference touches (SURVEY.md N1/A10; the
+reference's ``__main__`` at ``flowupdating-collectall.py:151-166``):
+``Engine(argv)`` -> ``load_platform`` -> ``register_actor`` ->
+``load_deployment`` -> ``netzone_root.add_host`` -> ``run_until`` — plus
+``Engine.clock``, the watcher, and ``global_values``-style readback.  Under
+the hood there are no actors or mailboxes: the deployment resolves to a
+:class:`Topology`, state is one pytree, and ``run_until`` advances it in
+compiled chunks of rounds, surfacing to the host only at watcher sampling
+points (the reference's every-10-sim-seconds dump,
+``collectall.py:139-142``).
+
+Simulated-time convention: one round == ``TICK_INTERVAL`` (1.0) simulated
+seconds, the reference peers' loop cadence.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import (
+    node_estimates,
+    run_rounds,
+)
+from flow_updating_tpu.models.state import FlowUpdatingState, init_state
+from flow_updating_tpu.topology.deployment import Deployment, load_deployment
+from flow_updating_tpu.topology.graph import Topology
+from flow_updating_tpu.topology.platform import Platform, load_platform
+
+logger = logging.getLogger("flow_updating_tpu.engine")
+
+TICK_INTERVAL = 1.0  # simulated seconds per round
+
+
+class _NetzoneShim:
+    """Compatibility shim for ``e.netzone_root.add_host(name, speed)``
+    (reference ``flowupdating-collectall.py:159``).  Hosts added here that
+    never receive a peer (like the reference's ``observer``) simply don't
+    join the gossip graph."""
+
+    def __init__(self, engine: "Engine"):
+        self._engine = engine
+
+    def add_host(self, name: str, speed: float):
+        if self._engine.platform is None:
+            self._engine.platform = Platform(hosts={}, links={}, routes={})
+        self._engine.platform = self._engine.platform.add_host(name, speed)
+        return name
+
+
+class Engine:
+    """Driver for one simulation/aggregation run."""
+
+    def __init__(self, argv=None, config: RoundConfig | None = None):
+        # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
+        # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
+        # accepts a ready RoundConfig here.
+        self.argv = list(argv) if argv else []
+        self.config = config or RoundConfig.fast()
+        self.platform: Platform | None = None
+        self.deployment: Deployment | None = None
+        self.topology: Topology | None = None
+        self.state: FlowUpdatingState | None = None
+        self._registered: dict = {}
+        self._watchers: list = []
+        self._clock = 0.0
+        self._killed = False
+        self.netzone_root = _NetzoneShim(self)
+
+    # ---- setup -----------------------------------------------------------
+    @property
+    def clock(self) -> float:
+        return self._clock
+
+    def load_platform(self, path: str) -> "Engine":
+        self.platform = load_platform(path)
+        return self
+
+    def register_actor(self, name: str, fn=None) -> "Engine":
+        """Register a deployable function name.  The built-in gossip "actors"
+        are selected via ``RoundConfig.variant``; arbitrary Python callables
+        are not supported (there is no per-actor execution here), so ``fn``
+        is accepted for API compatibility and recorded only."""
+        self._registered[name] = fn
+        return self
+
+    def load_deployment(self, path: str, function: str | None = None) -> "Engine":
+        if function is None and len(self._registered) == 1:
+            function = next(iter(self._registered))
+        self.deployment = load_deployment(path, function=function)
+        return self
+
+    def set_topology(self, topo: Topology) -> "Engine":
+        self.topology = topo
+        return self
+
+    def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
+        """Resolve deployment(+platform) into topology + fresh state."""
+        if self.topology is None:
+            if self.deployment is None:
+                raise RuntimeError("no deployment loaded and no topology set")
+            self.topology = self.deployment.to_topology(
+                platform=self.platform,
+                tick_interval=TICK_INTERVAL,
+                latency_scale=latency_scale,
+            )
+        if latency_scale > 0.0:
+            depth = max(self.config.delay_depth, self.topology.max_delay)
+            if depth != self.config.delay_depth:
+                import dataclasses
+
+                self.config = dataclasses.replace(self.config, delay_depth=depth)
+        self._topo_arrays = self.topology.device_arrays(
+            coloring=self.config.needs_coloring
+        )
+        self.state = init_state(self.topology, self.config, seed=seed)
+        return self
+
+    # ---- observability ---------------------------------------------------
+    def add_watcher(
+        self,
+        run_until: float = 1000.0,
+        time_interval: float = 10.0,
+        callback: Callable | None = None,
+    ) -> "Engine":
+        """The reference's watcher actor (``collectall.py:139-148``): sample
+        global state every ``time_interval`` simulated seconds, and at
+        ``run_until`` stop all peers ("kill_all")."""
+        self._watchers.append(
+            {"until": float(run_until), "every": float(time_interval),
+             "callback": callback}
+        )
+        return self
+
+    def global_values(self) -> dict:
+        """The reference's ``global_values`` mirror: per-host value and
+        last_avg keyed by host name (``collectall.py:47-63,131``)."""
+        if self.state is None:
+            return {}
+        names = self.topology.names or tuple(
+            str(i) for i in range(self.topology.num_nodes)
+        )
+        value = np.asarray(self.state.value)
+        last_avg = np.asarray(self.state.last_avg)
+        return {
+            "value": dict(zip(names, value.tolist())),
+            "last_avg": dict(zip(names, last_avg.tolist())),
+        }
+
+    def estimates(self) -> np.ndarray:
+        if self.state is None:
+            raise RuntimeError("engine not built")
+        return np.asarray(node_estimates(self.state, self._topo_arrays))
+
+    # ---- execution -------------------------------------------------------
+    def run_rounds(self, n: int) -> "Engine":
+        if self.state is None:
+            self.build()
+        if not self._killed and n > 0:
+            self.state = run_rounds(self.state, self._topo_arrays, self.config, n)
+        self._clock += n * TICK_INTERVAL
+        return self
+
+    def run_until(self, t_end: float) -> "Engine":
+        """Advance simulated time to ``t_end``, honoring watchers: compiled
+        chunks of rounds between sampling points, host callbacks at each
+        sample, and a hard stop of peer execution at a watcher's ``until``
+        (after which the clock still advances to ``t_end``, like the
+        reference's dead time between kill_all at t=1000 and engine stop at
+        t=10000, ``collectall.py:145,164``)."""
+        if self.state is None:
+            self.build()
+        events = sorted(
+            {w["until"] for w in self._watchers}
+            | {
+                t
+                for w in self._watchers
+                for t in np.arange(
+                    self._clock + w["every"], min(w["until"], t_end) + 1e-9, w["every"]
+                )
+            }
+        )
+        for t_ev in events + [float(t_end)]:
+            if t_ev > t_end:
+                break
+            n = int(round((t_ev - self._clock) / TICK_INTERVAL))
+            if n > 0 and not self._killed:
+                self.state = run_rounds(
+                    self.state, self._topo_arrays, self.config, n
+                )
+            self._clock = t_ev
+            for w in self._watchers:
+                hit_sample = (
+                    t_ev <= w["until"]
+                    and abs((t_ev - round(t_ev / w["every"]) * w["every"])) < 1e-9
+                )
+                if hit_sample:
+                    if w["callback"] is not None:
+                        w["callback"](self)
+                    else:
+                        for key, vals in self.global_values().items():
+                            logger.info("[%0.1f] %s%s", self._clock, key, vals)
+                if t_ev >= w["until"] and not self._killed:
+                    logger.info(
+                        "[%0.1f] watcher: stopping every peer.", self._clock
+                    )
+                    self._killed = True
+        self._clock = float(t_end)
+        return self

@@ -1,0 +1,20 @@
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.state import FlowUpdatingState, init_state
+from flow_updating_tpu.models.rounds import (
+    round_step,
+    run_rounds,
+    deliver_phase,
+    fire_phase,
+    node_estimates,
+)
+
+__all__ = [
+    "RoundConfig",
+    "FlowUpdatingState",
+    "init_state",
+    "round_step",
+    "run_rounds",
+    "deliver_phase",
+    "fire_phase",
+    "node_estimates",
+]

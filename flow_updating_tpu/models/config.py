@@ -1,0 +1,89 @@
+"""Static round configuration.
+
+One kernel serves every execution mode of the framework; this frozen,
+hashable config is passed as a jit-static argument and selects the mode at
+trace time (all branches resolve statically — no data-dependent Python
+control flow reaches XLA).
+
+Mapping to the reference's knobs:
+
+* ``variant``           — which script: ``flowupdating-collectall.py`` vs
+                          ``flowupdating-pairwise.py``.
+* ``fire_policy``       — 'reference' reproduces the all-neighbors-reported /
+                          timeout firing rule (collect-all,
+                          ``collectall.py:90-91,102-103``) and the
+                          receive-triggered + staleness rule (pairwise,
+                          ``pairwise.py:86-91,100``); 'every_round' is the
+                          fast synchronous mode (every node / edge averages
+                          each round — the throughput path).
+* ``drain``             — messages a node may process per round.  The
+                          reference's loop posts ONE async receive per 1-second
+                          tick (``collectall.py:70-85``), i.e. drain=1;
+                          0 means unbounded (fast mode).
+* ``timeout``           — collect-all: ticks before forced average
+                          (``collectall.py:24``, 50 ticks); pairwise: rounds
+                          of per-neighbor silence before re-initiation
+                          (``pairwise.py:24``, 50.0 sim-seconds == 50 rounds
+                          at the 1.0 s tick).
+* ``delay_depth``       — in-flight ring-buffer depth; 1 = unit-delay rounds,
+                          >= max(topology delay)+1 enables latency-warped
+                          rounds derived from platform link latencies.
+* ``drop_rate``         — per-message loss probability (fault injection; the
+                          protocol is self-healing by design and the test
+                          suite asserts it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+COLLECTALL = "collectall"
+PAIRWISE = "pairwise"
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundConfig:
+    variant: str = COLLECTALL          # 'collectall' | 'pairwise'
+    fire_policy: str = "every_round"   # 'every_round' | 'reference'
+    drain: int = 0                     # max msgs processed /node/round; 0 = all
+    timeout: int = 50                  # ticks (collectall) / rounds (pairwise)
+    delay_depth: int = 1               # ring buffer depth D (static)
+    drop_rate: float = 0.0             # message loss probability
+    dtype: str = "float32"             # ledger dtype
+
+    def __post_init__(self):
+        if self.variant not in (COLLECTALL, PAIRWISE):
+            raise ValueError(f"unknown variant {self.variant!r}")
+        if self.fire_policy not in ("every_round", "reference"):
+            raise ValueError(f"unknown fire_policy {self.fire_policy!r}")
+        if self.delay_depth < 1:
+            raise ValueError("delay_depth must be >= 1")
+        if self.drain < 0:
+            raise ValueError("drain must be >= 0 (0 = unbounded)")
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def needs_coloring(self) -> bool:
+        """Fast synchronous pairwise fires one edge-color class per round."""
+        return self.variant == PAIRWISE and self.fire_policy == "every_round"
+
+    @classmethod
+    def reference(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
+        """The faithful mode: reproduces the reference's asynchronous
+        dynamics (1 msg/round drain, 50-round timeouts)."""
+        kw.setdefault("fire_policy", "reference")
+        kw.setdefault("drain", 1)
+        kw.setdefault("timeout", 50)
+        return cls(variant=variant, **kw)
+
+    @classmethod
+    def fast(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
+        """The throughput mode: synchronous averaging every round."""
+        kw.setdefault("fire_policy", "every_round")
+        kw.setdefault("drain", 0)
+        return cls(variant=variant, **kw)

@@ -1,0 +1,305 @@
+"""The vectorized Flow-Updating round — the TPU replacement for SimGrid's DES.
+
+Where the reference multiplexes one coroutine per actor through SimGrid's
+sequential maestro (each peer: post one async receive, test it, tick, sleep
+1 s — ``flowupdating-collectall.py:66-85``), here *all* N peers advance one
+tick as a single bulk-synchronous step of dense edge-array ops, and R rounds
+run as one ``jax.lax.scan``.  One round has two phases, mirroring the
+reference loop body ordering (receive -> tick/fire -> average & send):
+
+``deliver_phase``
+    Pop this round's slot of the in-flight ring buffer (messages land in the
+    slot of the *receiver's* edge, so arrival is elementwise), merge into the
+    per-edge pending set (newer-wins — the protocol's state exchange is
+    idempotent), then *drain*: unbounded in fast mode, or a per-node
+    round-robin pick of ``cfg.drain`` messages (the reference's loop drains
+    at most one message per simulated second).  Processing a message applies
+    the antisymmetry write ``flow[e] = -msg.flow`` / ``est[e] = msg.estimate``
+    (reference ``:98-99``) into the receiver's ledger.
+
+``fire_phase``
+    Decide who averages (all-neighbors-reported / tick-timeout for
+    collect-all, receive-trigger / staleness for pairwise — or everyone, in
+    fast mode), compute the averages with segment reductions, update ledgers,
+    and scatter outgoing messages into future ring-buffer slots at
+    ``(t + delay[e]) % D`` (unit delay or latency-warped rounds share this
+    path).  Message loss (fault injection) masks the scatter only — the
+    sender's ledger is updated regardless, exactly like a lost ``put_async``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from flow_updating_tpu.models.config import COLLECTALL, PAIRWISE, RoundConfig
+from flow_updating_tpu.models.state import FlowUpdatingState
+from flow_updating_tpu.ops.segment import (
+    segment_all,
+    segment_max,
+    segment_min,
+    segment_sum,
+)
+from flow_updating_tpu.ops.segscan import segmented_affine_scan
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def node_estimates(state: FlowUpdatingState, topo) -> jnp.ndarray:
+    """Per-node current estimate: ``value - sum(out flows)``
+    (reference ``flowupdating-collectall.py:106-107``)."""
+    N = topo.out_deg.shape[0]
+    return state.value - segment_sum(state.flow, topo.src, N)
+
+
+def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
+    """Arrivals + drain + receive.  Returns (state, processed_mask)."""
+    N = topo.out_deg.shape[0]
+    D = cfg.delay_depth
+    slot = state.t % D
+
+    arr_valid = state.buf_valid[slot]
+    pending_flow = jnp.where(arr_valid, state.buf_flow[slot], state.pending_flow)
+    pending_est = jnp.where(arr_valid, state.buf_est[slot], state.pending_est)
+    pending_valid = state.pending_valid | arr_valid
+    buf_valid = state.buf_valid.at[slot].set(False)
+
+    receiver_alive = state.alive[topo.src]
+    candidates = pending_valid & receiver_alive
+
+    if cfg.drain == 0:
+        process = candidates
+    else:
+        # Round-robin pick of `drain` pending in-edges per node: priority is
+        # the edge's rank rotated by the round counter, so service order
+        # cycles fairly — the vectorized analogue of FIFO mailbox order.
+        process = jnp.zeros_like(candidates)
+        remaining = candidates
+        prio = jnp.mod(topo.edge_rank - state.t, jnp.maximum(topo.out_deg[topo.src], 1))
+        for _ in range(cfg.drain):
+            key = jnp.where(remaining, prio, _I32_MAX)
+            best = segment_min(key, topo.src, N)
+            pick = remaining & (key == best[topo.src]) & (key < _I32_MAX)
+            process = process | pick
+            remaining = remaining & ~pick
+
+    flow = jnp.where(process, -pending_flow, state.flow)
+    est = jnp.where(process, pending_est, state.est)
+    recv = state.recv | process
+    pending_valid = pending_valid & ~process
+
+    state = state.replace(
+        flow=flow,
+        est=est,
+        recv=recv,
+        pending_flow=pending_flow,
+        pending_est=pending_est,
+        pending_valid=pending_valid,
+        buf_valid=buf_valid,
+    )
+    return state, process
+
+
+def fire_phase(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, trigger
+) -> FlowUpdatingState:
+    """Tick, averaging, ledger update and message send."""
+    N = topo.out_deg.shape[0]
+    E = topo.src.shape[0]
+    D = cfg.delay_depth
+    dt = state.flow.dtype
+    t = state.t
+    src = topo.src
+
+    flows_sum = segment_sum(state.flow, src, N)
+    estimate = state.value - flows_sum
+
+    ticks = state.ticks
+    stamp = state.stamp
+    recv = state.recv
+    last_avg = state.last_avg
+    fired_ctr = state.fired
+
+    if cfg.variant == COLLECTALL:
+        ticks = ticks + 1
+        if cfg.fire_policy == "every_round":
+            fire_n = state.alive
+        else:
+            all_heard = segment_all(recv, src, N)
+            fire_n = (all_heard | (ticks >= cfg.timeout)) & state.alive
+        # avg over self + ALL neighbors' last-known estimates (unheard
+        # neighbors contribute their defaultdict 0.0, as in the reference,
+        # ``collectall.py:109-113``).
+        est_sum = segment_sum(state.est, src, N)
+        avg = (estimate + est_sum) / (topo.out_deg + 1).astype(dt)
+        fire_e = fire_n[src]
+        avg_e = avg[src]
+        new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
+        new_est = jnp.where(fire_e, avg_e, state.est)
+        msg_est = avg_e
+        send_mask = fire_e
+        ticks = jnp.where(fire_n, 0, ticks)
+        recv = recv & ~fire_e
+        last_avg = jnp.where(fire_n, avg, last_avg)
+        fired_ctr = fired_ctr + fire_n.astype(jnp.int32)
+    else:  # PAIRWISE
+        if cfg.fire_policy == "every_round":
+            # Fast synchronous pairwise = matching gossip in flow form: each
+            # round fires one proper-edge-color class, and matched endpoints
+            # exchange *directly* — in unit-delay synchronous mode both ends
+            # of an edge are visible on-chip, so the 2-party average uses
+            # both current estimates and writes exactly antisymmetric flow
+            # deltas.  Mass is conserved every round by construction.
+            # (Firing all edges at once through the message path diverges:
+            # crossing messages transiently inflate mass faster than later
+            # exchanges deflate it.)
+            if topo.edge_color is None:
+                raise ValueError(
+                    "fast pairwise mode needs the edge coloring: build the "
+                    "topology arrays with device_arrays(coloring=True)"
+                )
+            half = jnp.asarray(0.5, dt)
+            matched = (
+                (topo.edge_color == t % topo.num_colors)
+                & state.alive[src]
+                & state.alive[topo.dst]
+            )
+            x_u = estimate[src]
+            x_v = estimate[topo.dst]
+            avg_e = (x_u + x_v) * half
+            new_flow = jnp.where(
+                matched, state.flow + (x_u - x_v) * half, state.flow
+            )
+            new_est = jnp.where(matched, avg_e, state.est)
+            msg_est = avg_e
+            send_mask = jnp.zeros_like(matched)  # direct exchange, no messages
+            stamp = jnp.where(matched, t, stamp)
+            fire_any = segment_max(matched.astype(jnp.int32), src, N) > 0
+            node_avg = segment_sum(
+                jnp.where(matched, avg_e, jnp.asarray(0, dt)), src, N
+            )
+            last_avg = jnp.where(fire_any, node_avg, last_avg)
+            fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
+        else:
+            # Faithful message-based dynamics.
+            stale = stamp < (t - cfg.timeout)
+            fire_e = (trigger | stale) & state.alive[src]
+            # Sequential-within-tick semantics: each firing out-edge applies
+            # x -> (x + est)/2 to the node's running estimate, in edge order
+            # (the reference's for-loop over stale neighbors,
+            # ``pairwise.py:86-91,102-109``) — as one segmented affine scan.
+            a = jnp.where(fire_e, jnp.asarray(0.5, dt), jnp.asarray(1.0, dt))
+            b = jnp.where(
+                fire_e, state.est * jnp.asarray(0.5, dt), jnp.asarray(0.0, dt)
+            )
+            seg_start = topo.edge_rank == 0
+            A, B = segmented_affine_scan(a, b, seg_start)
+            run_est = A * estimate[src] + B  # estimate after processing edge e
+            avg_e = run_est                  # == the 2-party average at firing e
+            new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
+            new_est = jnp.where(fire_e, avg_e, state.est)
+            msg_est = avg_e
+            send_mask = fire_e
+            stamp = jnp.where(fire_e, t, stamp)
+            # last_avg per node = average at its last firing edge == its
+            # running estimate at the segment end (identity maps pass it
+            # through).
+            fire_any = segment_max(fire_e.astype(jnp.int32), src, N) > 0
+            seg_end = jnp.maximum(topo.row_start[1:] - 1, 0)
+            final_est = run_est[seg_end]
+            last_avg = jnp.where(fire_any, final_est, last_avg)
+            fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
+
+    key = state.key
+    if cfg.drop_rate > 0.0:
+        key, sub = jax.random.split(key)
+        keep = jax.random.bernoulli(sub, 1.0 - cfg.drop_rate, (E,))
+        send_mask = send_mask & keep
+
+    # Scatter messages into the receiver's ring-buffer slot.  Non-sending
+    # edges target an out-of-bounds index and are dropped by the scatter.
+    slot_idx = (t + topo.delay) % D
+    tgt = jnp.where(send_mask, topo.rev, E)
+    buf_flow = state.buf_flow.at[slot_idx, tgt].set(new_flow, mode="drop")
+    buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")
+    buf_valid = state.buf_valid.at[slot_idx, tgt].set(True, mode="drop")
+
+    return state.replace(
+        t=t + 1,
+        flow=new_flow,
+        est=new_est,
+        recv=recv,
+        ticks=ticks,
+        stamp=stamp,
+        last_avg=last_avg,
+        fired=fired_ctr,
+        buf_flow=buf_flow,
+        buf_est=buf_est,
+        buf_valid=buf_valid,
+        key=key,
+    )
+
+
+def round_step(
+    state: FlowUpdatingState, topo, cfg: RoundConfig
+) -> FlowUpdatingState:
+    """One full gossip round (= one simulated second of the reference)."""
+    state, processed = deliver_phase(state, topo, cfg)
+    return fire_phase(state, topo, cfg, processed)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
+def run_rounds(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int
+) -> FlowUpdatingState:
+    """Run ``num_rounds`` rounds as one compiled ``lax.scan``."""
+
+    def body(s, _):
+        return round_step(s, topo, cfg), None
+
+    state, _ = jax.lax.scan(body, state, None, length=num_rounds)
+    return state
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_rounds", "observe_every")
+)
+def run_rounds_observed(
+    state: FlowUpdatingState,
+    topo,
+    cfg: RoundConfig,
+    num_rounds: int,
+    observe_every: int,
+    true_mean,
+):
+    """Run rounds in chunks of ``observe_every``, emitting metrics per chunk.
+
+    This is the watcher's sampling loop (reference
+    ``flowupdating-collectall.py:139-142`` prints global state every 10
+    simulated seconds) expressed as a chunked scan: metrics stay on device
+    and come back stacked, one row per observation.
+    """
+    if num_rounds % observe_every:
+        raise ValueError("num_rounds must be a multiple of observe_every")
+    chunks = num_rounds // observe_every
+    mean = jnp.asarray(true_mean, state.value.dtype)
+
+    def chunk_body(s, _):
+        s = jax.lax.fori_loop(
+            0, observe_every, lambda _, x: round_step(x, topo, cfg), s
+        )
+        est = node_estimates(s, topo)
+        err = est - mean
+        metrics = {
+            "t": s.t,
+            "rmse": jnp.sqrt(jnp.mean(err * err)),
+            "max_abs_err": jnp.max(jnp.abs(err)),
+            "mass": jnp.sum(est),
+            "fired_total": jnp.sum(s.fired),
+        }
+        return s, metrics
+
+    state, metrics = jax.lax.scan(chunk_body, state, None, length=chunks)
+    return state, metrics

@@ -1,0 +1,80 @@
+"""The Flow-Updating state pytree.
+
+Everything a reference ``Peer`` keeps per actor (``flowupdating-collectall.py:
+26-45``: ``value``, ``flows``, ``estimates``, ``msg_recvd_ids``,
+``ticks_since_last_avg``, ``_last_avg``, pending comms) plus everything
+SimGrid keeps *for* it (the mailbox queue and in-flight comms) lives here as
+a handful of dense arrays.  Per-neighbor dicts become per-directed-edge
+arrays; the mailbox + in-flight comm set becomes a ``(D, E)`` ring buffer
+keyed by the *receiver's* edge index, so delivery is an elementwise select
+and sending is one masked scatter through ``rev``.
+
+Being a single pytree makes checkpoint/resume, vmapping over replicas and
+sharding trivial — the reference has no checkpointing at all (SURVEY.md §5);
+here it is a free by-product.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.topology.graph import Topology
+
+
+@flax.struct.dataclass
+class FlowUpdatingState:
+    t: jnp.ndarray             # () int32 — round counter ("Engine.clock")
+    value: jnp.ndarray         # (N,) — local input values
+    flow: jnp.ndarray          # (E,) — flow[e] = f(src->dst) as known by src
+    est: jnp.ndarray           # (E,) — src's last known estimate of dst
+    recv: jnp.ndarray          # (E,) bool — src heard from dst since last avg
+    ticks: jnp.ndarray         # (N,) int32 — ticks since last avg (collectall)
+    stamp: jnp.ndarray         # (E,) int32 — round of last avg on edge (pairwise)
+    last_avg: jnp.ndarray      # (N,) — last computed average per node
+    fired: jnp.ndarray         # (N,) int32 — total averaging events per node
+    alive: jnp.ndarray         # (N,) bool — failure-injection liveness mask
+    pending_flow: jnp.ndarray  # (E,) — undrained delivered message payloads
+    pending_est: jnp.ndarray   # (E,)
+    pending_valid: jnp.ndarray  # (E,) bool
+    buf_flow: jnp.ndarray      # (D, E) — in-flight ring buffer
+    buf_est: jnp.ndarray       # (D, E)
+    buf_valid: jnp.ndarray     # (D, E) bool
+    key: jnp.ndarray           # PRNG key (fault injection)
+
+
+def init_state(
+    topo: Topology, cfg: RoundConfig, seed: int = 0, values=None
+) -> FlowUpdatingState:
+    """Fresh state: zero flows/estimates (the reference's ``defaultdict(float)``
+    semantics, ``flowupdating-collectall.py:33-34``), empty buffers."""
+    N, E, D = topo.num_nodes, topo.num_edges, cfg.delay_depth
+    if D < topo.max_delay:
+        raise ValueError(
+            f"delay_depth={D} too small for topology max delay "
+            f"{topo.max_delay} (need delay_depth >= max_delay)"
+        )
+    dt = cfg.jnp_dtype
+    if values is None:
+        values = topo.values
+    return FlowUpdatingState(
+        t=jnp.zeros((), jnp.int32),
+        value=jnp.asarray(values, dt),
+        flow=jnp.zeros((E,), dt),
+        est=jnp.zeros((E,), dt),
+        recv=jnp.zeros((E,), bool),
+        ticks=jnp.zeros((N,), jnp.int32),
+        stamp=jnp.zeros((E,), jnp.int32),
+        last_avg=jnp.zeros((N,), dt),
+        fired=jnp.zeros((N,), jnp.int32),
+        alive=jnp.ones((N,), bool),
+        pending_flow=jnp.zeros((E,), dt),
+        pending_est=jnp.zeros((E,), dt),
+        pending_valid=jnp.zeros((E,), bool),
+        buf_flow=jnp.zeros((D, E), dt),
+        buf_est=jnp.zeros((D, E), dt),
+        buf_valid=jnp.zeros((D, E), bool),
+        key=jax.random.PRNGKey(seed),
+    )

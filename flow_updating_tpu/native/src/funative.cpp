@@ -1,0 +1,281 @@
+// funative — the C++ runtime layer of flow_updating_tpu.
+//
+// The reference's entire runtime is SimGrid 4.0 (C++ behind pybind11): the
+// DES kernel, network model, mailbox matching and platform routing
+// (SURVEY.md §2b N1-N9).  This library provides the native pieces the
+// TPU-first redesign still wants on the host side:
+//
+//  * exact graph generators at 1M+ node scale (the sequential
+//    preferential-attachment process is miserable in Python),
+//  * the symmetrize/dedup/sort/reverse-permutation graph builder,
+//  * a discrete-event "reference-style" simulator: per-actor FIFO mailbox,
+//    one message drained per 1.0s tick, collect-all and pairwise protocol
+//    logic with their timeout semantics (mirroring
+//    flowupdating-collectall.py:66-128 / flowupdating-pairwise.py:65-117).
+//    It serves two purposes: (a) the measured SimGrid-CPU-class baseline
+//    for bench.py (the reference publishes no numbers, BASELINE.md), and
+//    (b) a convergence-dynamics oracle the vectorized TPU kernel is tested
+//    against.  It is plain C ABI for ctypes consumption — no pybind11.
+//
+// Build: see Makefile (g++ -O3 -shared -fPIC).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Generators.  All emit directed pairs (u, v); symmetrization happens in
+// fu_build_graph.  Return value = number of pairs written, or -1 on error.
+// ---------------------------------------------------------------------------
+
+// Erdos-Renyi G(n, m) + a random Hamiltonian backbone for connectivity.
+// out_pairs must hold 2 * (m + n) int64 entries.
+int64_t fu_gen_erdos_renyi(int64_t n, int64_t m, uint64_t seed,
+                           int64_t* out_pairs) {
+  if (n < 2 || m < 0) return -1;
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int64_t> pick(0, n - 1);
+  int64_t k = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t u = pick(rng), v = pick(rng);
+    out_pairs[2 * k] = u;
+    out_pairs[2 * k + 1] = v;
+    ++k;
+  }
+  std::vector<int64_t> perm(n);
+  for (int64_t i = 0; i < n; ++i) perm[i] = i;
+  std::shuffle(perm.begin(), perm.end(), rng);
+  for (int64_t i = 0; i < n; ++i) {
+    out_pairs[2 * k] = perm[i];
+    out_pairs[2 * k + 1] = perm[(i + 1) % n];
+    ++k;
+  }
+  return k;
+}
+
+// Exact sequential Barabasi-Albert: seed clique on (m+1) nodes, then each
+// new node attaches to m endpoints sampled from the endpoint multiset
+// (preferential attachment).  out_pairs must hold
+// 2 * (m*(m+1)/2 + (n-m-1)*m) entries.
+int64_t fu_gen_barabasi_albert(int64_t n, int64_t m, uint64_t seed,
+                               int64_t* out_pairs) {
+  if (m < 1 || n < m + 2) return -1;
+  std::mt19937_64 rng(seed);
+  std::vector<int64_t> endpoints;
+  endpoints.reserve(2 * (size_t)(m * (m + 1) / 2 + (n - m - 1) * m));
+  int64_t k = 0;
+  for (int64_t i = 0; i <= m; ++i)
+    for (int64_t j = i + 1; j <= m; ++j) {
+      out_pairs[2 * k] = i;
+      out_pairs[2 * k + 1] = j;
+      endpoints.push_back(i);
+      endpoints.push_back(j);
+      ++k;
+    }
+  std::vector<int64_t> targets(m);
+  for (int64_t v = m + 1; v < n; ++v) {
+    // sample m distinct targets from the endpoint multiset
+    int64_t got = 0;
+    while (got < m) {
+      std::uniform_int_distribution<size_t> pick(0, endpoints.size() - 1);
+      int64_t t = endpoints[pick(rng)];
+      bool dup = false;
+      for (int64_t j = 0; j < got; ++j) dup |= (targets[j] == t);
+      if (!dup) targets[got++] = t;
+    }
+    for (int64_t j = 0; j < m; ++j) {
+      out_pairs[2 * k] = v;
+      out_pairs[2 * k + 1] = targets[j];
+      ++k;
+      endpoints.push_back(v);
+      endpoints.push_back(targets[j]);
+    }
+  }
+  return k;
+}
+
+// ---------------------------------------------------------------------------
+// Graph builder: directed pairs -> symmetrized, deduped, (src,dst)-sorted
+// edge list with reverse permutation and out-degrees.
+// Two-phase: count then fill, so the caller can allocate exactly.
+// scratch/out buffers are caller-allocated numpy arrays.
+// ---------------------------------------------------------------------------
+
+static void symmetrize_sort(int64_t n, int64_t npairs, const int64_t* pairs,
+                            std::vector<int64_t>& keys) {
+  keys.clear();
+  keys.reserve(2 * (size_t)npairs);
+  for (int64_t i = 0; i < npairs; ++i) {
+    int64_t u = pairs[2 * i], v = pairs[2 * i + 1];
+    if (u == v || u < 0 || v < 0 || u >= n || v >= n) continue;
+    keys.push_back(u * n + v);
+    keys.push_back(v * n + u);
+  }
+  std::sort(keys.begin(), keys.end());
+  keys.erase(std::unique(keys.begin(), keys.end()), keys.end());
+}
+
+int64_t fu_build_graph_count(int64_t n, int64_t npairs, const int64_t* pairs) {
+  std::vector<int64_t> keys;
+  symmetrize_sort(n, npairs, pairs, keys);
+  return (int64_t)keys.size();
+}
+
+// Fills src, dst (int32, length E), rev (int32, length E), out_deg (int32,
+// length n).  E must equal fu_build_graph_count's return.
+int64_t fu_build_graph(int64_t n, int64_t npairs, const int64_t* pairs,
+                       int32_t* src, int32_t* dst, int32_t* rev,
+                       int32_t* out_deg) {
+  std::vector<int64_t> keys;
+  symmetrize_sort(n, npairs, pairs, keys);
+  const int64_t E = (int64_t)keys.size();
+  memset(out_deg, 0, sizeof(int32_t) * (size_t)n);
+  for (int64_t e = 0; e < E; ++e) {
+    int64_t u = keys[e] / n, v = keys[e] % n;
+    src[e] = (int32_t)u;
+    dst[e] = (int32_t)v;
+    out_deg[u]++;
+  }
+  for (int64_t e = 0; e < E; ++e) {
+    int64_t rk = (int64_t)dst[e] * n + src[e];
+    rev[e] = (int32_t)(std::lower_bound(keys.begin(), keys.end(), rk) -
+                       keys.begin());
+  }
+  return E;
+}
+
+// ---------------------------------------------------------------------------
+// Reference-style discrete-event simulator.
+//
+// Actor semantics mirrored from the reference scripts:
+//  * every peer ticks once per simulated second and drains AT MOST ONE
+//    mailbox message per tick (the single get_async per loop pass,
+//    collectall.py:70-85);
+//  * mailbox delivery order = message arrival order (FIFO per arrival);
+//  * collect-all: average when all neighbors reported or after `timeout`
+//    ticks (collectall.py:87-103);
+//  * pairwise: every processed message triggers a 2-party average + reply;
+//    neighbors silent for > timeout seconds are re-initiated each tick
+//    (pairwise.py:86-100);
+//  * per-edge latency in whole ticks (>= 1) models the link delay.
+//
+// variant: 0 = collect-all, 1 = pairwise.
+// Returns number of processed messages (events), fills estimates (= value -
+// sum(flows)) and last_avg per node after `ticks` simulated seconds.
+// ---------------------------------------------------------------------------
+
+struct Msg {
+  int64_t arrival;   // tick at which the message is deliverable
+  int64_t seq;       // global sequence for FIFO among equal arrivals
+  int32_t edge;      // receiver's ledger edge (v -> u) the message updates
+  double flow;
+  double estimate;
+};
+struct MsgLater {
+  bool operator()(const Msg& a, const Msg& b) const {
+    if (a.arrival != b.arrival) return a.arrival > b.arrival;
+    return a.seq > b.seq;
+  }
+};
+
+int64_t fu_des_run(int64_t n, int64_t E, const int32_t* src,
+                   const int32_t* dst, const int32_t* rev,
+                   const int32_t* delay, const int64_t* row_start,
+                   const double* values, int32_t variant, int64_t timeout,
+                   int64_t ticks, double* est_out, double* last_avg_out) {
+  // Per-edge ledgers, exactly the per-neighbor dicts of a reference Peer.
+  std::vector<double> flow((size_t)E, 0.0), est((size_t)E, 0.0);
+  std::vector<uint8_t> recv((size_t)E, 0);          // collect-all
+  std::vector<int64_t> stamp((size_t)E, 0);         // pairwise
+  std::vector<int64_t> ticks_since(n, 0);           // collect-all
+  std::vector<int32_t> recv_count(n, 0);
+  std::vector<double> last_avg(n, 0.0);
+  std::vector<std::priority_queue<Msg, std::vector<Msg>, MsgLater>> mailbox(n);
+  int64_t seq = 0, events = 0;
+
+  auto deg = [&](int64_t v) { return row_start[v + 1] - row_start[v]; };
+
+  auto send = [&](int64_t t, int32_t e) {
+    // message travels edge e=(v,u); it updates the receiver's ledger rev[e]
+    Msg msg{t + std::max<int32_t>(1, delay[e]), seq++, rev[e], flow[e], 0.0};
+    msg.estimate = est[e];  // filled by caller via est[e] (set before send)
+    mailbox[dst[e]].push(msg);
+  };
+
+  auto avg_all = [&](int64_t v, int64_t t) {  // collect-all avg_and_send
+    double fsum = 0.0, esum = 0.0;
+    for (int64_t e = row_start[v]; e < row_start[v + 1]; ++e) {
+      fsum += flow[e];
+      esum += est[e];
+    }
+    double estimate = values[v] - fsum;
+    double avg = (estimate + esum) / (double)(deg(v) + 1);
+    last_avg[v] = avg;
+    for (int64_t e = row_start[v]; e < row_start[v + 1]; ++e) {
+      flow[e] += avg - est[e];
+      est[e] = avg;
+      send(t, (int32_t)e);
+      recv[e] = 0;
+    }
+    recv_count[v] = 0;
+    ticks_since[v] = 0;
+  };
+
+  auto avg_pair = [&](int64_t v, int32_t e, int64_t t) {  // pairwise
+    double fsum = 0.0;
+    for (int64_t k = row_start[v]; k < row_start[v + 1]; ++k) fsum += flow[k];
+    double estimate = values[v] - fsum;
+    double avg = (est[e] + estimate) / 2.0;
+    last_avg[v] = avg;
+    flow[e] += avg - est[e];
+    est[e] = avg;
+    stamp[e] = t;
+    send(t, e);
+  };
+
+  for (int64_t t = 0; t < ticks; ++t) {
+    for (int64_t v = 0; v < n; ++v) {
+      // drain at most one deliverable message
+      if (!mailbox[v].empty() && mailbox[v].top().arrival <= t) {
+        Msg m = mailbox[v].top();
+        mailbox[v].pop();
+        ++events;
+        int32_t e = m.edge;  // v's ledger entry about the sender
+        est[e] = m.estimate;
+        flow[e] = -m.flow;
+        if (variant == 0) {
+          if (!recv[e]) {
+            recv[e] = 1;
+            recv_count[v]++;
+          }
+          if (recv_count[v] >= deg(v)) avg_all(v, t);
+        } else {
+          avg_pair(v, e, t);
+        }
+      }
+      // tick
+      if (variant == 0) {
+        ticks_since[v]++;
+        if (ticks_since[v] >= timeout) avg_all(v, t);
+      } else {
+        for (int64_t e = row_start[v]; e < row_start[v + 1]; ++e)
+          if (stamp[e] < t - timeout) avg_pair(v, (int32_t)e, t);
+      }
+    }
+  }
+
+  for (int64_t v = 0; v < n; ++v) {
+    double fsum = 0.0;
+    for (int64_t e = row_start[v]; e < row_start[v + 1]; ++e) fsum += flow[e];
+    est_out[v] = values[v] - fsum;
+    last_avg_out[v] = last_avg[v];
+  }
+  return events;
+}
+
+}  // extern "C"

@@ -1,0 +1,15 @@
+from flow_updating_tpu.ops.segment import (
+    segment_sum,
+    segment_min,
+    segment_max,
+    segment_all,
+)
+from flow_updating_tpu.ops.segscan import segmented_affine_scan
+
+__all__ = [
+    "segment_sum",
+    "segment_min",
+    "segment_max",
+    "segment_all",
+    "segmented_affine_scan",
+]

@@ -1,0 +1,45 @@
+"""Segment reductions over the edge axis.
+
+The "mailbox" of the reference (SimGrid rendezvous matching, SURVEY.md N4)
+degenerates on TPU to segment reductions over the sorted ``src`` index
+vector: summing a node's incoming flow ledger, checking whether all
+neighbors have reported, picking which pending message a node drains this
+round.  Edges are sorted by ``src`` at topology build time so every wrapper
+passes ``indices_are_sorted=True``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data, segment_ids, num_segments: int):
+    return jax.ops.segment_sum(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=True,
+    )
+
+
+def segment_max(data, segment_ids, num_segments: int):
+    return jax.ops.segment_max(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=True,
+    )
+
+
+def segment_min(data, segment_ids, num_segments: int):
+    return jax.ops.segment_min(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=True,
+    )
+
+
+def segment_all(pred, segment_ids, num_segments: int):
+    """Per-segment logical AND of a boolean edge predicate.
+
+    Empty segments (isolated nodes) return False.
+    """
+    mins = segment_min(pred.astype(jnp.int32), segment_ids, num_segments)
+    counts = segment_sum(jnp.ones_like(pred, jnp.int32), segment_ids, num_segments)
+    return (mins == 1) & (counts > 0)

@@ -1,0 +1,43 @@
+"""Segmented affine scan — sequential-within-tick semantics, in parallel.
+
+The reference's pairwise variant mutates a node's running estimate *between*
+consecutive ``avg_and_send`` calls in one tick (``flowupdating-pairwise.py:
+86-91`` fires every stale neighbor in a Python for-loop; each call reads
+``value - sum(flows)`` after the previous call's flow update).  Each firing
+edge therefore applies an affine map to the node's running estimate:
+
+    x -> (x + est_e) / 2          (firing edge)
+    x -> x                        (non-firing edge)
+
+Sequential per node, but nodes' out-edges are contiguous segments of the
+edge axis — so the whole thing is one segmented inclusive scan of affine-map
+compositions via ``jax.lax.associative_scan``.  This keeps the reference's
+exact sequential dynamics while staying a single fused vector op on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segmented_affine_scan(a, b, seg_start):
+    """Inclusive scan of affine-map composition within segments.
+
+    Element i carries the map ``x -> a[i] * x + b[i]``; ``seg_start[i]`` is
+    True where a new segment begins.  Returns ``(A, B)`` such that the
+    composition of maps ``seg_first..i`` is ``x -> A[i] * x + B[i]``.
+    """
+    seg_start = seg_start.astype(bool)
+
+    def combine(left, right):
+        a1, b1, f1 = left
+        a2, b2, f2 = right
+        # right-after-left: x -> a2*(a1 x + b1) + b2, unless right starts a
+        # new segment, in which case left is discarded.
+        a_out = jnp.where(f2, a2, a2 * a1)
+        b_out = jnp.where(f2, b2, a2 * b1 + b2)
+        return a_out, b_out, f1 | f2
+
+    A, B, _ = jax.lax.associative_scan(combine, (a, b, seg_start))
+    return A, B

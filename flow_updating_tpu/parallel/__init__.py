@@ -1,0 +1,17 @@
+from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.parallel.auto import (
+    pad_topology,
+    init_sharded_state,
+    shard_state,
+    state_sharding,
+    topo_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "pad_topology",
+    "init_sharded_state",
+    "shard_state",
+    "state_sharding",
+    "topo_sharding",
+]

@@ -1,0 +1,150 @@
+"""GSPMD multi-chip execution: shard the node axis, let XLA place collectives.
+
+The scaling recipe: pad node and edge axes to multiples of the mesh size,
+annotate every state/topology array with a :class:`NamedSharding` over the
+``nodes`` mesh axis, and run the *same* round kernel under ``jit`` —
+computation follows data, and XLA's SPMD partitioner inserts the
+all-to-all/collective traffic for the only cross-shard operation the round
+has: scattering outgoing messages through the ``rev`` permutation into
+receiver ring-buffer slots (the ICI-riding replacement for the reference's
+SimGrid mailbox delivery).  An explicitly scheduled ``shard_map`` halo
+kernel lives in :mod:`flow_updating_tpu.parallel.sharded` for comparison.
+
+Padding invariants: dummy edges attach to a guaranteed-*padded* node (never
+a real one), and padded nodes are born dead (``alive=False``), so they can
+never fire and no dummy traffic exists; padded values are zero so mass-type
+metrics are unaffected.  Metrics must slice ``[:n_real]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.state import FlowUpdatingState, init_state
+from flow_updating_tpu.parallel.mesh import NODE_AXIS
+from flow_updating_tpu.topology.graph import Topology
+
+P = jax.sharding.PartitionSpec
+
+
+def _ceil_to(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+def pad_topology(topo: Topology, num_shards: int) -> tuple[Topology, int, int]:
+    """Pad nodes/edges to multiples of ``num_shards``.
+
+    Returns (padded_topology, n_real, e_real).  Always pads at least one
+    node so dummy edges can attach to a padded (never-firing) node.
+    """
+    N, E = topo.num_nodes, topo.num_edges
+    Np = _ceil_to(N + 1, num_shards)
+    Ep = _ceil_to(E, num_shards)
+    pad_n = Np - N
+    pad_e = Ep - E
+    dummy = Np - 1  # a padded node by construction
+
+    src = np.concatenate([topo.src, np.full(pad_e, dummy, np.int32)])
+    dst = np.concatenate([topo.dst, np.full(pad_e, dummy, np.int32)])
+    rev = np.concatenate(
+        [topo.rev, np.arange(E, Ep, dtype=np.int32)]  # dummies reverse to self
+    )
+    edge_rank = np.concatenate(
+        [topo.edge_rank, np.arange(pad_e, dtype=np.int32)]
+    )
+    delay = np.concatenate([topo.delay, np.ones(pad_e, np.int32)])
+    out_deg = np.concatenate([topo.out_deg, np.zeros(pad_n, np.int32)])
+    values = np.concatenate([topo.values, np.zeros(pad_n)])
+    # CSR over the padded edge list (dummy edges form node `dummy`'s row) —
+    # used only for segment-end lookups, not for degree arithmetic.
+    counts = np.bincount(src, minlength=Np)
+    row_start = np.zeros(Np + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_start[1:])
+
+    padded = dataclasses.replace(
+        topo,
+        num_nodes=Np,
+        src=src,
+        dst=dst,
+        rev=rev,
+        out_deg=out_deg,
+        row_start=row_start,
+        edge_rank=edge_rank,
+        delay=delay,
+        values=values,
+        names=None,
+        speeds=None,
+        bandwidth=None,
+        latency_s=None,
+    )
+    return padded, N, E
+
+
+def state_sharding(mesh: jax.sharding.Mesh) -> FlowUpdatingState:
+    """Pytree of NamedShardings matching FlowUpdatingState: node and edge
+    arrays split over the node axis, ring buffers split on their edge axis,
+    scalars replicated."""
+    ns = lambda spec: jax.sharding.NamedSharding(mesh, spec)
+    ax = P(NODE_AXIS)
+    return FlowUpdatingState(
+        t=ns(P()),
+        value=ns(ax),
+        flow=ns(ax),
+        est=ns(ax),
+        recv=ns(ax),
+        ticks=ns(ax),
+        stamp=ns(ax),
+        last_avg=ns(ax),
+        fired=ns(ax),
+        alive=ns(ax),
+        pending_flow=ns(ax),
+        pending_est=ns(ax),
+        pending_valid=ns(ax),
+        buf_flow=ns(P(None, NODE_AXIS)),
+        buf_est=ns(P(None, NODE_AXIS)),
+        buf_valid=ns(P(None, NODE_AXIS)),
+        key=ns(P()),
+    )
+
+
+def topo_sharding(mesh: jax.sharding.Mesh, arrays):
+    """Shardings for TopoArrays: edge/node arrays split, row_start
+    replicated (N+1 is never divisible; it is only gathered from)."""
+    ns = lambda spec: jax.sharding.NamedSharding(mesh, spec)
+    ax = P(NODE_AXIS)
+    return type(arrays)(
+        src=ns(ax),
+        dst=ns(ax),
+        rev=ns(ax),
+        out_deg=ns(ax),
+        row_start=ns(P()),
+        edge_rank=ns(ax),
+        delay=ns(ax),
+        edge_color=None if arrays.edge_color is None else ns(ax),
+        num_colors=arrays.num_colors,
+    )
+
+
+def init_sharded_state(
+    padded: Topology, cfg: RoundConfig, n_real: int,
+    mesh: jax.sharding.Mesh, seed: int = 0,
+):
+    """Fresh state on the mesh: padded nodes are dead, all arrays placed
+    with their NamedShardings.  Returns (state, topo_arrays)."""
+    state = init_state(padded, cfg, seed=seed)
+    alive = state.alive.at[n_real:].set(False)
+    state = state.replace(alive=alive)
+    arrays = padded.device_arrays(coloring=cfg.needs_coloring)
+    state = shard_state(state, mesh)
+    arrays = jax.device_put(arrays, topo_sharding(mesh, arrays))
+    return state, arrays
+
+
+def shard_state(state: FlowUpdatingState, mesh: jax.sharding.Mesh):
+    return jax.device_put(state, state_sharding(mesh))

@@ -1,0 +1,25 @@
+"""Device mesh helpers.
+
+The framework's one parallel axis is the *node axis* — the analogue of the
+reference's "thousands of simulated actors" (SURVEY.md §2c): nodes and their
+out-edge ledgers are sharded over devices; cross-shard edges ride XLA
+collectives over ICI (the TPU-native replacement for the mailbox rendezvous
+that SimGrid's kernel performs in shared memory)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> jax.sharding.Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:n_devices]
+    return jax.sharding.Mesh(np.array(devices), (axis,))

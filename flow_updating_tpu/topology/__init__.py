@@ -1,0 +1,12 @@
+from flow_updating_tpu.topology.graph import Topology, build_topology
+from flow_updating_tpu.topology.platform import Platform, load_platform
+from flow_updating_tpu.topology.deployment import Deployment, load_deployment
+
+__all__ = [
+    "Topology",
+    "build_topology",
+    "Platform",
+    "load_platform",
+    "Deployment",
+    "load_deployment",
+]

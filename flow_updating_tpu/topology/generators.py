@@ -1,0 +1,169 @@
+"""Synthetic topology generators for the benchmark configs.
+
+The reference only ships one 7-host platform; the benchmark ladder
+(BASELINE.json configs) needs Erdős–Rényi 10k, Barabási–Albert 100k and a
+1M-node fat-tree.  Generators return a :class:`Topology`; undirected edges
+are produced once and symmetrized by :func:`build_topology`.
+
+numpy implementations here; the C++ native runtime
+(``flow_updating_tpu.native``) accelerates the sequential BA process and
+large builds when available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flow_updating_tpu.topology.graph import Topology, build_topology
+
+
+def _finish(n, pairs, seed, values) -> Topology:
+    if values is None:
+        values = np.random.default_rng(seed + 1).uniform(0.0, 1.0, n)
+    # generators emit undirected edges as single-direction pairs by design;
+    # symmetrization is intended, not a declaration repair
+    return build_topology(n, pairs, values=values, seed=seed, warn_asymmetric=False)
+
+
+def ring(n: int, k: int = 1, seed: int = 0, values=None) -> Topology:
+    """Ring lattice: node i connected to i+1..i+k (mod n)."""
+    i = np.arange(n, dtype=np.int64)
+    pairs = np.concatenate(
+        [np.stack([i, (i + d) % n], axis=1) for d in range(1, k + 1)], axis=0
+    )
+    return _finish(n, pairs, seed, values)
+
+
+def grid2d(h: int, w: int, seed: int = 0, values=None) -> Topology:
+    """2-D grid (4-neighborhood)."""
+    idx = np.arange(h * w, dtype=np.int64).reshape(h, w)
+    right = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
+    down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
+    return _finish(h * w, np.concatenate([right, down]), seed, values)
+
+
+def complete(n: int, seed: int = 0, values=None) -> Topology:
+    i, j = np.triu_indices(n, k=1)
+    return _finish(n, np.stack([i, j], axis=1), seed, values)
+
+
+def erdos_renyi(n: int, avg_degree: float = 8.0, seed: int = 0, values=None) -> Topology:
+    """G(n, m) with m = n * avg_degree / 2 undirected edges, plus a random
+    Hamiltonian-cycle backbone so the graph is connected (convergence to the
+    global mean needs one component)."""
+    m = int(n * avg_degree / 2)
+    if n >= 100_000:
+        from flow_updating_tpu import native
+
+        pairs = native.gen_erdos_renyi_pairs(n, m, seed)
+        if pairs is not None:
+            return _finish(n, pairs, seed, values)
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n, size=m, dtype=np.int64)
+    v = rng.integers(0, n, size=m, dtype=np.int64)
+    perm = rng.permutation(n).astype(np.int64)
+    backbone = np.stack([perm, np.roll(perm, -1)], axis=1)
+    pairs = np.concatenate([np.stack([u, v], axis=1), backbone], axis=0)
+    return _finish(n, pairs, seed, values)
+
+
+def barabasi_albert(n: int, m: int = 4, seed: int = 0, values=None) -> Topology:
+    """Preferential attachment; degree-skewed (the hard case for segment ops).
+
+    Uses the repeated-endpoints sampling trick; vectorized in chunks (targets
+    for a whole chunk of new nodes are drawn from the endpoint multiset built
+    so far, which is a faithful-enough BA approximation at framework-test
+    scale — the C++ native generator does the exact sequential process).
+    """
+    if n > 10_000:
+        from flow_updating_tpu import native
+
+        pairs = native.gen_barabasi_albert_pairs(n, m, seed)
+        if pairs is not None:
+            return _finish(n, pairs, seed, values)
+    rng = np.random.default_rng(seed)
+    if n <= m + 1:
+        return complete(n, seed=seed, values=values)
+    # seed clique of m+1 nodes
+    i, j = np.triu_indices(m + 1, k=1)
+    endpoints = [np.concatenate([i, j]).astype(np.int64)]
+    pairs = [np.stack([i, j], axis=1).astype(np.int64)]
+    next_node = m + 1
+    chunk = max(256, n // 64)
+    while next_node < n:
+        cnt = min(chunk, n - next_node)
+        pool = np.concatenate(endpoints)
+        new = np.arange(next_node, next_node + cnt, dtype=np.int64)
+        tgt = pool[rng.integers(0, len(pool), size=(cnt, m))]
+        srcs = np.repeat(new, m)
+        dsts = tgt.ravel()
+        pairs.append(np.stack([srcs, dsts], axis=1))
+        endpoints.append(np.concatenate([srcs, dsts]))
+        next_node += cnt
+    return _finish(n, np.concatenate(pairs), seed, values)
+
+
+def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True) -> Topology:
+    """Al-Fares k-ary fat-tree; all hosts *and* switches are graph vertices.
+
+    Layout: hosts [0, k^3/4), edge switches, aggregation switches, core
+    switches.  k must be even.  Vertex count = k^3/4 + 5k^2/4; edge count
+    (undirected) = 3k^3/4.  k=160 gives ~1.056M vertices — the 1M-node
+    benchmark config.
+    """
+    if k % 2:
+        raise ValueError("fat-tree arity k must be even")
+    half = k // 2
+    n_host = half * half * k          # k^3/4
+    n_edge_sw = half * k
+    n_agg_sw = half * k
+    n_core = half * half
+    host0 = 0
+    edge0 = n_host
+    agg0 = edge0 + n_edge_sw
+    core0 = agg0 + n_agg_sw
+    n = core0 + n_core
+
+    pod = np.arange(k, dtype=np.int64)
+    e_in_pod = np.arange(half, dtype=np.int64)
+    h_in_edge = np.arange(half, dtype=np.int64)
+
+    # host <-> edge switch
+    P, E_, H = np.meshgrid(pod, e_in_pod, h_in_edge, indexing="ij")
+    hosts = host0 + (P * half + E_) * half + H
+    edges_sw = edge0 + P * half + E_
+    he = np.stack([hosts.ravel(), edges_sw.ravel()], axis=1)
+
+    # edge <-> aggregation (full bipartite within pod)
+    P, E_, A = np.meshgrid(pod, e_in_pod, e_in_pod, indexing="ij")
+    ea = np.stack(
+        [(edge0 + P * half + E_).ravel(), (agg0 + P * half + A).ravel()], axis=1
+    )
+
+    # aggregation <-> core: agg switch a in a pod connects to cores
+    # [a*half, (a+1)*half)
+    P, A, C = np.meshgrid(pod, e_in_pod, np.arange(half, dtype=np.int64), indexing="ij")
+    ac = np.stack(
+        [(agg0 + P * half + A).ravel(), (core0 + A * half + C).ravel()], axis=1
+    )
+
+    pairs = np.concatenate([he, ea, ac], axis=0)
+    if values is None:
+        rng = np.random.default_rng(seed + 1)
+        values = rng.uniform(0.0, 1.0, n)
+        if hosts_only_values:
+            # switches carry value 0 — only hosts hold data; the converged
+            # mean is then sum(host values) / all vertices, still a fixed
+            # point of the same protocol.
+            values[n_host:] = 0.0
+    return build_topology(n, pairs, values=values, seed=seed, warn_asymmetric=False)
+
+
+GENERATORS = {
+    "ring": ring,
+    "grid2d": grid2d,
+    "complete": complete,
+    "erdos_renyi": erdos_renyi,
+    "barabasi_albert": barabasi_albert,
+    "fat_tree": fat_tree,
+}

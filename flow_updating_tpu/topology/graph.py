@@ -1,0 +1,300 @@
+"""Dense edge-index topology — the TPU-native replacement for SimGrid routing.
+
+The reference delegates "who can talk to whom, and how fast" to SimGrid's
+C++ platform layer: hosts/links/routes parsed from XML and consumed by a
+flow-level network model (see SURVEY.md N3/N6; reference contact surface
+``flowupdating-collectall.py:152-157``).  On TPU the natural representation
+is a flat, static, *symmetrized* directed edge list:
+
+* ``src/dst (E,) int32`` — directed edges sorted by ``(src, dst)``, so every
+  node's out-edges are contiguous (CSR rows) and segment ops over ``src`` can
+  use ``indices_are_sorted=True``;
+* ``rev (E,) int32`` — index of the opposite direction.  The Flow-Updating
+  antisymmetry invariant (``flows[sender] = -msg.flow``,
+  reference ``flowupdating-collectall.py:99``) becomes a permutation by
+  ``rev``; message delivery into the receiver's ledger is a scatter through
+  ``rev`` at *send* time, making the delivery phase elementwise;
+* ``delay (E,) int32`` — per-edge delivery latency in whole rounds, derived
+  from route latencies for latency-warped ("async fidelity") execution.
+
+Symmetrization absorbs the reference's runtime neighbor-adoption repair
+(``flowupdating-collectall.py:94-96``; 6 of the 14 declared directed edges in
+its ``actors.xml`` have no reverse): missing reverse edges are added at load
+time and reported through :func:`build_topology`'s ``adopted`` output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("flow_updating_tpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static graph for one run.  Host-side (numpy); device views on demand."""
+
+    num_nodes: int
+    src: np.ndarray        # (E,) int32, sorted
+    dst: np.ndarray        # (E,) int32
+    rev: np.ndarray        # (E,) int32, rev[rev[e]] == e
+    out_deg: np.ndarray    # (N,) int32 (== in_deg after symmetrization)
+    row_start: np.ndarray  # (N+1,) int64 CSR offsets into src/dst
+    edge_rank: np.ndarray  # (E,) int32 position of edge within its src row
+    delay: np.ndarray      # (E,) int32 delivery delay in rounds, >= 1
+    values: np.ndarray     # (N,) float64 initial node values
+    names: tuple | None = None          # (N,) host names, optional
+    speeds: np.ndarray | None = None    # (N,) float64 host flop-rates, optional
+    bandwidth: np.ndarray | None = None  # (E,) float64 route bandwidth, optional
+    latency_s: np.ndarray | None = None  # (E,) float64 route latency (seconds)
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    @property
+    def max_delay(self) -> int:
+        return int(self.delay.max()) if self.num_edges else 1
+
+    @property
+    def true_mean(self) -> float:
+        return float(self.values.mean())
+
+    def edge_coloring(self) -> tuple[np.ndarray, int]:
+        """Proper edge coloring (undirected; both directions share a color).
+
+        Computed by repeated maximal-matching extraction (each pass picks
+        every edge that is the lowest-indexed uncolored edge at *both*
+        endpoints — a maximal matching — and gives it the next color).
+        Used by the fast synchronous pairwise mode: firing one color class
+        per round makes concurrent 2-party averages disjoint, which keeps
+        the crossing-message dynamics stable (all-edges-at-once pairwise
+        averaging diverges on irregular graphs).
+
+        Cached after first computation.  Returns (color (E,) int32, C).
+        """
+        cached = getattr(self, "_edge_coloring", None)
+        if cached is not None:
+            return cached
+        E = self.num_edges
+        und = np.where(self.src < self.dst)[0]
+        u = self.src[und].astype(np.int64)
+        v = self.dst[und].astype(np.int64)
+        M = len(und)
+        color = np.full(M, -1, np.int32)
+        uncolored = np.ones(M, bool)
+        idx = np.arange(M, dtype=np.int64)
+        c = 0
+        while uncolored.any():
+            # grow one MAXIMAL matching (repeat Luby-style picks until no
+            # uncolored edge has both endpoints free) -> <= 2*maxdeg - 1
+            # colors total
+            free = np.ones(self.num_nodes, bool)
+            this = np.zeros(M, bool)
+            avail = uncolored.copy()
+            while True:
+                eid = np.where(avail, idx, M)
+                first = np.full(self.num_nodes, M, dtype=np.int64)
+                np.minimum.at(first, u, eid)
+                np.minimum.at(first, v, eid)
+                pick = avail & (first[u] == idx) & (first[v] == idx)
+                if not pick.any():
+                    break
+                this |= pick
+                free[u[pick]] = False
+                free[v[pick]] = False
+                avail &= ~pick & free[u] & free[v]
+            color[this] = c
+            uncolored &= ~this
+            c += 1
+        full = np.full(E, -1, np.int32)
+        full[und] = color
+        full[self.rev[und]] = color
+        object.__setattr__(self, "_edge_coloring", (full, c))
+        return full, c
+
+    def name_to_id(self) -> dict:
+        if self.names is None:
+            raise ValueError("topology has no node names")
+        return {n: i for i, n in enumerate(self.names)}
+
+    def neighbors(self, node: int) -> np.ndarray:
+        lo, hi = self.row_start[node], self.row_start[node + 1]
+        return self.dst[lo:hi]
+
+    def device_arrays(self, coloring: bool = False):
+        """Device-resident pytree of the arrays the round kernel consumes.
+
+        ``coloring=True`` additionally materializes the edge coloring (only
+        needed by the fast synchronous pairwise mode)."""
+        import jax.numpy as jnp
+
+        edge_color = None
+        num_colors = 0
+        if coloring:
+            col, num_colors = self.edge_coloring()
+            edge_color = jnp.asarray(col)
+        return TopoArrays(
+            src=jnp.asarray(self.src),
+            dst=jnp.asarray(self.dst),
+            rev=jnp.asarray(self.rev),
+            out_deg=jnp.asarray(self.out_deg),
+            row_start=jnp.asarray(self.row_start, dtype=jnp.int32),
+            edge_rank=jnp.asarray(self.edge_rank),
+            delay=jnp.asarray(self.delay),
+            edge_color=edge_color,
+            num_colors=num_colors,
+        )
+
+    def with_values(self, values: np.ndarray) -> "Topology":
+        values = np.asarray(values, dtype=np.float64)
+        if values.shape != (self.num_nodes,):
+            raise ValueError(f"values must have shape ({self.num_nodes},)")
+        return dataclasses.replace(self, values=values)
+
+
+import flax.struct  # noqa: E402  (kept close to its sole consumer)
+
+
+@flax.struct.dataclass
+class TopoArrays:
+    """Pytree of device arrays the round kernel consumes."""
+
+    src: object
+    dst: object
+    rev: object
+    out_deg: object
+    row_start: object
+    edge_rank: object
+    delay: object
+    edge_color: object = None
+    num_colors: int = flax.struct.field(pytree_node=False, default=0)
+
+
+def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Both directions of every declared edge, deduped, self-loops dropped.
+
+    Returns (directed_edges sorted by (src, dst), adopted) where ``adopted``
+    lists directed edges that were only present via symmetrization — the
+    load-time equivalent of the reference's "X was not Y's neighbor" repair
+    path (``flowupdating-collectall.py:94-96``).
+    """
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    keep = pairs[:, 0] != pairs[:, 1]
+    pairs = pairs[keep]
+    fwd = pairs
+    bwd = pairs[:, ::-1]
+    both = np.concatenate([fwd, bwd], axis=0)
+    both = np.unique(both, axis=0)  # sorted lexicographically by (src, dst)
+    declared = np.unique(fwd, axis=0)
+    # adopted = directed edges present in `both` but not declared
+    both_keys = both[:, 0] * (both.max() + 1 if both.size else 1) + both[:, 1]
+    decl_keys = declared[:, 0] * (both.max() + 1 if both.size else 1) + declared[:, 1]
+    adopted = both[~np.isin(both_keys, decl_keys)]
+    return both, adopted
+
+
+def build_topology(
+    num_nodes: int,
+    pairs: np.ndarray | Sequence,
+    values: np.ndarray | None = None,
+    names: Sequence[str] | None = None,
+    latency_s: Mapping[tuple, float] | None = None,
+    bandwidth: Mapping[tuple, float] | None = None,
+    speeds: np.ndarray | None = None,
+    tick_interval: float = 1.0,
+    latency_scale: float = 0.0,
+    seed: int = 0,
+    warn_asymmetric: bool = True,
+) -> Topology:
+    """Build a :class:`Topology` from (possibly asymmetric) directed pairs.
+
+    Args:
+      num_nodes: node count N.
+      pairs: (M, 2) declared directed edges (asymmetric declarations allowed —
+        they are symmetrized, mirroring the reference's runtime adoption).
+      values: (N,) initial node values; defaults to uniform [0, 1) from `seed`.
+      names: optional host names.
+      latency_s: optional {(u, v): seconds} route latencies (symmetric lookup:
+        (u,v) falls back to (v,u)).
+      bandwidth: optional {(u, v): bytes/s} route bandwidths.
+      tick_interval: simulated seconds per round (the reference's
+        ``TICK_INTERVAL = 1.0``, ``flowupdating-collectall.py:23``).
+      latency_scale: 0.0 -> unit delay (fast path, every edge delivers next
+        round).  > 0 -> latency-warped rounds:
+        ``delay = max(1, round(latency * latency_scale / tick_interval))``.
+    """
+    edges, adopted = _symmetrize(pairs)
+    if len(adopted) and warn_asymmetric:
+        shown = ", ".join(
+            f"{int(a)}->{int(b)}" for a, b in adopted[:8]
+        )
+        logger.warning(
+            "topology: %d directed edge(s) had no declared reverse; adopted at "
+            "load time (%s%s)",
+            len(adopted), shown, "..." if len(adopted) > 8 else "",
+        )
+    if edges.size and edges.max() >= num_nodes:
+        raise ValueError("edge endpoint out of range")
+
+    E = edges.shape[0]
+    src = edges[:, 0].astype(np.int32)
+    dst = edges[:, 1].astype(np.int32)
+
+    # Reverse-edge permutation: position of (dst, src) in the sorted edge list.
+    order_keys = src.astype(np.int64) * num_nodes + dst.astype(np.int64)
+    rev_keys = dst.astype(np.int64) * num_nodes + src.astype(np.int64)
+    rev = np.searchsorted(order_keys, rev_keys).astype(np.int32)
+    assert np.array_equal(order_keys[rev], rev_keys), "graph not symmetric"
+
+    out_deg = np.bincount(src, minlength=num_nodes).astype(np.int32)
+    row_start = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(out_deg, out=row_start[1:])
+    edge_rank = (np.arange(E, dtype=np.int64) - row_start[src]).astype(np.int32)
+
+    if values is None:
+        rng = np.random.default_rng(seed)
+        values = rng.uniform(0.0, 1.0, size=num_nodes)
+    values = np.asarray(values, dtype=np.float64)
+    if values.shape != (num_nodes,):
+        raise ValueError(f"values must have shape ({num_nodes},)")
+
+    lat = None
+    bw = None
+    if latency_s is not None:
+        lat = np.zeros(E, dtype=np.float64)
+        for i in range(E):
+            key = (int(src[i]), int(dst[i]))
+            lat[i] = latency_s.get(key, latency_s.get((key[1], key[0]), 0.0))
+    if bandwidth is not None:
+        bw = np.zeros(E, dtype=np.float64)
+        for i in range(E):
+            key = (int(src[i]), int(dst[i]))
+            bw[i] = bandwidth.get(key, bandwidth.get((key[1], key[0]), 0.0))
+
+    if latency_scale > 0.0 and lat is not None:
+        delay = np.maximum(
+            1, np.rint(lat * latency_scale / tick_interval)
+        ).astype(np.int32)
+    else:
+        delay = np.ones(E, dtype=np.int32)
+
+    return Topology(
+        num_nodes=num_nodes,
+        src=src,
+        dst=dst,
+        rev=rev,
+        out_deg=out_deg,
+        row_start=row_start,
+        edge_rank=edge_rank,
+        delay=delay,
+        values=values,
+        names=tuple(names) if names is not None else None,
+        speeds=np.asarray(speeds, dtype=np.float64) if speeds is not None else None,
+        bandwidth=bw,
+        latency_s=lat,
+    )

@@ -1,0 +1,13 @@
+from flow_updating_tpu.utils.metrics import (
+    rmse,
+    mass_residual,
+    antisymmetry_residual,
+    convergence_report,
+)
+
+__all__ = [
+    "rmse",
+    "mass_residual",
+    "antisymmetry_residual",
+    "convergence_report",
+]

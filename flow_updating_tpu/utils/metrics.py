@@ -1,0 +1,51 @@
+"""Convergence and invariant metrics.
+
+The reference's only observability is the watcher's periodic dump of every
+peer's ``value``/``last_avg`` (``flowupdating-collectall.py:131-148``), with
+convergence judged by eye against the true mean.  Here the same quantities
+are first-class metrics, plus the protocol invariants the paper guarantees:
+
+* **mass conservation** — with antisymmetric flows the global sum of node
+  estimates equals the sum of inputs.  In-flight (sent, undelivered)
+  messages perturb it transiently; after a synchronous delivery it is exact.
+* **flow antisymmetry** — ``flow[e] == -flow[rev[e]]`` for every edge pair
+  whose latest messages have been delivered (the ``flows[sender] =
+  -msg.flow`` write, reference ``:99``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from flow_updating_tpu.models.rounds import node_estimates
+
+
+def rmse(estimates, true_mean) -> jnp.ndarray:
+    err = estimates - true_mean
+    return jnp.sqrt(jnp.mean(err * err))
+
+
+def mass_residual(state, topo) -> jnp.ndarray:
+    """sum(current estimates) - sum(inputs); ~0 in quiescent/synchronous
+    states, transiently nonzero while messages are in flight."""
+    est = node_estimates(state, topo)
+    return jnp.sum(est) - jnp.sum(state.value)
+
+
+def antisymmetry_residual(state, topo) -> jnp.ndarray:
+    """max |flow[e] + flow[rev[e]]| over edges."""
+    return jnp.max(jnp.abs(state.flow + state.flow[topo.rev]))
+
+
+def convergence_report(state, topo, true_mean) -> dict:
+    est = node_estimates(state, topo)
+    err = est - jnp.asarray(true_mean, est.dtype)
+    return {
+        "t": int(state.t),
+        "rmse": float(jnp.sqrt(jnp.mean(err * err))),
+        "max_abs_err": float(jnp.max(jnp.abs(err))),
+        "mass_residual": float(jnp.sum(est) - jnp.sum(state.value)),
+        "antisymmetry_residual": float(
+            jnp.max(jnp.abs(state.flow + state.flow[topo.rev]))
+        ),
+    }

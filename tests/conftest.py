@@ -1,0 +1,45 @@
+"""Test fixtures: force CPU backend with 8 virtual devices.
+
+Multi-chip sharding tests run on a virtual CPU mesh; real TPU execution is
+covered by the benchmark driver.  The ambient environment routes JAX at a
+single tunneled TPU chip via a sitecustomize hook that imports jax at
+interpreter startup — so env vars alone are too late here, and we must (a)
+update jax's live config and (b) deregister the TPU plugin factory before
+any backend initializes, or tests contend for (and hang on) the one chip.
+"""
+
+import os
+
+# XLA_FLAGS is read lazily at first backend init, so this is still in time.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _plugin in ("axon", "tpu"):
+    _xb._backend_factories.pop(_plugin, None)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small6():
+    """The in-repo 6-host example platform+deployment (mean 30.0)."""
+    from flow_updating_tpu.topology.deployment import load_deployment
+    from flow_updating_tpu.topology.platform import load_platform
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    platform = load_platform(os.path.join(root, "examples/platforms/small6.xml"))
+    deployment = load_deployment(
+        os.path.join(root, "examples/deployments/small6_actors.xml")
+    )
+    return platform, deployment

@@ -1,0 +1,70 @@
+"""Native runtime tests: graph builder parity and the DES oracle.
+
+The C++ DES reproduces the reference's actor semantics (1 msg/tick drain,
+FIFO mailboxes, timeout averaging); the vectorized faithful mode must agree
+with it on the quantities that define the protocol: the fixed point (true
+mean) and conservation."""
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu import native
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.topology import generators as gen
+from flow_updating_tpu.topology.graph import build_topology
+from flow_updating_tpu.utils.metrics import convergence_report
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def test_native_builder_matches_python():
+    rng = np.random.default_rng(0)
+    pairs = rng.integers(0, 50, size=(200, 2))
+    topo = build_topology(50, pairs, values=np.zeros(50), warn_asymmetric=False)
+    out = native.build_graph_arrays(50, pairs)
+    src, dst, rev, deg = out
+    np.testing.assert_array_equal(src, topo.src)
+    np.testing.assert_array_equal(dst, topo.dst)
+    np.testing.assert_array_equal(rev, topo.rev)
+    np.testing.assert_array_equal(deg, topo.out_deg)
+
+
+def test_native_ba_generator_valid():
+    pairs = native.gen_barabasi_albert_pairs(500, 3, seed=7)
+    topo = build_topology(500, pairs, warn_asymmetric=False)
+    assert topo.out_deg.min() >= 3
+    # preferential attachment -> heavy tail: max degree well above m
+    assert topo.out_deg.max() > 20
+
+
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_des_oracle_converges(variant):
+    topo = gen.erdos_renyi(100, avg_degree=6.0, seed=5)
+    est, last_avg, events = native.des_run(topo, variant, timeout=50, ticks=3000)
+    assert events > 0
+    rmse = float(np.sqrt(np.mean((est - topo.true_mean) ** 2)))
+    assert rmse < 1e-3
+    # mass conservation at the DES level
+    assert est.sum() == pytest.approx(topo.values.sum(), rel=1e-6)
+
+
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_vectorized_faithful_agrees_with_des(variant):
+    """Same topology, same protocol knobs: the TPU kernel's faithful mode and
+    the C++ DES must land on the same fixed point (the true mean)."""
+    topo = gen.ring(24, k=2, seed=9)
+    est, _, _ = native.des_run(topo, variant, timeout=50, ticks=4000)
+    des_rmse = float(np.sqrt(np.mean((est - topo.true_mean) ** 2)))
+
+    cfg = RoundConfig.reference(variant)
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg)
+    state = run_rounds(state, arrays, cfg, 4000)
+    rep = convergence_report(state, arrays, topo.true_mean)
+
+    assert des_rmse < 1e-3
+    assert rep["rmse"] < 1e-3

@@ -1,0 +1,112 @@
+import numpy as np
+import pytest
+
+from flow_updating_tpu.topology.graph import build_topology
+from flow_updating_tpu.topology.platform import parse_value
+from flow_updating_tpu.topology import generators as gen
+
+
+def check_invariants(topo):
+    E = topo.num_edges
+    # edges sorted by (src, dst)
+    keys = topo.src.astype(np.int64) * topo.num_nodes + topo.dst
+    assert np.all(np.diff(keys) > 0)
+    # rev is an involution mapping (u,v) -> (v,u)
+    assert np.array_equal(topo.rev[topo.rev], np.arange(E))
+    assert np.array_equal(topo.src[topo.rev], topo.dst)
+    assert np.array_equal(topo.dst[topo.rev], topo.src)
+    # CSR consistency
+    assert topo.row_start[-1] == E
+    assert np.array_equal(
+        np.diff(topo.row_start), topo.out_deg.astype(np.int64)
+    )
+    rank_ok = topo.edge_rank < topo.out_deg[topo.src]
+    assert np.all(rank_ok) and np.all(topo.edge_rank >= 0)
+    # no self loops
+    assert np.all(topo.src != topo.dst)
+
+
+def test_symmetrization_adopts_missing_reverse():
+    # 0->1 declared, 1->0 not; 1<->2 declared both ways; self-loop dropped.
+    topo = build_topology(3, [(0, 1), (1, 2), (2, 1), (2, 2)], values=np.zeros(3))
+    assert topo.num_edges == 4  # 0-1, 1-0, 1-2, 2-1
+    check_invariants(topo)
+    assert set(map(tuple, np.stack([topo.src, topo.dst], 1).tolist())) == {
+        (0, 1), (1, 0), (1, 2), (2, 1),
+    }
+
+
+def test_duplicate_declarations_collapse():
+    topo = build_topology(2, [(0, 1), (0, 1), (1, 0)], values=np.zeros(2))
+    assert topo.num_edges == 2
+
+
+@pytest.mark.parametrize(
+    "make",
+    [
+        lambda: gen.ring(20, k=2),
+        lambda: gen.grid2d(5, 7),
+        lambda: gen.complete(9),
+        lambda: gen.erdos_renyi(200, avg_degree=6.0, seed=3),
+        lambda: gen.barabasi_albert(300, m=3, seed=4),
+        lambda: gen.fat_tree(4),
+    ],
+)
+def test_generators_invariants(make):
+    topo = make()
+    check_invariants(topo)
+    assert topo.out_deg.min() >= 1  # connected-ish: no isolated nodes
+
+
+def test_fat_tree_shape():
+    k = 4
+    topo = gen.fat_tree(k)
+    assert topo.num_nodes == k**3 // 4 + 5 * k**2 // 4
+    # undirected links = 3k^3/4 -> directed edges = 3k^3/2
+    assert topo.num_edges == 3 * k**3 // 2
+    # hosts have degree 1
+    assert np.all(topo.out_deg[: k**3 // 4] == 1)
+
+
+def test_parse_units():
+    assert parse_value("98.095Mf", "speed") == pytest.approx(98.095e6)
+    assert parse_value("41.279125MBps", "bandwidth") == pytest.approx(41.279125e6)
+    assert parse_value("1GBps", "bandwidth") == pytest.approx(1e9)
+    assert parse_value("59.904us", "time") == pytest.approx(59.904e-6)
+    assert parse_value("35.083019ms", "time") == pytest.approx(35.083019e-3)
+    assert parse_value("15us", "time") == pytest.approx(15e-6)
+
+
+def test_platform_and_deployment(small6):
+    platform, deployment = small6
+    assert len(platform.hosts) == 6
+    assert platform.hosts["Lisboa"] == pytest.approx(120e6)
+    # multi-hop route latency = sum of link latencies
+    assert platform.route_latency("Lisboa", "Braga") == pytest.approx(
+        2.5e-3 + 0.8e-3
+    )
+    # symmetric lookup
+    assert platform.route_latency("Braga", "Lisboa") == pytest.approx(
+        platform.route_latency("Lisboa", "Braga")
+    )
+    assert platform.route_bandwidth("Coimbra", "Faro") == pytest.approx(22.5e6)
+
+    topo = deployment.to_topology(platform=platform)
+    check_invariants(topo)
+    assert topo.num_nodes == 6
+    assert topo.true_mean == pytest.approx(30.0)
+    names = topo.name_to_id()
+    # asymmetric declarations became symmetric edges
+    faro, coimbra = names["Faro"], names["Coimbra"]
+    assert coimbra in topo.neighbors(faro)
+    assert faro in topo.neighbors(coimbra)
+    # per-edge latency was resolved from the platform
+    assert topo.latency_s is not None and np.all(topo.latency_s > 0)
+
+
+def test_latency_scale_produces_delays(small6):
+    platform, deployment = small6
+    # with a large enough scale, multi-hop routes get multi-round delays
+    topo = deployment.to_topology(platform=platform, latency_scale=1000.0)
+    assert topo.delay.min() >= 1
+    assert topo.delay.max() > 1
