@@ -184,8 +184,9 @@ class Engine:
                     self._clock + w["every"], min(w["until"], t_end) + 1e-9, w["every"]
                 )
             }
+            | {float(t_end)}
         )
-        for t_ev in events + [float(t_end)]:
+        for t_ev in events:
             if t_ev > t_end:
                 break
             n = int(round((t_ev - self._clock) / TICK_INTERVAL))

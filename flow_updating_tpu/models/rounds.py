@@ -102,10 +102,17 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
     return state, process
 
 
-def fire_phase(
-    state: FlowUpdatingState, topo, cfg: RoundConfig, trigger
-) -> FlowUpdatingState:
-    """Tick, averaging, ledger update and message send."""
+def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
+    """Tick + averaging + ledger update; outgoing messages are *computed*
+    but not yet delivered.
+
+    Returns ``(state, msg_est, send_mask)`` where the message payload for
+    edge ``e`` is ``(state.flow[e], msg_est[e])`` — the sender's ledger after
+    the update, exactly what the reference puts on the wire
+    (``flowupdating-collectall.py:116-125``).  The caller scatters it into
+    ring-buffer slots: :func:`send_messages` on one device, the halo
+    exchange in :mod:`flow_updating_tpu.parallel.sharded` across devices.
+    """
     N = topo.out_deg.shape[0]
     E = topo.src.shape[0]
     D = cfg.delay_depth
@@ -218,16 +225,7 @@ def fire_phase(
         keep = jax.random.bernoulli(sub, 1.0 - cfg.drop_rate, (E,))
         send_mask = send_mask & keep
 
-    # Scatter messages into the receiver's ring-buffer slot.  Non-sending
-    # edges target an out-of-bounds index and are dropped by the scatter.
-    slot_idx = (t + topo.delay) % D
-    tgt = jnp.where(send_mask, topo.rev, E)
-    buf_flow = state.buf_flow.at[slot_idx, tgt].set(new_flow, mode="drop")
-    buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")
-    buf_valid = state.buf_valid.at[slot_idx, tgt].set(True, mode="drop")
-
-    return state.replace(
-        t=t + 1,
+    state = state.replace(
         flow=new_flow,
         est=new_est,
         recv=recv,
@@ -235,11 +233,35 @@ def fire_phase(
         stamp=stamp,
         last_avg=last_avg,
         fired=fired_ctr,
-        buf_flow=buf_flow,
-        buf_est=buf_est,
-        buf_valid=buf_valid,
         key=key,
     )
+    return state, msg_est, send_mask
+
+
+def send_messages(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, msg_est, send_mask
+) -> FlowUpdatingState:
+    """Single-device delivery: scatter each sending edge's payload into the
+    receiver edge's (``rev``) ring-buffer slot at ``(t + delay) % D``.
+    Non-sending edges target an out-of-bounds index and are dropped."""
+    E = topo.src.shape[0]
+    t = state.t
+    slot_idx = (t + topo.delay) % cfg.delay_depth
+    tgt = jnp.where(send_mask, topo.rev, E)
+    buf_flow = state.buf_flow.at[slot_idx, tgt].set(state.flow, mode="drop")
+    buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")
+    buf_valid = state.buf_valid.at[slot_idx, tgt].set(True, mode="drop")
+    return state.replace(
+        t=t + 1, buf_flow=buf_flow, buf_est=buf_est, buf_valid=buf_valid
+    )
+
+
+def fire_phase(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, trigger
+) -> FlowUpdatingState:
+    """Tick, averaging, ledger update and message send (one device)."""
+    state, msg_est, send_mask = fire_core(state, topo, cfg, trigger)
+    return send_messages(state, topo, cfg, msg_est, send_mask)
 
 
 def round_step(

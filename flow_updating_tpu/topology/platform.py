@@ -33,6 +33,12 @@ _SI = {
 _NUM_RE = re.compile(r"^\s*([0-9.eE+-]+)\s*([A-Za-z]*)\s*$")
 
 
+def _mult(unit: str, text: str, kind: str) -> float:
+    if unit not in _SI:
+        raise ValueError(f"unknown unit in {kind} value {text!r}")
+    return _SI[unit]
+
+
 def parse_value(text: str, kind: str) -> float:
     """Parse a SimGrid quantity: kind in {'speed', 'bandwidth', 'time'}."""
     m = _NUM_RE.match(text)
@@ -41,17 +47,17 @@ def parse_value(text: str, kind: str) -> float:
     num, unit = float(m.group(1)), m.group(2)
     if kind == "speed":  # '98.095Mf' -> flops
         unit = unit[:-1] if unit.endswith("f") else unit
-        return num * _SI.get(unit, None or _SI[unit])
+        return num * _mult(unit, text, kind)
     if kind == "bandwidth":  # '41.27MBps' or 'kBps' or 'Bps' -> bytes/s
         if unit.endswith("Bps"):
             unit = unit[:-3]
         elif unit.endswith("bps"):  # bits per second
-            return num * _SI[unit[:-3]] / 8.0
-        return num * _SI[unit]
+            return num * _mult(unit[:-3], text, kind) / 8.0
+        return num * _mult(unit, text, kind)
     if kind == "time":  # '59.904us' / '1.4ms' / '15s' / bare seconds
         if unit.endswith("s"):
             unit = unit[:-1]
-        return num * _SI[unit]
+        return num * _mult(unit, text, kind)
     raise ValueError(f"unknown kind {kind}")
 
 

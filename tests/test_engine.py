@@ -1,0 +1,88 @@
+"""Engine façade tests: the S4U-shaped driver API (SURVEY.md N1/A10)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.topology.platform import parse_value
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLATFORM = os.path.join(ROOT, "examples/platforms/small6.xml")
+ACTORS = os.path.join(ROOT, "examples/deployments/small6_actors.xml")
+
+
+def _engine(**kw):
+    e = Engine(config=RoundConfig.fast(**kw))
+    e.load_platform(PLATFORM)
+    e.register_actor("peer")
+    e.load_deployment(ACTORS)
+    return e
+
+
+def test_reference_shaped_driver_flow():
+    """The reference's __main__ sequence (flowupdating-collectall.py:151-166)
+    expressed against the Engine: load, watch, run, read back."""
+    e = _engine()
+    e.add_watcher(run_until=200.0, time_interval=10.0)
+    e.run_until(300.0)
+    est = e.estimates()
+    true_mean = np.mean(list(e.global_values()["value"].values()))
+    assert np.abs(est - true_mean).max() < 1e-3
+    assert e.clock == 300.0
+
+
+def test_run_until_partial_horizon_executes_all_rounds():
+    """run_until(t) short of any watcher event must still run rounds up to
+    exactly t (regression: trailing t_end was skipped when a watcher's
+    'until' lay beyond it)."""
+    e = _engine()
+    e.add_watcher(run_until=1000.0, time_interval=10.0)
+    e.run_until(95.0)
+    assert int(e.state.t) == 95
+    assert e.clock == 95.0
+    e.run_until(100.0)
+    assert int(e.state.t) == 100
+
+
+def test_watcher_callback_fires_once_at_coinciding_end():
+    calls = []
+    e = _engine()
+    e.add_watcher(run_until=100.0, time_interval=10.0,
+                  callback=lambda eng: calls.append(eng.clock))
+    e.run_until(100.0)
+    assert calls == [pytest.approx(10.0 * i) for i in range(1, 11)]
+
+
+def test_watcher_kill_freezes_state():
+    e = _engine()
+    e.add_watcher(run_until=50.0, time_interval=25.0)
+    e.run_until(200.0)
+    # peers stopped at t=50 (the reference's Actor.kill_all at the watcher
+    # deadline); clock still advances to the horizon
+    assert int(e.state.t) == 50
+    assert e.clock == 200.0
+
+
+def test_global_values_shape(small6):
+    e = _engine()
+    e.run_rounds(5)
+    gv = e.global_values()
+    assert set(gv) == {"value", "last_avg"}
+    assert len(gv["value"]) == 6
+
+
+def test_parse_value_units():
+    assert parse_value("98.095Mf", "speed") == pytest.approx(98.095e6)
+    assert parse_value("41.27MBps", "bandwidth") == pytest.approx(41.27e6)
+    assert parse_value("8Mbps", "bandwidth") == pytest.approx(1e6)
+    assert parse_value("59.904us", "time") == pytest.approx(59.904e-6)
+
+
+def test_parse_value_unknown_unit_is_loud():
+    with pytest.raises(ValueError, match="unknown unit"):
+        parse_value("5Xf", "speed")
+    with pytest.raises(ValueError, match="unknown unit"):
+        parse_value("5XBps", "bandwidth")
