@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Headline benchmark — gossip rounds/sec at 1M nodes (BASELINE.json north star).
+
+Measures the TPU-native vectorized Flow-Updating kernel (fast synchronous
+collect-all: every node averages with all neighbors every round) on a
+~1.056M-vertex fat-tree (k=160, the "1M-node fat-tree topology" config), and
+compares against the SimGrid-CPU-class baseline: the reference-style C++
+discrete-event simulator (flow_updating_tpu/native/src/funative.cpp,
+mirroring flowupdating-collectall.py:66-128) doing the *same algorithmic
+work per round* (timeout=1 -> every node averages + sends every tick).
+
+The reference publishes no numbers (BASELINE.md), so the baseline is
+measured here, live, on the same topology; if the native library cannot be
+built, a previously measured value recorded in BASELINE_MEASURED.json is
+used instead.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": rounds/sec, "unit": "rounds/sec", "vs_baseline": x}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+MEASURED_PATH = os.path.join(REPO, "BASELINE_MEASURED.json")
+
+
+def build_topology(k: int):
+    from flow_updating_tpu.topology.generators import fat_tree
+
+    return fat_tree(k, seed=0)
+
+
+def measure_tpu(topo, rounds: int) -> dict:
+    import jax
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+    from flow_updating_tpu.utils.metrics import rmse
+
+    cfg = RoundConfig.fast(variant="collectall")
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+    state = init_state(topo, cfg)
+
+    # Compile + warm (jit keyed on static (cfg, rounds): same call again is
+    # pure execution).
+    t0 = time.perf_counter()
+    out = run_rounds(state, arrays, cfg, rounds)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = run_rounds(state, arrays, cfg, rounds)
+    jax.block_until_ready(out)
+    run_s = time.perf_counter() - t0
+
+    est = node_estimates(out, arrays)
+    err = float(rmse(est, topo.true_mean))
+    return {
+        "rounds_per_sec": rounds / run_s,
+        "run_s": run_s,
+        "compile_s": compile_s,
+        "rounds": rounds,
+        "rmse_after": err,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def measure_des_baseline(topo, ticks: int) -> dict | None:
+    """Reference-style DES, same topology, full average per node per tick."""
+    from flow_updating_tpu import native
+
+    if not native.available():
+        return None
+    t0 = time.perf_counter()
+    _est, _la, events = native.des_run(
+        topo, variant="collectall", timeout=1, ticks=ticks
+    )
+    elapsed = time.perf_counter() - t0
+    return {
+        "rounds_per_sec": ticks / elapsed,
+        "run_s": elapsed,
+        "ticks": ticks,
+        "events": events,
+    }
+
+
+def recorded_baseline(k: int) -> float | None:
+    try:
+        with open(MEASURED_PATH) as f:
+            return float(json.load(f)[f"k{k}"]["des_rounds_per_sec"])
+    except Exception:
+        return None
+
+
+def record_baseline(k: int, entry: dict) -> None:
+    data = {}
+    try:
+        with open(MEASURED_PATH) as f:
+            data = json.load(f)
+    except Exception:
+        pass
+    data[f"k{k}"] = entry
+    try:
+        with open(MEASURED_PATH, "w") as f:
+            json.dump(data, f, indent=1)
+    except OSError:
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fat-tree-k", type=int, default=160,
+                    help="fat-tree arity (160 -> ~1.056M vertices)")
+    ap.add_argument("--rounds", type=int, default=512,
+                    help="timed TPU rounds")
+    ap.add_argument("--des-ticks", type=int, default=2,
+                    help="timed baseline DES ticks (heap grows ~E per tick)")
+    ap.add_argument("--skip-des", action="store_true",
+                    help="use the recorded baseline instead of measuring")
+    args = ap.parse_args()
+
+    topo = build_topology(args.fat_tree_k)
+    n, e = topo.num_nodes, topo.num_edges
+
+    tpu = measure_tpu(topo, args.rounds)
+
+    des = None if args.skip_des else measure_des_baseline(topo, args.des_ticks)
+    if des is not None:
+        base_rps = des["rounds_per_sec"]
+        base_src = "measured"
+        record_baseline(
+            args.fat_tree_k,
+            {"des_rounds_per_sec": base_rps, "nodes": n, "edges": e, "des": des},
+        )
+    else:
+        base_rps = recorded_baseline(args.fat_tree_k)
+        base_src = "recorded" if base_rps is not None else "none"
+
+    result = {
+        "metric": f"gossip rounds/sec, {n} nodes (fat-tree k={args.fat_tree_k}, "
+                  "collect-all, fast synchronous)",
+        "value": round(tpu["rounds_per_sec"], 2),
+        "unit": "rounds/sec",
+        "vs_baseline": (
+            round(tpu["rounds_per_sec"] / base_rps, 2) if base_rps else None
+        ),
+        "extra": {
+            "nodes": n,
+            "directed_edges": e,
+            "tpu": {k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in tpu.items()},
+            "baseline_rounds_per_sec": (
+                round(base_rps, 4) if base_rps else None
+            ),
+            "baseline_source": base_src,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

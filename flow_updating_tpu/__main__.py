@@ -1,0 +1,7 @@
+"""``python -m flow_updating_tpu`` — the CLI entry point."""
+
+import sys
+
+from flow_updating_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,266 @@
+"""Command-line driver — the framework's flag system.
+
+The reference has no CLI beyond argv passthrough into SimGrid's engine
+(``Engine(sys.argv)``, ``flowupdating-collectall.py:152``) and hard-coded
+constants (``TICK_INTERVAL``/``TICK_TIMEOUT``, ``collectall.py:23-24``) and
+paths (``collectall.py:154-164``).  Here every knob is a real flag, including
+the north-star ``--backend=jax_tpu`` gate (BASELINE.json) selecting the
+execution backend before JAX initializes.
+
+Subcommands:
+
+``run``
+    One aggregation run.  Topology from ``--platform``/``--deployment`` XML
+    (the reference's input format) or a synthetic ``--generator``.  Mirrors
+    the reference driver's shape: watcher sampling every ``--observe-every``
+    simulated seconds until ``--until`` (``collectall.py:151-166``).
+
+``generate``
+    Emit a synthetic topology's summary (nodes/edges/degree stats) — a
+    quick check of the benchmark-ladder configs.
+
+``oracle``
+    Run the native C++ reference-style discrete-event simulator on the same
+    topology (the SimGrid-CPU-class baseline) and print its convergence
+    report — for apples-to-apples comparisons from the shell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+
+def _select_backend(name: str) -> None:
+    """Pin the JAX backend.  Must run before any JAX backend initializes.
+
+    ``jax_tpu``  — use the ambient TPU platform (axon/tpu plugin).
+    ``cpu``      — force host CPU and deregister TPU plugin factories so
+                   nothing contends for (or hangs on) a TPU tunnel.
+    ``auto``     — leave discovery alone.
+    """
+    if name == "auto":
+        return
+    if name == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax._src.xla_bridge as xb
+
+        for plugin in ("axon", "tpu"):
+            xb._backend_factories.pop(plugin, None)
+    elif name == "jax_tpu":
+        # Clear a CPU pin so TPU discovery can happen; an explicit TPU-ish
+        # pin (tpu / axon tunnel) is kept as-is.
+        preset = os.environ.get("JAX_PLATFORMS", "")
+        if preset and not any(p in preset for p in ("tpu", "axon")):
+            del os.environ["JAX_PLATFORMS"]
+            import jax
+
+            jax.config.update("jax_platforms", None)
+    else:
+        raise SystemExit(f"unknown backend {name!r}")
+
+
+def _add_common(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--backend", default="auto",
+                    choices=("auto", "cpu", "jax_tpu"),
+                    help="execution backend (north-star gate)")
+    ap.add_argument("--platform", help="SimGrid-style platform XML")
+    ap.add_argument("--deployment", help="SimGrid-style deployment XML")
+    ap.add_argument("--generator", help="synthetic topology, e.g. "
+                    "'erdos_renyi:10000', 'barabasi_albert:100000:4', "
+                    "'fat_tree:16', 'ring:100:2', 'grid2d:32:32'")
+    ap.add_argument("--seed", type=int, default=0)
+
+
+def _build_topology(args):
+    from flow_updating_tpu.topology.deployment import load_deployment
+    from flow_updating_tpu.topology.generators import GENERATORS
+    from flow_updating_tpu.topology.platform import load_platform
+
+    if args.generator:
+        parts = args.generator.split(":")
+        name = parts[0]
+        if name not in GENERATORS:
+            raise SystemExit(
+                f"unknown generator {name!r}; have {sorted(GENERATORS)}"
+            )
+        try:
+            params = [
+                int(p) if p.lstrip("-").isdigit() else float(p)
+                for p in parts[1:]
+            ]
+        except ValueError:
+            raise SystemExit(f"bad generator parameters in {args.generator!r}")
+        return GENERATORS[name](*params, seed=args.seed)
+    if args.deployment:
+        from flow_updating_tpu.engine import TICK_INTERVAL
+
+        platform = load_platform(args.platform) if args.platform else None
+        lat = getattr(args, "latency_scale", 0.0)
+        return load_deployment(args.deployment).to_topology(
+            platform=platform, tick_interval=TICK_INTERVAL, latency_scale=lat
+        )
+    raise SystemExit("need --deployment (with optional --platform) "
+                     "or --generator")
+
+
+def _make_config(args):
+    from flow_updating_tpu.models.config import RoundConfig
+
+    maker = (RoundConfig.reference if args.fire_policy == "reference"
+             else RoundConfig.fast)
+    kw = dict(variant=args.variant, drop_rate=args.drop_rate)
+    if args.drain is not None:
+        kw["drain"] = args.drain
+    if args.timeout is not None:
+        kw["timeout"] = args.timeout
+    if args.delay_depth is not None:
+        kw["delay_depth"] = args.delay_depth
+    return maker(**kw)
+
+
+def cmd_run(args) -> int:
+    _select_backend(args.backend)
+
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.utils.metrics import convergence_report
+
+    cfg = _make_config(args)
+    engine = Engine(config=cfg)
+    engine.set_topology(_build_topology(args))
+    engine.build(latency_scale=args.latency_scale, seed=args.seed)
+
+    if args.rounds is not None:
+        engine.run_rounds(args.rounds)
+    else:
+        engine.add_watcher(run_until=args.until,
+                           time_interval=args.observe_every)
+        engine.run_until(args.until)
+
+    report = convergence_report(
+        engine.state, engine._topo_arrays, engine.topology.true_mean
+    )
+    report["true_mean"] = engine.topology.true_mean
+    report["nodes"] = engine.topology.num_nodes
+    report["edges"] = engine.topology.num_edges
+    report["variant"] = cfg.variant
+    report["fire_policy"] = cfg.fire_policy
+    print(json.dumps(report))
+    return 0
+
+
+def cmd_generate(args) -> int:
+    import numpy as np
+
+    topo = _build_topology(args)
+    deg = topo.out_deg
+    print(json.dumps({
+        "nodes": topo.num_nodes,
+        "directed_edges": topo.num_edges,
+        "degree_min": int(deg.min()),
+        "degree_mean": round(float(deg.mean()), 3),
+        "degree_max": int(deg.max()),
+        "max_delay": topo.max_delay,
+        "true_mean": round(topo.true_mean, 6),
+        "values_sum": round(float(np.sum(topo.values)), 6),
+    }))
+    return 0
+
+
+def cmd_oracle(args) -> int:
+    import numpy as np
+
+    from flow_updating_tpu import native
+
+    if not native.available():
+        raise SystemExit("native runtime unavailable (g++ missing?)")
+    topo = _build_topology(args)
+    est, last_avg, events = native.des_run(
+        topo, variant=args.variant,
+        timeout=args.timeout if args.timeout is not None else 50,
+        ticks=args.ticks,
+    )
+    err = est - topo.true_mean
+    print(json.dumps({
+        "ticks": args.ticks,
+        "events": events,
+        "rmse": float(np.sqrt(np.mean(err * err))),
+        "max_abs_err": float(np.max(np.abs(err))),
+        "mass_residual": float(est.sum() - topo.values.sum()),
+        "true_mean": topo.true_mean,
+    }))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="flow_updating_tpu",
+        description="TPU-native Flow-Updating distributed aggregation",
+    )
+    ap.add_argument("-v", "--verbose", action="store_true")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="one aggregation run")
+    _add_common(run)
+    run.add_argument("--variant", default="collectall",
+                     choices=("collectall", "pairwise"))
+    run.add_argument("--fire-policy", default="reference",
+                     choices=("reference", "every_round"),
+                     help="'reference' = faithful async dynamics; "
+                          "'every_round' = fast synchronous mode")
+    run.add_argument("--drain", type=int, default=None,
+                     help="msgs processed per node per round (0=unbounded; "
+                          "reference semantics: 1)")
+    run.add_argument("--timeout", type=int, default=None,
+                     help="collect-all tick timeout / pairwise staleness "
+                          "rounds (reference: 50)")
+    run.add_argument("--delay-depth", type=int, default=None,
+                     help="in-flight ring depth (latency-warped rounds)")
+    run.add_argument("--latency-scale", type=float, default=0.0,
+                     help=">0: derive per-edge delays from platform "
+                          "latencies x this scale")
+    run.add_argument("--drop-rate", type=float, default=0.0,
+                     help="per-message loss probability (fault injection)")
+    run.add_argument("--rounds", type=int, default=None,
+                     help="run exactly N rounds (no watcher)")
+    run.add_argument("--until", type=float, default=1000.0,
+                     help="watcher horizon in simulated seconds "
+                          "(reference: 1000)")
+    run.add_argument("--observe-every", type=float, default=10.0,
+                     help="watcher sampling interval (reference: 10)")
+    run.set_defaults(fn=cmd_run)
+
+    gen = sub.add_parser("generate", help="topology summary")
+    _add_common(gen)
+    gen.add_argument("--latency-scale", type=float, default=0.0)
+    gen.set_defaults(fn=cmd_generate)
+
+    orc = sub.add_parser("oracle", help="native DES reference-style run")
+    _add_common(orc)
+    orc.add_argument("--variant", default="collectall",
+                     choices=("collectall", "pairwise"))
+    orc.add_argument("--timeout", type=int, default=None)
+    orc.add_argument("--ticks", type=int, default=1000)
+    orc.add_argument("--latency-scale", type=float, default=0.0)
+    orc.set_defaults(fn=cmd_oracle)
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(levelname)s %(name)s: %(message)s",
+    )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

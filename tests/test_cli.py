@@ -1,0 +1,85 @@
+"""CLI flag-system tests (flow_updating_tpu.cli).
+
+The reference's only "CLI" is argv passthrough to SimGrid plus hard-coded
+constants/paths (``flowupdating-collectall.py:151-166``); the framework
+exposes those as real flags.  These tests run the subcommands in-process.
+"""
+
+import json
+import os
+
+import pytest
+
+from flow_updating_tpu.cli import main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLATFORM = os.path.join(ROOT, "examples/platforms/small6.xml")
+DEPLOYMENT = os.path.join(ROOT, "examples/deployments/small6_actors.xml")
+
+
+def _run(capsys, argv):
+    rc = main(argv)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def test_run_reference_small6(capsys):
+    rc, rep = _run(capsys, [
+        "run", "--backend", "auto",
+        "--platform", PLATFORM, "--deployment", DEPLOYMENT,
+        "--variant", "collectall", "--until", "300",
+        "--observe-every", "100",
+    ])
+    assert rc == 0
+    assert rep["nodes"] == 6
+    assert rep["true_mean"] == pytest.approx(30.0)
+    assert rep["rmse"] < 0.1
+    assert abs(rep["mass_residual"]) < 0.1
+
+
+def test_run_fast_generator_rounds(capsys):
+    rc, rep = _run(capsys, [
+        "run", "--generator", "ring:64:2", "--fire-policy", "every_round",
+        "--variant", "pairwise", "--rounds", "400", "--seed", "3",
+    ])
+    assert rc == 0
+    assert rep["nodes"] == 64
+    assert rep["rmse"] < 0.01  # ring mixes slowly (~1/N^2 spectral gap)
+    # fast pairwise is mass-conserving by construction
+    assert abs(rep["mass_residual"]) < 1e-3
+
+
+def test_run_fault_injection(capsys):
+    rc, rep = _run(capsys, [
+        "run", "--generator", "grid2d:6:6", "--variant", "collectall",
+        "--fire-policy", "reference", "--drop-rate", "0.2",
+        "--rounds", "2000",
+    ])
+    assert rc == 0
+    # self-healing under 20% message loss (SURVEY.md §5 fault tolerance)
+    assert rep["rmse"] < 0.05 * abs(rep["true_mean"]) + 0.05
+
+
+def test_generate_summary(capsys):
+    rc, rep = _run(capsys, ["generate", "--generator", "fat_tree:8"])
+    assert rc == 0
+    assert rep["nodes"] == 208
+    assert rep["directed_edges"] == 768
+    assert rep["degree_max"] == 8
+
+
+def test_oracle_matches_mean(capsys):
+    native = pytest.importorskip("flow_updating_tpu.native")
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    rc, rep = _run(capsys, [
+        "oracle", "--generator", "ring:32:2", "--ticks", "400",
+    ])
+    assert rc == 0
+    assert rep["rmse"] < 0.01
+    assert abs(rep["mass_residual"]) < 1e-6
+
+
+def test_unknown_generator_errors():
+    with pytest.raises(SystemExit):
+        main(["generate", "--generator", "nope:3"])
