@@ -134,7 +134,18 @@ def cmd_run(args) -> int:
     cfg = _make_config(args)
     engine = Engine(config=cfg)
     engine.set_topology(_build_topology(args))
-    engine.build(latency_scale=args.latency_scale, seed=args.seed)
+    if args.resume:
+        # restore allocates no fresh state; the checkpoint's config governs
+        # the run (it is part of the run's identity — e.g. delay_depth
+        # shapes the ring buffer).
+        engine.restore_checkpoint(args.resume)
+        if engine.config != cfg:
+            logging.getLogger("flow_updating_tpu.cli").warning(
+                "--resume: checkpoint config %s overrides CLI flags %s",
+                engine.config, cfg,
+            )
+    else:
+        engine.build(latency_scale=args.latency_scale, seed=args.seed)
 
     if args.rounds is not None:
         engine.run_rounds(args.rounds)
@@ -149,8 +160,11 @@ def cmd_run(args) -> int:
     report["true_mean"] = engine.topology.true_mean
     report["nodes"] = engine.topology.num_nodes
     report["edges"] = engine.topology.num_edges
-    report["variant"] = cfg.variant
-    report["fire_policy"] = cfg.fire_policy
+    report["variant"] = engine.config.variant
+    report["fire_policy"] = engine.config.fire_policy
+    if args.save_checkpoint:
+        engine.save_checkpoint(args.save_checkpoint)
+        report["checkpoint"] = args.save_checkpoint
     print(json.dumps(report))
     return 0
 
@@ -234,6 +248,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "(reference: 1000)")
     run.add_argument("--observe-every", type=float, default=10.0,
                      help="watcher sampling interval (reference: 10)")
+    run.add_argument("--save-checkpoint", metavar="PATH",
+                     help="write the final state pytree + config to PATH")
+    run.add_argument("--resume", metavar="PATH",
+                     help="resume from a checkpoint (same topology required)")
     run.set_defaults(fn=cmd_run)
 
     gen = sub.add_parser("generate", help="topology summary")

@@ -99,8 +99,7 @@ class Engine:
         self.topology = topo
         return self
 
-    def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
-        """Resolve deployment(+platform) into topology + fresh state."""
+    def _resolve_topology(self, latency_scale: float = 0.0) -> "Engine":
         if self.topology is None:
             if self.deployment is None:
                 raise RuntimeError("no deployment loaded and no topology set")
@@ -109,6 +108,11 @@ class Engine:
                 tick_interval=TICK_INTERVAL,
                 latency_scale=latency_scale,
             )
+        return self
+
+    def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
+        """Resolve deployment(+platform) into topology + fresh state."""
+        self._resolve_topology(latency_scale)
         if latency_scale > 0.0:
             depth = max(self.config.delay_depth, self.topology.max_delay)
             if depth != self.config.delay_depth:
@@ -156,6 +160,38 @@ class Engine:
         if self.state is None:
             raise RuntimeError("engine not built")
         return np.asarray(node_estimates(self.state, self._topo_arrays))
+
+    # ---- checkpoint / resume --------------------------------------------
+    def save_checkpoint(self, path: str) -> "Engine":
+        """Write the full run state (one pytree) + config + topology
+        fingerprint to ``path``.  The reference has no checkpointing
+        (SURVEY.md §5); here it is a by-product of the array design."""
+        from flow_updating_tpu.utils.checkpoint import save_checkpoint
+
+        if self.state is None:
+            raise RuntimeError("engine not built — nothing to checkpoint")
+        save_checkpoint(
+            path, self.state, self.config, topo=self.topology,
+            extra={"clock": self._clock, "killed": self._killed},
+        )
+        return self
+
+    def restore_checkpoint(self, path: str) -> "Engine":
+        """Resume from a checkpoint taken on the *same* topology (verified
+        by content fingerprint).  Restores state, config and clock; does not
+        allocate fresh state (``build()`` is not required first)."""
+        from flow_updating_tpu.utils.checkpoint import load_checkpoint
+
+        self._resolve_topology()
+        state, cfg, extra = load_checkpoint(path, topo=self.topology)
+        self.config = cfg
+        self._topo_arrays = self.topology.device_arrays(
+            coloring=cfg.needs_coloring
+        )
+        self.state = state
+        self._clock = float(extra.get("clock", float(state.t)))
+        self._killed = bool(extra.get("killed", False))
+        return self
 
     # ---- execution -------------------------------------------------------
     def run_rounds(self, n: int) -> "Engine":

@@ -4,10 +4,18 @@ from flow_updating_tpu.utils.metrics import (
     antisymmetry_residual,
     convergence_report,
 )
+from flow_updating_tpu.utils.checkpoint import (
+    save_checkpoint,
+    load_checkpoint,
+    topology_fingerprint,
+)
 
 __all__ = [
     "rmse",
     "mass_residual",
     "antisymmetry_residual",
     "convergence_report",
+    "save_checkpoint",
+    "load_checkpoint",
+    "topology_fingerprint",
 ]

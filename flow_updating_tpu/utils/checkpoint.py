@@ -1,0 +1,117 @@
+"""Checkpoint / resume.
+
+The reference has no checkpointing at all (SURVEY.md §5): a run's full state
+lives in per-actor Python attributes (``value``, ``flows``, ``estimates``,
+timers — ``flowupdating-collectall.py:26-45``) and dies with the process.
+Here the whole simulation state is one :class:`FlowUpdatingState` pytree, so
+checkpointing is a flat archive of named arrays plus a manifest:
+
+* every pytree leaf, fetched to host and stored in one compressed ``.npz``;
+* the :class:`RoundConfig` (all static knobs) as JSON;
+* a topology fingerprint (node/edge counts + content digest of the edge list,
+  delays and initial values), verified at restore so a checkpoint can never
+  be resumed against a different graph.
+
+Sharded states (leaves with a leading shard axis, or GSPMD-placed global
+arrays) round-trip transparently: ``np.asarray`` gathers to host at save;
+the caller re-places the restored state on its mesh (``shard_state`` /
+``init_plan_state``-style placement) after load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.state import FlowUpdatingState
+
+FORMAT_VERSION = 1
+
+
+def topology_fingerprint(topo) -> dict:
+    """Cheap content digest binding a checkpoint to its graph."""
+    h = hashlib.sha256()
+    for arr in (topo.src, topo.dst, topo.delay, topo.values):
+        a = np.ascontiguousarray(arr)
+        h.update(a.tobytes())
+    return {
+        "num_nodes": int(topo.num_nodes),
+        "num_edges": int(topo.num_edges),
+        "digest": h.hexdigest(),
+    }
+
+
+def save_checkpoint(
+    path: str,
+    state: FlowUpdatingState,
+    cfg: RoundConfig,
+    topo=None,
+    extra: dict | None = None,
+) -> None:
+    """Write one atomic checkpoint file (``.npz``) at ``path``."""
+    arrays = {}
+    for name in state.__dataclass_fields__:
+        leaf = getattr(state, name)
+        arrays[f"state.{name}"] = np.asarray(jax.device_get(leaf))
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "config": dataclasses.asdict(cfg),
+        "topology": topology_fingerprint(topo) if topo is not None else None,
+        "extra": extra or {},
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f, __manifest__=np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8
+            ), **arrays,
+        )
+    os.replace(tmp, path)
+
+
+def load_checkpoint(
+    path: str,
+    topo=None,
+) -> tuple[FlowUpdatingState, RoundConfig, dict]:
+    """Read a checkpoint.  Returns ``(state, config, extra)``.
+
+    If ``topo`` is given and the checkpoint carries a fingerprint, they must
+    match — a checkpoint can never be resumed against a different graph.
+    """
+    with np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        if manifest["format_version"] != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format {manifest['format_version']} != "
+                f"{FORMAT_VERSION}"
+            )
+        fields = {}
+        for key in z.files:
+            if key.startswith("state."):
+                fields[key[len("state."):]] = z[key]
+    want = set(FlowUpdatingState.__dataclass_fields__)
+    have = set(fields)
+    if have != want:
+        raise ValueError(
+            f"checkpoint fields mismatch: missing {sorted(want - have)}, "
+            f"unexpected {sorted(have - want)}"
+        )
+    if topo is not None and manifest.get("topology"):
+        fp = topology_fingerprint(topo)
+        if fp != manifest["topology"]:
+            raise ValueError(
+                "checkpoint was taken on a different topology "
+                f"(saved {manifest['topology']['num_nodes']} nodes/"
+                f"{manifest['topology']['num_edges']} edges, have "
+                f"{fp['num_nodes']}/{fp['num_edges']}, digests "
+                f"{'match' if fp['digest'] == manifest['topology']['digest'] else 'differ'})"
+            )
+    cfg = RoundConfig(**manifest["config"])
+    state = FlowUpdatingState(**fields)
+    return state, cfg, manifest.get("extra", {})

@@ -83,3 +83,19 @@ def test_oracle_matches_mean(capsys):
 def test_unknown_generator_errors():
     with pytest.raises(SystemExit):
         main(["generate", "--generator", "nope:3"])
+
+
+def test_run_save_and_resume_checkpoint(tmp_path, capsys):
+    ckpt = str(tmp_path / "run.npz")
+    rc, rep1 = _run(capsys, [
+        "run", "--generator", "ring:32:2", "--rounds", "50",
+        "--save-checkpoint", ckpt,
+    ])
+    assert rc == 0 and rep1["checkpoint"] == ckpt
+    rc, rep2 = _run(capsys, [
+        "run", "--generator", "ring:32:2", "--rounds", "50",
+        "--resume", ckpt,
+    ])
+    assert rc == 0
+    assert rep2["t"] == 100
+    assert rep2["rmse"] <= rep1["rmse"]
