@@ -147,12 +147,52 @@ def cmd_run(args) -> int:
     else:
         engine.build(latency_scale=args.latency_scale, seed=args.seed)
 
-    if args.rounds is not None:
-        engine.run_rounds(args.rounds)
-    else:
-        engine.add_watcher(run_until=args.until,
-                           time_interval=args.observe_every)
-        engine.run_until(args.until)
+    from flow_updating_tpu.utils.eventlog import EventLog
+    from flow_updating_tpu.utils.trace import trace
+
+    event_log = EventLog(args.event_log) if args.event_log else None
+    if event_log:
+        event_log.emit(
+            "run_start", nodes=engine.topology.num_nodes,
+            edges=engine.topology.num_edges, variant=engine.config.variant,
+            fire_policy=engine.config.fire_policy,
+        )
+
+    import jax
+
+    with trace(args.profile):
+        if args.stream:
+            emit = None
+            if event_log:
+                emit = lambda m: event_log.emit("watch", **m)
+            # --until is absolute simulated time (matches run_until even
+            # after --resume); --rounds is a relative count.
+            n = (args.rounds if args.rounds is not None
+                 else max(0, int(round(args.until - engine.clock))))
+            every = max(1, int(args.observe_every))
+            full = n - n % every
+            if full:
+                engine.run_streamed(full, observe_every=every, emit=emit)
+            if n - full:  # remainder rounds, unobserved — nothing truncated
+                engine.run_rounds(n - full)
+        elif args.rounds is not None:
+            engine.run_rounds(args.rounds)
+        else:
+            cb = None
+            if event_log:
+                cb = lambda e: event_log.emit(
+                    "watch", t=int(e.state.t), **{
+                        k: v for k, v in e.global_values().items()
+                    },
+                )
+            engine.add_watcher(run_until=args.until,
+                               time_interval=args.observe_every, callback=cb)
+            engine.run_until(args.until)
+        # keep execution (not just dispatch) inside the profiler trace, and
+        # flush pending debug-callback effects before reporting
+        if engine.state is not None:
+            jax.block_until_ready(engine.state)
+        jax.effects_barrier()
 
     report = convergence_report(
         engine.state, engine._topo_arrays, engine.topology.true_mean
@@ -165,6 +205,9 @@ def cmd_run(args) -> int:
     if args.save_checkpoint:
         engine.save_checkpoint(args.save_checkpoint)
         report["checkpoint"] = args.save_checkpoint
+    if event_log:
+        event_log.emit("run_end", **report)
+        event_log.close()
     print(json.dumps(report))
     return 0
 
@@ -248,6 +291,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "(reference: 1000)")
     run.add_argument("--observe-every", type=float, default=10.0,
                      help="watcher sampling interval (reference: 10)")
+    run.add_argument("--stream", action="store_true",
+                     help="one compiled run with metrics streamed mid-run "
+                          "via jax.debug.callback (vs host-chunked watcher)")
+    run.add_argument("--event-log", metavar="PATH",
+                     help="append structured JSONL events (watch samples, "
+                          "run start/end) to PATH")
+    run.add_argument("--profile", metavar="DIR",
+                     help="capture a JAX/XLA profiler trace into DIR")
     run.add_argument("--save-checkpoint", metavar="PATH",
                      help="write the final state pytree + config to PATH")
     run.add_argument("--resume", metavar="PATH",

@@ -26,6 +26,7 @@ from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.rounds import (
     node_estimates,
     run_rounds,
+    run_rounds_streamed,
 )
 from flow_updating_tpu.models.state import FlowUpdatingState, init_state
 from flow_updating_tpu.topology.deployment import Deployment, load_deployment
@@ -35,6 +36,13 @@ from flow_updating_tpu.topology.platform import Platform, load_platform
 logger = logging.getLogger("flow_updating_tpu.engine")
 
 TICK_INTERVAL = 1.0  # simulated seconds per round
+
+
+def _log_stream_sample(m: dict) -> None:
+    logger.info(
+        "[%d] rmse=%.3e max_err=%.3e mass=%.6g fired=%d",
+        m["t"], m["rmse"], m["max_abs_err"], m["mass"], m["fired_total"],
+    )
 
 
 class _NetzoneShim:
@@ -161,6 +169,80 @@ class Engine:
             raise RuntimeError("engine not built")
         return np.asarray(node_estimates(self.state, self._topo_arrays))
 
+    # ---- fault injection (SURVEY.md §5) ---------------------------------
+    def _node_ids(self, nodes) -> np.ndarray:
+        name_to_id = None
+        ids = []
+        for n in nodes:
+            if isinstance(n, str):
+                if name_to_id is None:
+                    name_to_id = self.topology.name_to_id()
+                ids.append(name_to_id[n])
+            else:
+                ids.append(int(n))
+        return np.asarray(ids, dtype=np.int32)
+
+    def kill_nodes(self, nodes) -> "Engine":
+        """Crash-stop the given nodes (ids or host names): they stop firing,
+        sending and processing.  Delivered-but-undrained messages stay queued
+        and are processed on revival — the protocol's idempotent state
+        exchange makes the whole sequence self-healing (the fault model the
+        Flow-Updating paper targets; the reference only exercises it through
+        message loss, SURVEY.md §5)."""
+        if self.state is None:
+            raise RuntimeError("engine not built")
+        ids = self._node_ids(nodes)
+        self.state = self.state.replace(
+            alive=self.state.alive.at[ids].set(False)
+        )
+        return self
+
+    def revive_nodes(self, nodes) -> "Engine":
+        if self.state is None:
+            raise RuntimeError("engine not built")
+        ids = self._node_ids(nodes)
+        self.state = self.state.replace(
+            alive=self.state.alive.at[ids].set(True)
+        )
+        return self
+
+    def _edge_ids(self, links) -> np.ndarray:
+        """Directed edge indices for (u, v) node pairs, both directions."""
+        topo = self.topology
+        keys = topo.src.astype(np.int64) * topo.num_nodes + topo.dst
+        ids = []
+        for u, v in links:
+            u, v = (int(x) for x in self._node_ids([u, v]))
+            for a, b in ((u, v), (v, u)):
+                key = a * topo.num_nodes + b  # Python ints: no int32 wrap
+                e = int(np.searchsorted(keys, key))
+                if e >= len(keys) or int(keys[e]) != key:
+                    raise ValueError(f"no edge {a}->{b} in topology")
+                ids.append(e)
+        return np.asarray(ids, dtype=np.int64)
+
+    def fail_links(self, links) -> "Engine":
+        """Fail the given undirected links (pairs of node ids or names):
+        every message put on them is lost, in both directions, until
+        :meth:`restore_links`.  Senders' ledgers still update — the exact
+        semantics of a lost ``put_async``."""
+        if self.state is None:
+            raise RuntimeError("engine not built")
+        ids = self._edge_ids(links)
+        self.state = self.state.replace(
+            edge_ok=self.state.edge_ok.at[ids].set(False)
+        )
+        return self
+
+    def restore_links(self, links) -> "Engine":
+        if self.state is None:
+            raise RuntimeError("engine not built")
+        ids = self._edge_ids(links)
+        self.state = self.state.replace(
+            edge_ok=self.state.edge_ok.at[ids].set(True)
+        )
+        return self
+
     # ---- checkpoint / resume --------------------------------------------
     def save_checkpoint(self, path: str) -> "Engine":
         """Write the full run state (one pytree) + config + topology
@@ -199,6 +281,25 @@ class Engine:
             self.build()
         if not self._killed and n > 0:
             self.state = run_rounds(self.state, self._topo_arrays, self.config, n)
+        self._clock += n * TICK_INTERVAL
+        return self
+
+    def run_streamed(
+        self, n: int, observe_every: int = 10, emit=None
+    ) -> "Engine":
+        """Run ``n`` rounds as ONE compiled computation, streaming watcher
+        metrics to the host mid-run via ``jax.debug.callback`` (no host
+        round-trips between sampling points, unlike :meth:`run_until`).
+        ``emit(metrics_dict)`` defaults to an INFO log line."""
+        if self.state is None:
+            self.build()
+        if emit is None:
+            emit = _log_stream_sample  # stable identity -> jit cache reuse
+        if not self._killed and n > 0:
+            self.state = run_rounds_streamed(
+                self.state, self._topo_arrays, self.config, n,
+                observe_every, self.topology.true_mean, emit,
+            )
         self._clock += n * TICK_INTERVAL
         return self
 

@@ -172,6 +172,11 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
                 (topo.edge_color == t % topo.num_colors)
                 & state.alive[src]
                 & state.alive[topo.dst]
+                # direct (message-free) exchange: a failed link in either
+                # direction disables the pair symmetrically, or antisymmetry
+                # would break within the round
+                & state.edge_ok
+                & state.edge_ok[topo.rev]
             )
             x_u = estimate[src]
             x_v = estimate[topo.dst]
@@ -218,6 +223,10 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             final_est = run_est[seg_end]
             last_avg = jnp.where(fire_any, final_est, last_avg)
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
+
+    # link-failure mask: a dead link loses every message put on it; the
+    # sender's ledger is still updated, exactly like per-message loss
+    send_mask = send_mask & state.edge_ok
 
     key = state.key
     if cfg.drop_rate > 0.0:
@@ -309,19 +318,87 @@ def run_rounds_observed(
     mean = jnp.asarray(true_mean, state.value.dtype)
 
     def chunk_body(s, _):
-        s = jax.lax.fori_loop(
-            0, observe_every, lambda _, x: round_step(x, topo, cfg), s
+        s, (t, rmse, max_err, mass, fired) = _observe_chunk(
+            s, topo, cfg, observe_every, mean
         )
-        est = node_estimates(s, topo)
-        err = est - mean
         metrics = {
-            "t": s.t,
-            "rmse": jnp.sqrt(jnp.mean(err * err)),
-            "max_abs_err": jnp.max(jnp.abs(err)),
-            "mass": jnp.sum(est),
-            "fired_total": jnp.sum(s.fired),
+            "t": t,
+            "rmse": rmse,
+            "max_abs_err": max_err,
+            "mass": mass,
+            "fired_total": fired,
         }
         return s, metrics
 
     state, metrics = jax.lax.scan(chunk_body, state, None, length=chunks)
     return state, metrics
+
+
+def _observe_chunk(s, topo, cfg, observe_every: int, mean):
+    """``observe_every`` rounds + one watcher sample (shared by the stacked
+    and streamed observers)."""
+    s = jax.lax.fori_loop(
+        0, observe_every, lambda _, x: round_step(x, topo, cfg), s
+    )
+    est = node_estimates(s, topo)
+    err = est - mean
+    sample = (
+        s.t,
+        jnp.sqrt(jnp.mean(err * err)),
+        jnp.max(jnp.abs(err)),
+        jnp.sum(est),
+        jnp.sum(s.fired),
+    )
+    return s, sample
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "chunks", "observe_every", "emit")
+)
+def _run_streamed(state, topo, cfg, chunks, observe_every, mean, emit):
+    def host_emit(t, rmse_v, max_err, mass, fired):
+        emit({
+            "t": int(t),
+            "rmse": float(rmse_v),
+            "max_abs_err": float(max_err),
+            "mass": float(mass),
+            "fired_total": int(fired),
+        })
+
+    def chunk_body(s, _):
+        s, sample = _observe_chunk(s, topo, cfg, observe_every, mean)
+        jax.debug.callback(host_emit, *sample, ordered=True)
+        return s, None
+
+    state, _ = jax.lax.scan(chunk_body, state, None, length=chunks)
+    return state
+
+
+def run_rounds_streamed(
+    state: FlowUpdatingState,
+    topo,
+    cfg: RoundConfig,
+    num_rounds: int,
+    observe_every: int,
+    true_mean,
+    emit,
+) -> FlowUpdatingState:
+    """Like :func:`run_rounds_observed`, but metrics *stream to the host
+    while the run executes*: each observation chunk ends in a
+    ``jax.debug.callback`` that invokes ``emit(metrics_dict)`` with host
+    scalars, in order.  This is the live equivalent of the reference's
+    watcher printing every 10 simulated seconds mid-run
+    (``flowupdating-collectall.py:139-142``) — one compiled computation, no
+    host round-trips between chunks, observability anyway.
+
+    ``emit`` is a jit-static argument: passing the *same callable object*
+    across calls reuses the compiled computation.  It must not block for
+    long (it runs on the runtime's callback thread and backpressures the
+    device queue).  Completion of all emits is only guaranteed after
+    ``jax.effects_barrier()``.
+    """
+    if num_rounds % observe_every:
+        raise ValueError("num_rounds must be a multiple of observe_every")
+    chunks = num_rounds // observe_every
+    mean = jnp.asarray(true_mean, state.value.dtype)
+    return _run_streamed(state, topo, cfg, chunks, observe_every, mean, emit)

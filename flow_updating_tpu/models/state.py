@@ -36,6 +36,7 @@ class FlowUpdatingState:
     last_avg: jnp.ndarray      # (N,) — last computed average per node
     fired: jnp.ndarray         # (N,) int32 — total averaging events per node
     alive: jnp.ndarray         # (N,) bool — failure-injection liveness mask
+    edge_ok: jnp.ndarray       # (E,) bool — link-failure mask (False = no send)
     pending_flow: jnp.ndarray  # (E,) — undrained delivered message payloads
     pending_est: jnp.ndarray   # (E,)
     pending_valid: jnp.ndarray  # (E,) bool
@@ -70,6 +71,7 @@ def init_state(
         last_avg=jnp.zeros((N,), dt),
         fired=jnp.zeros((N,), jnp.int32),
         alive=jnp.ones((N,), bool),
+        edge_ok=jnp.ones((E,), bool),
         pending_flow=jnp.zeros((E,), dt),
         pending_est=jnp.zeros((E,), dt),
         pending_valid=jnp.zeros((E,), bool),

@@ -103,6 +103,7 @@ def state_sharding(mesh: jax.sharding.Mesh) -> FlowUpdatingState:
         last_avg=ns(ax),
         fired=ns(ax),
         alive=ns(ax),
+        edge_ok=ns(ax),
         pending_flow=ns(ax),
         pending_est=ns(ax),
         pending_valid=ns(ax),

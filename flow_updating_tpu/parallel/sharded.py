@@ -230,6 +230,7 @@ def init_plan_state(
         last_avg=jnp.zeros((S, Nb), dt),
         fired=jnp.zeros((S, Nb), jnp.int32),
         alive=jnp.asarray(plan.alive0),
+        edge_ok=jnp.ones((S, Eb), bool),
         pending_flow=jnp.zeros((S, Eb), dt),
         pending_est=jnp.zeros((S, Eb), dt),
         pending_valid=jnp.zeros((S, Eb), bool),

@@ -9,6 +9,8 @@ from flow_updating_tpu.utils.checkpoint import (
     load_checkpoint,
     topology_fingerprint,
 )
+from flow_updating_tpu.utils.eventlog import EventLog
+from flow_updating_tpu.utils.trace import trace, annotate
 
 __all__ = [
     "rmse",
@@ -18,4 +20,7 @@ __all__ = [
     "save_checkpoint",
     "load_checkpoint",
     "topology_fingerprint",
+    "EventLog",
+    "trace",
+    "annotate",
 ]

@@ -1,0 +1,51 @@
+"""Structured JSONL event log — the framework's XBT-logging equivalent.
+
+The reference logs through SimGrid's XBT: timestamped, actor-attributed
+text lines (``this_actor.info/error``, ``flowupdating-collectall.py:67,96``)
+plus the watcher's periodic ``global_values`` dump (``:134-136``).  Here the
+analogous channel is machine-readable: one JSON object per line, each
+carrying the simulated round ``t`` and an event ``kind``, written by the
+host (watcher samples, engine lifecycle) or streamed out of a compiled run
+via :func:`flow_updating_tpu.models.rounds.run_rounds_streamed`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import IO
+
+
+class EventLog:
+    """Append-only JSONL sink.  Thread-safe (debug callbacks may fire from
+    runtime threads)."""
+
+    def __init__(self, path_or_file: str | IO):
+        if isinstance(path_or_file, str):
+            self._fh = open(path_or_file, "a", buffering=1)
+            self._owns = True
+        else:
+            self._fh = path_or_file
+            self._owns = False
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+    def emit(self, kind: str, **fields) -> None:
+        record = {"kind": kind, "wall_s": round(time.monotonic() - self._t0, 6)}
+        for k, v in fields.items():
+            if hasattr(v, "item"):  # 0-d numpy / jax scalars
+                v = v.item()
+            record[k] = v
+        with self._lock:
+            self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._owns:
+            self._fh.close()
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

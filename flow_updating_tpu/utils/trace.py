@@ -1,0 +1,32 @@
+"""Profiling helpers.
+
+The reference's tracing story is SimGrid's (unused) Paje-trace CLI flags
+(SURVEY.md §5); on TPU the native equivalent is the JAX/XLA profiler: a
+trace context that captures device timelines, fusion boundaries and HBM
+traffic, viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """``with trace('/tmp/fu-trace'):`` — profile the enclosed device work.
+
+    ``log_dir=None`` is a no-op, so call sites can thread a CLI flag through
+    unconditionally.
+    """
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (shows up on the TensorBoard timeline)."""
+    return jax.profiler.TraceAnnotation(name)

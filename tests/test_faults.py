@@ -1,0 +1,118 @@
+"""Fault injection: node churn and link failure self-healing.
+
+The reference's fault tolerance is implicit in the algorithm (SURVEY.md §5):
+flow re-sending heals message loss, timeouts prevent deadlock.  The
+framework makes the fault model explicit — ``Engine.kill_nodes`` /
+``revive_nodes`` (crash-stop churn via the ``alive`` mask) and
+``fail_links`` / ``restore_links`` (per-edge loss masks) — and these tests
+assert the paper's headline property: after the faults clear, the protocol
+reconverges to the *true* mean with no state reset, because the flow ledgers
+(``flows[sender] = -msg.flow``, reference ``flowupdating-collectall.py:99``)
+conserve mass through arbitrary loss.
+"""
+
+import numpy as np
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.topology.generators import erdos_renyi, ring
+from flow_updating_tpu.utils.metrics import convergence_report
+
+
+def _max_err(engine):
+    return float(np.max(np.abs(engine.estimates() - engine.topology.true_mean)))
+
+
+def test_kill_revive_reconverges_collectall():
+    topo = erdos_renyi(48, avg_degree=5.0, seed=2)
+    cfg = RoundConfig.reference(variant="collectall", delay_depth=2)
+    e = Engine(config=cfg).set_topology(topo).build()
+
+    e.run_rounds(150)
+    err_before = _max_err(e)
+
+    e.kill_nodes([0, 1, 2])
+    e.run_rounds(300)
+    est = e.estimates()
+    assert np.all(np.isfinite(est))  # survivors keep running
+
+    e.revive_nodes([0, 1, 2])
+    e.run_rounds(1500)
+    assert _max_err(e) < max(1e-3, err_before * 1e-2)
+
+
+def test_kill_revive_reconverges_pairwise():
+    topo = ring(24, k=2, seed=1)
+    cfg = RoundConfig.reference(variant="pairwise", delay_depth=2)
+    e = Engine(config=cfg).set_topology(topo).build()
+    e.run_rounds(100)
+    e.kill_nodes([5, 6])
+    e.run_rounds(200)
+    e.revive_nodes([5, 6])
+    e.run_rounds(4000)
+    assert _max_err(e) < 1e-3
+
+
+def test_link_failure_then_restore_collectall():
+    topo = ring(16, k=2, seed=0)
+    cfg = RoundConfig.reference(variant="collectall", delay_depth=2)
+    e = Engine(config=cfg).set_topology(topo).build()
+    bad = [(0, 1), (4, 5), (8, 9)]
+    e.fail_links(bad)
+    e.run_rounds(300)
+    assert np.all(np.isfinite(e.estimates()))
+    e.restore_links(bad)
+    e.run_rounds(1200)
+    assert _max_err(e) < 1e-3
+    rep = convergence_report(e.state, e._topo_arrays, topo.true_mean)
+    # quiescent + healed: antisymmetry restored on the once-failed links
+    assert rep["antisymmetry_residual"] < 1e-3
+
+
+def test_failed_link_excluded_from_fast_pairwise_matching():
+    """Direct-exchange pairwise: a failed link simply never matches; the
+    rest of the (still connected) graph converges to the true mean, and
+    mass is conserved exactly every round."""
+    topo = ring(12, k=2, seed=3)
+    cfg = RoundConfig.fast(variant="pairwise")
+    arrays = topo.device_arrays(coloring=True)
+    state = init_state(topo, cfg)
+
+    keys = topo.src.astype(np.int64) * topo.num_nodes + topo.dst
+    dead = [(0, 1)]
+    ids = [int(np.searchsorted(keys, u * topo.num_nodes + v))
+           for (u, v) in dead for (u, v) in ((0, 1), (1, 0))]
+    state = state.replace(edge_ok=state.edge_ok.at[np.asarray(ids)].set(False))
+
+    total = float(np.sum(topo.values))
+    for _ in range(8):
+        state = run_rounds(state, arrays, cfg, 25)
+        est = np.asarray(node_estimates(state, arrays))
+        np.testing.assert_allclose(est.sum(), total, rtol=1e-6)
+    assert np.max(np.abs(est - topo.true_mean)) < 1e-4
+
+
+def test_fail_links_by_name(small6):
+    platform, deployment = small6
+    cfg = RoundConfig.reference(variant="collectall", delay_depth=2)
+    e = Engine(config=cfg)
+    e.platform, e.deployment = platform, deployment
+    e.build()
+    e.fail_links([("Lisboa", "Porto")])
+    e.run_rounds(600)
+    e.restore_links([("Lisboa", "Porto")])
+    e.run_rounds(600)
+    assert _max_err(e) < 1e-3
+
+
+def test_unknown_link_rejected():
+    topo = ring(8, seed=0)
+    e = Engine(config=RoundConfig.fast()).set_topology(topo).build()
+    try:
+        e.fail_links([(0, 4)])  # not an edge in ring(k=1)
+    except ValueError as err:
+        assert "no edge" in str(err)
+    else:
+        raise AssertionError("expected ValueError for missing edge")
