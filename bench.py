@@ -38,38 +38,71 @@ def build_topology(k: int):
     return fat_tree(k, seed=0)
 
 
-def measure_tpu(topo, rounds: int) -> dict:
+def measure_tpu(topo, rounds: int, kernel: str = "node") -> dict:
+    """Time the fast synchronous collect-all kernel.
+
+    Timing notes: under the axon TPU tunnel, ``jax.block_until_ready`` can
+    return before remote execution finishes, so completion is forced with a
+    device->host read; and each executable launch carries a large fixed
+    tunnel round-trip, so the per-round cost is the *difference* between a
+    2R-round and an R-round scan divided by R (launch overhead cancels).
+    """
     import jax
+    import numpy as np
 
     from flow_updating_tpu.models.config import RoundConfig
-    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
-    from flow_updating_tpu.models.state import init_state
     from flow_updating_tpu.utils.metrics import rmse
 
     cfg = RoundConfig.fast(variant="collectall")
-    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
-    state = init_state(topo, cfg)
 
-    # Compile + warm (jit keyed on static (cfg, rounds): same call again is
-    # pure execution).
+    if kernel == "node":
+        from flow_updating_tpu.models import sync
+
+        k = sync.NodeKernel(topo, cfg)
+        state = k.init_state()
+
+        def run(r):
+            out = k.run(state, r)
+            np.asarray(out.S[:2])  # force completion through the tunnel
+            return out
+
+        read_est = k.estimates
+    else:
+        from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+        from flow_updating_tpu.models.state import init_state
+
+        arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+        state = init_state(topo, cfg)
+
+        def run(r):
+            out = run_rounds(state, arrays, cfg, r)
+            np.asarray(out.flow[:2])
+            return out
+
+        read_est = lambda out: np.asarray(node_estimates(out, arrays))
+
     t0 = time.perf_counter()
-    out = run_rounds(state, arrays, cfg, rounds)
-    jax.block_until_ready(out)
+    out = run(rounds)
     compile_s = time.perf_counter() - t0
+    run(2 * rounds)  # compile the 2R program too
 
     t0 = time.perf_counter()
-    out = run_rounds(state, arrays, cfg, rounds)
-    jax.block_until_ready(out)
-    run_s = time.perf_counter() - t0
+    out = run(rounds)
+    t_r = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out2 = run(2 * rounds)
+    t_2r = time.perf_counter() - t0
+    per_round = max((t_2r - t_r) / rounds, 1e-9)
 
-    est = node_estimates(out, arrays)
-    err = float(rmse(est, topo.true_mean))
+    err = float(rmse(read_est(out2), topo.true_mean))
     return {
-        "rounds_per_sec": rounds / run_s,
-        "run_s": run_s,
+        "rounds_per_sec": 1.0 / per_round,
+        "per_round_s": per_round,
+        "launch_overhead_s": max(t_r - rounds * per_round, 0.0),
         "compile_s": compile_s,
-        "rounds": rounds,
+        "rounds": 2 * rounds,
         "rmse_after": err,
+        "kernel": kernel,
         "device": str(jax.devices()[0]),
     }
 
@@ -122,6 +155,9 @@ def main():
                     help="fat-tree arity (160 -> ~1.056M vertices)")
     ap.add_argument("--rounds", type=int, default=512,
                     help="timed TPU rounds")
+    ap.add_argument("--kernel", default="node", choices=("node", "edge"),
+                    help="fast-path kernel: node-collapsed SpMV recurrence "
+                         "(models/sync.py) or the general edge kernel")
     ap.add_argument("--des-ticks", type=int, default=2,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--skip-des", action="store_true",
@@ -131,7 +167,7 @@ def main():
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 
-    tpu = measure_tpu(topo, args.rounds)
+    tpu = measure_tpu(topo, args.rounds, kernel=args.kernel)
 
     des = None if args.skip_des else measure_des_baseline(topo, args.des_ticks)
     if des is not None:

@@ -115,7 +115,8 @@ def _make_config(args):
 
     maker = (RoundConfig.reference if args.fire_policy == "reference"
              else RoundConfig.fast)
-    kw = dict(variant=args.variant, drop_rate=args.drop_rate)
+    kw = dict(variant=args.variant, drop_rate=args.drop_rate,
+              kernel=getattr(args, "kernel", "edge"))
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
@@ -129,10 +130,19 @@ def cmd_run(args) -> int:
     _select_backend(args.backend)
 
     from flow_updating_tpu.engine import Engine
-    from flow_updating_tpu.utils.metrics import convergence_report
 
+    if args.stream and args.kernel == "node":
+        raise SystemExit(
+            "--stream needs the edge kernel; with --kernel node use the "
+            "default watcher sampling (drop --stream)"
+        )
     cfg = _make_config(args)
-    engine = Engine(config=cfg)
+    mesh = None
+    if args.shards:
+        from flow_updating_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.shards)
+    engine = Engine(config=cfg, mesh=mesh)
     engine.set_topology(_build_topology(args))
     if args.resume:
         # restore allocates no fresh state; the checkpoint's config governs
@@ -194,9 +204,7 @@ def cmd_run(args) -> int:
             jax.block_until_ready(engine.state)
         jax.effects_barrier()
 
-    report = convergence_report(
-        engine.state, engine._topo_arrays, engine.topology.true_mean
-    )
+    report = engine.convergence_report()
     report["true_mean"] = engine.topology.true_mean
     report["nodes"] = engine.topology.num_nodes
     report["edges"] = engine.topology.num_edges
@@ -271,6 +279,13 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=("reference", "every_round"),
                      help="'reference' = faithful async dynamics; "
                           "'every_round' = fast synchronous mode")
+    run.add_argument("--shards", type=int, default=0,
+                     help="shard the node axis over N devices (GSPMD over a "
+                          "jax Mesh; 0 = single device)")
+    run.add_argument("--kernel", default="edge", choices=("edge", "node"),
+                     help="'edge' = general per-edge kernel; 'node' = "
+                          "collapsed SpMV recurrence (fast synchronous "
+                          "collect-all only, the throughput path)")
     run.add_argument("--drain", type=int, default=None,
                      help="msgs processed per node per round (0=unbounded; "
                           "reference semantics: 1)")

@@ -64,12 +64,16 @@ class _NetzoneShim:
 class Engine:
     """Driver for one simulation/aggregation run."""
 
-    def __init__(self, argv=None, config: RoundConfig | None = None):
+    def __init__(self, argv=None, config: RoundConfig | None = None,
+                 mesh=None):
         # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
         # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
-        # accepts a ready RoundConfig here.
+        # accepts a ready RoundConfig here.  ``mesh`` (a jax.sharding.Mesh
+        # over the 'nodes' axis) turns on multi-chip GSPMD execution: the
+        # node axis is sharded and XLA places the cross-shard collectives.
         self.argv = list(argv) if argv else []
         self.config = config or RoundConfig.fast()
+        self.mesh = mesh
         self.platform: Platform | None = None
         self.deployment: Deployment | None = None
         self.topology: Topology | None = None
@@ -78,6 +82,7 @@ class Engine:
         self._watchers: list = []
         self._clock = 0.0
         self._killed = False
+        self._n_real: int | None = None   # real node count when mesh-padded
         self.netzone_root = _NetzoneShim(self)
 
     # ---- setup -----------------------------------------------------------
@@ -118,19 +123,50 @@ class Engine:
             )
         return self
 
-    def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
-        """Resolve deployment(+platform) into topology + fresh state."""
-        self._resolve_topology(latency_scale)
+    def _prepare_arrays(self, latency_scale: float = 0.0) -> None:
+        """Device arrays for the configured kernel (no fresh state)."""
+        if self.config.kernel == "node":
+            from flow_updating_tpu.models import sync
+
+            self._node_kernel = sync.NodeKernel(
+                self.topology, self.config, mesh=self.mesh
+            )
+            self._topo_arrays = None
+            return
         if latency_scale > 0.0:
             depth = max(self.config.delay_depth, self.topology.max_delay)
             if depth != self.config.delay_depth:
                 import dataclasses
 
                 self.config = dataclasses.replace(self.config, delay_depth=depth)
-        self._topo_arrays = self.topology.device_arrays(
-            coloring=self.config.needs_coloring
-        )
-        self.state = init_state(self.topology, self.config, seed=seed)
+        if self.mesh is not None:
+            from flow_updating_tpu.parallel import auto
+
+            padded, self._n_real, _ = auto.pad_topology(
+                self.topology, self.mesh.devices.size
+            )
+            self._padded_topology = padded
+            self._topo_arrays = None  # built with the state in build()
+        else:
+            self._topo_arrays = self.topology.device_arrays(
+                coloring=self.config.needs_coloring
+            )
+
+    def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
+        """Resolve deployment(+platform) into topology + fresh state."""
+        self._resolve_topology(latency_scale)
+        self._prepare_arrays(latency_scale)
+        if self.config.kernel == "node":
+            self.state = self._node_kernel.init_state()
+        elif self.mesh is not None:
+            from flow_updating_tpu.parallel import auto
+
+            self.state, self._topo_arrays = auto.init_sharded_state(
+                self._padded_topology, self.config, self._n_real,
+                self.mesh, seed=seed,
+            )
+        else:
+            self.state = init_state(self.topology, self.config, seed=seed)
         return self
 
     # ---- observability ---------------------------------------------------
@@ -157,8 +193,13 @@ class Engine:
         names = self.topology.names or tuple(
             str(i) for i in range(self.topology.num_nodes)
         )
-        value = np.asarray(self.state.value)
-        last_avg = np.asarray(self.state.last_avg)
+        if self.config.kernel == "node":
+            value = self.topology.values
+            last_avg = self._node_kernel.last_avg(self.state)
+        else:
+            n = self._n_real or self.topology.num_nodes
+            value = np.asarray(self.state.value)[:n]
+            last_avg = np.asarray(self.state.last_avg)[:n]
         return {
             "value": dict(zip(names, value.tolist())),
             "last_avg": dict(zip(names, last_avg.tolist())),
@@ -167,9 +208,36 @@ class Engine:
     def estimates(self) -> np.ndarray:
         if self.state is None:
             raise RuntimeError("engine not built")
-        return np.asarray(node_estimates(self.state, self._topo_arrays))
+        if self.config.kernel == "node":
+            return self._node_kernel.estimates(self.state)
+        est = np.asarray(node_estimates(self.state, self._topo_arrays))
+        return est[: self._n_real] if self._n_real is not None else est
+
+    def convergence_report(self) -> dict:
+        """Convergence + invariant metrics for the current state."""
+        est = self.estimates()
+        err = est - self.topology.true_mean
+        report = {
+            "t": int(self.state.t),
+            "rmse": float(np.sqrt(np.mean(err * err))),
+            "max_abs_err": float(np.max(np.abs(err))),
+            "mass_residual": float(est.sum() - self.topology.values.sum()),
+        }
+        if self.config.kernel == "edge":
+            flow = np.asarray(self.state.flow)[: self.topology.num_edges]
+            report["antisymmetry_residual"] = float(
+                np.max(np.abs(flow + flow[self.topology.rev]))
+            )
+        return report
 
     # ---- fault injection (SURVEY.md §5) ---------------------------------
+    def _require_edge_kernel(self, what: str) -> None:
+        if self.config.kernel != "edge":
+            raise ValueError(
+                f"{what} needs per-edge state; the node-collapsed kernel is "
+                "exactly the fault-free fast path — use kernel='edge'"
+            )
+
     def _node_ids(self, nodes) -> np.ndarray:
         name_to_id = None
         ids = []
@@ -189,6 +257,7 @@ class Engine:
         exchange makes the whole sequence self-healing (the fault model the
         Flow-Updating paper targets; the reference only exercises it through
         message loss, SURVEY.md §5)."""
+        self._require_edge_kernel("kill_nodes")
         if self.state is None:
             raise RuntimeError("engine not built")
         ids = self._node_ids(nodes)
@@ -198,6 +267,7 @@ class Engine:
         return self
 
     def revive_nodes(self, nodes) -> "Engine":
+        self._require_edge_kernel("revive_nodes")
         if self.state is None:
             raise RuntimeError("engine not built")
         ids = self._node_ids(nodes)
@@ -226,6 +296,7 @@ class Engine:
         every message put on them is lost, in both directions, until
         :meth:`restore_links`.  Senders' ledgers still update — the exact
         semantics of a lost ``put_async``."""
+        self._require_edge_kernel("fail_links")
         if self.state is None:
             raise RuntimeError("engine not built")
         ids = self._edge_ids(links)
@@ -235,6 +306,7 @@ class Engine:
         return self
 
     def restore_links(self, links) -> "Engine":
+        self._require_edge_kernel("restore_links")
         if self.state is None:
             raise RuntimeError("engine not built")
         ids = self._edge_ids(links)
@@ -267,20 +339,62 @@ class Engine:
         self._resolve_topology()
         state, cfg, extra = load_checkpoint(path, topo=self.topology)
         self.config = cfg
-        self._topo_arrays = self.topology.device_arrays(
-            coloring=cfg.needs_coloring
-        )
+        self._prepare_arrays()
+        if self.config.kernel == "edge" and self.mesh is not None:
+            from flow_updating_tpu.parallel import auto
+
+            self._topo_arrays = self._padded_topology.device_arrays(
+                coloring=cfg.needs_coloring
+            )
+            import jax
+
+            self._topo_arrays = jax.device_put(
+                self._topo_arrays,
+                auto.topo_sharding(self.mesh, self._topo_arrays),
+            )
+        expect = (self._node_kernel.padded_size if cfg.kernel == "node"
+                  else (self._padded_topology.num_nodes
+                        if self.mesh is not None else self.topology.num_nodes))
+        got = state.S.shape[0] if cfg.kernel == "node" else state.value.shape[0]
+        if got != expect:
+            raise ValueError(
+                f"checkpoint state has node axis {got} but this engine's "
+                f"layout expects {expect} — restore with the same "
+                "mesh/padding it was saved under"
+            )
+        if self.mesh is not None:
+            if cfg.kernel == "node":
+                # NodeKernel.init_state carries the placement; reuse it
+                template = self._node_kernel.init_state()
+                import jax
+
+                state = jax.device_put(
+                    state, jax.tree.map(lambda x: x.sharding, template)
+                )
+            else:
+                from flow_updating_tpu.parallel import auto
+
+                state = auto.shard_state(state, self.mesh)
         self.state = state
         self._clock = float(extra.get("clock", float(state.t)))
         self._killed = bool(extra.get("killed", False))
         return self
 
     # ---- execution -------------------------------------------------------
+    def _advance(self, n: int) -> None:
+        """Dispatch ``n`` compiled rounds to the configured kernel."""
+        if self.config.kernel == "node":
+            self.state = self._node_kernel.run(self.state, n)
+        else:
+            self.state = run_rounds(
+                self.state, self._topo_arrays, self.config, n
+            )
+
     def run_rounds(self, n: int) -> "Engine":
         if self.state is None:
             self.build()
         if not self._killed and n > 0:
-            self.state = run_rounds(self.state, self._topo_arrays, self.config, n)
+            self._advance(n)
         self._clock += n * TICK_INTERVAL
         return self
 
@@ -293,6 +407,12 @@ class Engine:
         ``emit(metrics_dict)`` defaults to an INFO log line."""
         if self.state is None:
             self.build()
+        if self.config.kernel == "node":
+            raise NotImplementedError(
+                "run_streamed is implemented for the edge kernel; with "
+                "kernel='node' use run_rounds/run_until (watcher sampling "
+                "between compiled chunks)"
+            )
         if emit is None:
             emit = _log_stream_sample  # stable identity -> jit cache reuse
         if not self._killed and n > 0:
@@ -328,9 +448,7 @@ class Engine:
                 break
             n = int(round((t_ev - self._clock) / TICK_INTERVAL))
             if n > 0 and not self._killed:
-                self.state = run_rounds(
-                    self.state, self._topo_arrays, self.config, n
-                )
+                self._advance(n)
             self._clock = t_ev
             for w in self._watchers:
                 hit_sample = (

@@ -52,6 +52,9 @@ class RoundConfig:
     delay_depth: int = 1               # ring buffer depth D (static)
     drop_rate: float = 0.0             # message loss probability
     dtype: str = "float32"             # ledger dtype
+    kernel: str = "edge"               # 'edge' (general) | 'node' (collapsed
+    #                                    SpMV recurrence; fast sync
+    #                                    collect-all only, models/sync.py)
 
     def __post_init__(self):
         if self.variant not in (COLLECTALL, PAIRWISE):
@@ -62,6 +65,17 @@ class RoundConfig:
             raise ValueError("delay_depth must be >= 1")
         if self.drain < 0:
             raise ValueError("drain must be >= 0 (0 = unbounded)")
+        if self.kernel not in ("edge", "node"):
+            raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.kernel == "node" and (
+            self.variant != COLLECTALL or self.fire_policy != "every_round"
+            or self.delay_depth != 1 or self.drain != 0 or self.drop_rate > 0.0
+        ):
+            raise ValueError(
+                "kernel='node' covers exactly the fast synchronous "
+                "collect-all mode (every_round, drain=0, delay_depth=1, no "
+                "message drop); use kernel='edge' otherwise"
+            )
 
     @property
     def jnp_dtype(self):

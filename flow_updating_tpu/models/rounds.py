@@ -336,17 +336,25 @@ def run_rounds_observed(
 
 def _observe_chunk(s, topo, cfg, observe_every: int, mean):
     """``observe_every`` rounds + one watcher sample (shared by the stacked
-    and streamed observers)."""
+    and streamed observers).
+
+    Metrics cover *alive* nodes only — this excludes both mesh-padding
+    dummies (born dead, see ``parallel.auto.pad_topology``) and
+    crash-stopped nodes, whose frozen estimates would otherwise put a
+    floor under the reported rmse.
+    """
     s = jax.lax.fori_loop(
         0, observe_every, lambda _, x: round_step(x, topo, cfg), s
     )
     est = node_estimates(s, topo)
-    err = est - mean
+    alive = s.alive
+    cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
+    err = jnp.where(alive, est - mean, 0)
     sample = (
         s.t,
-        jnp.sqrt(jnp.mean(err * err)),
+        jnp.sqrt(jnp.sum(err * err) / cnt),
         jnp.max(jnp.abs(err)),
-        jnp.sum(est),
+        jnp.sum(jnp.where(alive, est, 0)),
         jnp.sum(s.fired),
     )
     return s, sample

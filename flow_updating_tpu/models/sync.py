@@ -1,0 +1,230 @@
+"""Node-collapsed kernel for the fast synchronous collect-all mode.
+
+In the fast mode (``fire_policy='every_round'``, unit delay, unbounded
+drain, no faults) every message is sent and delivered every round, so the
+per-edge ledgers are *determined* by the node history: at fire time of
+round r,
+
+    est[u->v] = avg_{r-1}[v]
+    flow_{r+1}[u->v] = -(flow_r[v->u] + avg_r[v] - avg_{r-1}[u])
+
+Summing over each node's out-edges collapses the whole edge state into four
+per-node vectors — S (sum of own out-flows), G (sum of flows the neighbors
+hold toward the node), avg and its neighbor sum A(avg)[u] = sum_{v in N(u)}
+avg[v] — with the recurrence
+
+    avg_r   = (value - S_r + A(avg_{r-1})) / (deg + 1)
+    S_{r+1} = -G_r - A(avg_r) + deg * avg_{r-1}
+    G_{r+1} = -S_r - deg * avg_r + A(avg_{r-1})
+
+(initial conditions S_0 = G_0 = 0, avg_{-1} = 0, matching zero-initialized
+ledgers, reference ``flowupdating-collectall.py:33-34``).  The only graph
+operation left is the neighbor sum A — one adjacency SpMV per round.  This
+is the TPU-first replacement for the reference's whole message machinery on
+the throughput path: the DES mailbox dance (SURVEY.md N2/N4) becomes a
+scatter-free SpMV recurrence in O(N) state.
+
+The SpMV uses the degree-bucketed ELL layout (:meth:`Topology.ell_buckets`):
+all node vectors live in ascending-degree permuted order, each bucket does
+one dense gather + row reduction, results concatenate back — no scatters,
+no segment ops, no (E,) intermediates beyond the gather itself.
+
+Equivalence with the general edge kernel (`models/rounds.py`, same config)
+is asserted in tests/test_sync.py to float tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flax.struct
+
+from flow_updating_tpu.models.config import COLLECTALL, RoundConfig
+from flow_updating_tpu.topology.graph import Topology
+
+
+@flax.struct.dataclass
+class NodeSyncState:
+    """Per-node state, stored in the ELL permutation's node order."""
+
+    t: jnp.ndarray         # () int32
+    S: jnp.ndarray         # (N,) sum of own out-edge flows
+    G: jnp.ndarray         # (N,) sum of neighbors' flows toward the node
+    avg_prev: jnp.ndarray  # (N,) avg_{r-1}
+    A_prev: jnp.ndarray    # (N,) neighbor sum of avg_{r-1}
+
+
+@flax.struct.dataclass
+class NodeSyncArrays:
+    """Device-side constants for the node-collapsed round."""
+
+    value: jnp.ndarray     # (N,) initial values (permuted order)
+    inv_depp1: jnp.ndarray  # (N,) 1 / (deg + 1)
+    deg: jnp.ndarray       # (N,) float degree
+    mats: tuple            # per-bucket (rows, width) int32 neighbor matrices
+
+
+def _check_cfg(cfg: RoundConfig) -> None:
+    if (cfg.variant != COLLECTALL or cfg.fire_policy != "every_round"
+            or cfg.delay_depth != 1 or cfg.drain != 0 or cfg.drop_rate > 0.0):
+        raise ValueError(
+            "the node-collapsed kernel covers exactly the fast synchronous "
+            "collect-all mode (every_round, drain=0, delay_depth=1, no "
+            "message drop); use the edge kernel (models.rounds) otherwise"
+        )
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+class NodeKernel:
+    """Bundled node-collapsed fast kernel for one topology.
+
+    ``row_multiple > 1`` pads every degree bucket's row count (and hence
+    every per-node vector) to that multiple, so the whole computation
+    shards evenly over a ``row_multiple``-device mesh: pass ``mesh`` to
+    place arrays with :class:`~jax.sharding.NamedSharding` over the node
+    axis — the per-round neighbor gather then compiles to one all-gather
+    of the avg vector over ICI (4 bytes/node/round, independent of E).
+    Padded rows have value 0, no neighbors, and nothing references them.
+    """
+
+    def __init__(self, topo: Topology, cfg: RoundConfig,
+                 row_multiple: int = 1, mesh=None):
+        _check_cfg(cfg)
+        self.topo = topo
+        self.cfg = cfg
+        if mesh is not None:
+            row_multiple = max(row_multiple, mesh.devices.size)
+        self.row_multiple = row_multiple
+        self.mesh = mesh
+        ell = topo.ell_buckets()
+        dt = cfg.jnp_dtype
+
+        counts = [_ceil_to(c, row_multiple) for c in ell.row_counts]
+        offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        self.padded_size = M = int(offs[-1])
+        # padded position of each permuted-real row
+        pos = np.concatenate([
+            offs[b] + np.arange(c, dtype=np.int64)
+            for b, c in enumerate(ell.row_counts)
+        ]) if ell.row_counts else np.zeros((0,), np.int64)
+        self._pos_of_real = pos          # (N,) permuted-real -> padded slot
+        self._perm = ell.perm            # (N,) permuted-real -> original id
+
+        value = np.zeros(M, np.float64)
+        deg = np.zeros(M, np.float64)
+        value[pos] = topo.values[ell.perm]
+        deg[pos] = topo.out_deg[ell.perm]
+
+        mats = []
+        for b, m in enumerate(ell.mats):
+            rows = counts[b]
+            w = m.shape[1]
+            mat = np.full((rows, w), M, np.int32)  # M -> zero slot
+            if m.size:
+                # remap neighbor indices from permuted-real to padded slots
+                mat[: m.shape[0]] = np.where(
+                    m < topo.num_nodes, pos[np.minimum(m, topo.num_nodes - 1)],
+                    M,
+                ).astype(np.int32)
+            mats.append(mat)
+
+        self.arrays = NodeSyncArrays(
+            value=jnp.asarray(value, dt),
+            inv_depp1=jnp.asarray(1.0 / (deg + 1.0), dt),
+            deg=jnp.asarray(deg, dt),
+            mats=tuple(jnp.asarray(m) for m in mats),
+        )
+        if mesh is not None:
+            import jax.sharding as jsh
+
+            ns = lambda spec: jsh.NamedSharding(mesh, spec)
+            from flow_updating_tpu.parallel.mesh import NODE_AXIS
+
+            ax = jsh.PartitionSpec(NODE_AXIS)
+            arrs_sh = NodeSyncArrays(
+                value=ns(ax), inv_depp1=ns(ax), deg=ns(ax),
+                mats=tuple(ns(jsh.PartitionSpec(NODE_AXIS, None))
+                           for _ in self.arrays.mats),
+            )
+            self.arrays = jax.device_put(self.arrays, arrs_sh)
+
+    def init_state(self) -> NodeSyncState:
+        z = jnp.zeros((self.padded_size,), self.cfg.jnp_dtype)
+        state = NodeSyncState(t=jnp.zeros((), jnp.int32), S=z, G=z,
+                              avg_prev=z, A_prev=z)
+        if self.mesh is not None:
+            import jax.sharding as jsh
+
+            from flow_updating_tpu.parallel.mesh import NODE_AXIS
+
+            ns = lambda spec: jsh.NamedSharding(self.mesh, spec)
+            ax = jsh.PartitionSpec(NODE_AXIS)
+            state = jax.device_put(
+                state,
+                NodeSyncState(t=ns(jsh.PartitionSpec()), S=ns(ax), G=ns(ax),
+                              avg_prev=ns(ax), A_prev=ns(ax)),
+            )
+        return state
+
+    def run(self, state: NodeSyncState, num_rounds: int) -> NodeSyncState:
+        return run_rounds_node(state, self.arrays, self.cfg, num_rounds)
+
+    def _unpermute(self, padded: np.ndarray) -> np.ndarray:
+        out = np.empty(self.topo.num_nodes, padded.dtype)
+        out[self._perm] = padded[self._pos_of_real]
+        return out
+
+    def estimates(self, state: NodeSyncState) -> np.ndarray:
+        """Per-node estimates in original node order (edge-kernel readback
+        convention: ``sum_out flow2_{r-1}[u] == -G_r[u]``, see module doc)."""
+        return self._unpermute(np.asarray(self.arrays.value + state.G))
+
+    def last_avg(self, state: NodeSyncState) -> np.ndarray:
+        return self._unpermute(np.asarray(state.avg_prev))
+
+
+
+
+def neighbor_sum(x: jnp.ndarray, mats: tuple) -> jnp.ndarray:
+    """A(x)[u] = sum of x over u's neighbors — bucketed gather + row sums."""
+    xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
+    parts = []
+    for m in mats:
+        if m.shape[1] == 0:
+            parts.append(jnp.zeros((m.shape[0],), x.dtype))
+        else:
+            parts.append(jnp.sum(xp[m], axis=1))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def node_round_step(
+    state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig
+) -> NodeSyncState:
+    avg = (arrs.value - state.S + state.A_prev) * arrs.inv_depp1
+    A_cur = neighbor_sum(avg, arrs.mats)
+    S_next = -state.G - A_cur + arrs.deg * state.avg_prev
+    G_next = -state.S - arrs.deg * avg + state.A_prev
+    return NodeSyncState(
+        t=state.t + 1, S=S_next, G=G_next, avg_prev=avg, A_prev=A_cur
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
+def run_rounds_node(
+    state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig,
+    num_rounds: int,
+) -> NodeSyncState:
+    def body(s, _):
+        return node_round_step(s, arrs, cfg), None
+
+    state, _ = jax.lax.scan(body, state, None, length=num_rounds)
+    return state
+
+

@@ -117,6 +117,61 @@ class Topology:
         object.__setattr__(self, "_edge_coloring", (full, c))
         return full, c
 
+    def ell_buckets(self) -> "EllBuckets":
+        """Degree-bucketed ELL adjacency for scatter-free neighbor sums.
+
+        Nodes are permuted into ascending-degree order and grouped into
+        buckets whose padded width is the next power of two of their degree;
+        each bucket stores a dense ``(rows, width)`` neighbor-index matrix
+        (indices in *permuted* node space, padded with N → a zero slot).
+        A neighbor sum then needs only per-bucket gathers + row reductions
+        and one concatenate — no scatter, no segment ops.  This is the
+        TPU answer to SURVEY.md §7's hard part (a): degree-skewed
+        scatter/gather without serializing scatters.
+
+        Cached after first computation.
+        """
+        cached = getattr(self, "_ell_buckets", None)
+        if cached is not None:
+            return cached
+        N = self.num_nodes
+        deg = self.out_deg.astype(np.int64)
+        width = np.zeros(N, np.int64)
+        nz = deg > 0
+        width[nz] = 1 << np.ceil(np.log2(deg[nz])).astype(np.int64)
+        order = np.argsort(width, kind="stable").astype(np.int32)
+        inv = np.empty(N, np.int32)
+        inv[order] = np.arange(N, dtype=np.int32)
+
+        mats = []
+        row_counts = []
+        widths = []
+        start = 0
+        sorted_w = width[order]
+        while start < N:
+            w = sorted_w[start]
+            end = int(np.searchsorted(sorted_w, w, side="right"))
+            rows = order[start:end]
+            if w == 0:
+                mat = np.empty((len(rows), 0), np.int32)
+            else:
+                lo = self.row_start[rows]
+                d = deg[rows]
+                ar = np.arange(int(w), dtype=np.int64)
+                valid = ar[None, :] < d[:, None]
+                col = np.where(valid, lo[:, None] + ar[None, :], 0)
+                mat = np.where(valid, inv[self.dst[col]], N).astype(np.int32)
+            mats.append(mat)
+            row_counts.append(len(rows))
+            widths.append(int(w))
+            start = end
+        out = EllBuckets(
+            perm=order, inv_perm=inv, widths=tuple(widths),
+            row_counts=tuple(row_counts), mats=tuple(mats),
+        )
+        object.__setattr__(self, "_ell_buckets", out)
+        return out
+
     def name_to_id(self) -> dict:
         if self.names is None:
             raise ValueError("topology has no node names")
@@ -155,6 +210,23 @@ class Topology:
         if values.shape != (self.num_nodes,):
             raise ValueError(f"values must have shape ({self.num_nodes},)")
         return dataclasses.replace(self, values=values)
+
+
+@dataclasses.dataclass(frozen=True)
+class EllBuckets:
+    """Degree-bucketed ELL adjacency (host-side; see Topology.ell_buckets).
+
+    ``perm`` maps permuted position -> original node id; bucket ``b`` covers
+    permuted rows ``[sum(row_counts[:b]), sum(row_counts[:b+1]))`` with a
+    dense ``(row_counts[b], widths[b])`` neighbor matrix in permuted space,
+    padded with N.
+    """
+
+    perm: np.ndarray        # (N,) int32
+    inv_perm: np.ndarray    # (N,) int32
+    widths: tuple           # per-bucket padded width
+    row_counts: tuple       # per-bucket row count
+    mats: tuple             # per-bucket (rows, width) int32 matrices
 
 
 import flax.struct  # noqa: E402  (kept close to its sole consumer)

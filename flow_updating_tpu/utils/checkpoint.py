@@ -34,6 +34,15 @@ from flow_updating_tpu.models.state import FlowUpdatingState
 FORMAT_VERSION = 1
 
 
+def _state_classes() -> dict:
+    from flow_updating_tpu.models.sync import NodeSyncState
+
+    return {
+        "FlowUpdatingState": FlowUpdatingState,
+        "NodeSyncState": NodeSyncState,
+    }
+
+
 def topology_fingerprint(topo) -> dict:
     """Cheap content digest binding a checkpoint to its graph."""
     h = hashlib.sha256()
@@ -61,6 +70,7 @@ def save_checkpoint(
         arrays[f"state.{name}"] = np.asarray(jax.device_get(leaf))
     manifest = {
         "format_version": FORMAT_VERSION,
+        "state_class": type(state).__name__,
         "config": dataclasses.asdict(cfg),
         "topology": topology_fingerprint(topo) if topo is not None else None,
         "extra": extra or {},
@@ -95,7 +105,12 @@ def load_checkpoint(
         for key in z.files:
             if key.startswith("state."):
                 fields[key[len("state."):]] = z[key]
-    want = set(FlowUpdatingState.__dataclass_fields__)
+    cls_name = manifest.get("state_class", "FlowUpdatingState")
+    classes = _state_classes()
+    if cls_name not in classes:
+        raise ValueError(f"unknown checkpoint state class {cls_name!r}")
+    state_cls = classes[cls_name]
+    want = set(state_cls.__dataclass_fields__)
     have = set(fields)
     if have != want:
         raise ValueError(
@@ -113,5 +128,5 @@ def load_checkpoint(
                 f"{'match' if fp['digest'] == manifest['topology']['digest'] else 'differ'})"
             )
     cfg = RoundConfig(**manifest["config"])
-    state = FlowUpdatingState(**fields)
+    state = state_cls(**fields)
     return state, cfg, manifest.get("extra", {})

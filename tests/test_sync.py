@@ -1,0 +1,176 @@
+"""Node-collapsed fast kernel vs the general edge kernel.
+
+The collapse in models/sync.py is an exact algebraic identity for the fast
+synchronous collect-all mode; these tests assert the two kernels produce
+the same estimate trajectory to float tolerance on diverse graphs
+(including the degree-skewed BA case, SURVEY.md §7 hard part (a)).
+"""
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.models import sync
+from flow_updating_tpu.topology.generators import (
+    barabasi_albert,
+    erdos_renyi,
+    fat_tree,
+    ring,
+)
+
+GRAPHS = [
+    ("ring", lambda: ring(33, k=2, seed=0)),
+    ("er", lambda: erdos_renyi(200, avg_degree=6.0, seed=1)),
+    ("ba", lambda: barabasi_albert(300, m=3, seed=2)),
+    ("fat_tree", lambda: fat_tree(4, seed=0)),
+]
+
+
+@pytest.mark.parametrize("name,make", GRAPHS)
+@pytest.mark.parametrize("rounds", [1, 2, 7, 60])
+def test_matches_edge_kernel(name, make, rounds):
+    topo = make()
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+
+    e_state = init_state(topo, cfg)
+    e_arrays = topo.device_arrays()
+    e_out = run_rounds(e_state, e_arrays, cfg, rounds)
+    e_est = np.asarray(node_estimates(e_out, e_arrays))
+
+    k = sync.NodeKernel(topo, cfg)
+    n_out = k.run(k.init_state(), rounds)
+    n_est = k.estimates(n_out)
+
+    np.testing.assert_allclose(n_est, e_est, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(
+        k.last_avg(n_out), np.asarray(e_out.last_avg), rtol=1e-9, atol=1e-9,
+    )
+
+
+def test_converges_to_true_mean():
+    topo = erdos_renyi(500, avg_degree=8.0, seed=3)
+    cfg = RoundConfig.fast(variant="collectall")
+    k = sync.NodeKernel(topo, cfg)
+    out = k.run(k.init_state(), 300)
+    est = k.estimates(out)
+    assert np.max(np.abs(est - topo.true_mean)) < 1e-4
+
+
+def test_rejects_non_fast_configs():
+    topo = ring(8, seed=0)
+    for bad in [
+        RoundConfig.reference(variant="collectall"),
+        RoundConfig.fast(variant="pairwise"),
+        RoundConfig.fast(variant="collectall", drop_rate=0.1),
+        RoundConfig.fast(variant="collectall", delay_depth=2),
+    ]:
+        with pytest.raises(ValueError, match="node-collapsed|kernel"):
+            sync.NodeKernel(topo, bad)
+
+
+def test_ell_buckets_cover_all_edges():
+    topo = barabasi_albert(150, m=4, seed=5)
+    ell = topo.ell_buckets()
+    assert sum(ell.row_counts) == topo.num_nodes
+    # each node's real neighbors appear exactly once, padding is N
+    total_real = sum(int((m < topo.num_nodes).sum()) for m in ell.mats)
+    assert total_real == topo.num_edges
+    # neighbor sum of ones == degree
+    import jax.numpy as jnp
+
+    ones = jnp.ones((topo.num_nodes,))
+    mats = tuple(jnp.asarray(m) for m in ell.mats)
+    ns = np.asarray(sync.neighbor_sum(ones, mats))
+    np.testing.assert_array_equal(ns, topo.out_deg[ell.perm])
+
+
+def test_engine_node_kernel_end_to_end(tmp_path):
+    topo = erdos_renyi(128, avg_degree=6.0, seed=7)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node")
+    from flow_updating_tpu.engine import Engine
+
+    e = Engine(config=cfg).set_topology(topo).build()
+    e.run_rounds(150)
+    rep = e.convergence_report()
+    assert rep["rmse"] < 1e-4
+    gv = e.global_values()
+    assert len(gv["last_avg"]) == topo.num_nodes
+
+    # checkpoint round-trips the node state class
+    path = str(tmp_path / "node.npz")
+    e.save_checkpoint(path)
+    e2 = Engine(config=RoundConfig.fast()).set_topology(topo)
+    e2.restore_checkpoint(path)
+    assert e2.config.kernel == "node"
+    e.run_rounds(50)
+    e2.run_rounds(50)
+    np.testing.assert_array_equal(e.estimates(), e2.estimates())
+
+    # fault APIs refuse (the collapse assumes the fault-free fast mode)
+    with pytest.raises(ValueError, match="per-edge state"):
+        e.kill_nodes([0])
+
+
+def test_cli_node_kernel(capsys, tmp_path):
+    from flow_updating_tpu.cli import main
+    import json
+
+    rc = main(["run", "--generator", "ring:64:2", "--rounds", "200",
+               "--fire-policy", "every_round", "--kernel", "node"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = json.loads(out)
+    assert rc == 0
+    assert rep["rmse"] < 1e-4
+    assert abs(rep["mass_residual"]) < 1e-3
+
+
+def test_node_kernel_sharded_matches(monkeypatch):
+    """GSPMD: padded NodeKernel on an 8-device mesh == single device."""
+    import jax
+
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = barabasi_albert(301, m=3, seed=2)  # odd N, uneven buckets
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    k1 = sync.NodeKernel(topo, cfg)
+    ref = k1.estimates(k1.run(k1.init_state(), 40))
+
+    mesh = make_mesh(8)
+    k8 = sync.NodeKernel(topo, cfg, mesh=mesh)
+    assert k8.padded_size % 8 == 0
+    out = k8.run(k8.init_state(), 40)
+    np.testing.assert_allclose(k8.estimates(out), ref, rtol=1e-12, atol=1e-12)
+
+
+def test_engine_mesh_edge_kernel_matches():
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = erdos_renyi(101, avg_degree=5.0, seed=9)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    a = Engine(config=cfg).set_topology(topo).build().run_rounds(30)
+    b = (Engine(config=cfg, mesh=make_mesh(8)).set_topology(topo)
+         .build().run_rounds(30))
+    np.testing.assert_allclose(b.estimates(), a.estimates(),
+                               rtol=1e-12, atol=1e-12)
+    assert len(b.global_values()["last_avg"]) == topo.num_nodes
+
+
+def test_engine_mesh_node_kernel_and_checkpoint(tmp_path):
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = erdos_renyi(96, avg_degree=4.0, seed=4)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node")
+    mesh = make_mesh(8)
+    e = Engine(config=cfg, mesh=mesh).set_topology(topo).build()
+    e.run_rounds(100)
+    path = str(tmp_path / "mesh.npz")
+    e.save_checkpoint(path)
+    e2 = Engine(config=cfg, mesh=mesh).set_topology(topo)
+    e2.restore_checkpoint(path)
+    e.run_rounds(20)
+    e2.run_rounds(20)
+    np.testing.assert_array_equal(e.estimates(), e2.estimates())
