@@ -19,6 +19,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from flow_updating_tpu import Engine, RoundConfig
+from flow_updating_tpu.cli import _select_backend
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
@@ -27,7 +28,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--until", type=float, default=1000.0)
     ap.add_argument("--observe-every", type=float, default=10.0)
+    ap.add_argument("--backend", default="cpu",
+                    choices=("auto", "cpu", "jax_tpu"),
+                    help="default cpu: a 6-node run needs no accelerator, "
+                         "and the ambient tunneled-TPU backend would make "
+                         "this example contend for the shared chip")
     args = ap.parse_args()
+    _select_backend(args.backend)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
 
     e = Engine(sys.argv,

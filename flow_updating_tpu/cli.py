@@ -123,7 +123,10 @@ def _make_config(args):
         kw["timeout"] = args.timeout
     if args.delay_depth is not None:
         kw["delay_depth"] = args.delay_depth
-    return maker(**kw)
+    try:
+        return maker(**kw)
+    except ValueError as err:
+        raise SystemExit(f"invalid flag combination: {err}")
 
 
 def cmd_run(args) -> int:
@@ -131,11 +134,6 @@ def cmd_run(args) -> int:
 
     from flow_updating_tpu.engine import Engine
 
-    if args.stream and args.kernel == "node":
-        raise SystemExit(
-            "--stream needs the edge kernel; with --kernel node use the "
-            "default watcher sampling (drop --stream)"
-        )
     cfg = _make_config(args)
     mesh = None
     if args.shards:
@@ -155,7 +153,10 @@ def cmd_run(args) -> int:
                 engine.config, cfg,
             )
     else:
-        engine.build(latency_scale=args.latency_scale, seed=args.seed)
+        try:
+            engine.build(latency_scale=args.latency_scale, seed=args.seed)
+        except ValueError as err:
+            raise SystemExit(f"invalid flag combination: {err}")
 
     from flow_updating_tpu.utils.eventlog import EventLog
     from flow_updating_tpu.utils.trace import trace

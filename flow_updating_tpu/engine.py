@@ -126,6 +126,12 @@ class Engine:
     def _prepare_arrays(self, latency_scale: float = 0.0) -> None:
         """Device arrays for the configured kernel (no fresh state)."""
         if self.config.kernel == "node":
+            if latency_scale > 0.0:
+                raise ValueError(
+                    "latency-warped rounds need per-edge delivery state; "
+                    "the node-collapsed kernel is unit-delay only — use "
+                    "kernel='edge' with latency_scale"
+                )
             from flow_updating_tpu.models import sync
 
             self._node_kernel = sync.NodeKernel(
@@ -407,19 +413,18 @@ class Engine:
         ``emit(metrics_dict)`` defaults to an INFO log line."""
         if self.state is None:
             self.build()
-        if self.config.kernel == "node":
-            raise NotImplementedError(
-                "run_streamed is implemented for the edge kernel; with "
-                "kernel='node' use run_rounds/run_until (watcher sampling "
-                "between compiled chunks)"
-            )
         if emit is None:
             emit = _log_stream_sample  # stable identity -> jit cache reuse
         if not self._killed and n > 0:
-            self.state = run_rounds_streamed(
-                self.state, self._topo_arrays, self.config, n,
-                observe_every, self.topology.true_mean, emit,
-            )
+            if self.config.kernel == "node":
+                self.state = self._node_kernel.run_streamed(
+                    self.state, n, observe_every, emit
+                )
+            else:
+                self.state = run_rounds_streamed(
+                    self.state, self._topo_arrays, self.config, n,
+                    observe_every, self.topology.true_mean, emit,
+                )
         self._clock += n * TICK_INTERVAL
         return self
 

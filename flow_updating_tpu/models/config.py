@@ -55,6 +55,12 @@ class RoundConfig:
     kernel: str = "edge"               # 'edge' (general) | 'node' (collapsed
     #                                    SpMV recurrence; fast sync
     #                                    collect-all only, models/sync.py)
+    delivery: str = "gather"           # single-device message delivery:
+    #                                    'gather' (receiver pulls through rev
+    #                                    — elementwise over (D, E), no
+    #                                    scatter) | 'scatter' (sender pushes;
+    #                                    2-D dynamic-index scatter, slow on
+    #                                    TPU).  Identical semantics.
 
     def __post_init__(self):
         if self.variant not in (COLLECTALL, PAIRWISE):
@@ -67,15 +73,24 @@ class RoundConfig:
             raise ValueError("drain must be >= 0 (0 = unbounded)")
         if self.kernel not in ("edge", "node"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
-        if self.kernel == "node" and (
-            self.variant != COLLECTALL or self.fire_policy != "every_round"
-            or self.delay_depth != 1 or self.drain != 0 or self.drop_rate > 0.0
-        ):
+        if self.delivery not in ("gather", "scatter"):
+            raise ValueError(f"unknown delivery {self.delivery!r}")
+        if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(
                 "kernel='node' covers exactly the fast synchronous "
                 "collect-all mode (every_round, drain=0, delay_depth=1, no "
                 "message drop); use kernel='edge' otherwise"
             )
+
+    @property
+    def is_fast_sync_collectall(self) -> bool:
+        """The node-collapsed kernel's domain of algebraic validity
+        (see models/sync.py)."""
+        return (self.variant == COLLECTALL
+                and self.fire_policy == "every_round"
+                and self.delay_depth == 1
+                and self.drain == 0
+                and self.drop_rate == 0.0)
 
     @property
     def jnp_dtype(self):

@@ -250,16 +250,41 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
 def send_messages(
     state: FlowUpdatingState, topo, cfg: RoundConfig, msg_est, send_mask
 ) -> FlowUpdatingState:
-    """Single-device delivery: scatter each sending edge's payload into the
-    receiver edge's (``rev``) ring-buffer slot at ``(t + delay) % D``.
-    Non-sending edges target an out-of-bounds index and are dropped."""
+    """Single-device delivery into the receiver edge's ring-buffer slot at
+    ``(t + delay) % D``.
+
+    Two equivalent formulations (``cfg.delivery``):
+
+    * ``gather`` (default): each *receiving* edge r pulls its payload from
+      its reverse edge ``rev[r]`` — since ``rev`` is an involution, the
+      scatter "sender pushes through rev" is exactly the gather "receiver
+      pulls through rev".  The update is then elementwise over the (D, E)
+      buffers with a slot-match mask — no scatter at all, which matters on
+      TPU where 2-D dynamic-index scatters serialize.
+    * ``scatter``: the literal push (kept for cross-checking; non-sending
+      edges target an out-of-bounds index and are dropped).
+    """
     E = topo.src.shape[0]
     t = state.t
-    slot_idx = (t + topo.delay) % cfg.delay_depth
-    tgt = jnp.where(send_mask, topo.rev, E)
-    buf_flow = state.buf_flow.at[slot_idx, tgt].set(state.flow, mode="drop")
-    buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")
-    buf_valid = state.buf_valid.at[slot_idx, tgt].set(True, mode="drop")
+    D = cfg.delay_depth
+    if cfg.delivery == "gather":
+        rf = topo.rev
+        sending = send_mask[rf]
+        pay_flow = state.flow[rf]
+        pay_est = msg_est[rf]
+        slot_r = (t + topo.delay[rf]) % D
+        hit = sending[None, :] & (
+            slot_r[None, :] == jnp.arange(D, dtype=slot_r.dtype)[:, None]
+        )
+        buf_flow = jnp.where(hit, pay_flow[None, :], state.buf_flow)
+        buf_est = jnp.where(hit, pay_est[None, :], state.buf_est)
+        buf_valid = state.buf_valid | hit
+    else:
+        slot_idx = (t + topo.delay) % D
+        tgt = jnp.where(send_mask, topo.rev, E)
+        buf_flow = state.buf_flow.at[slot_idx, tgt].set(state.flow, mode="drop")
+        buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")
+        buf_valid = state.buf_valid.at[slot_idx, tgt].set(True, mode="drop")
     return state.replace(
         t=t + 1, buf_flow=buf_flow, buf_est=buf_est, buf_valid=buf_valid
     )

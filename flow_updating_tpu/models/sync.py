@@ -69,8 +69,7 @@ class NodeSyncArrays:
 
 
 def _check_cfg(cfg: RoundConfig) -> None:
-    if (cfg.variant != COLLECTALL or cfg.fire_policy != "every_round"
-            or cfg.delay_depth != 1 or cfg.drain != 0 or cfg.drop_rate > 0.0):
+    if not cfg.is_fast_sync_collectall:
         raise ValueError(
             "the node-collapsed kernel covers exactly the fast synchronous "
             "collect-all mode (every_round, drain=0, delay_depth=1, no "
@@ -176,6 +175,13 @@ class NodeKernel:
     def run(self, state: NodeSyncState, num_rounds: int) -> NodeSyncState:
         return run_rounds_node(state, self.arrays, self.cfg, num_rounds)
 
+    def run_streamed(self, state: NodeSyncState, num_rounds: int,
+                     observe_every: int, emit) -> NodeSyncState:
+        return run_rounds_node_streamed(
+            state, self.arrays, self.cfg, num_rounds, observe_every,
+            self.topo.true_mean, emit,
+        )
+
     def _unpermute(self, padded: np.ndarray) -> np.ndarray:
         out = np.empty(self.topo.num_nodes, padded.dtype)
         out[self._perm] = padded[self._pos_of_real]
@@ -188,8 +194,6 @@ class NodeKernel:
 
     def last_avg(self, state: NodeSyncState) -> np.ndarray:
         return self._unpermute(np.asarray(state.avg_prev))
-
-
 
 
 def neighbor_sum(x: jnp.ndarray, mats: tuple) -> jnp.ndarray:
@@ -228,3 +232,62 @@ def run_rounds_node(
     return state
 
 
+def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):
+    """One watcher sample.  Padded rows sit at est == 0 forever and would
+    put a floor under the rmse, so metrics mask to communicating rows
+    (deg > 0 — padding has degree 0)."""
+    real = arrs.inv_depp1 < 1.0  # deg > 0 <=> 1/(deg+1) < 1
+    est = arrs.value + s.G
+    cnt = jnp.maximum(jnp.sum(real), 1).astype(est.dtype)
+    err = jnp.where(real, est - mean, 0)
+    return (
+        s.t,
+        jnp.sqrt(jnp.sum(err * err) / cnt),
+        jnp.max(jnp.abs(err)),
+        jnp.sum(jnp.where(real, est, 0)),
+        # in fast sync mode every communicating node fires every round
+        s.t * jnp.sum(real).astype(jnp.int32),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "chunks", "observe_every", "emit")
+)
+def _run_node_streamed(state, arrs, cfg, chunks, observe_every, mean, emit):
+    def host_emit(t, rmse_v, max_err, mass, fired):
+        emit({
+            "t": int(t),
+            "rmse": float(rmse_v),
+            "max_abs_err": float(max_err),
+            "mass": float(mass),
+            "fired_total": int(fired),
+        })
+
+    def chunk_body(s, _):
+        s = jax.lax.fori_loop(
+            0, observe_every, lambda _, x: node_round_step(x, arrs, cfg), s
+        )
+        jax.debug.callback(host_emit, *_node_sample(s, arrs, mean),
+                           ordered=True)
+        return s, None
+
+    state, _ = jax.lax.scan(chunk_body, state, None, length=chunks)
+    return state
+
+
+def run_rounds_node_streamed(
+    state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig,
+    num_rounds: int, observe_every: int, true_mean, emit,
+) -> NodeSyncState:
+    """Streamed observer for the node kernel — same contract as
+    :func:`flow_updating_tpu.models.rounds.run_rounds_streamed` (ordered
+    ``emit`` callbacks mid-run; flush with ``jax.effects_barrier()``).
+    Metrics cover real (non-padding) nodes; isolated real nodes with degree
+    0 are excluded along with padding (they never communicate anyway)."""
+    if num_rounds % observe_every:
+        raise ValueError("num_rounds must be a multiple of observe_every")
+    mean = jnp.asarray(true_mean, state.S.dtype)
+    return _run_node_streamed(
+        state, arrs, cfg, num_rounds // observe_every, observe_every, mean,
+        emit,
+    )

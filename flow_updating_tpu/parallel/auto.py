@@ -149,5 +149,3 @@ def init_sharded_state(
 
 def shard_state(state: FlowUpdatingState, mesh: jax.sharding.Mesh):
     return jax.device_put(state, state_sharding(mesh))
-
-
