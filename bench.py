@@ -38,7 +38,8 @@ def build_topology(k: int):
     return fat_tree(k, seed=0)
 
 
-def measure_tpu(topo, rounds: int, kernel: str = "node") -> dict:
+def measure_tpu(topo, rounds: int, kernel: str = "node",
+                spmv: str = "xla") -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: under the axon TPU tunnel, ``jax.block_until_ready`` can
@@ -58,6 +59,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node") -> dict:
     if kernel == "node":
         from flow_updating_tpu.models import sync
 
+        cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv=spmv)
         k = sync.NodeKernel(topo, cfg)
         state = k.init_state()
 
@@ -158,6 +160,8 @@ def main():
     ap.add_argument("--kernel", default="node", choices=("node", "edge"),
                     help="fast-path kernel: node-collapsed SpMV recurrence "
                          "(models/sync.py) or the general edge kernel")
+    ap.add_argument("--spmv", default="xla", choices=("xla", "pallas"),
+                    help="neighbor-sum implementation for --kernel node")
     ap.add_argument("--des-ticks", type=int, default=2,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--skip-des", action="store_true",
@@ -167,7 +171,7 @@ def main():
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 
-    tpu = measure_tpu(topo, args.rounds, kernel=args.kernel)
+    tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=args.spmv)
 
     des = None if args.skip_des else measure_des_baseline(topo, args.des_ticks)
     if des is not None:

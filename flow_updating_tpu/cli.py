@@ -49,6 +49,9 @@ def _select_backend(name: str) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # pallas (via checkify) registers TPU lowering rules at import time
+        # and refuses once "tpu" is deregistered — import it first
+        import jax.experimental.pallas  # noqa: F401
         import jax._src.xla_bridge as xb
 
         for plugin in ("axon", "tpu"):
@@ -116,7 +119,9 @@ def _make_config(args):
     maker = (RoundConfig.reference if args.fire_policy == "reference"
              else RoundConfig.fast)
     kw = dict(variant=args.variant, drop_rate=args.drop_rate,
-              kernel=getattr(args, "kernel", "edge"))
+              kernel=getattr(args, "kernel", "edge"),
+              delivery=getattr(args, "delivery", "gather"),
+              spmv=getattr(args, "spmv", "xla"))
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
@@ -280,6 +285,13 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=("reference", "every_round"),
                      help="'reference' = faithful async dynamics; "
                           "'every_round' = fast synchronous mode")
+    run.add_argument("--delivery", default="gather",
+                     choices=("gather", "scatter"),
+                     help="message-delivery formulation (identical "
+                          "semantics; gather avoids TPU scatters)")
+    run.add_argument("--spmv", default="xla", choices=("xla", "pallas"),
+                     help="node-kernel neighbor-sum implementation "
+                          "(pallas keeps the vector VMEM-resident)")
     run.add_argument("--shards", type=int, default=0,
                      help="shard the node axis over N devices (GSPMD over a "
                           "jax Mesh; 0 = single device)")

@@ -126,7 +126,7 @@ class Engine:
     def _prepare_arrays(self, latency_scale: float = 0.0) -> None:
         """Device arrays for the configured kernel (no fresh state)."""
         if self.config.kernel == "node":
-            if latency_scale > 0.0:
+            if latency_scale > 0.0 or self.topology.max_delay > 1:
                 raise ValueError(
                     "latency-warped rounds need per-edge delivery state; "
                     "the node-collapsed kernel is unit-delay only — use "

@@ -61,6 +61,9 @@ class RoundConfig:
     #                                    scatter) | 'scatter' (sender pushes;
     #                                    2-D dynamic-index scatter, slow on
     #                                    TPU).  Identical semantics.
+    spmv: str = "xla"                  # node-kernel neighbor sum: 'xla'
+    #                                    (gather + rowsum) | 'pallas' (VMEM-
+    #                                    resident x, ops/pallas_spmv.py)
 
     def __post_init__(self):
         if self.variant not in (COLLECTALL, PAIRWISE):
@@ -75,6 +78,8 @@ class RoundConfig:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.delivery not in ("gather", "scatter"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
+        if self.spmv not in ("xla", "pallas"):
+            raise ValueError(f"unknown spmv {self.spmv!r}")
         if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(
                 "kernel='node' covers exactly the fast synchronous "

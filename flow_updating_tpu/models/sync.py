@@ -98,8 +98,14 @@ class NodeKernel:
         _check_cfg(cfg)
         self.topo = topo
         self.cfg = cfg
+        if cfg.spmv == "pallas":
+            from flow_updating_tpu.ops.pallas_spmv import BLOCK_ROWS
+
+            row_multiple = max(row_multiple, BLOCK_ROWS)
         if mesh is not None:
-            row_multiple = max(row_multiple, mesh.devices.size)
+            import math
+
+            row_multiple = math.lcm(row_multiple, mesh.devices.size)
         self.row_multiple = row_multiple
         self.mesh = mesh
         ell = topo.ell_buckets()
@@ -212,7 +218,12 @@ def node_round_step(
     state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig
 ) -> NodeSyncState:
     avg = (arrs.value - state.S + state.A_prev) * arrs.inv_depp1
-    A_cur = neighbor_sum(avg, arrs.mats)
+    if cfg.spmv == "pallas":
+        from flow_updating_tpu.ops.pallas_spmv import neighbor_sum_pallas
+
+        A_cur = neighbor_sum_pallas(avg, arrs.mats)
+    else:
+        A_cur = neighbor_sum(avg, arrs.mats)
     S_next = -state.G - A_cur + arrs.deg * state.avg_prev
     G_next = -state.S - arrs.deg * avg + state.A_prev
     return NodeSyncState(
@@ -245,8 +256,9 @@ def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):
         jnp.sqrt(jnp.sum(err * err) / cnt),
         jnp.max(jnp.abs(err)),
         jnp.sum(jnp.where(real, est, 0)),
-        # in fast sync mode every communicating node fires every round
-        s.t * jnp.sum(real).astype(jnp.int32),
+        # communicating-node count; the host multiplies by t (in Python
+        # ints — t * N overflows int32 at ~1M nodes x ~2k rounds)
+        jnp.sum(real),
     )
 
 
@@ -254,13 +266,14 @@ def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):
     jax.jit, static_argnames=("cfg", "chunks", "observe_every", "emit")
 )
 def _run_node_streamed(state, arrs, cfg, chunks, observe_every, mean, emit):
-    def host_emit(t, rmse_v, max_err, mass, fired):
+    def host_emit(t, rmse_v, max_err, mass, cnt):
+        # in fast sync mode every communicating node fires every round
         emit({
             "t": int(t),
             "rmse": float(rmse_v),
             "max_abs_err": float(max_err),
             "mass": float(mass),
-            "fired_total": int(fired),
+            "fired_total": int(t) * int(cnt),
         })
 
     def chunk_body(s, _):

@@ -23,6 +23,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# jax.experimental.pallas (via checkify) registers TPU lowering rules at
+# import time and refuses if "tpu" is not a known platform — import it
+# BEFORE deregistering the TPU plugin factories below.
+import jax.experimental.pallas  # noqa: E402,F401
+
 import jax._src.xla_bridge as _xb  # noqa: E402
 
 for _plugin in ("axon", "tpu"):

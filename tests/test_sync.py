@@ -174,3 +174,50 @@ def test_engine_mesh_node_kernel_and_checkpoint(tmp_path):
     e.run_rounds(20)
     e2.run_rounds(20)
     np.testing.assert_array_equal(e.estimates(), e2.estimates())
+
+
+def test_pallas_spmv_matches_xla():
+    """Pallas bucketed SpMV (interpret mode on CPU) == XLA neighbor_sum, and
+    the full node kernel agrees between spmv impls."""
+    import dataclasses
+
+    topo = barabasi_albert(400, m=3, seed=8)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv="pallas")
+    kp = sync.NodeKernel(topo, cfg)
+    assert kp.row_multiple >= 256 and kp.padded_size % 256 == 0
+
+    cfg_x = dataclasses.replace(cfg, spmv="xla")
+    kx = sync.NodeKernel(topo, cfg_x)
+
+    # direct op equality on the same padded layout
+    import jax.numpy as jnp
+    import numpy as np
+    from flow_updating_tpu.ops.pallas_spmv import neighbor_sum_pallas
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=kp.padded_size), jnp.float32)
+    a = np.asarray(sync.neighbor_sum(x, kp.arrays.mats))
+    b = np.asarray(neighbor_sum_pallas(x, kp.arrays.mats))
+    np.testing.assert_allclose(b, a, rtol=1e-6, atol=1e-6)
+
+    # end-to-end: 30 rounds, same estimates
+    op = kp.run(kp.init_state(), 30)
+    ox = kx.run(kx.init_state(), 30)
+    np.testing.assert_allclose(kp.estimates(op), kx.estimates(ox),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_node_kernel_rejects_latency_topology():
+    from flow_updating_tpu.engine import Engine
+
+    rng = np.random.default_rng(0)
+    pairs = np.stack([np.arange(15), (np.arange(15) + 1) % 16], axis=1)
+    from flow_updating_tpu.topology.graph import build_topology
+
+    lat = {(int(u), int(v)): 3.0 for u, v in pairs}
+    topo = build_topology(16, pairs, latency_s=lat, latency_scale=1.0,
+                          warn_asymmetric=False)
+    assert topo.max_delay > 1
+    cfg = RoundConfig.fast(variant="collectall", kernel="node")
+    with pytest.raises(ValueError, match="unit-delay"):
+        Engine(config=cfg).set_topology(topo).build()
