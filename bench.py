@@ -86,14 +86,21 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
     t0 = time.perf_counter()
     out = run(rounds)
     compile_s = time.perf_counter() - t0
-    run(2 * rounds)  # compile the 2R program too
 
-    t0 = time.perf_counter()
-    out = run(rounds)
-    t_r = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out2 = run(2 * rounds)
-    t_2r = time.perf_counter() - t0
+    # adaptive: grow the scan until the R-vs-2R difference clears timer +
+    # launch-overhead noise (tiny graphs run far under the tunnel RTT)
+    while True:
+        run(rounds)      # warm both scan lengths (jit keys on num_rounds,
+        run(2 * rounds)  # so a grown `rounds` needs a fresh compile)
+        t0 = time.perf_counter()
+        out = run(rounds)
+        t_r = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out2 = run(2 * rounds)
+        t_2r = time.perf_counter() - t0
+        if t_2r - t_r > 0.05 or rounds >= 262144:
+            break
+        rounds *= 8
     per_round = max((t_2r - t_r) / rounds, 1e-9)
 
     err = float(rmse(read_est(out2), topo.true_mean))
@@ -107,6 +114,36 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         "kernel": kernel,
         "device": str(jax.devices()[0]),
     }
+
+
+def measure_rounds_to_rmse(topo, threshold: float = 1e-6,
+                           chunk: int = 64, cap: int = 4096) -> dict:
+    """Secondary north-star metric: rounds until RMSE(vs true mean) drops
+    below ``threshold`` (chunk granularity), on the node kernel."""
+    import numpy as np
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models import sync
+    from flow_updating_tpu.utils.metrics import rmse
+
+    cfg = RoundConfig.fast(variant="collectall", kernel="node")
+    k = sync.NodeKernel(topo, cfg)
+    state = k.init_state()
+    rounds = 0
+    err = float("inf")
+    while rounds < cap:
+        state = k.run(state, chunk)
+        rounds += chunk
+        prev = err
+        err = float(rmse(k.estimates(state), topo.true_mean))
+        if err < threshold:
+            break
+        if err > prev * 0.95:
+            # float32 noise floor reached above the threshold — stop
+            # burning rounds, report the plateau
+            break
+    return {"rounds": rounds, "rmse": err, "threshold": threshold,
+            "converged": err < threshold}
 
 
 def measure_des_baseline(topo, ticks: int) -> dict | None:
@@ -166,12 +203,15 @@ def main():
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--skip-des", action="store_true",
                     help="use the recorded baseline instead of measuring")
+    ap.add_argument("--skip-convergence", action="store_true",
+                    help="skip the rounds-to-1e-6-RMSE secondary metric")
     args = ap.parse_args()
 
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 
     tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=args.spmv)
+    conv = None if args.skip_convergence else measure_rounds_to_rmse(topo)
 
     des = None if args.skip_des else measure_des_baseline(topo, args.des_ticks)
     if des is not None:
@@ -196,6 +236,7 @@ def main():
         "extra": {
             "nodes": n,
             "directed_edges": e,
+            "rounds_to_1e-6_rmse": conv,
             "tpu": {k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in tpu.items()},
             "baseline_rounds_per_sec": (

@@ -98,13 +98,18 @@ class NodeKernel:
         _check_cfg(cfg)
         self.topo = topo
         self.cfg = cfg
+        import math
+
         if cfg.spmv == "pallas":
+            if mesh is not None:
+                raise NotImplementedError(
+                    "spmv='pallas' has no SPMD partitioning path yet; use "
+                    "spmv='xla' with a mesh (GSPMD handles the collective)"
+                )
             from flow_updating_tpu.ops.pallas_spmv import BLOCK_ROWS
 
-            row_multiple = max(row_multiple, BLOCK_ROWS)
+            row_multiple = math.lcm(row_multiple, BLOCK_ROWS)
         if mesh is not None:
-            import math
-
             row_multiple = math.lcm(row_multiple, mesh.devices.size)
         self.row_multiple = row_multiple
         self.mesh = mesh

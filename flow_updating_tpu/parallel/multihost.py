@@ -1,0 +1,81 @@
+"""Multi-host (multi-process) execution over DCN.
+
+The reference's distributed story is SimGrid's *simulated* network; this
+framework's real one is JAX's: each host runs one process, `jax.distributed`
+wires them into a single logical runtime over DCN, and every `Mesh` in
+:mod:`flow_updating_tpu.parallel.mesh` then spans all hosts' devices — the
+GSPMD collectives (all-gather of the avg vector, halo payload exchange)
+ride ICI within a pod slice and DCN across slices, with no change to any
+kernel in this package (SPMD: computation follows the sharding).
+
+Single-process runs (the common case, and all CI) need none of this; every
+helper degrades to a no-op.
+
+Typical launch (one process per host):
+
+    JAX_COORDINATOR=host0:1234 NPROC=4 PROC_ID=$i python my_run.py
+
+    import flow_updating_tpu.parallel.multihost as mh
+    mh.initialize()                       # no-op if single process
+    mesh = mh.global_mesh()               # all devices on all hosts
+    eng = Engine(config=cfg, mesh=mesh)   # unchanged from single-host
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger("flow_updating_tpu.multihost")
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Join the multi-process JAX runtime.
+
+    Arguments default from ``JAX_COORDINATOR`` / ``NPROC`` / ``PROC_ID``
+    (and jax's own auto-detection on supported cluster schedulers).  Returns
+    True if a multi-process runtime was initialized, False for the
+    single-process no-op.
+    """
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("NPROC", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("PROC_ID")
+        process_id = int(pid) if pid is not None else None
+    if coordinator is None and num_processes in (None, 1):
+        logger.debug("single-process run; jax.distributed not initialized")
+        return False
+    if coordinator is None:
+        raise ValueError(
+            f"num_processes={num_processes} but no coordinator address "
+            "(set JAX_COORDINATOR=host:port or pass coordinator=)"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "multihost: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def global_mesh(axis: str | None = None) -> jax.sharding.Mesh:
+    """One-axis mesh over every device of every process (node axis)."""
+    from flow_updating_tpu.parallel.mesh import NODE_AXIS
+
+    devices = jax.devices()
+    return jax.sharding.Mesh(devices, (axis or NODE_AXIS,))
+
+
+def is_primary() -> bool:
+    """True on the process that should write logs/checkpoints/reports."""
+    return jax.process_index() == 0

@@ -300,30 +300,48 @@ def build_topology(
         round).  > 0 -> latency-warped rounds:
         ``delay = max(1, round(latency * latency_scale / tick_interval))``.
     """
-    edges, adopted = _symmetrize(pairs)
-    if len(adopted) and warn_asymmetric:
-        shown = ", ".join(
-            f"{int(a)}->{int(b)}" for a, b in adopted[:8]
-        )
-        logger.warning(
-            "topology: %d directed edge(s) had no declared reverse; adopted at "
-            "load time (%s%s)",
-            len(adopted), shown, "..." if len(adopted) > 8 else "",
-        )
-    if edges.size and edges.max() >= num_nodes:
-        raise ValueError("edge endpoint out of range")
+    pairs_arr = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    native_out = None
+    if len(pairs_arr) >= 2_000_000 and not warn_asymmetric:
+        # big-graph fast path: C++ symmetrize+sort+rev (generators only —
+        # the adopted-edge report needs the numpy path).  Range-check
+        # BEFORE the call: the native builder filters bad endpoints
+        # instead of raising.
+        if pairs_arr.size and (pairs_arr.min() < 0
+                               or pairs_arr.max() >= num_nodes):
+            raise ValueError("edge endpoint out of range")
+        from flow_updating_tpu import native
 
-    E = edges.shape[0]
-    src = edges[:, 0].astype(np.int32)
-    dst = edges[:, 1].astype(np.int32)
+        native_out = native.build_graph_arrays(num_nodes, pairs_arr)
+    if native_out is not None:
+        src, dst, rev, out_deg = native_out
+        E = len(src)
+    else:
+        edges, adopted = _symmetrize(pairs_arr)
+        if len(adopted) and warn_asymmetric:
+            shown = ", ".join(
+                f"{int(a)}->{int(b)}" for a, b in adopted[:8]
+            )
+            logger.warning(
+                "topology: %d directed edge(s) had no declared reverse; "
+                "adopted at load time (%s%s)",
+                len(adopted), shown, "..." if len(adopted) > 8 else "",
+            )
+        if edges.size and edges.max() >= num_nodes:
+            raise ValueError("edge endpoint out of range")
 
-    # Reverse-edge permutation: position of (dst, src) in the sorted edge list.
-    order_keys = src.astype(np.int64) * num_nodes + dst.astype(np.int64)
-    rev_keys = dst.astype(np.int64) * num_nodes + src.astype(np.int64)
-    rev = np.searchsorted(order_keys, rev_keys).astype(np.int32)
-    assert np.array_equal(order_keys[rev], rev_keys), "graph not symmetric"
+        E = edges.shape[0]
+        src = edges[:, 0].astype(np.int32)
+        dst = edges[:, 1].astype(np.int32)
 
-    out_deg = np.bincount(src, minlength=num_nodes).astype(np.int32)
+        # Reverse-edge permutation: position of (dst, src) in the sorted
+        # edge list.
+        order_keys = src.astype(np.int64) * num_nodes + dst.astype(np.int64)
+        rev_keys = dst.astype(np.int64) * num_nodes + src.astype(np.int64)
+        rev = np.searchsorted(order_keys, rev_keys).astype(np.int32)
+        assert np.array_equal(order_keys[rev], rev_keys), "graph not symmetric"
+
+        out_deg = np.bincount(src, minlength=num_nodes).astype(np.int32)
     row_start = np.zeros(num_nodes + 1, dtype=np.int64)
     np.cumsum(out_deg, out=row_start[1:])
     edge_rank = (np.arange(E, dtype=np.int64) - row_start[src]).astype(np.int32)

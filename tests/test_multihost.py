@@ -1,0 +1,32 @@
+"""Multi-host helpers: single-process no-op semantics and env parsing.
+
+A real multi-host launch can't run in CI; what can be pinned down is the
+degradation contract (no coordinator + one process == no-op) and that
+misconfiguration fails loudly instead of reaching jax.distributed with
+half-missing arguments.
+"""
+
+import pytest
+
+from flow_updating_tpu.parallel import multihost
+
+
+def test_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR", raising=False)
+    monkeypatch.delenv("NPROC", raising=False)
+    monkeypatch.delenv("PROC_ID", raising=False)
+    assert multihost.initialize() is False
+    assert multihost.is_primary() is True
+
+
+def test_nproc_without_coordinator_rejected(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR", raising=False)
+    monkeypatch.setenv("NPROC", "4")
+    with pytest.raises(ValueError, match="no coordinator"):
+        multihost.initialize()
+
+
+def test_global_mesh_spans_devices():
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == 8  # the conftest CPU mesh
+    assert mesh.axis_names == ("nodes",)

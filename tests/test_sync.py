@@ -221,3 +221,12 @@ def test_node_kernel_rejects_latency_topology():
     cfg = RoundConfig.fast(variant="collectall", kernel="node")
     with pytest.raises(ValueError, match="unit-delay"):
         Engine(config=cfg).set_topology(topo).build()
+
+
+def test_pallas_with_mesh_rejected():
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = ring(32, k=2, seed=0)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv="pallas")
+    with pytest.raises(NotImplementedError, match="pallas"):
+        sync.NodeKernel(topo, cfg, mesh=make_mesh(8))
