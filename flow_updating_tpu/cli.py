@@ -107,7 +107,8 @@ def _build_topology(args):
         platform = load_platform(args.platform) if args.platform else None
         lat = getattr(args, "latency_scale", 0.0)
         return load_deployment(args.deployment).to_topology(
-            platform=platform, tick_interval=TICK_INTERVAL, latency_scale=lat
+            platform=platform, tick_interval=TICK_INTERVAL, latency_scale=lat,
+            msg_bytes=getattr(args, "msg_bytes", 104.0),
         )
     raise SystemExit("need --deployment (with optional --platform) "
                      "or --generator")
@@ -310,6 +311,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--latency-scale", type=float, default=0.0,
                      help=">0: derive per-edge delays from platform "
                           "latencies x this scale")
+    run.add_argument("--msg-bytes", type=float, default=104.0,
+                     help="simulated message wire size; adds the "
+                          "size/bandwidth serialization term to latency-"
+                          "warped delays (reference: ~104 B)")
     run.add_argument("--drop-rate", type=float, default=0.0,
                      help="per-message loss probability (fault injection)")
     run.add_argument("--rounds", type=int, default=None,

@@ -37,6 +37,10 @@ import jax.numpy as jnp
 from flow_updating_tpu.models.config import COLLECTALL, PAIRWISE, RoundConfig
 from flow_updating_tpu.models.state import FlowUpdatingState
 from flow_updating_tpu.ops.segment import (
+    ell_segment_all,
+    ell_segment_max,
+    ell_segment_min,
+    ell_segment_sum,
     segment_all,
     segment_max,
     segment_min,
@@ -47,11 +51,41 @@ from flow_updating_tpu.ops.segscan import segmented_affine_scan
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
+# Per-node reductions over out-edges dispatch on the topology arrays: when
+# the degree-bucketed out-edge ELL matrices are materialized
+# (device_arrays(segment_ell=True), selected by cfg.segment_impl='ell'),
+# every reduction is a scatter-free gather + row-reduce; otherwise the
+# jax.ops segment primitives (scatter-based lowering) are used.
+
+def _seg_sum(x, topo, N):
+    if topo.ell_edge_mats is not None:
+        return ell_segment_sum(x, topo)
+    return segment_sum(x, topo.src, N)
+
+
+def _seg_min(x, topo, N, identity):
+    if topo.ell_edge_mats is not None:
+        return ell_segment_min(x, topo, identity)
+    return segment_min(x, topo.src, N)
+
+
+def _seg_max(x, topo, N, identity):
+    if topo.ell_edge_mats is not None:
+        return ell_segment_max(x, topo, identity)
+    return segment_max(x, topo.src, N)
+
+
+def _seg_all(pred, topo, N):
+    if topo.ell_edge_mats is not None:
+        return ell_segment_all(pred, topo)
+    return segment_all(pred, topo.src, N)
+
+
 def node_estimates(state: FlowUpdatingState, topo) -> jnp.ndarray:
     """Per-node current estimate: ``value - sum(out flows)``
     (reference ``flowupdating-collectall.py:106-107``)."""
     N = topo.out_deg.shape[0]
-    return state.value - segment_sum(state.flow, topo.src, N)
+    return state.value - _seg_sum(state.flow, topo, N)
 
 
 def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
@@ -80,7 +114,7 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
         prio = jnp.mod(topo.edge_rank - state.t, jnp.maximum(topo.out_deg[topo.src], 1))
         for _ in range(cfg.drain):
             key = jnp.where(remaining, prio, _I32_MAX)
-            best = segment_min(key, topo.src, N)
+            best = _seg_min(key, topo, N, _I32_MAX)
             pick = remaining & (key == best[topo.src]) & (key < _I32_MAX)
             process = process | pick
             remaining = remaining & ~pick
@@ -120,7 +154,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     t = state.t
     src = topo.src
 
-    flows_sum = segment_sum(state.flow, src, N)
+    flows_sum = _seg_sum(state.flow, topo, N)
     estimate = state.value - flows_sum
 
     ticks = state.ticks
@@ -134,12 +168,12 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
         if cfg.fire_policy == "every_round":
             fire_n = state.alive
         else:
-            all_heard = segment_all(recv, src, N)
+            all_heard = _seg_all(recv, topo, N)
             fire_n = (all_heard | (ticks >= cfg.timeout)) & state.alive
         # avg over self + ALL neighbors' last-known estimates (unheard
         # neighbors contribute their defaultdict 0.0, as in the reference,
         # ``collectall.py:109-113``).
-        est_sum = segment_sum(state.est, src, N)
+        est_sum = _seg_sum(state.est, topo, N)
         avg = (estimate + est_sum) / (topo.out_deg + 1).astype(dt)
         fire_e = fire_n[src]
         avg_e = avg[src]
@@ -188,9 +222,9 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             msg_est = avg_e
             send_mask = jnp.zeros_like(matched)  # direct exchange, no messages
             stamp = jnp.where(matched, t, stamp)
-            fire_any = segment_max(matched.astype(jnp.int32), src, N) > 0
-            node_avg = segment_sum(
-                jnp.where(matched, avg_e, jnp.asarray(0, dt)), src, N
+            fire_any = _seg_max(matched.astype(jnp.int32), topo, N, 0) > 0
+            node_avg = _seg_sum(
+                jnp.where(matched, avg_e, jnp.asarray(0, dt)), topo, N
             )
             last_avg = jnp.where(fire_any, node_avg, last_avg)
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
@@ -218,7 +252,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             # last_avg per node = average at its last firing edge == its
             # running estimate at the segment end (identity maps pass it
             # through).
-            fire_any = segment_max(fire_e.astype(jnp.int32), src, N) > 0
+            fire_any = _seg_max(fire_e.astype(jnp.int32), topo, N, 0) > 0
             seg_end = jnp.maximum(topo.row_start[1:] - 1, 0)
             final_est = run_est[seg_end]
             last_avg = jnp.where(fire_any, final_est, last_avg)

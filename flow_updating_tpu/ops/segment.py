@@ -43,3 +43,48 @@ def segment_all(pred, segment_ids, num_segments: int):
     mins = segment_min(pred.astype(jnp.int32), segment_ids, num_segments)
     counts = segment_sum(jnp.ones_like(pred, jnp.int32), segment_ids, num_segments)
     return (mins == 1) & (counts > 0)
+
+
+# ---- scatter-free variants over the degree-bucketed out-edge ELL layout ---
+#
+# Each reduction gathers edge values by the per-bucket (rows, width) edge-
+# index matrices (padded with E -> a neutral-element slot), reduces rows,
+# concatenates buckets (ascending-degree node order) and unpermutes back to
+# original node order with one (N,) gather.  No scatter ops at all — the
+# TPU-friendly lowering of the same per-node reductions.
+
+
+def _ell_reduce(values, pad_value, topo, reducer, out_dtype=None):
+    xp = jnp.concatenate(
+        [values, jnp.asarray([pad_value], dtype=values.dtype)]
+    )
+    parts = []
+    for m in topo.ell_edge_mats:
+        if m.shape[1] == 0:
+            parts.append(jnp.full((m.shape[0],), pad_value, xp.dtype))
+        else:
+            parts.append(reducer(xp[m]))
+    cat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    out = cat[topo.ell_inv_perm]
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+def ell_segment_sum(values, topo):
+    return _ell_reduce(values, 0, topo, lambda v: jnp.sum(v, axis=1))
+
+
+def ell_segment_min(values, topo, identity):
+    return _ell_reduce(values, identity, topo, lambda v: jnp.min(v, axis=1))
+
+
+def ell_segment_max(values, topo, identity):
+    return _ell_reduce(values, identity, topo, lambda v: jnp.max(v, axis=1))
+
+
+def ell_segment_all(pred, topo):
+    """AND over each node's out-edges; empty rows (isolated nodes) False —
+    matching :func:`segment_all`."""
+    allr = _ell_reduce(
+        pred.astype(jnp.int32), 1, topo, lambda v: jnp.min(v, axis=1)
+    )
+    return (allr == 1) & (topo.out_deg > 0)

@@ -50,6 +50,7 @@ class Deployment:
         platform: Platform | None = None,
         tick_interval: float = 1.0,
         latency_scale: float = 0.0,
+        msg_bytes: float = 104.0,
     ) -> Topology:
         """Deployment (+ optional platform for latencies/speeds) -> Topology.
 
@@ -70,9 +71,11 @@ class Deployment:
                     )
                 pairs.append((ids[a.host], ids[nb]))
         latency = None
+        bandwidth = None
         speeds = None
         if platform is not None:
             latency = platform.latency_table(names)
+            bandwidth = platform.bandwidth_table(names)
             speeds = np.array(
                 [platform.hosts.get(n, 0.0) for n in names], dtype=np.float64
             )
@@ -82,9 +85,11 @@ class Deployment:
             values=values,
             names=names,
             latency_s=latency,
+            bandwidth=bandwidth,
             speeds=speeds,
             tick_interval=tick_interval,
             latency_scale=latency_scale,
+            msg_bytes=msg_bytes,
         )
 
 

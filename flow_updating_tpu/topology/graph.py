@@ -144,6 +144,7 @@ class Topology:
         inv[order] = np.arange(N, dtype=np.int32)
 
         mats = []
+        edge_mats = []
         row_counts = []
         widths = []
         start = 0
@@ -154,6 +155,7 @@ class Topology:
             rows = order[start:end]
             if w == 0:
                 mat = np.empty((len(rows), 0), np.int32)
+                emat = np.empty((len(rows), 0), np.int32)
             else:
                 lo = self.row_start[rows]
                 d = deg[rows]
@@ -161,13 +163,16 @@ class Topology:
                 valid = ar[None, :] < d[:, None]
                 col = np.where(valid, lo[:, None] + ar[None, :], 0)
                 mat = np.where(valid, inv[self.dst[col]], N).astype(np.int32)
+                emat = np.where(valid, col, self.num_edges).astype(np.int32)
             mats.append(mat)
+            edge_mats.append(emat)
             row_counts.append(len(rows))
             widths.append(int(w))
             start = end
         out = EllBuckets(
             perm=order, inv_perm=inv, widths=tuple(widths),
             row_counts=tuple(row_counts), mats=tuple(mats),
+            edge_mats=tuple(edge_mats),
         )
         object.__setattr__(self, "_ell_buckets", out)
         return out
@@ -181,11 +186,14 @@ class Topology:
         lo, hi = self.row_start[node], self.row_start[node + 1]
         return self.dst[lo:hi]
 
-    def device_arrays(self, coloring: bool = False):
+    def device_arrays(self, coloring: bool = False,
+                      segment_ell: bool = False):
         """Device-resident pytree of the arrays the round kernel consumes.
 
         ``coloring=True`` additionally materializes the edge coloring (only
-        needed by the fast synchronous pairwise mode)."""
+        needed by the fast synchronous pairwise mode).  ``segment_ell=True``
+        materializes the degree-bucketed out-edge ELL matrices used by the
+        scatter-free segment reductions (``cfg.segment_impl='ell'``)."""
         import jax.numpy as jnp
 
         edge_color = None
@@ -193,6 +201,12 @@ class Topology:
         if coloring:
             col, num_colors = self.edge_coloring()
             edge_color = jnp.asarray(col)
+        ell_edge_mats = None
+        ell_inv_perm = None
+        if segment_ell:
+            ell = self.ell_buckets()
+            ell_edge_mats = tuple(jnp.asarray(m) for m in ell.edge_mats)
+            ell_inv_perm = jnp.asarray(ell.inv_perm)
         return TopoArrays(
             src=jnp.asarray(self.src),
             dst=jnp.asarray(self.dst),
@@ -203,6 +217,8 @@ class Topology:
             delay=jnp.asarray(self.delay),
             edge_color=edge_color,
             num_colors=num_colors,
+            ell_edge_mats=ell_edge_mats,
+            ell_inv_perm=ell_inv_perm,
         )
 
     def with_values(self, values: np.ndarray) -> "Topology":
@@ -226,7 +242,10 @@ class EllBuckets:
     inv_perm: np.ndarray    # (N,) int32
     widths: tuple           # per-bucket padded width
     row_counts: tuple       # per-bucket row count
-    mats: tuple             # per-bucket (rows, width) int32 matrices
+    mats: tuple             # per-bucket (rows, width) int32 NEIGHBOR indices
+    #                         (permuted node space, padded with N)
+    edge_mats: tuple        # per-bucket (rows, width) int32 OUT-EDGE indices
+    #                         (CSR edge space, padded with E)
 
 
 import flax.struct  # noqa: E402  (kept close to its sole consumer)
@@ -245,6 +264,8 @@ class TopoArrays:
     delay: object
     edge_color: object = None
     num_colors: int = flax.struct.field(pytree_node=False, default=0)
+    ell_edge_mats: object = None   # tuple of (rows, w) out-edge ELL buckets
+    ell_inv_perm: object = None    # (N,) original node -> permuted row
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -280,6 +301,7 @@ def build_topology(
     speeds: np.ndarray | None = None,
     tick_interval: float = 1.0,
     latency_scale: float = 0.0,
+    msg_bytes: float = 104.0,
     seed: int = 0,
     warn_asymmetric: bool = True,
 ) -> Topology:
@@ -298,7 +320,12 @@ def build_topology(
         ``TICK_INTERVAL = 1.0``, ``flowupdating-collectall.py:23``).
       latency_scale: 0.0 -> unit delay (fast path, every edge delivers next
         round).  > 0 -> latency-warped rounds:
-        ``delay = max(1, round(latency * latency_scale / tick_interval))``.
+        ``delay = max(1, round((latency + msg_bytes/bandwidth) *
+        latency_scale / tick_interval))``.
+      msg_bytes: simulated wire size of one protocol message, the
+        serialization term of the transfer time when route bandwidths are
+        known (the reference self-reports ~104 bytes via
+        ``FlowUpdatingMsg.size()``, ``flowupdating-collectall.py:13-19``).
     """
     pairs_arr = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     native_out = None
@@ -367,8 +394,16 @@ def build_topology(
             bw[i] = bandwidth.get(key, bandwidth.get((key[1], key[0]), 0.0))
 
     if latency_scale > 0.0 and lat is not None:
+        # transfer time = route latency + serialization at route bandwidth
+        # (the flow-model cost of the reference's sized put_async:
+        # FlowUpdatingMsg.size() ~= 104 bytes fed to put_async,
+        # flowupdating-collectall.py:13-19,124)
+        transfer_s = lat.copy()
+        if bw is not None:
+            pos = bw > 0
+            transfer_s[pos] += msg_bytes / bw[pos]
         delay = np.maximum(
-            1, np.rint(lat * latency_scale / tick_interval)
+            1, np.rint(transfer_s * latency_scale / tick_interval)
         ).astype(np.int32)
     else:
         delay = np.ones(E, dtype=np.int32)

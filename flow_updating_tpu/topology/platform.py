@@ -128,6 +128,18 @@ class Platform:
                     out[(i, j)] = r.latency(self.links)
         return out
 
+    def bandwidth_table(self, names: list) -> dict:
+        """{(u_id, v_id): bytes/s} (bottleneck link along the route)."""
+        out = {}
+        for i, a in enumerate(names):
+            for j, b in enumerate(names):
+                if i == j:
+                    continue
+                r = self.route(a, b)
+                if r is not None:
+                    out[(i, j)] = r.bandwidth(self.links)
+        return out
+
 
 _UNSUPPORTED = {"cluster", "cabinet", "peer", "trace", "trace_connect", "bypassRoute"}
 

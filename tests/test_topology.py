@@ -110,3 +110,19 @@ def test_latency_scale_produces_delays(small6):
     topo = deployment.to_topology(platform=platform, latency_scale=1000.0)
     assert topo.delay.min() >= 1
     assert topo.delay.max() > 1
+
+
+def test_bandwidth_aware_delays(small6):
+    """Latency-warped delays include the size/bandwidth serialization term
+    (the reference's sized put_async, flowupdating-collectall.py:13-19,124):
+    a larger message on a slow route must take more rounds."""
+    platform, deployment = small6
+    # huge scale so the per-route differences are visible in whole rounds
+    t_small = deployment.to_topology(platform=platform, latency_scale=5e3,
+                                     msg_bytes=104.0)
+    t_big = deployment.to_topology(platform=platform, latency_scale=5e3,
+                                   msg_bytes=50e6)
+    assert t_big.max_delay > t_small.max_delay
+    assert np.all(t_big.delay >= t_small.delay)
+    # bandwidth table populated from the platform
+    assert t_small.bandwidth is not None and np.all(t_small.bandwidth > 0)
