@@ -45,17 +45,9 @@ def _select_backend(name: str) -> None:
     if name == "auto":
         return
     if name == "cpu":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+        from flow_updating_tpu.utils.backend import pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-        # pallas (via checkify) registers TPU lowering rules at import time
-        # and refuses once "tpu" is deregistered — import it first
-        import jax.experimental.pallas  # noqa: F401
-        import jax._src.xla_bridge as xb
-
-        for plugin in ("axon", "tpu"):
-            xb._backend_factories.pop(plugin, None)
+        pin_cpu()
     elif name == "jax_tpu":
         # Clear a CPU pin so TPU discovery can happen; an explicit TPU-ish
         # pin (tpu / axon tunnel) is kept as-is.

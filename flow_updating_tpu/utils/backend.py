@@ -1,0 +1,47 @@
+"""Backend pinning for environments that tunnel JAX at a single TPU chip.
+
+The ambient environment registers an ``axon`` TPU plugin through a
+sitecustomize hook that imports jax at interpreter startup, so CPU-only
+work (tests, virtual multi-device meshes, benchmark fallbacks) must both
+force the CPU platform *and* deregister the TPU plugin factories before
+any backend initializes — otherwise the process contends for (and can
+hang on) the one tunneled chip.  This module is the single home of that
+ordering-sensitive recipe; tests/conftest.py, the CLI ``--backend cpu``
+path, and bench.py's CPU fallback all share it.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu(n_virtual_devices: int | None = None) -> None:
+    """Force the host-CPU backend, optionally with N virtual devices.
+
+    Must run before any JAX backend initializes (env vars are read lazily
+    at first backend init, so calling this after ``import jax`` — but
+    before any ``jax.devices()``/trace — is still in time).
+    """
+    if n_virtual_devices:
+        flags = [
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={int(n_virtual_devices)}"
+        )
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("JAX_PLATFORM_NAME", None)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # pallas (via checkify) registers TPU lowering rules at import time and
+    # refuses once "tpu" is deregistered — import it BEFORE the pops.
+    import jax.experimental.pallas  # noqa: F401
+    import jax._src.xla_bridge as xb
+
+    for plugin in ("axon", "tpu"):
+        xb._backend_factories.pop(plugin, None)

@@ -9,29 +9,17 @@ any backend initializes, or tests contend for (and hang on) the one chip.
 """
 
 import os
+import sys
 
-# XLA_FLAGS is read lazily at first backend init, so this is still in time.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flow_updating_tpu.utils.backend import pin_cpu  # noqa: E402
+
+pin_cpu(n_virtual_devices=8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-
-# jax.experimental.pallas (via checkify) registers TPU lowering rules at
-# import time and refuses if "tpu" is not a known platform — import it
-# BEFORE deregistering the TPU plugin factories below.
-import jax.experimental.pallas  # noqa: E402,F401
-
-import jax._src.xla_bridge as _xb  # noqa: E402
-
-for _plugin in ("axon", "tpu"):
-    _xb._backend_factories.pop(_plugin, None)
 
 import pytest  # noqa: E402
 
