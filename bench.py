@@ -241,6 +241,11 @@ def run_bench(args) -> dict:
                   "collect-all, fast synchronous)",
         "value": round(tpu["rounds_per_sec"], 2),
         "unit": "rounds/sec",
+        # which backend actually measured: "tpu", or "cpu" for the pinned
+        # fallback — so a fallback line can never pass as a TPU number
+        # (extra.tpu.device carries the concrete device).  The DES baseline
+        # is native host C++ either way, so recording it stays valid.
+        "backend": args.backend,
         "vs_baseline": (
             round(tpu["rounds_per_sec"] / base_rps, 2) if base_rps else None
         ),

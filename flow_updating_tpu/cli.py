@@ -144,7 +144,10 @@ def cmd_run(args) -> int:
         # restore allocates no fresh state; the checkpoint's config governs
         # the run (it is part of the run's identity — e.g. delay_depth
         # shapes the ring buffer).
-        engine.restore_checkpoint(args.resume)
+        try:
+            engine.restore_checkpoint(args.resume)
+        except ValueError as err:
+            raise SystemExit(f"invalid flag combination: {err}")
         if engine.config != cfg:
             logging.getLogger("flow_updating_tpu.cli").warning(
                 "--resume: checkpoint config %s overrides CLI flags %s",

@@ -184,7 +184,26 @@ class Engine:
     ) -> "Engine":
         """The reference's watcher actor (``collectall.py:139-148``): sample
         global state every ``time_interval`` simulated seconds, and at
-        ``run_until`` stop all peers ("kill_all")."""
+        ``run_until`` stop all peers ("kill_all").
+
+        Registering a watcher whose deadline lies in the future revives a
+        previously killed run: a checkpoint taken after a watcher fired
+        restores ``killed`` (faithful dead-time semantics within the saved
+        run), but a *new* watcher with a later deadline is an explicit
+        request to keep simulating — without this, ``--resume --until T``
+        past an old deadline would silently freeze every peer.
+        """
+        if self._killed and float(run_until) > self._clock:
+            logger.info(
+                "[%0.1f] watcher: reviving peers (new deadline %.1f)",
+                self._clock, float(run_until),
+            )
+            self._killed = False
+            # prune expired watchers, or the first run_until event would
+            # immediately re-kill the revived peers at their old deadline
+            self._watchers = [
+                w for w in self._watchers if w["until"] > self._clock
+            ]
         self._watchers.append(
             {"until": float(run_until), "every": float(time_interval),
              "callback": callback}

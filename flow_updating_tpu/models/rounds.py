@@ -409,12 +409,18 @@ def _observe_chunk(s, topo, cfg, observe_every: int, mean):
     alive = s.alive
     cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
     err = jnp.where(alive, est - mean, 0)
+    # Summing (N,) int32 fire counters keeps int32 in JAX and would wrap
+    # once N*rounds exceeds ~2.1e9 — i.e. at the advertised ~1M-node bench
+    # scale.  Accumulate in int64 when x64 is on; otherwise float32 (never
+    # wraps; approximate beyond 2^24 events, fine for an observability
+    # counter).
+    fired_acc = jnp.int64 if jax.config.jax_enable_x64 else jnp.float32
     sample = (
         s.t,
         jnp.sqrt(jnp.sum(err * err) / cnt),
         jnp.max(jnp.abs(err)),
         jnp.sum(jnp.where(alive, est, 0)),
-        jnp.sum(s.fired),
+        jnp.sum(s.fired, dtype=fired_acc),
     )
     return s, sample
 

@@ -102,7 +102,10 @@ class NodeKernel:
 
         if cfg.spmv == "pallas":
             if mesh is not None:
-                raise NotImplementedError(
+                # a config-validity error: the CLI's build/resume handlers
+                # turn ValueError into a clean "invalid flag combination"
+                # exit (cli.py:cmd_run)
+                raise ValueError(
                     "spmv='pallas' has no SPMD partitioning path yet; use "
                     "spmv='xla' with a mesh (GSPMD handles the collective)"
                 )

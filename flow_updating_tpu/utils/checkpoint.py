@@ -24,6 +24,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import warnings
 
 import jax
 import numpy as np
@@ -73,6 +74,7 @@ def save_checkpoint(
         "state_class": type(state).__name__,
         "config": dataclasses.asdict(cfg),
         "topology": topology_fingerprint(topo) if topo is not None else None,
+        "dtypes": {k[len("state."):]: str(v.dtype) for k, v in arrays.items()},
         "extra": extra or {},
     }
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -128,5 +130,29 @@ def load_checkpoint(
                 f"{'match' if fp['digest'] == manifest['topology']['digest'] else 'differ'})"
             )
     cfg = RoundConfig(**manifest["config"])
+
+    # Dtype validation: a checkpoint saved under x64 (float64/int64 leaves)
+    # restored in an x64-disabled runtime would be *silently* downcast to
+    # 32-bit the moment the numpy leaves enter jit, quietly changing
+    # trajectories while claiming a bit-exact resume.  Detect that here and
+    # make the cast loud and explicit instead.
+    saved_dtypes = manifest.get("dtypes", {})
+    for name, arr in fields.items():
+        saved = saved_dtypes.get(name)
+        if saved is not None and str(arr.dtype) != saved:
+            raise ValueError(
+                f"checkpoint leaf {name!r} dtype {arr.dtype} does not match "
+                f"its manifest entry {saved!r} (corrupt archive?)"
+            )
+        canonical = jax.dtypes.canonicalize_dtype(arr.dtype)
+        if canonical != arr.dtype:
+            warnings.warn(
+                f"checkpoint leaf {name!r} was saved as {arr.dtype} but this "
+                f"runtime canonicalizes it to {canonical} (jax_enable_x64 is "
+                "off) — casting explicitly; the resume is NOT bit-exact",
+                stacklevel=2,
+            )
+            fields[name] = arr.astype(canonical)
+
     state = state_cls(**fields)
     return state, cfg, manifest.get("extra", {})

@@ -95,3 +95,50 @@ def test_config_restored_overrides(tmp_path):
     e.restore_checkpoint(path)
     assert e.config == saved_cfg
     assert e.state.buf_flow.shape[0] == 3
+
+
+def test_resume_past_watcher_kill(tmp_path, small6):
+    """A checkpoint taken after a watcher's kill_all restores killed=True,
+    but a new watcher with a later deadline must revive the peers —
+    otherwise --resume --until T would silently freeze the whole run."""
+    platform, deployment = small6
+    cfg = RoundConfig.reference(variant="collectall", delay_depth=2)
+
+    def fresh():
+        e = Engine(config=cfg)
+        e.platform = platform
+        e.deployment = deployment
+        return e
+
+    path = str(tmp_path / "killed.npz")
+    a = fresh().build()
+    a.add_watcher(run_until=50.0, time_interval=25.0)
+    a.run_until(50.0)
+    a.save_checkpoint(path)
+    rmse_at_kill = float(np.sqrt(np.mean(
+        (a.estimates() - a.topology.true_mean) ** 2)))
+
+    b = fresh().restore_checkpoint(path)
+    b.add_watcher(run_until=400.0, time_interval=100.0)
+    b.run_until(400.0)
+    assert int(b.state.t) == 400
+    rmse_resumed = float(np.sqrt(np.mean(
+        (b.estimates() - b.topology.true_mean) ** 2)))
+    assert rmse_resumed < rmse_at_kill / 10
+
+
+def test_revive_in_session(small6):
+    """Reviving must also work on one live engine: the stale expired
+    watcher must not re-kill the peers at its old deadline."""
+    platform, deployment = small6
+    e = Engine(config=RoundConfig.reference(variant="collectall",
+                                            delay_depth=2))
+    e.platform = platform
+    e.deployment = deployment
+    e.build()
+    e.add_watcher(run_until=50.0, time_interval=25.0)
+    e.run_until(50.0)
+    assert int(e.state.t) == 50
+    e.add_watcher(run_until=400.0, time_interval=100.0)
+    e.run_until(400.0)
+    assert int(e.state.t) == 400

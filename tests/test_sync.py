@@ -228,5 +228,5 @@ def test_pallas_with_mesh_rejected():
 
     topo = ring(32, k=2, seed=0)
     cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv="pallas")
-    with pytest.raises(NotImplementedError, match="pallas"):
+    with pytest.raises(ValueError, match="pallas"):
         sync.NodeKernel(topo, cfg, mesh=make_mesh(8))
