@@ -115,6 +115,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         "rmse_after": err,
         "kernel": kernel,
         "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
     }
 
 
@@ -241,11 +242,11 @@ def run_bench(args) -> dict:
                   "collect-all, fast synchronous)",
         "value": round(tpu["rounds_per_sec"], 2),
         "unit": "rounds/sec",
-        # which backend actually measured: "tpu", or "cpu" for the pinned
-        # fallback — so a fallback line can never pass as a TPU number
-        # (extra.tpu.device carries the concrete device).  The DES baseline
-        # is native host C++ either way, so recording it stays valid.
-        "backend": args.backend,
+        # the platform that ACTUALLY measured (not the CLI flag): a CPU
+        # fallback — or a --backend tpu run that silently landed on CPU —
+        # can never pass as a TPU number.  The DES baseline is native host
+        # C++ either way, so recording it stays valid.
+        "backend": {"axon": "tpu"}.get(tpu["platform"], tpu["platform"]),
         "vs_baseline": (
             round(tpu["rounds_per_sec"] / base_rps, 2) if base_rps else None
         ),
@@ -297,16 +298,13 @@ def _run_child(extra_args, cpu_pinned: bool, timeout_s: float = 5400.0) -> int:
     successful probe must still end in the CPU fallback / diagnostic JSON,
     never an indefinite parent hang.
     """
-    env = dict(os.environ)
-    env[_CHILD_ENV] = "1"
     if cpu_pinned:
-        keep = [
-            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon_site" not in p
-        ]
-        env["PYTHONPATH"] = os.pathsep.join([REPO, *keep])
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("JAX_PLATFORM_NAME", None)
+        from flow_updating_tpu.utils.backend import cpu_subprocess_env
+
+        env = cpu_subprocess_env(extra_path=REPO)
+    else:
+        env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
     argv, skip = [], False
     for a in sys.argv[1:]:
         if skip:

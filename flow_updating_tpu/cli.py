@@ -147,7 +147,9 @@ def cmd_run(args) -> int:
         try:
             engine.restore_checkpoint(args.resume)
         except ValueError as err:
-            raise SystemExit(f"invalid flag combination: {err}")
+            # covers both bad checkpoints (format/fingerprint/dtype) and
+            # config-validity errors raised while rebuilding kernels
+            raise SystemExit(f"cannot resume from {args.resume}: {err}")
         if engine.config != cfg:
             logging.getLogger("flow_updating_tpu.cli").warning(
                 "--resume: checkpoint config %s overrides CLI flags %s",

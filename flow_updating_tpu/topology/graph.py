@@ -51,6 +51,12 @@ class Topology:
     speeds: np.ndarray | None = None    # (N,) float64 host flop-rates, optional
     bandwidth: np.ndarray | None = None  # (E,) float64 route bandwidth, optional
     latency_s: np.ndarray | None = None  # (E,) float64 route latency (seconds)
+    adopted: np.ndarray | None = None   # (A,2) int64 directed edges adopted at
+    #                                     load to symmetrize a declared-
+    #                                     asymmetric graph (the load-time
+    #                                     mirror of the reference's runtime
+    #                                     neighbor repair, collectall.py:94-96);
+    #                                     None on the native big-graph path
 
     @property
     def num_edges(self) -> int:
@@ -340,6 +346,7 @@ def build_topology(
         from flow_updating_tpu import native
 
         native_out = native.build_graph_arrays(num_nodes, pairs_arr)
+    adopted = None
     if native_out is not None:
         src, dst, rev, out_deg = native_out
         E = len(src)
@@ -422,4 +429,5 @@ def build_topology(
         speeds=np.asarray(speeds, dtype=np.float64) if speeds is not None else None,
         bandwidth=bw,
         latency_s=lat,
+        adopted=adopted,
     )

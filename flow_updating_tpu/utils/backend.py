@@ -15,6 +15,43 @@ from __future__ import annotations
 import os
 
 
+def _with_host_device_count(flags: str, n: int) -> str:
+    kept = [
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={int(n)}")
+    return " ".join(kept)
+
+
+def cpu_subprocess_env(
+    n_virtual_devices: int | None = None,
+    extra_path: str | None = None,
+) -> dict:
+    """Environment for a *subprocess* that must come up CPU-only.
+
+    A fresh interpreter needs no factory deregistration — dropping the axon
+    sitecustomize entry from PYTHONPATH means the TPU plugin never
+    registers.  ``extra_path`` (e.g. the repo root) is prepended so the
+    child can still import this package.
+    """
+    env = dict(os.environ)
+    keep = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p
+    ]
+    if extra_path:
+        keep.insert(0, extra_path)
+    env["PYTHONPATH"] = os.pathsep.join(keep)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_PLATFORM_NAME", None)
+    if n_virtual_devices:
+        env["XLA_FLAGS"] = _with_host_device_count(
+            env.get("XLA_FLAGS", ""), n_virtual_devices
+        )
+    return env
+
+
 def pin_cpu(n_virtual_devices: int | None = None) -> None:
     """Force the host-CPU backend, optionally with N virtual devices.
 
@@ -23,14 +60,9 @@ def pin_cpu(n_virtual_devices: int | None = None) -> None:
     before any ``jax.devices()``/trace — is still in time).
     """
     if n_virtual_devices:
-        flags = [
-            f for f in os.environ.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
-        ]
-        flags.append(
-            f"--xla_force_host_platform_device_count={int(n_virtual_devices)}"
+        os.environ["XLA_FLAGS"] = _with_host_device_count(
+            os.environ.get("XLA_FLAGS", ""), n_virtual_devices
         )
-        os.environ["XLA_FLAGS"] = " ".join(flags)
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("JAX_PLATFORM_NAME", None)
 

@@ -1,0 +1,100 @@
+"""End-to-end parity against the REAL reference inputs.
+
+Every other test uses the in-repo ``small6`` fixtures; these load the
+reference's own ``platforms/small_platform.xml`` + ``actors.xml``
+(``flowupdating-collectall.py:154-157``) and assert the two behaviors the
+reference exhibits on them:
+
+* the declared neighbor lists are asymmetric and exactly 6 directed edges
+  must be adopted to symmetrize (the runtime repair path at
+  ``flowupdating-collectall.py:94-96``, absorbed at load time here);
+* the faithful-mode run converges every estimate to the deployment mean
+  31.6667 (values 15, 10, 20, 60, 80, 5 — ``actors.xml:4-27``), the
+  reference's only correctness signal (watcher log, SURVEY.md §4).
+
+Skipped wholesale when the reference snapshot is not present.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+
+REF = "/root/reference"
+PLATFORM_XML = os.path.join(REF, "platforms", "small_platform.xml")
+ACTORS_XML = os.path.join(REF, "actors.xml")
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(PLATFORM_XML) and os.path.exists(ACTORS_XML)),
+    reason="reference snapshot not available",
+)
+
+TRUE_MEAN = (15.0 + 10.0 + 20.0 + 60.0 + 80.0 + 5.0) / 6.0  # 31.666...
+
+# reverse directions never declared in actors.xml (SURVEY.md A7): the six
+# edges the reference adopts at runtime and this loader adopts at load time
+EXPECTED_ADOPTED = {
+    ("Ginette", "Boivin"),
+    ("Fafard", "Jacquelin"),
+    ("Ginette", "Jacquelin"),
+    ("Fafard", "Bourassa"),
+    ("Ginette", "Bourassa"),
+    ("Jacquelin", "Bourassa"),
+}
+
+
+@pytest.fixture(scope="module")
+def reference_inputs():
+    from flow_updating_tpu.topology.deployment import load_deployment
+    from flow_updating_tpu.topology.platform import load_platform
+
+    return load_platform(PLATFORM_XML), load_deployment(ACTORS_XML)
+
+
+def test_platform_parses(reference_inputs):
+    platform, _ = reference_inputs
+    assert len(platform.hosts) == 7
+    assert len(platform.links) == 24
+    assert len(platform.routes) == 26
+    # spot values from small_platform.xml:5-36
+    assert platform.hosts["Tremblay"] > 0
+    assert all(l.bandwidth > 0 and l.latency >= 0
+               for l in platform.links.values())
+
+
+def test_deployment_parses(reference_inputs):
+    _, deployment = reference_inputs
+    assert len(deployment.actors) == 6
+    values = {a.host: float(a.args[0]) for a in deployment.actors}
+    assert values == {"Fafard": 15.0, "Ginette": 10.0, "Boivin": 20.0,
+                      "Jupiter": 60.0, "Jacquelin": 80.0, "Bourassa": 5.0}
+
+
+def test_exactly_six_adopted_edges(reference_inputs):
+    platform, deployment = reference_inputs
+    topo = deployment.to_topology(platform)
+    assert topo.adopted is not None
+    names = topo.names
+    adopted = {(names[int(a)], names[int(b)]) for a, b in topo.adopted}
+    assert adopted == EXPECTED_ADOPTED
+    # 14 declared + 6 adopted = 20 directed edges, symmetric
+    assert topo.num_edges == 20
+    np.testing.assert_array_equal(topo.src[topo.rev], topo.dst)
+
+
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_faithful_convergence_to_reference_mean(reference_inputs, variant):
+    platform, deployment = reference_inputs
+    cfg = RoundConfig.reference(variant=variant, delay_depth=2)
+    e = Engine(config=cfg)
+    e.platform = platform
+    e.deployment = deployment
+    e.build()
+    e.run_until(1000.0)  # the reference watcher's kill deadline
+    est = e.estimates()
+    assert abs(float(est.mean()) - TRUE_MEAN) < 1e-3
+    rmse = float(np.sqrt(np.mean((est - TRUE_MEAN) ** 2)))
+    assert rmse < 1e-4
