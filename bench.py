@@ -41,7 +41,7 @@ def build_topology(k: int):
 
 
 def measure_tpu(topo, rounds: int, kernel: str = "node",
-                spmv: str = "xla") -> dict:
+                spmv: str = "xla", segment: str = "auto") -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: under the axon TPU tunnel, ``jax.block_until_ready`` can
@@ -56,7 +56,11 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
     from flow_updating_tpu.models.config import RoundConfig
     from flow_updating_tpu.utils.metrics import rmse
 
-    cfg = RoundConfig.fast(variant="collectall")
+    if segment != "auto" and kernel != "edge":
+        raise SystemExit(
+            "--segment selects the edge kernel's reduction layout; "
+            "combine it with --kernel edge"
+        )
 
     if kernel == "node":
         from flow_updating_tpu.models import sync
@@ -75,7 +79,9 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         from flow_updating_tpu.models.rounds import node_estimates, run_rounds
         from flow_updating_tpu.models.state import init_state
 
-        arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+        cfg = RoundConfig.fast(variant="collectall", segment_impl=segment)
+        arrays = topo.device_arrays(coloring=cfg.needs_coloring,
+                                    segment_ell=cfg.use_segment_ell)
         state = init_state(topo, cfg)
 
         def run(r):
@@ -114,6 +120,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         "rounds": 2 * rounds,
         "rmse_after": err,
         "kernel": kernel,
+        "segment": segment if kernel == "edge" else None,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }
@@ -205,6 +212,9 @@ def parse_args(argv=None):
                          "(models/sync.py) or the general edge kernel")
     ap.add_argument("--spmv", default="xla", choices=("xla", "pallas"),
                     help="neighbor-sum implementation for --kernel node")
+    ap.add_argument("--segment", default="auto",
+                    choices=("auto", "segment", "ell"),
+                    help="per-node reduction layout for --kernel edge")
     ap.add_argument("--des-ticks", type=int, default=2,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--skip-des", action="store_true",
@@ -222,7 +232,8 @@ def run_bench(args) -> dict:
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 
-    tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=args.spmv)
+    tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=args.spmv,
+                      segment=args.segment)
     conv = None if args.skip_convergence else measure_rounds_to_rmse(topo)
 
     des = None if args.skip_des else measure_des_baseline(topo, args.des_ticks)

@@ -114,7 +114,8 @@ def _make_config(args):
     kw = dict(variant=args.variant, drop_rate=args.drop_rate,
               kernel=getattr(args, "kernel", "edge"),
               delivery=getattr(args, "delivery", "gather"),
-              spmv=getattr(args, "spmv", "xla"))
+              spmv=getattr(args, "spmv", "xla"),
+              segment_impl=getattr(args, "segment", "auto"))
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
@@ -290,6 +291,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--spmv", default="xla", choices=("xla", "pallas"),
                      help="node-kernel neighbor-sum implementation "
                           "(pallas keeps the vector VMEM-resident)")
+    run.add_argument("--segment", default="auto",
+                     choices=("auto", "segment", "ell"),
+                     help="edge-kernel per-node reduction layout: jax.ops "
+                          "segment primitives vs scatter-free degree-"
+                          "bucketed ELL gather+row-reduce")
     run.add_argument("--shards", type=int, default=0,
                      help="shard the node axis over N devices (GSPMD over a "
                           "jax Mesh; 0 = single device)")

@@ -146,6 +146,12 @@ class Engine:
 
                 self.config = dataclasses.replace(self.config, delay_depth=depth)
         if self.mesh is not None:
+            if self.config.use_segment_ell:
+                raise ValueError(
+                    "segment_impl='ell' is single-device only (the ELL "
+                    "matrices index the global edge list); with a mesh, "
+                    "GSPMD lowers the segment path's collectives instead"
+                )
             from flow_updating_tpu.parallel import auto
 
             padded, self._n_real, _ = auto.pad_topology(
@@ -155,7 +161,8 @@ class Engine:
             self._topo_arrays = None  # built with the state in build()
         else:
             self._topo_arrays = self.topology.device_arrays(
-                coloring=self.config.needs_coloring
+                coloring=self.config.needs_coloring,
+                segment_ell=self.config.use_segment_ell,
             )
 
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":

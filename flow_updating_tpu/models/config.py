@@ -64,6 +64,12 @@ class RoundConfig:
     spmv: str = "xla"                  # node-kernel neighbor sum: 'xla'
     #                                    (gather + rowsum) | 'pallas' (VMEM-
     #                                    resident x, ops/pallas_spmv.py)
+    segment_impl: str = "auto"         # edge-kernel per-node reductions:
+    #                                    'segment' (jax.ops segment_* —
+    #                                    scatter-based lowering) | 'ell'
+    #                                    (degree-bucketed out-edge ELL
+    #                                    gather + row-reduce, scatter-free;
+    #                                    ops/segment.py) | 'auto' (= segment)
 
     def __post_init__(self):
         if self.variant not in (COLLECTALL, PAIRWISE):
@@ -80,6 +86,13 @@ class RoundConfig:
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
+        if self.segment_impl not in ("auto", "segment", "ell"):
+            raise ValueError(f"unknown segment_impl {self.segment_impl!r}")
+        if self.segment_impl == "ell" and self.kernel == "node":
+            raise ValueError(
+                "segment_impl='ell' selects the edge kernel's reduction "
+                "layout; the node kernel has its own (spmv='xla'|'pallas')"
+            )
         if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(
                 "kernel='node' covers exactly the fast synchronous "
@@ -100,6 +113,12 @@ class RoundConfig:
     @property
     def jnp_dtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def use_segment_ell(self) -> bool:
+        """Materialize the ELL out-edge matrices for scatter-free
+        per-node reductions in the edge kernel."""
+        return self.segment_impl == "ell"
 
     @property
     def needs_coloring(self) -> bool:

@@ -53,9 +53,10 @@ _I32_MAX = jnp.iinfo(jnp.int32).max
 
 # Per-node reductions over out-edges dispatch on the topology arrays: when
 # the degree-bucketed out-edge ELL matrices are materialized
-# (device_arrays(segment_ell=True), selected by cfg.segment_impl='ell'),
-# every reduction is a scatter-free gather + row-reduce; otherwise the
-# jax.ops segment primitives (scatter-based lowering) are used.
+# (device_arrays(segment_ell=True), selected by cfg.segment_impl='ell'
+# through Engine._prepare_arrays / the CLI --segment flag), every reduction
+# is a scatter-free gather + row-reduce; otherwise the jax.ops segment
+# primitives (scatter-based lowering) are used.
 
 def _seg_sum(x, topo, N):
     if topo.ell_edge_mats is not None:

@@ -1,0 +1,114 @@
+"""The scatter-free ELL segment-reduction path (cfg.segment_impl='ell').
+
+SURVEY.md §7 hard part (a): degree-skewed scatter/gather.  These tests pin
+the ELL lowering to the jax.ops segment lowering on a degree-skewed
+Barabási–Albert graph — same reductions, same trajectories, end to end
+through the engine.  Order-free reductions (min/max/all) must match
+bit-for-bit; sums only to ~1e-13 relative, since XLA guarantees no
+particular float summation order for either lowering.
+"""
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.ops.segment import (
+    ell_segment_all,
+    ell_segment_max,
+    ell_segment_min,
+    ell_segment_sum,
+    segment_all,
+    segment_max,
+    segment_min,
+    segment_sum,
+)
+from flow_updating_tpu.topology.generators import barabasi_albert
+
+
+@pytest.fixture(scope="module")
+def ba():
+    return barabasi_albert(300, m=3, seed=7)
+
+
+@pytest.fixture(scope="module")
+def ba_arrays(ba):
+    return ba.device_arrays(segment_ell=True)
+
+
+def test_ell_reductions_match_segment_ops(ba, ba_arrays):
+    rng = np.random.default_rng(0)
+    E, N = ba.num_edges, ba.num_nodes
+    x = rng.normal(size=E)
+    pred = rng.random(E) < 0.5
+
+    np.testing.assert_allclose(
+        np.asarray(ell_segment_sum(x, ba_arrays)),
+        np.asarray(segment_sum(x, ba_arrays.src, N)),
+        rtol=1e-13, atol=1e-13,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ell_segment_min(x, ba_arrays, np.inf)),
+        np.asarray(segment_min(x, ba_arrays.src, N)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ell_segment_max(x, ba_arrays, -np.inf)),
+        np.asarray(segment_max(x, ba_arrays.src, N)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ell_segment_all(pred, ba_arrays)),
+        np.asarray(segment_all(pred, ba_arrays.src, N)),
+    )
+
+
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_ell_trajectories_match(ba, ba_arrays, variant):
+    """The full faithful-mode kernel under ELL reductions reproduces the
+    segment-path trajectory (float64; tolerance covers summation-order
+    float drift only — any indexing bug would diverge by whole values)."""
+    cfg = RoundConfig.reference(variant=variant, dtype="float64")
+    seg_arrays = ba.device_arrays(coloring=cfg.needs_coloring)
+    state0 = init_state(ba, cfg)
+
+    out_seg = run_rounds(state0, seg_arrays, cfg, 120)
+    out_ell = run_rounds(state0, ba_arrays, cfg, 120)
+    np.testing.assert_allclose(
+        np.asarray(node_estimates(out_seg, seg_arrays)),
+        np.asarray(node_estimates(out_ell, ba_arrays)),
+        rtol=1e-10, atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_seg.flow), np.asarray(out_ell.flow),
+        rtol=1e-10, atol=1e-10,
+    )
+
+
+def test_engine_segment_impl_knob(ba):
+    ests = {}
+    for impl in ("segment", "ell"):
+        cfg = RoundConfig.fast(variant="collectall", dtype="float64",
+                               segment_impl=impl)
+        e = Engine(config=cfg).set_topology(ba).build()
+        e.run_rounds(60)
+        ests[impl] = e.estimates()
+    np.testing.assert_allclose(ests["segment"], ests["ell"],
+                               rtol=1e-10, atol=1e-10)
+    assert np.max(np.abs(ests["ell"] - ba.true_mean)) < 1e-6
+
+
+def test_invalid_combinations():
+    with pytest.raises(ValueError, match="segment_impl"):
+        RoundConfig(segment_impl="bogus")
+    with pytest.raises(ValueError, match="node kernel"):
+        RoundConfig.fast(kernel="node", segment_impl="ell")
+
+    from flow_updating_tpu.parallel.mesh import make_mesh
+    from flow_updating_tpu.topology.generators import ring
+
+    cfg = RoundConfig.fast(segment_impl="ell")
+    with pytest.raises(ValueError, match="single-device"):
+        Engine(config=cfg, mesh=make_mesh(8)).set_topology(
+            ring(32, k=2, seed=0)
+        ).build()
