@@ -40,21 +40,28 @@ def build_topology(k: int):
     return fat_tree(k, seed=0)
 
 
-def measure_tpu(topo, rounds: int, kernel: str = "node",
-                spmv: str = "xla", segment: str = "auto") -> dict:
-    """Time the fast synchronous collect-all kernel.
+# A single on-device execution through the axon tunnel is killed at ~60s
+# ("TPU worker process crashed or restarted"; bisected in TPU_LADDER.json:
+# 50.7s scan OK, ~67s scan dies — see BENCH_NOTES.md).  Keep every launch
+# far below that: grow the timed scan only while its 2R run stays under
+# this cap.
+MAX_LAUNCH_S = 20.0
 
-    Timing notes: under the axon TPU tunnel, ``jax.block_until_ready`` can
-    return before remote execution finishes, so completion is forced with a
-    device->host read; and each executable launch carries a large fixed
-    tunnel round-trip, so the per-round cost is the *difference* between a
-    2R-round and an R-round scan divided by R (launch overhead cancels).
+
+def make_runner(topo, kernel: str = "node", spmv: str = "xla",
+                segment: str = "auto"):
+    """Build the fast collect-all measurement closure for one topology.
+
+    Returns ``(run, read_est)``: ``run(r)`` executes an r-round compiled
+    scan from the *initial* state and forces completion with a
+    device->host read (under the axon tunnel, ``block_until_ready`` can
+    return before remote execution finishes); ``read_est(out)`` reads the
+    per-node estimates.  Shared by the headline bench and the scale-ladder
+    diagnostic (scripts/tpu_ladder.py) so both measure the same thing.
     """
-    import jax
     import numpy as np
 
     from flow_updating_tpu.models.config import RoundConfig
-    from flow_updating_tpu.utils.metrics import rmse
 
     if segment != "auto" and kernel != "edge":
         raise SystemExit(
@@ -90,13 +97,34 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
             return out
 
         read_est = lambda out: np.asarray(node_estimates(out, arrays))
+    return run, read_est
+
+
+def measure_tpu(topo, rounds: int, kernel: str = "node",
+                spmv: str = "xla", segment: str = "auto") -> dict:
+    """Time the fast synchronous collect-all kernel.
+
+    Timing notes: each executable launch carries a large fixed tunnel
+    round-trip, so the per-round cost is the *difference* between a
+    2R-round and an R-round scan divided by R (launch overhead cancels).
+    Each launch is bounded by ``MAX_LAUNCH_S`` (the tunnel kills ~60s
+    executions); long convergence runs are chunked instead.
+    """
+    import jax
+    import numpy as np
+
+    from flow_updating_tpu.utils.metrics import rmse
+
+    run, read_est = make_runner(topo, kernel=kernel, spmv=spmv,
+                                segment=segment)
 
     t0 = time.perf_counter()
     out = run(rounds)
     compile_s = time.perf_counter() - t0
 
     # adaptive: grow the scan until the R-vs-2R difference clears timer +
-    # launch-overhead noise (tiny graphs run far under the tunnel RTT)
+    # launch-overhead noise (tiny graphs run far under the tunnel RTT) —
+    # but never past the per-launch execution cap.
     while True:
         run(rounds)      # warm both scan lengths (jit keys on num_rounds,
         run(2 * rounds)  # so a grown `rounds` needs a fresh compile)
@@ -106,7 +134,8 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         t0 = time.perf_counter()
         out2 = run(2 * rounds)
         t_2r = time.perf_counter() - t0
-        if t_2r - t_r > 0.05 or rounds >= 262144:
+        if (t_2r - t_r > 0.05 or rounds >= 262144
+                or t_2r * 8 > MAX_LAUNCH_S):
             break
         rounds *= 8
     per_round = max((t_2r - t_r) / rounds, 1e-9)
@@ -159,21 +188,31 @@ def measure_rounds_to_rmse(topo, threshold: float = 1e-6,
             "converged": err < threshold}
 
 
-def measure_des_baseline(topo, ticks: int) -> dict | None:
-    """Reference-style DES, same topology, full average per node per tick."""
+def measure_des_baseline(topo, ticks: int, repeats: int = 3) -> dict | None:
+    """Reference-style DES, same topology, full average per node per tick.
+
+    Runs ``repeats`` independent measurements and reports the mean with
+    spread (ADVICE r2: a single 2-tick sample was noisy enough to move the
+    headline ratio 1.7x between rounds)."""
     from flow_updating_tpu import native
 
     if not native.available():
         return None
-    t0 = time.perf_counter()
-    _est, _la, events = native.des_run(
-        topo, variant="collectall", timeout=1, ticks=ticks
-    )
-    elapsed = time.perf_counter() - t0
+    rates, events = [], 0
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        _est, _la, events = native.des_run(
+            topo, variant="collectall", timeout=1, ticks=ticks
+        )
+        rates.append(ticks / (time.perf_counter() - t0))
+    mean = sum(rates) / len(rates)
     return {
-        "rounds_per_sec": ticks / elapsed,
-        "run_s": elapsed,
+        "rounds_per_sec": mean,
+        "rounds_per_sec_min": min(rates),
+        "rounds_per_sec_max": max(rates),
+        "spread_pct": round(100 * (max(rates) - min(rates)) / mean, 1),
         "ticks": ticks,
+        "repeats": len(rates),
         "events": events,
     }
 
@@ -187,12 +226,19 @@ def recorded_baseline(k: int) -> float | None:
 
 
 def record_baseline(k: int, entry: dict) -> None:
+    """Persist a measured DES baseline — but never replace a recorded entry
+    with a lower-quality one (fewer ticks x repeats; ADVICE r2 found a
+    2-tick sample silently overwriting a better measurement)."""
     data = {}
     try:
         with open(MEASURED_PATH) as f:
             data = json.load(f)
     except Exception:
         pass
+    old = data.get(f"k{k}", {}).get("des", {})
+    quality = lambda d: d.get("ticks", 0) * d.get("repeats", 1)
+    if quality(old) > quality(entry["des"]):
+        return
     data[f"k{k}"] = entry
     try:
         with open(MEASURED_PATH, "w") as f:
@@ -205,8 +251,10 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--fat-tree-k", type=int, default=160,
                     help="fat-tree arity (160 -> ~1.056M vertices)")
-    ap.add_argument("--rounds", type=int, default=512,
-                    help="timed TPU rounds")
+    ap.add_argument("--rounds", type=int, default=64,
+                    help="starting timed scan length (grows adaptively while "
+                         "each launch stays under the tunnel execution cap; "
+                         "at 1M nodes 64 rounds is already ~4s on-device)")
     ap.add_argument("--kernel", default="node", choices=("node", "edge"),
                     help="fast-path kernel: node-collapsed SpMV recurrence "
                          "(models/sync.py) or the general edge kernel")
@@ -215,8 +263,11 @@ def parse_args(argv=None):
     ap.add_argument("--segment", default="auto",
                     choices=("auto", "segment", "ell"),
                     help="per-node reduction layout for --kernel edge")
-    ap.add_argument("--des-ticks", type=int, default=2,
+    ap.add_argument("--des-ticks", type=int, default=10,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
+    ap.add_argument("--des-repeats", type=int, default=3,
+                    help="independent DES baseline measurements (mean+spread "
+                         "reported)")
     ap.add_argument("--skip-des", action="store_true",
                     help="use the recorded baseline instead of measuring")
     ap.add_argument("--skip-convergence", action="store_true",
@@ -236,7 +287,8 @@ def run_bench(args) -> dict:
                       segment=args.segment)
     conv = None if args.skip_convergence else measure_rounds_to_rmse(topo)
 
-    des = None if args.skip_des else measure_des_baseline(topo, args.des_ticks)
+    des = None if args.skip_des else measure_des_baseline(
+        topo, args.des_ticks, args.des_repeats)
     if des is not None:
         base_rps = des["rounds_per_sec"]
         base_src = "measured"
@@ -301,9 +353,12 @@ def _probe_tpu(timeout_s: float = 290.0):
     return ("ok", plat) if plat in ("tpu", "axon") else ("other", plat)
 
 
-def _run_child(extra_args, cpu_pinned: bool, timeout_s: float = 5400.0) -> int:
-    """Re-exec this script with a settled backend; child inherits stdout so
-    its single JSON line passes straight through.
+def _run_child(extra_args, cpu_pinned: bool, timeout_s: float = 5400.0):
+    """Re-exec this script with a settled backend, capturing its output.
+
+    Returns ``(rc, result_dict | None, stderr_tail)``: the child's single
+    JSON line is parsed here (not passed through) so the parent can attach
+    fallback/diagnostic metadata before printing the final line.
 
     ``timeout_s`` bounds the whole child run: a tunnel wedge *after* a
     successful probe must still end in the CPU fallback / diagnostic JSON,
@@ -325,13 +380,54 @@ def _run_child(extra_args, cpu_pinned: bool, timeout_s: float = 5400.0) -> int:
         elif not a.startswith("--backend="):
             argv.append(a)
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), *argv, *extra_args]
+    err_lines: list[str] = []
+
+    def _pump(stream):
+        # echo the child's stderr line-by-line AS IT RUNS (a silent
+        # multi-minute bench is undebuggable) while keeping a tail for the
+        # final JSON
+        for line in stream:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            err_lines.append(line)
+            if len(err_lines) > 400:
+                del err_lines[:200]
+
     try:
-        return subprocess.run(cmd, env=env, cwd=REPO,
-                              timeout=timeout_s).returncode
+        p = subprocess.Popen(cmd, env=env, cwd=REPO, text=True,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except OSError as e:
+        return -1, None, f"bench: child failed to start: {e}"
+    import threading
+
+    out_parts: list[str] = []
+    t_err = threading.Thread(target=_pump, args=(p.stderr,), daemon=True)
+    t_out = threading.Thread(
+        target=lambda: out_parts.extend(p.stdout), daemon=True
+    )
+    t_err.start()
+    t_out.start()
+    try:
+        rc = p.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return -2
-    except subprocess.SubprocessError:
-        return -1
+        p.kill()
+        p.wait()
+        rc = -2
+        err_lines.append(f"bench: child timed out after {timeout_s:.0f}s\n")
+    t_err.join(timeout=5.0)
+    t_out.join(timeout=5.0)
+    out = "".join(out_parts)
+    err = "".join(err_lines)
+    result = None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            result = parsed
+            break
+    return rc, result, err.strip()[-3000:]
 
 
 def main():
@@ -356,18 +452,35 @@ def main():
         time.sleep(60)
         status, detail = _probe_tpu()
 
+    tpu_failure = None
     if status == "ok":
-        rc = _run_child(["--backend", "tpu"], cpu_pinned=False)
-        if rc == 0:
+        rc, result, err_tail = _run_child(["--backend", "tpu"],
+                                          cpu_pinned=False)
+        # rc alone is not enough: a --backend tpu child whose backend init
+        # silently landed on CPU exits 0 with backend:"cpu" — that must
+        # take the degraded path, not read as a passing TPU number
+        if rc == 0 and result is not None and result.get("backend") == "tpu":
+            result["ok"] = True
+            print(json.dumps(result))
             return
-        print(f"bench: TPU child run failed (rc={rc}); "
+        tpu_failure = {"rc": rc, "stderr_tail": err_tail,
+                       "child_backend": (result or {}).get("backend")}
+        print(f"bench: TPU child run failed (rc={rc}, "
+              f"backend={(result or {}).get('backend')}); "
               "falling back to CPU", file=sys.stderr)
     else:
+        tpu_failure = {"probe": [status, detail]}
         print(f"bench: no usable TPU backend ({status}: {detail}); "
               "falling back to CPU", file=sys.stderr)
 
-    rc = _run_child(["--backend", "cpu"], cpu_pinned=True)
-    if rc == 0:
+    rc, result, err_tail = _run_child(["--backend", "cpu"], cpu_pinned=True)
+    if rc == 0 and result is not None:
+        # ADVICE r2: a fallback number must never read as a passing TPU
+        # result — flag it at top level, with the TPU child's evidence.
+        result["ok"] = False
+        result["degraded"] = "tpu_unavailable_cpu_fallback"
+        result.setdefault("extra", {})["tpu_failure"] = tpu_failure
+        print(json.dumps(result))
         return
 
     # Last resort: one parseable diagnostic line, never a bare traceback.
@@ -376,7 +489,9 @@ def main():
         "value": None,
         "unit": "rounds/sec",
         "vs_baseline": None,
-        "error": {"tpu_probe": [status, detail], "cpu_child_rc": rc},
+        "ok": False,
+        "error": {"tpu_probe": [status, detail], "tpu_failure": tpu_failure,
+                  "cpu_child": {"rc": rc, "stderr_tail": err_tail}},
     }))
     sys.exit(1)
 

@@ -90,40 +90,91 @@ def node_estimates(state: FlowUpdatingState, topo) -> jnp.ndarray:
 
 
 def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
-    """Arrivals + drain + receive.  Returns (state, processed_mask)."""
+    """Arrivals + drain + receive.  Returns (state, processed_mask).
+
+    The per-edge pending mailbox is a depth-``Q`` FIFO (``cfg.pending_depth``;
+    slot 0 = oldest): arrivals append at the first free slot (overwriting the
+    newest on overflow), draining pops the head and shifts.  Q=1 degenerates
+    to the newer-wins single slot.  SimGrid's mailbox queues unmatched puts
+    unboundedly (reference ``flowupdating-collectall.py:74,123-125``); the
+    depth-Q queue reproduces those per-message events up to Q deep —
+    tests/test_dynamics_parity.py quantifies the difference against the DES
+    oracle.
+    """
     N = topo.out_deg.shape[0]
     D = cfg.delay_depth
+    Q = cfg.pending_depth
     slot = state.t % D
 
-    arr_valid = state.buf_valid[slot]
-    pending_flow = jnp.where(arr_valid, state.buf_flow[slot], state.pending_flow)
-    pending_est = jnp.where(arr_valid, state.buf_est[slot], state.pending_est)
-    pending_valid = state.pending_valid | arr_valid
+    arr_valid = state.buf_valid[slot]                      # (E,)
+    # append arrivals at each edge's first free queue slot (newest slot is
+    # overwritten when the queue is full)
+    depth = jnp.sum(state.pending_valid, axis=0)           # (E,) int32
+    put = jnp.minimum(depth, Q - 1)                        # (E,)
+    hit = arr_valid[None, :] & (
+        jnp.arange(Q, dtype=put.dtype)[:, None] == put[None, :]
+    )
+    pending_flow = jnp.where(hit, state.buf_flow[slot][None, :],
+                             state.pending_flow)
+    pending_est = jnp.where(hit, state.buf_est[slot][None, :],
+                            state.pending_est)
+    pending_stamp = jnp.where(hit, state.t, state.pending_stamp)
+    pending_valid = state.pending_valid | hit
     buf_valid = state.buf_valid.at[slot].set(False)
 
     receiver_alive = state.alive[topo.src]
-    candidates = pending_valid & receiver_alive
+    candidates = pending_valid[0] & receiver_alive         # head slot ready
 
     if cfg.drain == 0:
         process = candidates
     else:
-        # Round-robin pick of `drain` pending in-edges per node: priority is
-        # the edge's rank rotated by the round counter, so service order
-        # cycles fairly — the vectorized analogue of FIFO mailbox order.
+        # FIFO pick of `drain` pending in-edges per node: primary key is the
+        # head message's *arrival round* (SimGrid pops the oldest message
+        # across the whole node mailbox — reference ``collectall.py:74``),
+        # tie-broken by the edge's rank rotated by the round counter so
+        # same-round arrivals are serviced round-robin.  Arrival order
+        # matters: a rotating-rank-only pick services queued edges with
+        # systematically stale replies, which destabilizes the pairwise
+        # ping-pong (sustained oscillation at pending_depth > 1).
         process = jnp.zeros_like(candidates)
         remaining = candidates
         prio = jnp.mod(topo.edge_rank - state.t, jnp.maximum(topo.out_deg[topo.src], 1))
         for _ in range(cfg.drain):
-            key = jnp.where(remaining, prio, _I32_MAX)
+            skey = jnp.where(remaining, pending_stamp[0], _I32_MAX)
+            oldest = _seg_min(skey, topo, N, _I32_MAX)
+            tie = remaining & (skey == oldest[topo.src]) & (skey < _I32_MAX)
+            key = jnp.where(tie, prio, _I32_MAX)
             best = _seg_min(key, topo, N, _I32_MAX)
-            pick = remaining & (key == best[topo.src]) & (key < _I32_MAX)
+            pick = tie & (key == best[topo.src]) & (key < _I32_MAX)
             process = process | pick
             remaining = remaining & ~pick
 
-    flow = jnp.where(process, -pending_flow, state.flow)
-    est = jnp.where(process, pending_est, state.est)
+    flow = jnp.where(process, -pending_flow[0], state.flow)
+    est = jnp.where(process, pending_est[0], state.est)
     recv = state.recv | process
-    pending_valid = pending_valid & ~process
+
+    # pop the head of each processed queue: shift slots down by one
+    if Q > 1:
+        shift = lambda a, fill: jnp.concatenate([a[1:], fill], axis=0)
+        pending_flow = jnp.where(
+            process[None, :], shift(pending_flow, pending_flow[-1:]),
+            pending_flow,
+        )
+        pending_est = jnp.where(
+            process[None, :], shift(pending_est, pending_est[-1:]),
+            pending_est,
+        )
+        pending_stamp = jnp.where(
+            process[None, :], shift(pending_stamp, pending_stamp[-1:]),
+            pending_stamp,
+        )
+        pending_valid = jnp.where(
+            process[None, :],
+            shift(pending_valid, jnp.zeros_like(pending_valid[:1])),
+            pending_valid,
+        )
+    else:
+        pending_valid = pending_valid & ~process[None, :]
 
     state = state.replace(
         flow=flow,
@@ -132,6 +183,7 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
         pending_flow=pending_flow,
         pending_est=pending_est,
         pending_valid=pending_valid,
+        pending_stamp=pending_stamp,
         buf_valid=buf_valid,
     )
     return state, process

@@ -37,9 +37,10 @@ class FlowUpdatingState:
     fired: jnp.ndarray         # (N,) int32 — total averaging events per node
     alive: jnp.ndarray         # (N,) bool — failure-injection liveness mask
     edge_ok: jnp.ndarray       # (E,) bool — link-failure mask (False = no send)
-    pending_flow: jnp.ndarray  # (E,) — undrained delivered message payloads
-    pending_est: jnp.ndarray   # (E,)
-    pending_valid: jnp.ndarray  # (E,) bool
+    pending_flow: jnp.ndarray  # (Q, E) — undrained delivered message FIFO
+    pending_est: jnp.ndarray   # (Q, E)    (slot 0 = oldest; Q = cfg.pending_depth)
+    pending_valid: jnp.ndarray  # (Q, E) bool
+    pending_stamp: jnp.ndarray  # (Q, E) int32 — arrival round (drain order key)
     buf_flow: jnp.ndarray      # (D, E) — in-flight ring buffer
     buf_est: jnp.ndarray       # (D, E)
     buf_valid: jnp.ndarray     # (D, E) bool
@@ -72,9 +73,10 @@ def init_state(
         fired=jnp.zeros((N,), jnp.int32),
         alive=jnp.ones((N,), bool),
         edge_ok=jnp.ones((E,), bool),
-        pending_flow=jnp.zeros((E,), dt),
-        pending_est=jnp.zeros((E,), dt),
-        pending_valid=jnp.zeros((E,), bool),
+        pending_flow=jnp.zeros((cfg.pending_depth, E), dt),
+        pending_est=jnp.zeros((cfg.pending_depth, E), dt),
+        pending_valid=jnp.zeros((cfg.pending_depth, E), bool),
+        pending_stamp=jnp.zeros((cfg.pending_depth, E), jnp.int32),
         buf_flow=jnp.zeros((D, E), dt),
         buf_est=jnp.zeros((D, E), dt),
         buf_valid=jnp.zeros((D, E), bool),

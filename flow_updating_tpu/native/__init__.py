@@ -73,6 +73,12 @@ def get_lib():
         i64, i64, i32p, i32p, i32p, i32p, i64p, f64p,
         ctypes.c_int32, i64, i64, f64p, f64p,
     ]
+    lib.fu_des_run_traj.restype = i64
+    lib.fu_des_run_traj.argtypes = [
+        i64, i64, i32p, i32p, i32p, i32p, i64p, f64p,
+        ctypes.c_int32, i64, i64, f64p, f64p,
+        i64, ctypes.c_double, f64p,
+    ]
     _lib = lib
     return _lib
 
@@ -159,3 +165,33 @@ def des_run(topo, variant: str = "collectall", timeout: int = 50,
         _ptr(est, ctypes.c_double), _ptr(last_avg, ctypes.c_double),
     )
     return est, last_avg, int(events)
+
+
+def des_run_traj(topo, variant: str = "collectall", timeout: int = 50,
+                 ticks: int = 1000, obs_every: int = 10):
+    """Like :func:`des_run`, but also returns the RMSE-vs-true-mean
+    trajectory sampled every ``obs_every`` ticks — the dynamics-parity
+    oracle curve (reference semantics per tick, see funative.cpp
+    ``fu_des_run_traj``)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native DES unavailable (no compiler?)")
+    n, E = topo.num_nodes, topo.num_edges
+    src = np.ascontiguousarray(topo.src, np.int32)
+    dst = np.ascontiguousarray(topo.dst, np.int32)
+    rev = np.ascontiguousarray(topo.rev, np.int32)
+    delay = np.ascontiguousarray(topo.delay, np.int32)
+    row_start = np.ascontiguousarray(topo.row_start, np.int64)
+    values = np.ascontiguousarray(topo.values, np.float64)
+    est = np.empty(n, np.float64)
+    last_avg = np.empty(n, np.float64)
+    rmse = np.empty(ticks // obs_every, np.float64)
+    events = lib.fu_des_run_traj(
+        n, E, _ptr(src, ctypes.c_int32), _ptr(dst, ctypes.c_int32),
+        _ptr(rev, ctypes.c_int32), _ptr(delay, ctypes.c_int32),
+        _ptr(row_start, ctypes.c_int64), _ptr(values, ctypes.c_double),
+        0 if variant == "collectall" else 1, timeout, ticks,
+        _ptr(est, ctypes.c_double), _ptr(last_avg, ctypes.c_double),
+        obs_every, float(topo.true_mean), _ptr(rmse, ctypes.c_double),
+    )
+    return rmse, est, last_avg, int(events)

@@ -20,6 +20,7 @@
 // Build: see Makefile (g++ -O3 -shared -fPIC).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <queue>
@@ -183,11 +184,12 @@ struct MsgLater {
   }
 };
 
-int64_t fu_des_run(int64_t n, int64_t E, const int32_t* src,
-                   const int32_t* dst, const int32_t* rev,
-                   const int32_t* delay, const int64_t* row_start,
-                   const double* values, int32_t variant, int64_t timeout,
-                   int64_t ticks, double* est_out, double* last_avg_out) {
+static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
+                        const int32_t* dst, const int32_t* rev,
+                        const int32_t* delay, const int64_t* row_start,
+                        const double* values, int32_t variant, int64_t timeout,
+                        int64_t ticks, double* est_out, double* last_avg_out,
+                        int64_t obs_every, double mean, double* rmse_out) {
   // Per-edge ledgers, exactly the per-neighbor dicts of a reference Peer.
   std::vector<double> flow((size_t)E, 0.0), est((size_t)E, 0.0);
   std::vector<uint8_t> recv((size_t)E, 0);          // collect-all
@@ -267,6 +269,19 @@ int64_t fu_des_run(int64_t n, int64_t E, const int32_t* src,
           if (stamp[e] < t - timeout) avg_pair(v, (int32_t)e, t);
       }
     }
+    // trajectory observation (dynamics-parity oracle): RMSE of the node
+    // estimates vs the true mean after every obs_every-th tick
+    if (obs_every > 0 && (t + 1) % obs_every == 0) {
+      double acc = 0.0;
+      for (int64_t v = 0; v < n; ++v) {
+        double fsum = 0.0;
+        for (int64_t e = row_start[v]; e < row_start[v + 1]; ++e)
+          fsum += flow[e];
+        double d = values[v] - fsum - mean;
+        acc += d * d;
+      }
+      rmse_out[(t + 1) / obs_every - 1] = std::sqrt(acc / (double)n);
+    }
   }
 
   for (int64_t v = 0; v < n; ++v) {
@@ -276,6 +291,28 @@ int64_t fu_des_run(int64_t n, int64_t E, const int32_t* src,
     last_avg_out[v] = last_avg[v];
   }
   return events;
+}
+
+int64_t fu_des_run(int64_t n, int64_t E, const int32_t* src,
+                   const int32_t* dst, const int32_t* rev,
+                   const int32_t* delay, const int64_t* row_start,
+                   const double* values, int32_t variant, int64_t timeout,
+                   int64_t ticks, double* est_out, double* last_avg_out) {
+  return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
+                  timeout, ticks, est_out, last_avg_out, 0, 0.0, nullptr);
+}
+
+// Trajectory variant: additionally fills rmse_out[ticks / obs_every] with
+// the RMSE (vs `mean`) of node estimates sampled every obs_every ticks.
+int64_t fu_des_run_traj(int64_t n, int64_t E, const int32_t* src,
+                        const int32_t* dst, const int32_t* rev,
+                        const int32_t* delay, const int64_t* row_start,
+                        const double* values, int32_t variant, int64_t timeout,
+                        int64_t ticks, double* est_out, double* last_avg_out,
+                        int64_t obs_every, double mean, double* rmse_out) {
+  return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
+                  timeout, ticks, est_out, last_avg_out, obs_every, mean,
+                  rmse_out);
 }
 
 }  // extern "C"

@@ -104,9 +104,10 @@ def state_sharding(mesh: jax.sharding.Mesh) -> FlowUpdatingState:
         fired=ns(ax),
         alive=ns(ax),
         edge_ok=ns(ax),
-        pending_flow=ns(ax),
-        pending_est=ns(ax),
-        pending_valid=ns(ax),
+        pending_flow=ns(P(None, NODE_AXIS)),
+        pending_est=ns(P(None, NODE_AXIS)),
+        pending_valid=ns(P(None, NODE_AXIS)),
+        pending_stamp=ns(P(None, NODE_AXIS)),
         buf_flow=ns(P(None, NODE_AXIS)),
         buf_est=ns(P(None, NODE_AXIS)),
         buf_valid=ns(P(None, NODE_AXIS)),

@@ -231,9 +231,10 @@ def init_plan_state(
         fired=jnp.zeros((S, Nb), jnp.int32),
         alive=jnp.asarray(plan.alive0),
         edge_ok=jnp.ones((S, Eb), bool),
-        pending_flow=jnp.zeros((S, Eb), dt),
-        pending_est=jnp.zeros((S, Eb), dt),
-        pending_valid=jnp.zeros((S, Eb), bool),
+        pending_flow=jnp.zeros((S, cfg.pending_depth, Eb), dt),
+        pending_est=jnp.zeros((S, cfg.pending_depth, Eb), dt),
+        pending_valid=jnp.zeros((S, cfg.pending_depth, Eb), bool),
+        pending_stamp=jnp.zeros((S, cfg.pending_depth, Eb), jnp.int32),
         buf_flow=jnp.zeros((S, D, Eb), dt),
         buf_est=jnp.zeros((S, D, Eb), dt),
         buf_valid=jnp.zeros((S, D, Eb), bool),

@@ -32,7 +32,9 @@ import numpy as np
 from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.state import FlowUpdatingState
 
-FORMAT_VERSION = 1
+# 2: pending_* mailbox arrays gained a leading depth axis (Q, E) and the
+#    pending_stamp field (models/state.py) — v1 checkpoints cannot resume.
+FORMAT_VERSION = 2
 
 
 def _state_classes() -> dict:

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""TPU scale-ladder diagnostic (VERDICT r2 item 1).
+
+Runs the fast collect-all kernels on the ambient backend up a fat-tree
+scale ladder (k=8 -> 160), each scale in its OWN subprocess so a TPU
+worker crash is isolated and its full stderr is captured.  Per step it
+logs wall times for bounded scan lengths plus `device.memory_stats()`.
+
+Usage:
+    python scripts/tpu_ladder.py                   # full ladder, node kernel
+    python scripts/tpu_ladder.py --ks 8 40 --kernel edge
+    python scripts/tpu_ladder.py --child --k 160 ...   (internal)
+
+Writes a JSON report to TPU_LADDER.json (repo root) unless --no-report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def child(args) -> None:
+    import jax
+
+    from bench import make_runner
+    from flow_updating_tpu.topology.generators import fat_tree
+
+    dev = jax.devices()[0]
+    out = {"k": args.k, "kernel": args.kernel, "spmv": args.spmv,
+           "device": str(dev), "platform": dev.platform}
+
+    t0 = time.perf_counter()
+    topo = fat_tree(args.k, seed=0)
+    out["build_s"] = round(time.perf_counter() - t0, 3)
+    out["nodes"] = topo.num_nodes
+    out["edges"] = topo.num_edges
+
+    # same measurement closure as the headline bench (bench.make_runner),
+    # so ladder timings and bench timings are directly comparable
+    run, read = make_runner(topo, kernel=args.kernel, spmv=args.spmv)
+
+    def mem():
+        try:
+            s = dev.memory_stats()
+            return {k: s[k] for k in ("bytes_in_use", "peak_bytes_in_use")
+                    if k in s}
+        except Exception as e:  # platform may not expose stats
+            return {"err": str(e)[:120]}
+
+    out["mem_after_build"] = mem()
+    steps = []
+    last = None
+    for r in args.round_ladder:
+        t0 = time.perf_counter()
+        last = run(r)
+        wall = time.perf_counter() - t0
+        # second run of the same length: compile cached -> pure exec+launch
+        t0 = time.perf_counter()
+        last = run(r)
+        exec_s = time.perf_counter() - t0
+        steps.append({"rounds": r, "first_s": round(wall, 4),
+                      "exec_s": round(exec_s, 4), "mem": mem()})
+        print(f"  k={args.k} rounds={r}: first={wall:.3f}s exec={exec_s:.3f}s",
+              file=sys.stderr, flush=True)
+    out["steps"] = steps
+    if steps:
+        r_a, r_b = args.round_ladder[-2:] if len(args.round_ladder) > 1 else (
+            0, args.round_ladder[-1])
+        ea = next(s["exec_s"] for s in steps if s["rounds"] == r_a) \
+            if r_a else 0.0
+        eb = steps[-1]["exec_s"]
+        if r_b > r_a:
+            out["per_round_s"] = round((eb - ea) / (r_b - r_a), 6)
+            out["rounds_per_sec"] = round(1.0 / max(out["per_round_s"], 1e-9), 2)
+    from flow_updating_tpu.utils.metrics import rmse
+
+    out["rmse_after"] = float(rmse(read(last), topo.true_mean))
+    print(json.dumps(out))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--ks", type=int, nargs="+", default=[8, 40, 96, 160])
+    ap.add_argument("--kernel", default="node", choices=("node", "edge"))
+    ap.add_argument("--spmv", default="xla", choices=("xla", "pallas"))
+    ap.add_argument("--round-ladder", type=int, nargs="+",
+                    default=[64, 256, 1024, 4096])
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--no-report", action="store_true")
+    args = ap.parse_args()
+
+    if args.child:
+        child(args)
+        return
+
+    report = {"ladder": [], "argv": sys.argv[1:]}
+    for k in args.ks:
+        cmd = [sys.executable, os.path.abspath(__file__), "--child",
+               "--k", str(k), "--kernel", args.kernel, "--spmv", args.spmv,
+               "--round-ladder", *map(str, args.round_ladder)]
+        print(f"=== ladder k={k} ({args.kernel}/{args.spmv}) ===",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=args.timeout, cwd=REPO)
+            entry = {"k": k, "rc": p.returncode,
+                     "wall_s": round(time.perf_counter() - t0, 1)}
+            line = (p.stdout.strip().splitlines() or [""])[-1]
+            try:
+                entry["result"] = json.loads(line)
+            except json.JSONDecodeError:
+                entry["stdout_tail"] = p.stdout[-1000:]
+            if p.returncode != 0:
+                entry["stderr_tail"] = p.stderr[-3000:]
+            else:
+                entry["stderr_tail"] = p.stderr[-500:]
+        except subprocess.TimeoutExpired as e:
+            entry = {"k": k, "rc": "timeout",
+                     "wall_s": round(time.perf_counter() - t0, 1),
+                     "stderr_tail": ((e.stderr or b"").decode("utf-8", "replace")
+                                     if isinstance(e.stderr, bytes)
+                                     else (e.stderr or ""))[-3000:]}
+        report["ladder"].append(entry)
+        ok = entry["rc"] == 0
+        print(f"=== k={k}: rc={entry['rc']} wall={entry['wall_s']}s "
+              f"{'OK' if ok else 'FAILED'} ===", file=sys.stderr, flush=True)
+        if not ok:
+            break  # higher scales will only be worse; keep the tunnel alive
+
+    if not args.no_report:
+        with open(os.path.join(REPO, "TPU_LADDER.json"), "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps(report["ladder"], indent=1)[:4000])
+
+
+if __name__ == "__main__":
+    main()
