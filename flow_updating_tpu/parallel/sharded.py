@@ -78,6 +78,22 @@ class HaloTables:
     delay: jnp.ndarray   # (S*H,) i32 — sending edge's delivery delay
 
 
+@flax.struct.dataclass
+class PermTables:
+    """Per-offset point-to-point halo routing (``halo='ppermute'``).
+
+    One entry per nonzero shard offset ``d`` that carries any cut edge:
+    shard ``s`` sends its cut edges targeting shard ``(s+d) % S`` as one
+    ``ppermute`` of a dense payload block.  All tables are plan-time
+    constants sharded with their rows; per-round traffic is exactly the
+    padded per-pair cut-edge payloads — O(cut edges), not O(S * cut).
+    """
+
+    send_idx: tuple      # per offset: (S, Hd) i32 local slots to send (Eb pad)
+    recv_tlocal: tuple   # per offset: (S, Hd) i32 receiver slot (Eb pad)
+    recv_delay: tuple    # per offset: (S, Hd) i32 sending edge's delay
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardPlan:
     """Host-side sharding plan for one topology on S devices."""
@@ -92,6 +108,10 @@ class ShardPlan:
     halo: HaloTables    # numpy-backed, replicated at init
     values: np.ndarray  # (S, Nb) initial node values (0 on padding)
     alive0: np.ndarray  # (S, Nb) bool initial liveness (False on padding)
+    perm_offsets: tuple = ()         # nonzero shard offsets with cut edges
+    perm_tables: PermTables | None = None  # per-offset ppermute routing
+    order: np.ndarray | None = None  # partition node order (new -> original
+    #                                  id); None = identity (contiguous ids)
 
     @property
     def cut_fraction(self) -> float:
@@ -99,13 +119,57 @@ class ShardPlan:
         idx = np.asarray(self.arrays.halo_idx)
         return float((idx < self.Eb).sum()) / max(self.topo.num_edges, 1)
 
+    def collective_bytes_per_round(self, dtype_bytes: int = 4) -> dict:
+        """Per-round halo traffic entering the interconnect, both paths,
+        using each path's ACTUAL wire format.
 
-def plan_sharding(topo: Topology, num_shards: int) -> ShardPlan:
+        ``allgather``: every shard broadcasts its padded cut-edge payload
+        block (flow + estimate arrays of the ledger dtype, plus a separate
+        1-byte bool valid array) to all S shards — S * S * H entries.
+        ``ppermute``: each shard sends each per-offset padded block to
+        exactly one peer — S * sum(Hd) entries, each 3 lanes of the ledger
+        dtype (valid travels as a dtype lane in the stacked payload).
+        """
+        S, H = self.num_shards, self.H
+        ag_entry = 2 * dtype_bytes + 1   # flow + est + bool valid
+        pp_entry = 3 * dtype_bytes      # jnp.stack([flow, est, valid.astype])
+        sum_hd = sum(
+            int(np.asarray(t).shape[1]) for t in (
+                self.perm_tables.send_idx if self.perm_tables else ())
+        )
+        return {
+            "allgather_bytes": S * S * H * ag_entry,
+            "ppermute_bytes": S * sum_hd * pp_entry,
+            "cut_edges": int((np.asarray(self.arrays.halo_idx)
+                              < self.Eb).sum()),
+            "cut_fraction": round(self.cut_fraction, 4),
+            "num_offsets": len(self.perm_offsets),
+        }
+
+
+def plan_sharding(topo: Topology, num_shards: int,
+                  partition: str = "contiguous") -> ShardPlan:
     """Partition nodes into contiguous blocks and edges with their source.
+
+    ``partition='bfs'`` renumbers nodes by BFS order first
+    (:func:`~flow_updating_tpu.topology.graph.locality_order`), which keeps
+    neighborhoods within blocks and cuts far fewer edges on structured
+    topologies; estimates read back through :func:`gather_estimates` are
+    always in the caller's original node order.
 
     Local node ``Nb-1`` of every shard is a dummy (dead, value 0) that owns
     the padded edge slots, so padding can never fire or send.
     """
+    order = None
+    if partition == "bfs":
+        from flow_updating_tpu.topology.graph import (
+            locality_order, reorder_topology,
+        )
+
+        order = locality_order(topo)
+        topo = reorder_topology(topo, order)
+    elif partition != "contiguous":
+        raise ValueError(f"unknown partition {partition!r}")
     N, E, S = topo.num_nodes, topo.num_edges, num_shards
     cap = max(1, math.ceil(N / S))
     Nb = cap + 1
@@ -178,6 +242,37 @@ def plan_sharding(topo: Topology, num_shards: int) -> ShardPlan:
         delay=np.where(h_ok, delay[sidx, hi], 1).astype(np.int32).ravel(),
     )
 
+    # point-to-point routing: group each shard's cut edges by target-shard
+    # OFFSET (d = target - source mod S); one ppermute per distinct offset
+    off_of_cut = np.where(
+        is_cut, (tshard - np.arange(S, dtype=np.int32)[:, None]) % S, -1
+    )
+    offsets = sorted(int(d) for d in np.unique(off_of_cut) if d > 0)
+    send_idx_t, recv_tlocal_t, recv_delay_t = [], [], []
+    for d in offsets:
+        per_shard = [np.where(off_of_cut[s] == d)[0] for s in range(S)]
+        Hd = max(max((len(p) for p in per_shard), default=0), 1)
+        sidx_d = np.full((S, Hd), Eb, np.int32)
+        for s in range(S):
+            sidx_d[s, : len(per_shard[s])] = per_shard[s]
+        # receiver-side tables: shard r's row describes what arrives from
+        # shard (r - d) % S, in that sender's send order
+        rt = np.full((S, Hd), Eb, np.int32)
+        rd = np.ones((S, Hd), np.int32)
+        for r in range(S):
+            s = (r - d) % S
+            slots = per_shard[s]
+            rt[r, : len(slots)] = tlocal[s, slots]
+            rd[r, : len(slots)] = delay[s, slots]
+        send_idx_t.append(sidx_d)
+        recv_tlocal_t.append(rt)
+        recv_delay_t.append(rd)
+    perm_tables = PermTables(
+        send_idx=tuple(send_idx_t),
+        recv_tlocal=tuple(recv_tlocal_t),
+        recv_delay=tuple(recv_delay_t),
+    )
+
     arrays = PlanArrays(
         src_local=src_local,
         out_deg=out_deg,
@@ -191,6 +286,7 @@ def plan_sharding(topo: Topology, num_shards: int) -> ShardPlan:
     return ShardPlan(
         topo=topo, num_shards=S, cap=cap, Nb=Nb, Eb=Eb, H=H, arrays=arrays,
         halo=halo, values=values, alive0=alive0,
+        perm_offsets=tuple(offsets), perm_tables=perm_tables, order=order,
     )
 
 
@@ -245,18 +341,21 @@ def init_plan_state(
 
 def plan_device_arrays(
     plan: ShardPlan, mesh: jax.sharding.Mesh
-) -> tuple[PlanArrays, HaloTables]:
-    """Device placement: per-shard arrays blocked over the mesh, halo
-    routing tables replicated."""
+) -> tuple[PlanArrays, HaloTables, PermTables]:
+    """Device placement: per-shard arrays (incl. the per-offset ppermute
+    tables) blocked over the mesh, all_gather routing tables replicated."""
     arrays = jax.tree.map(jnp.asarray, plan.arrays)
     arrays = jax.device_put(arrays, _sharding_tree(arrays, mesh))
     rep = jax.sharding.NamedSharding(mesh, P())
     halo = jax.device_put(jax.tree.map(jnp.asarray, plan.halo), rep)
-    return arrays, halo
+    perm = jax.tree.map(jnp.asarray, plan.perm_tables)
+    perm = jax.device_put(perm, _sharding_tree(perm, mesh))
+    return arrays, halo, perm
 
 
 def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
-                 cfg: RoundConfig, Eb: int):
+                 perm: PermTables, cfg: RoundConfig, Eb: int, S: int,
+                 offsets: tuple, halo_mode: str):
     """One round on one shard's block (runs inside shard_map)."""
     me = jax.lax.axis_index(NODE_AXIS)
     D = cfg.delay_depth
@@ -282,26 +381,48 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
     buf_est = st.buf_est.at[slot, tgt].set(msg_est, mode="drop")
     buf_valid = st.buf_valid.at[slot, tgt].set(True, mode="drop")
 
-    # halo exchange: all_gather only the *payloads* of this shard's cut
-    # edges; routing (target shard/slot/delay) comes from the replicated
-    # plan-time tables, and t is lockstep across shards
-    hidx = jnp.minimum(pl.halo_idx, Eb - 1)
-    in_range = pl.halo_idx < Eb
-    h_valid = send_mask[hidx] & in_range
-    h_flow = st.flow[hidx]
-    h_est = msg_est[hidx]
+    if halo_mode == "ppermute":
+        # point-to-point halo: one ppermute per plan-time shard offset —
+        # per-round traffic is each shard's own (padded, per-pair) cut-edge
+        # payloads, O(cut edges), vs the all_gather broadcast's O(S * cut).
+        # Routing tables are plan-time constants sharded with their rows.
+        dt = st.flow.dtype
+        for di in range(len(offsets)):
+            sidx = perm.send_idx[di]
+            in_r = sidx < Eb
+            slc = jnp.minimum(sidx, Eb - 1)
+            v = (send_mask[slc] & in_r).astype(dt)
+            payload = jnp.stack([st.flow[slc], msg_est[slc], v])
+            pairs = [(s, (s + offsets[di]) % S) for s in range(S)]
+            got = jax.lax.ppermute(payload, NODE_AXIS, pairs)
+            rv = got[2] > 0.5
+            rt = perm.recv_tlocal[di]
+            slot_r = (t + perm.recv_delay[di]) % D
+            tgt2 = jnp.where(rv & (rt < Eb), rt, Eb)
+            buf_flow = buf_flow.at[slot_r, tgt2].set(got[0], mode="drop")
+            buf_est = buf_est.at[slot_r, tgt2].set(got[1], mode="drop")
+            buf_valid = buf_valid.at[slot_r, tgt2].set(True, mode="drop")
+    else:
+        # broadcast halo: all_gather every shard's cut-edge payloads;
+        # simple, one collective — and measured competitive at small S
+        # (see collective_bytes_per_round for the traffic comparison)
+        hidx = jnp.minimum(pl.halo_idx, Eb - 1)
+        in_range = pl.halo_idx < Eb
+        h_valid = send_mask[hidx] & in_range
+        h_flow = st.flow[hidx]
+        h_est = msg_est[hidx]
 
-    g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(-1)
-    a_valid = g(h_valid)
-    a_flow = g(h_flow)
-    a_est = g(h_est)
-    a_slot = (t + halo.delay) % D
+        g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(-1)
+        a_valid = g(h_valid)
+        a_flow = g(h_flow)
+        a_est = g(h_est)
+        a_slot = (t + halo.delay) % D
 
-    mine = a_valid & (halo.tshard == me)
-    tgt2 = jnp.where(mine, halo.tlocal, Eb)
-    buf_flow = buf_flow.at[a_slot, tgt2].set(a_flow, mode="drop")
-    buf_est = buf_est.at[a_slot, tgt2].set(a_est, mode="drop")
-    buf_valid = buf_valid.at[a_slot, tgt2].set(True, mode="drop")
+        mine = a_valid & (halo.tshard == me)
+        tgt2 = jnp.where(mine, halo.tlocal, Eb)
+        buf_flow = buf_flow.at[a_slot, tgt2].set(a_flow, mode="drop")
+        buf_est = buf_est.at[a_slot, tgt2].set(a_est, mode="drop")
+        buf_valid = buf_valid.at[a_slot, tgt2].set(True, mode="drop")
 
     return st.replace(
         t=t + 1, buf_flow=buf_flow, buf_est=buf_est, buf_valid=buf_valid
@@ -309,19 +430,27 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "mesh", "num_rounds", "Eb")
+    jax.jit,
+    static_argnames=("cfg", "mesh", "num_rounds", "Eb", "offsets",
+                     "halo_mode"),
 )
-def _run_sharded(state, arrays, halo, cfg, mesh, num_rounds, Eb):
+def _run_sharded(state, arrays, halo, perm, cfg, mesh, num_rounds, Eb,
+                 offsets, halo_mode):
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
+    perm_specs = jax.tree.map(_spec, perm)
+    S = mesh.devices.size
 
-    def body(st_s, pl_s, halo_t):
+    def body(st_s, pl_s, halo_t, pm_s):
         st = jax.tree.map(lambda x: x[0], st_s)
         pl = jax.tree.map(lambda x: x[0], pl_s)
+        pm = jax.tree.map(lambda x: x[0], pm_s)
 
         def step(s, _):
-            return _local_round(s, pl, halo_t, cfg, Eb), None
+            return _local_round(
+                s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
+            ), None
 
         st, _ = jax.lax.scan(step, st, None, length=num_rounds)
         return jax.tree.map(lambda x: x[None], st)
@@ -329,11 +458,11 @@ def _run_sharded(state, arrays, halo, cfg, mesh, num_rounds, Eb):
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(state_specs, plan_specs, halo_specs),
+        in_specs=(state_specs, plan_specs, halo_specs, perm_specs),
         out_specs=state_specs,
         check_vma=False,
     )
-    return fn(state, arrays, halo)
+    return fn(state, arrays, halo, perm)
 
 
 def run_rounds_sharded(
@@ -342,22 +471,34 @@ def run_rounds_sharded(
     cfg: RoundConfig,
     mesh: jax.sharding.Mesh,
     num_rounds: int,
-    arrays: tuple[PlanArrays, HaloTables] | None = None,
+    arrays: tuple[PlanArrays, HaloTables, PermTables] | None = None,
+    halo: str = "ppermute",
 ) -> FlowUpdatingState:
-    """Run ``num_rounds`` sharded rounds as one compiled shard_map'd scan."""
+    """Run ``num_rounds`` sharded rounds as one compiled shard_map'd scan.
+
+    ``halo`` selects the cut-edge exchange: ``'ppermute'`` (point-to-point,
+    O(cut) traffic — the default and the multi-pod path) or ``'allgather'``
+    (broadcast; one collective, competitive at small S).
+    """
     if cfg.needs_coloring:
         raise NotImplementedError(
             "fast synchronous pairwise reads the remote endpoint's estimate; "
             "use the GSPMD path (flow_updating_tpu.parallel.auto) for it"
         )
+    if halo not in ("ppermute", "allgather"):
+        raise ValueError(f"unknown halo mode {halo!r}")
     if arrays is None:
         arrays = plan_device_arrays(plan, mesh)
-    plan_arrays, halo = arrays
-    return _run_sharded(state, plan_arrays, halo, cfg, mesh, num_rounds, plan.Eb)
+    plan_arrays, halo_tables, perm = arrays
+    return _run_sharded(
+        state, plan_arrays, halo_tables, perm, cfg, mesh, num_rounds,
+        plan.Eb, plan.perm_offsets, halo,
+    )
 
 
 def gather_estimates(state: FlowUpdatingState, plan: ShardPlan) -> np.ndarray:
-    """Per-node estimates in *global* node order (host-side)."""
+    """Per-node estimates in the caller's *original* node order
+    (host-side; undoes both the block layout and any partition reorder)."""
     S, Nb, Eb, N = plan.num_shards, plan.Nb, plan.Eb, plan.topo.num_nodes
     flow = np.asarray(state.flow)
     value = np.asarray(state.value)
@@ -366,10 +507,19 @@ def gather_estimates(state: FlowUpdatingState, plan: ShardPlan) -> np.ndarray:
     for s in range(S):
         np.add.at(sums[s], src[s], flow[s])
     est = value - sums
-    return est[:, : plan.cap].reshape(-1)[:N].copy()
+    return _unpermute(est[:, : plan.cap].reshape(-1)[:N], plan)
 
 
 def gather_node_array(x, plan: ShardPlan) -> np.ndarray:
-    """Unpad a (S, Nb)-stacked per-node array back to global (N,) order."""
+    """Unpad a (S, Nb)-stacked per-node array back to the original global
+    node order."""
     N = plan.topo.num_nodes
-    return np.asarray(x)[:, : plan.cap].reshape(-1)[:N].copy()
+    return _unpermute(np.asarray(x)[:, : plan.cap].reshape(-1)[:N], plan)
+
+
+def _unpermute(x: np.ndarray, plan: ShardPlan) -> np.ndarray:
+    if plan.order is None:
+        return x.copy()
+    out = np.empty_like(x)
+    out[plan.order] = x
+    return out

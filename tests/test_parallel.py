@@ -75,6 +75,57 @@ def test_shard_map_degree_skewed_converges():
     np.testing.assert_allclose(est, ref, atol=1e-9)
 
 
+def test_halo_allgather_matches_ppermute():
+    """Both cut-edge exchanges are exact: same estimates bit-for-bit."""
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    cfg = RoundConfig.reference(variant="pairwise", delay_depth=2,
+                                dtype="float64")
+    mesh = make_mesh(8)
+    plan = sharded.plan_sharding(topo, 8)
+    outs = {}
+    for halo in ("ppermute", "allgather"):
+        state = sharded.init_plan_state(plan, cfg, mesh)
+        out = sharded.run_rounds_sharded(state, plan, cfg, mesh, 60,
+                                         halo=halo)
+        outs[halo] = sharded.gather_estimates(out, plan)
+    np.testing.assert_array_equal(outs["ppermute"], outs["allgather"])
+
+
+def test_bfs_partition_matches_and_cuts_less():
+    """BFS locality partition: exact results in the caller's original node
+    order, and a far lower cut fraction than contiguous blocking when the
+    input numbering is arbitrary (the XML-platform case — generator
+    orderings are already local, measured in PARITY.md)."""
+    from flow_updating_tpu.topology.generators import grid2d
+    from flow_updating_tpu.topology.graph import reorder_topology
+
+    rng = np.random.default_rng(12)
+    base = grid2d(16, 16, seed=3)
+    topo = reorder_topology(base, rng.permutation(base.num_nodes))
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    mesh = make_mesh(8)
+    ref = _single_device_estimates(topo, cfg, 40)
+    cuts = {}
+    for part in ("contiguous", "bfs"):
+        plan = sharded.plan_sharding(topo, 8, partition=part)
+        cuts[part] = plan.cut_fraction
+        state = sharded.init_plan_state(plan, cfg, mesh)
+        out = sharded.run_rounds_sharded(state, plan, cfg, mesh, 40)
+        est = sharded.gather_estimates(out, plan)
+        np.testing.assert_allclose(est, ref, atol=1e-9)
+    # scrambled grid: contiguous cuts ~87% of edges, BFS recovers locality
+    assert cuts["bfs"] < 0.6 * cuts["contiguous"]
+    # traffic accounting: recompute both paths' bytes from the plan's own
+    # routing tables and wire formats (guards the report against formula
+    # drift — the two paths ship different payload layouts)
+    plan = sharded.plan_sharding(topo, 8, partition="bfs")
+    rep = plan.collective_bytes_per_round(8)
+    sum_hd = sum(t.shape[1] for t in plan.perm_tables.send_idx)
+    assert rep["ppermute_bytes"] == 8 * sum_hd * 3 * 8
+    assert rep["allgather_bytes"] == 8 * 8 * plan.H * (2 * 8 + 1)
+    assert rep["cut_edges"] > 0 and rep["num_offsets"] >= 1
+
+
 def test_sharded_rejects_fast_pairwise():
     topo = erdos_renyi(64, avg_degree=4.0, seed=0)
     cfg = RoundConfig.fast(variant="pairwise")
