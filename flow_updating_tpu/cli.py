@@ -115,13 +115,16 @@ def _make_config(args):
               kernel=getattr(args, "kernel", "edge"),
               delivery=getattr(args, "delivery", "gather"),
               spmv=getattr(args, "spmv", "xla"),
-              segment_impl=getattr(args, "segment", "auto"))
+              segment_impl=getattr(args, "segment", "auto"),
+              contention=getattr(args, "contention", False))
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
         kw["timeout"] = args.timeout
     if args.delay_depth is not None:
         kw["delay_depth"] = args.delay_depth
+    if getattr(args, "pending_depth", None) is not None:
+        kw["pending_depth"] = args.pending_depth
     try:
         return maker(**kw)
     except ValueError as err:
@@ -311,6 +314,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "rounds (reference: 50)")
     run.add_argument("--delay-depth", type=int, default=None,
                      help="in-flight ring depth (latency-warped rounds)")
+    run.add_argument("--pending-depth", type=int, default=None,
+                     help="per-edge mailbox FIFO depth (default: mode "
+                          "default — 2 in reference mode, 1 in fast mode)")
+    run.add_argument("--contention", action="store_true",
+                     help="shared-link bandwidth contention (needs "
+                          "--platform and --latency-scale > 0): concurrent "
+                          "sends crossing a SHARED link split its capacity; "
+                          "FATPIPE links never share")
     run.add_argument("--latency-scale", type=float, default=0.0,
                      help=">0: derive per-edge delays from platform "
                           "latencies x this scale")

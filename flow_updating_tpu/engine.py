@@ -145,6 +145,30 @@ class Engine:
                 import dataclasses
 
                 self.config = dataclasses.replace(self.config, delay_depth=depth)
+        if self.config.contention:
+            if not self.topology.has_link_model:
+                raise ValueError(
+                    "contention=True needs a platform-loaded topology with "
+                    "a link model and latency_scale > 0 (generators have "
+                    "no links)"
+                )
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "contention is single-device (the per-round link flow "
+                    "count is a global reduction; fidelity runs are "
+                    "platform-scale)"
+                )
+            # the ring buffer must cover the WORST contended delay, or
+            # edge_delays' clamp silently flattens contention back to the
+            # static profile
+            depth = max(self.config.delay_depth,
+                        self.topology.contended_max_delay())
+            if depth != self.config.delay_depth:
+                import dataclasses
+
+                self.config = dataclasses.replace(
+                    self.config, delay_depth=depth
+                )
         if self.mesh is not None:
             if self.config.use_segment_ell:
                 raise ValueError(

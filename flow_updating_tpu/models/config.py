@@ -63,6 +63,18 @@ class RoundConfig:
     #                                    drained oldest-first; overflow
     #                                    overwrites the newest slot.
     drop_rate: float = 0.0             # message loss probability
+    contention: bool = False           # shared-link bandwidth contention:
+    #                                    per round, concurrent sends crossing
+    #                                    a SHARED link split its capacity
+    #                                    (bottleneck fair share — the
+    #                                    quasi-static approximation of
+    #                                    SimGrid's max-min LMM solver,
+    #                                    SURVEY.md N3); FATPIPE links never
+    #                                    share.  Needs a platform-loaded
+    #                                    topology with a link model and
+    #                                    latency_scale > 0; delays are
+    #                                    recomputed each round and clamped
+    #                                    to delay_depth.
     dtype: str = "float32"             # ledger dtype
     kernel: str = "edge"               # 'edge' (general) | 'node' (collapsed
     #                                    SpMV recurrence; fast sync
@@ -115,6 +127,11 @@ class RoundConfig:
             raise ValueError(
                 "segment_impl='ell' selects the edge kernel's reduction "
                 "layout; the node kernel has its own (spmv='xla'|'pallas')"
+            )
+        if self.contention and self.kernel != "edge":
+            raise ValueError(
+                "contention recomputes per-edge delays each round; only the "
+                "edge kernel carries the in-flight ring buffer (kernel='edge')"
             )
         if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(

@@ -334,6 +334,41 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     return state, msg_est, send_mask
 
 
+def edge_delays(topo, cfg: RoundConfig, send_mask) -> jnp.ndarray:
+    """Per-edge delivery delay for this round's sends.
+
+    Static (``topo.delay``) unless ``cfg.contention``: then each SHARED
+    link's capacity is split across this round's concurrent sends
+    (bottleneck fair share — the quasi-static approximation of SimGrid's
+    max-min LMM; FATPIPE links never share, SURVEY.md N3 /
+    ``small_platform.xml:13-36``), and
+
+        delay[e] = clamp(round(lat_rounds[e] +
+                               max_{l in route(e)} load[l] * ser[l]),
+                         1, delay_depth)
+
+    where ``load[l]`` = number of concurrent sends crossing l (>= 1) on
+    SHARED links, 1 on FATPIPE.
+    """
+    if not cfg.contention:
+        return topo.delay
+    if topo.edge_links is None:
+        raise ValueError(
+            "cfg.contention needs a topology with a link model (platform-"
+            "loaded with latency_scale > 0; generators have no links)"
+        )
+    Lp = topo.link_ser_rounds.shape[0]          # L + 1 (pad slot)
+    K = topo.edge_links.shape[1]
+    flows = jnp.zeros((Lp,), jnp.int32).at[topo.edge_links.reshape(-1)].add(
+        jnp.repeat(send_mask.astype(jnp.int32), K)
+    )
+    load = jnp.where(topo.link_shared, jnp.maximum(flows, 1), 1)
+    ser = load.astype(topo.link_ser_rounds.dtype) * topo.link_ser_rounds
+    worst = jnp.max(ser[topo.edge_links], axis=1)   # pad slot contributes 0
+    dyn = jnp.rint(topo.lat_rounds + worst).astype(jnp.int32)
+    return jnp.clip(dyn, 1, cfg.delay_depth)
+
+
 def send_messages(
     state: FlowUpdatingState, topo, cfg: RoundConfig, msg_est, send_mask
 ) -> FlowUpdatingState:
@@ -354,12 +389,13 @@ def send_messages(
     E = topo.src.shape[0]
     t = state.t
     D = cfg.delay_depth
+    delay = edge_delays(topo, cfg, send_mask)
     if cfg.delivery == "gather":
         rf = topo.rev
         sending = send_mask[rf]
         pay_flow = state.flow[rf]
         pay_est = msg_est[rf]
-        slot_r = (t + topo.delay[rf]) % D
+        slot_r = (t + delay[rf]) % D
         hit = sending[None, :] & (
             slot_r[None, :] == jnp.arange(D, dtype=slot_r.dtype)[:, None]
         )
@@ -367,7 +403,7 @@ def send_messages(
         buf_est = jnp.where(hit, pay_est[None, :], state.buf_est)
         buf_valid = state.buf_valid | hit
     else:
-        slot_idx = (t + topo.delay) % D
+        slot_idx = (t + delay) % D
         tgt = jnp.where(send_mask, topo.rev, E)
         buf_flow = state.buf_flow.at[slot_idx, tgt].set(state.flow, mode="drop")
         buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")

@@ -79,6 +79,14 @@ def get_lib():
         ctypes.c_int32, i64, i64, f64p, f64p,
         i64, ctypes.c_double, f64p,
     ]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.fu_des_run_contend.restype = i64
+    lib.fu_des_run_contend.argtypes = [
+        i64, i64, i32p, i32p, i32p, i32p, i64p, f64p,
+        ctypes.c_int32, i64, i64, f64p, f64p,
+        i64, ctypes.c_double, f64p,
+        i64, i32p, i64, f64p, u8p, f64p, i64,
+    ]
     _lib = lib
     return _lib
 
@@ -195,3 +203,49 @@ def des_run_traj(topo, variant: str = "collectall", timeout: int = 50,
         obs_every, float(topo.true_mean), _ptr(rmse, ctypes.c_double),
     )
     return rmse, est, last_avg, int(events)
+
+
+def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
+                    ticks: int = 1000, obs_every: int = 10,
+                    clamp_d: int = 0):
+    """DES with the shared-link contention model (same model as the
+    vectorized kernel's ``models.rounds.edge_delays`` — per-tick
+    bottleneck fair share over SHARED links, FATPIPE exempt; see
+    funative.cpp ``LinkModel``).  ``clamp_d`` mirrors the ring-buffer
+    clamp of a ``delay_depth``-bounded run (0 = unclamped).
+
+    Returns (rmse trajectory, estimates, last_avg, events)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native DES unavailable (no compiler?)")
+    if topo.edge_links is None:
+        raise ValueError("topology has no link model (see build_topology)")
+    n, E = topo.num_nodes, topo.num_edges
+    src = np.ascontiguousarray(topo.src, np.int32)
+    dst = np.ascontiguousarray(topo.dst, np.int32)
+    rev = np.ascontiguousarray(topo.rev, np.int32)
+    delay = np.ascontiguousarray(topo.delay, np.int32)
+    row_start = np.ascontiguousarray(topo.row_start, np.int64)
+    values = np.ascontiguousarray(topo.values, np.float64)
+    elinks = np.ascontiguousarray(topo.edge_links, np.int32)
+    K = elinks.shape[1]
+    ser = np.ascontiguousarray(topo.link_ser_rounds, np.float64)
+    shared = np.ascontiguousarray(
+        topo.link_shared.astype(np.uint8)
+    )
+    latr = np.ascontiguousarray(topo.lat_rounds, np.float64)
+    est = np.empty(n, np.float64)
+    last_avg = np.empty(n, np.float64)
+    rmse = np.empty(max(ticks // obs_every, 1), np.float64)
+    events = lib.fu_des_run_contend(
+        n, E, _ptr(src, ctypes.c_int32), _ptr(dst, ctypes.c_int32),
+        _ptr(rev, ctypes.c_int32), _ptr(delay, ctypes.c_int32),
+        _ptr(row_start, ctypes.c_int64), _ptr(values, ctypes.c_double),
+        0 if variant == "collectall" else 1, timeout, ticks,
+        _ptr(est, ctypes.c_double), _ptr(last_avg, ctypes.c_double),
+        obs_every, float(topo.true_mean), _ptr(rmse, ctypes.c_double),
+        K, _ptr(elinks, ctypes.c_int32), len(ser),
+        _ptr(ser, ctypes.c_double), _ptr(shared, ctypes.c_uint8),
+        _ptr(latr, ctypes.c_double), clamp_d,
+    )
+    return rmse[: ticks // obs_every], est, last_avg, int(events)

@@ -184,12 +184,29 @@ struct MsgLater {
   }
 };
 
+// Optional link-level contention model (mirrors models/rounds.py::
+// edge_delays): all sends buffered within one tick contend; each SHARED
+// link's serialization cost scales with its concurrent-flow count
+// (bottleneck fair share); FATPIPE links never share.  delay[e] =
+// clamp(round(lat_rounds[e] + max_l load[l] * ser[l]), 1, clamp_d).
+struct LinkModel {
+  int64_t K = 0;                      // route length (padded)
+  const int32_t* edge_links = nullptr;  // (E*K), pad = L
+  int64_t L = 0;
+  const double* link_ser_rounds = nullptr;  // (L,)
+  const uint8_t* link_shared = nullptr;     // (L,)
+  const double* lat_rounds = nullptr;       // (E,)
+  int64_t clamp_d = 0;                // 0 = unclamped
+  bool active() const { return edge_links != nullptr; }
+};
+
 static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
                         const int32_t* dst, const int32_t* rev,
                         const int32_t* delay, const int64_t* row_start,
                         const double* values, int32_t variant, int64_t timeout,
                         int64_t ticks, double* est_out, double* last_avg_out,
-                        int64_t obs_every, double mean, double* rmse_out) {
+                        int64_t obs_every, double mean, double* rmse_out,
+                        const LinkModel& lm = LinkModel()) {
   // Per-edge ledgers, exactly the per-neighbor dicts of a reference Peer.
   std::vector<double> flow((size_t)E, 0.0), est((size_t)E, 0.0);
   std::vector<uint8_t> recv((size_t)E, 0);          // collect-all
@@ -202,11 +219,52 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
 
   auto deg = [&](int64_t v) { return row_start[v + 1] - row_start[v]; };
 
+  // contention mode: sends buffer within the tick, delays are assigned at
+  // tick end from the per-link concurrent counts (same-model validation
+  // target for the vectorized kernel's edge_delays)
+  struct PendSend {
+    int32_t e;
+    double flow_v, est_v;
+  };
+  std::vector<PendSend> tick_sends;
+  std::vector<int64_t> link_cnt(lm.active() ? (size_t)lm.L : 0, 0);
+
   auto send = [&](int64_t t, int32_t e) {
+    if (lm.active()) {
+      tick_sends.push_back({e, flow[e], est[e]});
+      return;
+    }
     // message travels edge e=(v,u); it updates the receiver's ledger rev[e]
     Msg msg{t + std::max<int32_t>(1, delay[e]), seq++, rev[e], flow[e], 0.0};
     msg.estimate = est[e];  // filled by caller via est[e] (set before send)
     mailbox[dst[e]].push(msg);
+  };
+
+  auto flush_tick_sends = [&](int64_t t) {
+    if (!lm.active() || tick_sends.empty()) return;
+    std::fill(link_cnt.begin(), link_cnt.end(), 0);
+    for (const auto& p : tick_sends)
+      for (int64_t k = 0; k < lm.K; ++k) {
+        int32_t l = lm.edge_links[(int64_t)p.e * lm.K + k];
+        if (l < lm.L) link_cnt[l]++;
+      }
+    for (const auto& p : tick_sends) {
+      double worst = 0.0;
+      for (int64_t k = 0; k < lm.K; ++k) {
+        int32_t l = lm.edge_links[(int64_t)p.e * lm.K + k];
+        if (l >= lm.L) continue;
+        double load = lm.link_shared[l]
+                          ? (double)std::max<int64_t>(link_cnt[l], 1)
+                          : 1.0;
+        worst = std::max(worst, load * lm.link_ser_rounds[l]);
+      }
+      int64_t d = (int64_t)std::llround(lm.lat_rounds[p.e] + worst);
+      d = std::max<int64_t>(d, 1);
+      if (lm.clamp_d > 0) d = std::min(d, lm.clamp_d);
+      mailbox[dst[p.e]].push(
+          Msg{t + d, seq++, rev[p.e], p.flow_v, p.est_v});
+    }
+    tick_sends.clear();
   };
 
   auto avg_all = [&](int64_t v, int64_t t) {  // collect-all avg_and_send
@@ -269,6 +327,7 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
           if (stamp[e] < t - timeout) avg_pair(v, (int32_t)e, t);
       }
     }
+    flush_tick_sends(t);
     // trajectory observation (dynamics-parity oracle): RMSE of the node
     // estimates vs the true mean after every obs_every-th tick
     if (obs_every > 0 && (t + 1) % obs_every == 0) {
@@ -313,6 +372,29 @@ int64_t fu_des_run_traj(int64_t n, int64_t E, const int32_t* src,
   return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
                   timeout, ticks, est_out, last_avg_out, obs_every, mean,
                   rmse_out);
+}
+
+// Contention variant: per-tick shared-link bandwidth splitting (see
+// LinkModel above) — the same-model oracle for cfg.contention runs.
+int64_t fu_des_run_contend(
+    int64_t n, int64_t E, const int32_t* src, const int32_t* dst,
+    const int32_t* rev, const int32_t* delay, const int64_t* row_start,
+    const double* values, int32_t variant, int64_t timeout, int64_t ticks,
+    double* est_out, double* last_avg_out, int64_t obs_every, double mean,
+    double* rmse_out, int64_t K, const int32_t* edge_links, int64_t L,
+    const double* link_ser_rounds, const uint8_t* link_shared,
+    const double* lat_rounds, int64_t clamp_d) {
+  LinkModel lm;
+  lm.K = K;
+  lm.edge_links = edge_links;
+  lm.L = L;
+  lm.link_ser_rounds = link_ser_rounds;
+  lm.link_shared = link_shared;
+  lm.lat_rounds = lat_rounds;
+  lm.clamp_d = clamp_d;
+  return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
+                  timeout, ticks, est_out, last_avg_out, obs_every, mean,
+                  rmse_out, lm);
 }
 
 }  // extern "C"

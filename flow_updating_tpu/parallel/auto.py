@@ -82,6 +82,13 @@ def pad_topology(topo: Topology, num_shards: int) -> tuple[Topology, int, int]:
         speeds=None,
         bandwidth=None,
         latency_s=None,
+        # the link-contention model is single-device (engine rejects
+        # contention+mesh); dropping the arrays keeps the padded pytree
+        # consistent with topo_sharding's field set
+        edge_links=None,
+        link_ser_rounds=None,
+        link_shared=None,
+        lat_rounds=None,
     )
     return padded, N, E
 

@@ -487,6 +487,11 @@ def run_rounds_sharded(
         )
     if halo not in ("ppermute", "allgather"):
         raise ValueError(f"unknown halo mode {halo!r}")
+    if cfg.contention:
+        raise NotImplementedError(
+            "contention is single-device (per-round link flow counts are a "
+            "global reduction; fidelity runs are platform-scale)"
+        )
     if arrays is None:
         arrays = plan_device_arrays(plan, mesh)
     plan_arrays, halo_tables, perm = arrays

@@ -73,12 +73,20 @@ class Deployment:
         latency = None
         bandwidth = None
         speeds = None
+        link_caps = None
+        link_shared = None
+        route_links = None
         if platform is not None:
             latency = platform.latency_table(names)
             bandwidth = platform.bandwidth_table(names)
             speeds = np.array(
                 [platform.hosts.get(n, 0.0) for n in names], dtype=np.float64
             )
+            if latency_scale > 0.0:
+                # the link model only feeds latency-warped / contention
+                # runs; build_topology discards it otherwise
+                link_caps, link_shared, route_links = \
+                    platform.link_table(names)
         return build_topology(
             num_nodes=len(names),
             pairs=np.array(pairs, dtype=np.int64).reshape(-1, 2),
@@ -90,6 +98,9 @@ class Deployment:
             tick_interval=tick_interval,
             latency_scale=latency_scale,
             msg_bytes=msg_bytes,
+            route_links=route_links,
+            link_caps=link_caps,
+            link_shared=link_shared,
         )
 
 

@@ -57,6 +57,16 @@ class Topology:
     #                                     mirror of the reference's runtime
     #                                     neighbor repair, collectall.py:94-96);
     #                                     None on the native big-graph path
+    # --- link-level contention model (platform-loaded topologies only) ---
+    edge_links: np.ndarray | None = None     # (E, K) int32 link ids along each
+    #                                          edge's route, padded with L
+    link_ser_rounds: np.ndarray | None = None  # (L,) f64 per-link serialization
+    #                                          cost of ONE message in rounds
+    #                                          (= msg_bytes * latency_scale /
+    #                                          (tick * capacity))
+    link_shared: np.ndarray | None = None    # (L,) bool — False = FATPIPE
+    lat_rounds: np.ndarray | None = None     # (E,) f64 route latency in rounds
+    #                                          (pre-scaled; no serialization)
 
     @property
     def num_edges(self) -> int:
@@ -65,6 +75,26 @@ class Topology:
     @property
     def max_delay(self) -> int:
         return int(self.delay.max()) if self.num_edges else 1
+
+    @property
+    def has_link_model(self) -> bool:
+        return self.edge_links is not None
+
+    def contended_max_delay(self, max_flows: int | None = None) -> int:
+        """Upper bound on the dynamic delay under contention: every edge's
+        latency plus its worst link serialization with ``max_flows``
+        concurrent flows (default: all E edges at once) — the safe
+        ``delay_depth`` for ``cfg.contention`` runs."""
+        if not self.has_link_model:
+            return self.max_delay
+        mf = self.num_edges if max_flows is None else max_flows
+        ser = np.where(self.link_shared, self.link_ser_rounds * mf,
+                       self.link_ser_rounds)
+        serp = np.concatenate([ser, [0.0]])
+        worst = serp[self.edge_links].max(axis=1)
+        return max(
+            1, int(np.ceil((self.lat_rounds + worst).max()))
+        )
 
     @property
     def true_mean(self) -> float:
@@ -213,6 +243,20 @@ class Topology:
             ell = self.ell_buckets()
             ell_edge_mats = tuple(jnp.asarray(m) for m in ell.edge_mats)
             ell_inv_perm = jnp.asarray(ell.inv_perm)
+        link = {}
+        if self.has_link_model:
+            # pad entry L: serialization 0 (never the max), not shared
+            link = dict(
+                edge_links=jnp.asarray(self.edge_links),
+                link_ser_rounds=jnp.asarray(
+                    np.concatenate([self.link_ser_rounds, [0.0]]),
+                    dtype=jnp.float32,
+                ),
+                link_shared=jnp.asarray(
+                    np.concatenate([self.link_shared, [False]])
+                ),
+                lat_rounds=jnp.asarray(self.lat_rounds, dtype=jnp.float32),
+            )
         return TopoArrays(
             src=jnp.asarray(self.src),
             dst=jnp.asarray(self.dst),
@@ -225,6 +269,7 @@ class Topology:
             num_colors=num_colors,
             ell_edge_mats=ell_edge_mats,
             ell_inv_perm=ell_inv_perm,
+            **link,
         )
 
     def with_values(self, values: np.ndarray) -> "Topology":
@@ -272,6 +317,11 @@ class TopoArrays:
     num_colors: int = flax.struct.field(pytree_node=False, default=0)
     ell_edge_mats: object = None   # tuple of (rows, w) out-edge ELL buckets
     ell_inv_perm: object = None    # (N,) original node -> permuted row
+    # link-level contention model (cfg.contention; platform topologies)
+    edge_links: object = None        # (E, K) i32 link ids (pad = L)
+    link_ser_rounds: object = None   # (L+1,) f32 one-message cost in rounds
+    link_shared: object = None       # (L+1,) bool — False = FATPIPE / pad
+    lat_rounds: object = None        # (E,) f32 route latency in rounds
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -379,6 +429,8 @@ def reorder_topology(topo: Topology, order: np.ndarray) -> Topology:
         bandwidth=pick_e(topo.bandwidth),
         latency_s=pick_e(topo.latency_s),
         adopted=None,
+        edge_links=pick_e(topo.edge_links),
+        lat_rounds=pick_e(topo.lat_rounds),
     )
 
 
@@ -395,6 +447,9 @@ def build_topology(
     msg_bytes: float = 104.0,
     seed: int = 0,
     warn_asymmetric: bool = True,
+    route_links: Mapping[tuple, tuple] | None = None,
+    link_caps: np.ndarray | None = None,
+    link_shared: np.ndarray | None = None,
 ) -> Topology:
     """Build a :class:`Topology` from (possibly asymmetric) directed pairs.
 
@@ -417,6 +472,13 @@ def build_topology(
         serialization term of the transfer time when route bandwidths are
         known (the reference self-reports ~104 bytes via
         ``FlowUpdatingMsg.size()``, ``flowupdating-collectall.py:13-19``).
+      route_links / link_caps / link_shared: link-level route membership for
+        the shared-link contention model (``Platform.link_table``) —
+        {(u, v): tuple(link_idx)}, per-link capacities (bytes/s), and
+        SHARED-vs-FATPIPE flags.  Requires ``latency_scale > 0``; enables
+        ``RoundConfig(contention=True)`` runs where the per-round delay is
+        recomputed from concurrent flow counts (SimGrid's max-min model
+        approximated by bottleneck fair share, SURVEY.md N3).
     """
     pairs_arr = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     native_out = None
@@ -500,6 +562,29 @@ def build_topology(
     else:
         delay = np.ones(E, dtype=np.int32)
 
+    edge_links_arr = None
+    link_ser = None
+    link_shared_arr = None
+    lat_rounds = None
+    if route_links is not None and latency_scale > 0.0:
+        if link_caps is None or lat is None:
+            raise ValueError(
+                "route_links needs link_caps and latency_s for the "
+                "contention model"
+            )
+        L = len(link_caps)
+        K = max((len(v) for v in route_links.values()), default=1) or 1
+        edge_links_arr = np.full((E, K), L, np.int32)
+        for i in range(E):
+            key = (int(src[i]), int(dst[i]))
+            lks = route_links.get(key, route_links.get((key[1], key[0]), ()))
+            edge_links_arr[i, : len(lks)] = lks
+        link_ser = (msg_bytes * latency_scale
+                    / (tick_interval * np.asarray(link_caps, np.float64)))
+        link_shared_arr = (np.ones(L, bool) if link_shared is None
+                           else np.asarray(link_shared, bool))
+        lat_rounds = lat * latency_scale / tick_interval
+
     return Topology(
         num_nodes=num_nodes,
         src=src,
@@ -515,4 +600,8 @@ def build_topology(
         bandwidth=bw,
         latency_s=lat,
         adopted=adopted,
+        edge_links=edge_links_arr,
+        link_ser_rounds=link_ser,
+        link_shared=link_shared_arr,
+        lat_rounds=lat_rounds,
     )

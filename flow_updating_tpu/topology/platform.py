@@ -140,6 +140,36 @@ class Platform:
                     out[(i, j)] = r.bandwidth(self.links)
         return out
 
+    def link_table(self, names: list):
+        """Link-level route membership for the contention model.
+
+        Returns ``(link_caps (L,) f64, link_shared (L,) bool,
+        route_links {(u_id, v_id): tuple(link_idx)})`` over a stable
+        (declaration-ordered) link indexing.  This is what SimGrid's
+        flow-level model contends over: concurrent transfers crossing the
+        same SHARED link split its bandwidth; FATPIPE links don't share
+        (SURVEY.md N3; reference links at
+        ``platforms/small_platform.xml:13-36``).
+        """
+        link_ids = list(self.links.keys())
+        idx = {lid: k for k, lid in enumerate(link_ids)}
+        caps = np.array(
+            [self.links[l].bandwidth for l in link_ids], dtype=np.float64
+        )
+        shared = np.array(
+            [self.links[l].sharing_policy.upper() != "FATPIPE"
+             for l in link_ids], dtype=bool,
+        )
+        route_links = {}
+        for i, a in enumerate(names):
+            for j, b in enumerate(names):
+                if i == j:
+                    continue
+                r = self.route(a, b)
+                if r is not None:
+                    route_links[(i, j)] = tuple(idx[l] for l in r.links)
+        return caps, shared, route_links
+
 
 _UNSUPPORTED = {"cluster", "cabinet", "peer", "trace", "trace_connect", "bypassRoute"}
 
