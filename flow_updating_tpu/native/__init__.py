@@ -79,6 +79,8 @@ def get_lib():
         ctypes.c_int32, i64, i64, f64p, f64p,
         i64, ctypes.c_double, f64p,
     ]
+    lib.fu_edge_coloring.restype = i64
+    lib.fu_edge_coloring.argtypes = [i64, i64, i32p, i32p, i32p, i32p]
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.fu_des_run_contend.restype = i64
     lib.fu_des_run_contend.argtypes = [
@@ -121,6 +123,27 @@ def gen_erdos_renyi_pairs(n: int, m: int, seed: int = 0) -> np.ndarray:
     if k < 0:
         raise ValueError("bad ER parameters")
     return out[: 2 * k].reshape(-1, 2)
+
+
+def edge_coloring(topo) -> tuple[np.ndarray, int] | None:
+    """Native greedy proper edge coloring (hubs-first, near-maxdeg colors);
+    None if the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    E = topo.num_edges
+    src = np.ascontiguousarray(topo.src, np.int32)
+    dst = np.ascontiguousarray(topo.dst, np.int32)
+    rev = np.ascontiguousarray(topo.rev, np.int32)
+    color = np.full(E, -1, np.int32)
+    c = lib.fu_edge_coloring(
+        topo.num_nodes, E, _ptr(src, ctypes.c_int32),
+        _ptr(dst, ctypes.c_int32), _ptr(rev, ctypes.c_int32),
+        _ptr(color, ctypes.c_int32),
+    )
+    if c < 0:
+        raise ValueError("malformed edge list")
+    return color, int(c)
 
 
 def build_graph_arrays(num_nodes: int, pairs: np.ndarray):

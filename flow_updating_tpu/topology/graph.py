@@ -82,13 +82,22 @@ class Topology:
 
     def contended_max_delay(self, max_flows: int | None = None) -> int:
         """Upper bound on the dynamic delay under contention: every edge's
-        latency plus its worst link serialization with ``max_flows``
-        concurrent flows (default: all E edges at once) — the safe
-        ``delay_depth`` for ``cfg.contention`` runs."""
+        latency plus its worst link serialization when every edge whose
+        route CROSSES that link sends at once (``max_flows`` caps the
+        per-link count) — the safe ``delay_depth`` for ``cfg.contention``
+        runs.  Uses exact per-link crossing counts: a link only ever sees
+        the routes that traverse it, so sizing by total edge count would
+        inflate the (D, E) ring buffers quadratically for nothing."""
         if not self.has_link_model:
             return self.max_delay
-        mf = self.num_edges if max_flows is None else max_flows
-        ser = np.where(self.link_shared, self.link_ser_rounds * mf,
+        L = self.link_ser_rounds.shape[0]
+        cross = np.bincount(
+            self.edge_links.reshape(-1), minlength=L + 1
+        )[:L]
+        if max_flows is not None:
+            cross = np.minimum(cross, max_flows)
+        ser = np.where(self.link_shared,
+                       self.link_ser_rounds * np.maximum(cross, 1),
                        self.link_ser_rounds)
         serp = np.concatenate([ser, [0.0]])
         worst = serp[self.edge_links].max(axis=1)
@@ -111,12 +120,25 @@ class Topology:
         the crossing-message dynamics stable (all-edges-at-once pairwise
         averaging diverges on irregular graphs).
 
-        Cached after first computation.  Returns (color (E,) int32, C).
+        Cached after first computation (and carried through checkpoints —
+        ``utils/checkpoint.py`` re-seeds it on restore).  At scale
+        (>= 50k directed edges) the C++ greedy coloring is used instead
+        when available: hubs-first smallest-free-color, near-maxdeg color
+        counts, ~20x faster than the matching extractor at BA-100k
+        (measured 16.8 s -> well under a second).  Returns
+        (color (E,) int32, C).
         """
         cached = getattr(self, "_edge_coloring", None)
         if cached is not None:
             return cached
         E = self.num_edges
+        if E >= 50_000:
+            from flow_updating_tpu import native
+
+            out = native.edge_coloring(self)
+            if out is not None:
+                object.__setattr__(self, "_edge_coloring", out)
+                return out
         und = np.where(self.src < self.dst)[0]
         u = self.src[und].astype(np.int64)
         v = self.dst[und].astype(np.int64)

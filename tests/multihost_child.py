@@ -1,0 +1,50 @@
+"""Child process for tests/test_multihost.py::test_two_process_cpu_run.
+
+Joins a 2-process jax.distributed CPU runtime (4 virtual devices per
+process -> 8 global), runs the GSPMD kernel over the global mesh, and
+prints the final RMSE — which the parent compares against a
+single-process run of the same configuration.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # match the parent's conftest
+
+    from flow_updating_tpu.parallel import multihost as mh
+
+    assert mh.initialize(), "expected a multi-process runtime"
+    assert jax.process_count() == 2
+    assert jax.device_count() == 8
+
+    import jax.numpy as jnp
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.parallel import auto
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    topo = erdos_renyi(64, avg_degree=4.0, seed=3)
+    cfg = RoundConfig.reference(variant="collectall", delay_depth=2,
+                                dtype="float64")
+    mesh = mh.global_mesh()
+    padded, n_real, _ = auto.pad_topology(topo, mesh.devices.size)
+    state, arrays = auto.init_sharded_state(padded, cfg, n_real, mesh)
+    out = run_rounds(state, arrays, cfg, 4)
+    est = node_estimates(out, arrays)
+    alive = out.alive
+    # fully-replicated scalar: safe to read on every process
+    cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
+    err = jnp.where(alive, est - topo.true_mean, 0.0)
+    rmse = jnp.sqrt(jnp.sum(err * err) / cnt)
+    print(f"RMSE {float(rmse):.17g} PROC {jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,27 @@ def test_roundtrip_bitexact(tmp_path, cfg):
         )
 
 
+def test_edge_coloring_cached_through_checkpoint(tmp_path):
+    """A computed coloring rides the checkpoint and is re-seeded on a fresh
+    Topology at restore — resumed fast-pairwise runs never recolor (the
+    coloring is minutes-scale at 100k+ nodes without the native library)."""
+    cfg = RoundConfig.fast(variant="pairwise")
+    topo = ring(32, k=2, seed=1)
+    arrays = topo.device_arrays(coloring=True)   # computes + caches
+    color, C = topo.edge_coloring()
+    state = init_state(topo, cfg, seed=0)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, state, cfg, topo=topo)
+
+    fresh = ring(32, k=2, seed=1)               # same graph, no cache
+    assert getattr(fresh, "_edge_coloring", None) is None
+    load_checkpoint(path, topo=fresh)
+    cached = getattr(fresh, "_edge_coloring", None)
+    assert cached is not None
+    np.testing.assert_array_equal(cached[0], color)
+    assert cached[1] == C
+
+
 def test_topology_mismatch_rejected(tmp_path):
     cfg = RoundConfig.fast()
     topo = ring(16, k=2, seed=0)
