@@ -149,6 +149,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         "rounds": 2 * rounds,
         "rmse_after": err,
         "kernel": kernel,
+        "spmv": spmv if kernel == "node" else None,
         "segment": segment if kernel == "edge" else None,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
@@ -258,7 +259,8 @@ def parse_args(argv=None):
     ap.add_argument("--kernel", default="node", choices=("node", "edge"),
                     help="fast-path kernel: node-collapsed SpMV recurrence "
                          "(models/sync.py) or the general edge kernel")
-    ap.add_argument("--spmv", default="xla", choices=("xla", "pallas"),
+    ap.add_argument("--spmv", default="xla",
+                    choices=("xla", "pallas", "benes"),
                     help="neighbor-sum implementation for --kernel node")
     ap.add_argument("--segment", default="auto",
                     choices=("auto", "segment", "ell"),

@@ -291,7 +291,8 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=("gather", "scatter"),
                      help="message-delivery formulation (identical "
                           "semantics; gather avoids TPU scatters)")
-    run.add_argument("--spmv", default="xla", choices=("xla", "pallas"),
+    run.add_argument("--spmv", default="xla",
+                     choices=("xla", "pallas", "benes"),
                      help="node-kernel neighbor-sum implementation "
                           "(pallas keeps the vector VMEM-resident)")
     run.add_argument("--segment", default="auto",

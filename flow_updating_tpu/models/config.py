@@ -87,7 +87,11 @@ class RoundConfig:
     #                                    TPU).  Identical semantics.
     spmv: str = "xla"                  # node-kernel neighbor sum: 'xla'
     #                                    (gather + rowsum) | 'pallas' (VMEM-
-    #                                    resident x, ops/pallas_spmv.py)
+    #                                    resident x, ops/pallas_spmv.py) |
+    #                                    'benes' (gather-free permutation
+    #                                    network, ops/spmv_benes.py — the
+    #                                    TPU path; XLA's dynamic gather
+    #                                    lowers to a scalar loop there)
     segment_impl: str = "auto"         # edge-kernel per-node reductions:
     #                                    'segment' (jax.ops segment_* —
     #                                    scatter-based lowering) | 'ell'
@@ -119,7 +123,7 @@ class RoundConfig:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.delivery not in ("gather", "scatter"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
-        if self.spmv not in ("xla", "pallas"):
+        if self.spmv not in ("xla", "pallas", "benes"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
         if self.segment_impl not in ("auto", "segment", "ell"):
             raise ValueError(f"unknown segment_impl {self.segment_impl!r}")

@@ -66,6 +66,9 @@ class NodeSyncArrays:
     inv_depp1: jnp.ndarray  # (N,) 1 / (deg + 1)
     deg: jnp.ndarray       # (N,) float degree
     mats: tuple            # per-bucket (rows, width) int32 neighbor matrices
+    ns_masks: tuple = ()   # spmv='benes': permutation-network stage masks
+    ns_plan: object = flax.struct.field(pytree_node=False, default=None)
+    #                        static NeighborSumPlan (identity-hashed)
 
 
 def _check_cfg(cfg: RoundConfig) -> None:
@@ -100,15 +103,17 @@ class NodeKernel:
         self.cfg = cfg
         import math
 
-        if cfg.spmv == "pallas":
+        if cfg.spmv in ("pallas", "benes"):
             if mesh is not None:
                 # a config-validity error: the CLI's build/resume handlers
                 # turn ValueError into a clean "invalid flag combination"
                 # exit (cli.py:cmd_run)
                 raise ValueError(
-                    "spmv='pallas' has no SPMD partitioning path yet; use "
-                    "spmv='xla' with a mesh (GSPMD handles the collective)"
+                    f"spmv={cfg.spmv!r} has no SPMD partitioning path yet; "
+                    "use spmv='xla' with a mesh (GSPMD handles the "
+                    "collective)"
                 )
+        if cfg.spmv == "pallas":
             from flow_updating_tpu.ops.pallas_spmv import BLOCK_ROWS
 
             row_multiple = math.lcm(row_multiple, BLOCK_ROWS)
@@ -148,11 +153,20 @@ class NodeKernel:
                 ).astype(np.int32)
             mats.append(mat)
 
+        ns_plan = None
+        ns_masks = ()
+        if cfg.spmv == "benes":
+            from flow_updating_tpu.ops.spmv_benes import plan_neighbor_sum
+
+            ns_plan = plan_neighbor_sum(tuple(mats), M + 1)
+            ns_masks = ns_plan.device_masks()
         self.arrays = NodeSyncArrays(
             value=jnp.asarray(value, dt),
             inv_depp1=jnp.asarray(1.0 / (deg + 1.0), dt),
             deg=jnp.asarray(deg, dt),
             mats=tuple(jnp.asarray(m) for m in mats),
+            ns_masks=ns_masks,
+            ns_plan=ns_plan,
         )
         if mesh is not None:
             import jax.sharding as jsh
@@ -230,6 +244,10 @@ def node_round_step(
         from flow_updating_tpu.ops.pallas_spmv import neighbor_sum_pallas
 
         A_cur = neighbor_sum_pallas(avg, arrs.mats)
+    elif cfg.spmv == "benes":
+        from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
+
+        A_cur = neighbor_sum_benes(avg, arrs.ns_plan, arrs.ns_masks)
     else:
         A_cur = neighbor_sum(avg, arrs.mats)
     S_next = -state.G - A_cur + arrs.deg * state.avg_prev

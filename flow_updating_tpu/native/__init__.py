@@ -82,6 +82,8 @@ def get_lib():
     lib.fu_edge_coloring.restype = i64
     lib.fu_edge_coloring.argtypes = [i64, i64, i32p, i32p, i32p, i32p]
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.fu_benes_route.restype = i64
+    lib.fu_benes_route.argtypes = [i64, i64p, u8p]
     lib.fu_des_run_contend.restype = i64
     lib.fu_des_run_contend.argtypes = [
         i64, i64, i32p, i32p, i32p, i32p, i64p, f64p,
@@ -123,6 +125,26 @@ def gen_erdos_renyi_pairs(n: int, m: int, seed: int = 0) -> np.ndarray:
     if k < 0:
         raise ValueError("bad ER parameters")
     return out[: 2 * k].reshape(-1, 2)
+
+
+def benes_route(perm: np.ndarray):
+    """C++ Beneš router (same masks as the numpy recursion in
+    ops/permute.py); None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    perm = np.ascontiguousarray(perm, np.int64)
+    n = len(perm)
+    if n < 2 or n & (n - 1):
+        raise ValueError("benes_route needs power-of-two length >= 2")
+    k = n.bit_length() - 1
+    stages = 2 * k - 1
+    out = np.zeros((stages, n), np.uint8)
+    rc = lib.fu_benes_route(n, _ptr(perm, ctypes.c_int64),
+                            _ptr(out, ctypes.c_uint8))
+    if rc < 0:
+        raise ValueError("bad permutation")
+    return [out[s].astype(bool) for s in range(stages)]
 
 
 def edge_coloring(topo) -> tuple[np.ndarray, int] | None:

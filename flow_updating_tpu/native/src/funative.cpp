@@ -151,6 +151,76 @@ int64_t fu_build_graph(int64_t n, int64_t npairs, const int64_t* pairs,
 }
 
 // ---------------------------------------------------------------------------
+// Beneš network routing: swap masks realizing y = x[perm] as 2*log2(n)-1
+// columns of 2x2 switches (mirrors ops/permute.py::benes_plan — the
+// gather-free data-movement primitive; this router handles the
+// 8M-16M-element plans the numpy recursion cannot).
+// out must hold (2*log2(n)-1) * n uint8; returns 0, or -1 on bad input.
+// ---------------------------------------------------------------------------
+
+int64_t fu_benes_route(int64_t n, const int64_t* perm, uint8_t* out) {
+  if (n < 2 || (n & (n - 1))) return -1;
+  int k = 0;
+  while ((int64_t(1) << k) < n) ++k;
+  {
+    std::vector<uint8_t> seen(n, 0);
+    for (int64_t i = 0; i < n; ++i) {
+      if (perm[i] < 0 || perm[i] >= n || seen[perm[i]]) return -1;
+      seen[perm[i]] = 1;
+    }
+  }
+  std::vector<int64_t> cur(perm, perm + n), nxt(n), pinv(n);
+  std::vector<int8_t> color(n);
+  for (int level = 0; level < k - 1; ++level) {
+    const int64_t m = n >> level;
+    const int64_t h = m >> 1;
+    uint8_t* in_row = out + (int64_t)level * n;
+    uint8_t* out_row = out + (int64_t)(2 * k - 2 - level) * n;
+    for (int64_t start = 0; start < n; start += m) {
+      const int64_t* p = &cur[start];
+      for (int64_t o = 0; o < m; ++o) pinv[start + p[o]] = o;
+      std::fill(color.begin() + start, color.begin() + start + m, -1);
+      int8_t* col = &color[start];
+      const int64_t* pv = &pinv[start];
+      for (int64_t s = 0; s < m; ++s) {
+        if (col[s] != -1) continue;
+        int64_t i = s;
+        int8_t c = 0;
+        while (col[i] == -1) {
+          col[i] = c;
+          int64_t partner = i ^ h;
+          col[partner] = 1 - c;
+          i = p[pv[partner] ^ h];
+        }
+      }
+      for (int64_t i = 0; i < h; ++i) {
+        uint8_t sw = col[i] == 1;
+        in_row[start + i] = sw;
+        in_row[start + h + i] = sw;
+      }
+      for (int64_t o = 0; o < h; ++o) {
+        bool top_u = col[p[o]] == 0;
+        uint8_t sw = !top_u;
+        out_row[start + o] = sw;
+        out_row[start + h + o] = sw;
+        int64_t s_u = top_u ? p[o] : p[o + h];
+        int64_t s_l = top_u ? p[o + h] : p[o];
+        nxt[start + o] = s_u & (h - 1);
+        nxt[start + h + o] = s_l & (h - 1);
+      }
+    }
+    std::swap(cur, nxt);
+  }
+  uint8_t* mid = out + (int64_t)(k - 1) * n;
+  for (int64_t start = 0; start < n; start += 2) {
+    uint8_t sw = cur[start] == 1;
+    mid[start] = sw;
+    mid[start + 1] = sw;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
 // Greedy proper edge coloring (undirected; both directions share a color).
 //
 // Host-side prerequisite of the fast synchronous pairwise mode (one color

@@ -1,0 +1,214 @@
+"""Fixed permutations as Beneš switching networks — gather-free data movement.
+
+Why: XLA lowers a dynamic gather ``x[idx]`` to a *scalar* loop on TPU
+(~10 ns/element; measured to be ~92% of the node kernel's round time at
+1M nodes — BENCH_NOTES.md).  But the framework's gathers are all *static*
+maps fixed at topology-build time, and a fixed permutation needs no
+gather hardware at all: route it through a Beneš network — ``2*log2(n)-1``
+columns of 2x2 switches — whose swap decisions are precomputed on the
+host.  Applying one column is ``where(mask, swap_within_pairs(x), x)``:
+reshape + reverse + select, all dense VPU work at HBM bandwidth, no
+scalar loop anywhere.  45 streamed passes beat 6M serialized gathers by
+an order of magnitude.
+
+This module provides the two host-side planners and the on-device
+applicator:
+
+* :func:`benes_plan` — route an arbitrary permutation (classic recursive
+  cycle 2-coloring), returning per-stage swap masks.
+* :func:`spread_plan` — route a *monotone injective* placement
+  (``z[targets[i]] = x[i]``, targets strictly increasing) as a barrel
+  shifter: log2(n) masked-roll stages, masks computed by exact host
+  simulation.  Monotone routes are conflict-free, so no Beneš needed.
+* :func:`apply_stages` — run the stages under jit (static masks).
+
+The planners are numpy; :mod:`flow_updating_tpu.native` accelerates
+Beneš routing in C++ at million-element scale (same output, asserted in
+tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """Device-applicable stage sequence.
+
+    ``kind`` per stage: 'swap' (Beneš column: exchange within pairs at
+    ``dist``) or 'roll' (barrel-shifter stage: take the value ``dist``
+    positions to the left).  Masks are bool (n,) host arrays, moved to
+    device once by the consumer.
+    """
+
+    n: int
+    dists: tuple
+    kinds: tuple          # 'swap' | 'roll'
+    masks: tuple          # (n,) bool per stage
+
+
+def _route_block(p: np.ndarray) -> np.ndarray:
+    """2-color the inputs of one Beneš recursion block.
+
+    ``p`` is the block-local permutation (output o takes input ``p[o]``).
+    Constraints: input pair (i, i^h) differ; sources of output pair
+    (o, o^h) differ.  The constraint graph is a disjoint union of even
+    cycles — walk each, alternating colors.
+    """
+    m = len(p)
+    h = m // 2
+    pinv = np.empty(m, np.int64)
+    pinv[p] = np.arange(m, dtype=np.int64)
+    color = np.full(m, -1, np.int8)
+    for s in range(m):
+        if color[s] != -1:
+            continue
+        i, c = s, 0
+        while color[i] == -1:
+            color[i] = c
+            partner = i ^ h
+            color[partner] = 1 - c
+            i = int(p[pinv[partner] ^ h])
+    return color
+
+
+def benes_plan(perm: np.ndarray) -> StagePlan:
+    """Swap-stage plan computing ``y = x[perm]`` for a power-of-two n.
+
+    Uses the native C++ router when available (identical output);
+    otherwise the numpy/python recursion below.
+    """
+    perm = np.asarray(perm, np.int64)
+    n = len(perm)
+    if n & (n - 1) or n < 2:
+        raise ValueError("benes_plan needs power-of-two length >= 2")
+    if np.any(np.sort(perm) != np.arange(n)):
+        raise ValueError("not a permutation")
+    k = n.bit_length() - 1
+
+    from flow_updating_tpu import native
+
+    masks_native = native.benes_route(perm) if n >= 1 << 14 else None
+    if masks_native is not None:
+        masks = masks_native
+    else:
+        masks = [np.zeros(n, bool) for _ in range(2 * k - 1)]
+        perms = {0: perm}
+        for level in range(k - 1):
+            m = n >> level
+            h = m >> 1
+            nxt = {}
+            for start, p in perms.items():
+                color = _route_block(p)
+                swap_in = color[:h] == 1
+                masks[level][start: start + h] = swap_in
+                masks[level][start + h: start + m] = swap_in
+                pcol = color[p]
+                swap_out = pcol[:h] == 1
+                out_s = 2 * k - 2 - level
+                masks[out_s][start: start + h] = swap_out
+                masks[out_s][start + h: start + m] = swap_out
+                up = np.where(pcol[:h] == 0, p[:h], p[h:m])
+                lo = np.where(pcol[:h] == 0, p[h:m], p[:h])
+                nxt[start] = up % h
+                nxt[start + h] = lo % h
+            perms = nxt
+        for start, p in perms.items():   # middle column, size-2 blocks
+            sw = p[0] == 1
+            masks[k - 1][start] = sw
+            masks[k - 1][start + 1] = sw
+    dists = [n >> (level + 1) for level in range(k)]
+    dists = dists + dists[-2::-1]
+    return StagePlan(
+        n=n, dists=tuple(dists), kinds=("swap",) * (2 * k - 1),
+        masks=tuple(masks),
+    )
+
+
+def spread_plan(targets: np.ndarray, n: int) -> StagePlan:
+    """Roll-stage plan placing ``x[i]`` at ``targets[i]`` (strictly
+    increasing, ``targets[i] >= i``); other positions end up with
+    unspecified junk.  Monotone non-crossing moves are realized bit by
+    bit (largest shift first) — the host simulation tracks exact
+    occupancy, so reads can never hit a vacated slot.
+    """
+    targets = np.asarray(targets, np.int64)
+    if len(targets) and (np.any(np.diff(targets) <= 0)
+                        or targets[-1] >= n
+                        or np.any(targets < np.arange(len(targets)))):
+        raise ValueError("targets must be strictly increasing, >= index, < n")
+    offset = targets - np.arange(len(targets), dtype=np.int64)
+    maxbit = int(offset.max()).bit_length() if len(targets) else 0
+    # pos[i] = current position of element i; process bits high -> low
+    pos = np.arange(len(targets), dtype=np.int64)
+    dists, kinds, masks = [], [], []
+    for k in range(maxbit - 1, -1, -1):
+        d = 1 << k
+        move = (offset & d) != 0
+        mask = np.zeros(n, bool)
+        mask[pos[move] + d] = True
+        pos = pos + np.where(move, d, 0)
+        dists.append(d)
+        kinds.append("roll")
+        masks.append(mask)
+    return StagePlan(n=n, dists=tuple(dists), kinds=tuple(kinds),
+                     masks=tuple(masks))
+
+
+def fill_forward_stages(run_id: np.ndarray) -> StagePlan:
+    """Roll-stage plan copying each run's HEAD value over the whole run.
+
+    ``run_id`` (n,) is a non-decreasing array of run labels; position j's
+    distance to its run head is static, so stage k copies from ``2^k`` to
+    the left exactly where bit k of that distance is set (ascending bit
+    order composes correctly within a run).
+    """
+    run_id = np.asarray(run_id)
+    n = len(run_id)
+    heads = np.zeros(n, bool)
+    heads[0] = True
+    heads[1:] = run_id[1:] != run_id[:-1]
+    head_pos = np.maximum.accumulate(
+        np.where(heads, np.arange(n, dtype=np.int64), -1)
+    )
+    dist = np.arange(n, dtype=np.int64) - head_pos
+    maxbit = int(dist.max()).bit_length() if n else 0
+    dists, kinds, masks = [], [], []
+    for k in range(maxbit):
+        d = 1 << k
+        dists.append(d)
+        kinds.append("roll")
+        masks.append(((dist >> k) & 1).astype(bool))
+    return StagePlan(n=n, dists=tuple(dists), kinds=tuple(kinds),
+                     masks=tuple(masks))
+
+
+def concat_plans(*plans: StagePlan) -> StagePlan:
+    n = plans[0].n
+    assert all(p.n == n for p in plans)
+    return StagePlan(
+        n=n,
+        dists=sum((p.dists for p in plans), ()),
+        kinds=sum((p.kinds for p in plans), ()),
+        masks=sum((p.masks for p in plans), ()),
+    )
+
+
+def apply_stages(x, plan: StagePlan, masks_dev=None):
+    """Run the plan's stages on device.  ``masks_dev`` lets the caller
+    pass pre-uploaded mask arrays (tuple, same order)."""
+    import jax.numpy as jnp
+
+    n = plan.n
+    if masks_dev is None:
+        masks_dev = tuple(jnp.asarray(m) for m in plan.masks)
+    for dist, kind, mask in zip(plan.dists, plan.kinds, masks_dev):
+        if kind == "swap":
+            sw = jnp.flip(x.reshape(-1, 2, dist), axis=1).reshape(n)
+        else:  # roll: take the value `dist` to the left
+            sw = jnp.roll(x, dist)
+        x = jnp.where(mask, sw, x)
+    return x

@@ -1,0 +1,134 @@
+"""Gather-free neighbor sum: the adjacency SpMV as a permutation network.
+
+Drop-in alternative to :func:`flow_updating_tpu.models.sync.neighbor_sum`
+(``cfg.spmv='benes'``).  XLA lowers the ELL gather ``x[mat]`` to a scalar
+loop on TPU (~10 ns/element — the whole round is gather-bound at 1M
+nodes, BENCH_NOTES.md); here the same data movement runs as ~90 static
+masked swap/roll stages, each a dense reshape/roll + select at HBM
+bandwidth, no scalar loop anywhere.
+
+Factorization (all maps are topology constants, planned on the host
+once):
+
+    x[idx_flat]  =  permute_benes( fill_forward( spread(x) ) )
+
+* ``spread``: place ``x[v]`` at the first slot of value v's run in the
+  *sorted* index list (monotone injective -> conflict-free barrel
+  shifter, log2 P stages).  A synthetic leading block [0..m1) in the
+  index list guarantees every value occurs, which both fixes the spread
+  preconditions and makes the sorted runs cover all of x.
+* ``fill_forward``: copy each run head over its run (static distance
+  bits, log2 P stages).  After this, slot j of the sorted order holds
+  ``x[sorted_idx[j]]``.
+* ``permute_benes``: route sorted positions back to ELL slots (the
+  inverse argsort — an arbitrary fixed permutation, 2 log2 P - 1 swap
+  columns routed by the C++ planner).
+
+The ELL row sums that follow are plain vectorized reductions.  Total
+device work: ~(3 log2 P) streamed passes over a power-of-two padded
+array — at 1M nodes/6M edges that is ~10 GB of HBM traffic versus ~60 ms
+of serialized gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.ops.permute import (
+    StagePlan,
+    apply_stages,
+    benes_plan,
+    concat_plans,
+    fill_forward_stages,
+    spread_plan,
+)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class NeighborSumPlan:
+    """Host-side plan.  ``eq=False``: instances hash/compare by identity so
+    the plan can ride through jit as a static (non-pytree) field; the mask
+    arrays themselves travel separately as pytree leaves (embedding ~100
+    multi-MB masks as jaxpr constants would wreck compile times)."""
+
+    m1: int              # padded node-vector length incl. the zero slot
+    P: int               # power-of-two network width
+    flat_begin: int      # ELL payload offset inside the network domain
+    bucket_shapes: tuple  # (rows, width) per ELL bucket
+    stages: StagePlan
+
+    def device_masks(self):
+        import jax.numpy as jnp
+
+        return tuple(jnp.asarray(m) for m in self.stages.masks)
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(x - 1, 1).bit_length()
+
+
+def plan_neighbor_sum(mats: tuple, m1: int) -> NeighborSumPlan:
+    """Plan the network for the NodeKernel's ELL matrices.
+
+    ``mats``: per-bucket (rows, width) int32 neighbor-slot matrices in
+    padded node space, pad value ``m1 - 1`` (the zero slot).  ``m1`` =
+    padded vector length + 1.
+    """
+    bucket_shapes = tuple(m.shape for m in mats)
+    flats = [np.asarray(m, np.int64).ravel() for m in mats]
+    idx_flat = (np.concatenate(flats) if flats
+                else np.zeros(0, np.int64))
+    # synthetic block: every value present at least once
+    aug = np.concatenate([np.arange(m1, dtype=np.int64), idx_flat])
+    Ea = len(aug)
+    P = _next_pow2(max(Ea, m1, 2))
+
+    order = np.argsort(aug, kind="stable")
+    g = aug[order]
+    heads = np.zeros(Ea, bool)
+    heads[0] = True
+    heads[1:] = g[1:] != g[:-1]
+    head_pos = np.flatnonzero(heads)
+    assert len(head_pos) == m1, "synthetic block guarantees all values"
+
+    spread = spread_plan(head_pos, P)
+    run_id = np.concatenate([g, np.full(P - Ea, g[-1] if Ea else 0)])
+    fill = fill_forward_stages(run_id)
+    # sorted position r holds x[g[r]]; ELL slot s needs x[aug[s]] =
+    # value at sorted position inv_order[s]
+    inv_order = np.empty(Ea, np.int64)
+    inv_order[order] = np.arange(Ea, dtype=np.int64)
+    perm2 = np.concatenate(
+        [inv_order, np.arange(Ea, P, dtype=np.int64)]
+    )
+    benes = benes_plan(perm2)
+    return NeighborSumPlan(
+        m1=m1, P=P, flat_begin=m1, bucket_shapes=bucket_shapes,
+        stages=concat_plans(spread, fill, benes),
+    )
+
+
+def neighbor_sum_benes(x, plan: NeighborSumPlan, masks):
+    """A(x) for the node kernel: x is the padded vector (m1 - 1,); the
+    zero slot is appended here, exactly like the gather path's ``xp``.
+    ``masks`` are the plan's stage masks as device arrays (pytree-carried
+    by the caller)."""
+    import jax.numpy as jnp
+
+    xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
+    z = jnp.concatenate(
+        [xp, jnp.zeros((plan.P - plan.m1,), x.dtype)]
+    )
+    z = apply_stages(z, plan.stages, masks)
+    parts = []
+    off = plan.flat_begin
+    for rows, w in plan.bucket_shapes:
+        if w == 0:
+            parts.append(jnp.zeros((rows,), x.dtype))
+        else:
+            blk = z[off: off + rows * w].reshape(rows, w)
+            parts.append(jnp.sum(blk, axis=1))
+            off += rows * w
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]

@@ -1,0 +1,149 @@
+"""Permutation-network data movement (ops/permute.py, ops/spmv_benes.py).
+
+The gather-free SpMV story: XLA lowers dynamic gathers to scalar loops on
+TPU (BENCH_NOTES.md cost accounting), so the node kernel's adjacency
+gather is re-expressed as static Beneš/barrel-shifter stages.  These
+tests pin the three host planners (exhaustively for small Beneš), the
+C++ router's equivalence to the numpy recursion, and the end-to-end
+neighbor-sum equivalence with the gather path.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flow_updating_tpu import native
+from flow_updating_tpu.ops.permute import (
+    apply_stages,
+    benes_plan,
+    concat_plans,
+    fill_forward_stages,
+    spread_plan,
+)
+from flow_updating_tpu.topology import generators as gen
+
+rng = np.random.default_rng(42)
+
+
+def test_benes_exhaustive_n4():
+    for p in itertools.permutations(range(4)):
+        plan = benes_plan(np.array(p))
+        x = np.arange(4.0) + 10
+        y = np.asarray(apply_stages(jnp.asarray(x), plan))
+        np.testing.assert_array_equal(y, x[list(p)])
+
+
+@pytest.mark.parametrize("n", [2, 8, 64, 1024, 4096])
+def test_benes_random(n):
+    for _ in range(3):
+        p = rng.permutation(n)
+        plan = benes_plan(p)
+        assert len(plan.dists) == 2 * (n.bit_length() - 1) - 1
+        x = rng.normal(size=n).astype(np.float64)
+        y = np.asarray(apply_stages(jnp.asarray(x), plan))
+        np.testing.assert_array_equal(y, x[p])
+
+
+def test_benes_rejects_bad_input():
+    with pytest.raises(ValueError):
+        benes_plan(np.array([0, 1, 2]))      # not a power of two
+    with pytest.raises(ValueError):
+        benes_plan(np.array([0, 0, 1, 1]))   # not a permutation
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.parametrize("n", [8, 256, 4096])
+def test_cpp_router_matches_numpy(n):
+    p = rng.permutation(n)
+    cpp = native.benes_route(p)
+    ref = benes_plan(p)   # n < 2**14 -> numpy recursion
+    assert len(cpp) == len(ref.masks)
+    for a, b in zip(cpp, ref.masks):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_spread_places_monotone():
+    n = 1 << 12
+    m = 700
+    targets = np.sort(rng.choice(n, size=m, replace=False))
+    targets = np.maximum.accumulate(np.maximum(targets, np.arange(m)))
+    targets = np.unique(targets)
+    plan = spread_plan(targets, n)
+    x = rng.normal(size=n).astype(np.float64)
+    y = np.asarray(apply_stages(jnp.asarray(x), plan))
+    np.testing.assert_array_equal(y[targets], x[: len(targets)])
+
+
+def test_fill_forward_runs():
+    runs = rng.integers(1, 17, size=40)
+    run_id = np.repeat(np.arange(len(runs)), runs)
+    plan = fill_forward_stages(run_id)
+    x = rng.normal(size=len(run_id)).astype(np.float64)
+    y = np.asarray(apply_stages(jnp.asarray(x), plan))
+    heads = np.concatenate([[0], np.flatnonzero(np.diff(run_id)) + 1])
+    np.testing.assert_array_equal(y, x[heads][run_id])
+
+
+def test_spread_fill_compose_as_monotone_gather():
+    """spread + fill = x[g] for sorted g covering all values — the exact
+    composition the planned SpMV uses."""
+    m1 = 300
+    g = np.sort(np.concatenate([
+        np.arange(m1), rng.integers(0, m1, size=1500)
+    ]))
+    P = 1 << 11
+    heads = np.concatenate([[0], np.flatnonzero(np.diff(g)) + 1])
+    plan = concat_plans(
+        spread_plan(heads, P),
+        fill_forward_stages(np.concatenate([g, np.full(P - len(g), g[-1])])),
+    )
+    x = rng.normal(size=P).astype(np.float64)
+    y = np.asarray(apply_stages(jnp.asarray(x), plan))
+    np.testing.assert_array_equal(y[: len(g)], x[g])
+
+
+@pytest.mark.parametrize("make", [
+    lambda: gen.erdos_renyi(500, avg_degree=6.0, seed=4),
+    lambda: gen.barabasi_albert(400, m=3, seed=7),
+    lambda: gen.fat_tree(8, seed=0),
+    lambda: gen.ring(64, k=1, seed=0),
+])
+def test_neighbor_sum_benes_exact(make):
+    """Single application must match the gather path exactly (same values,
+    same row-sum layout)."""
+    from flow_updating_tpu.models import sync
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
+
+    topo = make()
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="benes", dtype="float64")
+    k = sync.NodeKernel(topo, cfg)
+    x = jnp.asarray(rng.normal(size=k.padded_size))
+    a_gather = np.asarray(sync.neighbor_sum(x, k.arrays.mats))
+    a_benes = np.asarray(
+        neighbor_sum_benes(x, k.arrays.ns_plan, k.arrays.ns_masks)
+    )
+    np.testing.assert_array_equal(a_benes, a_gather)
+
+
+def test_node_kernel_benes_converges_like_xla():
+    """Iterated rounds: same trajectory up to XLA fusion reassociation."""
+    from flow_updating_tpu.models import sync
+    from flow_updating_tpu.models.config import RoundConfig
+
+    topo = gen.erdos_renyi(500, avg_degree=6.0, seed=4)
+    ests = {}
+    for spmv in ("xla", "benes"):
+        cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                               spmv=spmv, dtype="float64")
+        k = sync.NodeKernel(topo, cfg)
+        ests[spmv] = k.estimates(k.run(k.init_state(), 60))
+    np.testing.assert_allclose(ests["benes"], ests["xla"],
+                               rtol=0, atol=1e-12)
+    # ER-500 is ~5e-5 off the mean after 60 rounds; the xla-equality above
+    # is the real assertion, this just pins that it is in fact converging
+    assert np.abs(ests["benes"] - topo.true_mean).max() < 1e-3
