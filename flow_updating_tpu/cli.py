@@ -288,9 +288,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="'reference' = faithful async dynamics; "
                           "'every_round' = fast synchronous mode")
     run.add_argument("--delivery", default="gather",
-                     choices=("gather", "scatter"),
+                     choices=("gather", "scatter", "benes"),
                      help="message-delivery formulation (identical "
-                          "semantics; gather avoids TPU scatters)")
+                          "semantics; gather avoids TPU scatters, benes "
+                          "avoids TPU gathers too)")
     run.add_argument("--spmv", default="xla",
                      choices=("xla", "pallas", "benes"),
                      help="node-kernel neighbor-sum implementation "

@@ -176,6 +176,12 @@ class Engine:
                     "matrices index the global edge list); with a mesh, "
                     "GSPMD lowers the segment path's collectives instead"
                 )
+            if self.config.delivery == "benes":
+                raise ValueError(
+                    "delivery='benes' is single-device only (the network "
+                    "masks index the global edge list); with a mesh, use "
+                    "delivery='gather' or the shard_map halo kernel"
+                )
             from flow_updating_tpu.parallel import auto
 
             padded, self._n_real, _ = auto.pad_topology(
@@ -187,6 +193,7 @@ class Engine:
             self._topo_arrays = self.topology.device_arrays(
                 coloring=self.config.needs_coloring,
                 segment_ell=self.config.use_segment_ell,
+                delivery_benes=self.config.delivery == "benes",
             )
 
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":

@@ -84,7 +84,11 @@ class RoundConfig:
     #                                    — elementwise over (D, E), no
     #                                    scatter) | 'scatter' (sender pushes;
     #                                    2-D dynamic-index scatter, slow on
-    #                                    TPU).  Identical semantics.
+    #                                    TPU) | 'benes' (the rev pull runs
+    #                                    through the planned permutation
+    #                                    network, ops/permute.py — no
+    #                                    dynamic gather at all; single-
+    #                                    device).  Identical semantics.
     spmv: str = "xla"                  # node-kernel neighbor sum: 'xla'
     #                                    (gather + rowsum) | 'pallas' (VMEM-
     #                                    resident x, ops/pallas_spmv.py) |
@@ -121,7 +125,7 @@ class RoundConfig:
             )
         if self.kernel not in ("edge", "node"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
-        if self.delivery not in ("gather", "scatter"):
+        if self.delivery not in ("gather", "scatter", "benes"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas", "benes"):
             raise ValueError(f"unknown spmv {self.spmv!r}")

@@ -390,12 +390,46 @@ def send_messages(
     t = state.t
     D = cfg.delay_depth
     delay = edge_delays(topo, cfg, send_mask)
-    if cfg.delivery == "gather":
-        rf = topo.rev
-        sending = send_mask[rf]
-        pay_flow = state.flow[rf]
-        pay_est = msg_est[rf]
-        slot_r = (t + delay[rf]) % D
+    if cfg.delivery in ("gather", "benes"):
+        if cfg.delivery == "benes":
+            # same receiver-pull formulation, but the rev permutation runs
+            # through the planned Beneš network (ops/permute.py) instead of
+            # a dynamic gather — on TPU the gather lowers to a scalar loop.
+            # All payload lanes ride one batched application; the delay
+            # lane is only needed under contention (static otherwise).
+            from flow_updating_tpu.ops.permute import apply_padded_perm
+
+            if topo.rev_plan is None:
+                raise ValueError(
+                    "delivery='benes' needs device_arrays("
+                    "delivery_benes=True)"
+                )
+            dt = state.flow.dtype
+            # the delay lane carries int32 slot counts: a bf16/f16 ledger
+            # dtype would corrupt delays > 256, so lanes ride in at least
+            # float32 under contention (exact for int32 < 2^24; casting
+            # payload values f32 -> bf16 afterwards is value-preserving)
+            lane_dt = jnp.promote_types(dt, jnp.float32) \
+                if cfg.contention else dt
+            lanes = [state.flow.astype(lane_dt), msg_est.astype(lane_dt),
+                     send_mask.astype(lane_dt)]
+            if cfg.contention:
+                lanes.append(delay.astype(lane_dt))
+            moved = apply_padded_perm(
+                jnp.stack(lanes), topo.rev_plan, topo.rev_masks
+            )
+            pay_flow = moved[0].astype(dt)
+            pay_est = moved[1].astype(dt)
+            sending = moved[2] > 0.5
+            delay_r = (moved[3].astype(topo.delay.dtype) if cfg.contention
+                       else topo.delay_rev)
+            slot_r = (t + delay_r) % D
+        else:
+            rf = topo.rev
+            sending = send_mask[rf]
+            pay_flow = state.flow[rf]
+            pay_est = msg_est[rf]
+            slot_r = (t + delay[rf]) % D
         hit = sending[None, :] & (
             slot_r[None, :] == jnp.arange(D, dtype=slot_r.dtype)[:, None]
         )

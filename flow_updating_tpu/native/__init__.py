@@ -139,12 +139,14 @@ def benes_route(perm: np.ndarray):
         raise ValueError("benes_route needs power-of-two length >= 2")
     k = n.bit_length() - 1
     stages = 2 * k - 1
-    out = np.zeros((stages, n), np.uint8)
+    # bool and uint8 share layout: rows come back as zero-copy views (the
+    # buffer is ~800 MB at the 16M-element plans this path exists for)
+    out = np.zeros((stages, n), np.bool_)
     rc = lib.fu_benes_route(n, _ptr(perm, ctypes.c_int64),
                             _ptr(out, ctypes.c_uint8))
     if rc < 0:
         raise ValueError("bad permutation")
-    return [out[s].astype(bool) for s in range(stages)]
+    return [out[s] for s in range(stages)]
 
 
 def edge_coloring(topo) -> tuple[np.ndarray, int] | None:

@@ -49,6 +49,11 @@ class StagePlan:
     kinds: tuple          # 'swap' | 'roll'
     masks: tuple          # (n,) bool per stage
 
+    def device_masks(self):
+        import jax.numpy as jnp
+
+        return tuple(jnp.asarray(m) for m in self.masks)
+
 
 def _route_block(p: np.ndarray) -> np.ndarray:
     """2-color the inputs of one Beneš recursion block.
@@ -186,6 +191,43 @@ def fill_forward_stages(run_id: np.ndarray) -> StagePlan:
                      masks=tuple(masks))
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class PaddedPermPlan:
+    """A permutation on [0, n) routed through a power-of-two Beneš network
+    (identity on the padding).  ``eq=False``: identity-hashed so it can be
+    a jit-static field; masks travel separately as pytree leaves."""
+
+    n: int
+    stages: StagePlan
+
+    def device_masks(self):
+        return self.stages.device_masks()
+
+
+def padded_perm_plan(perm: np.ndarray) -> PaddedPermPlan:
+    """Beneš plan for ``y = x[perm]`` with arbitrary (non-power-of-two)
+    length; the network is padded to the next power of two."""
+    perm = np.asarray(perm, np.int64)
+    n = len(perm)
+    P = 1 << max(n - 1, 1).bit_length()
+    full = np.concatenate([perm, np.arange(n, P, dtype=np.int64)])
+    return PaddedPermPlan(n=n, stages=benes_plan(full))
+
+
+def apply_padded_perm(x, plan: PaddedPermPlan, masks_dev=None):
+    """Apply over the last axis; pads to the network width and slices
+    back."""
+    import jax.numpy as jnp
+
+    P = plan.stages.n
+    pad = P - plan.n
+    if pad:
+        width = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        x = jnp.pad(x, width)
+    y = apply_stages(x, plan.stages, masks_dev)
+    return y[..., : plan.n]
+
+
 def concat_plans(*plans: StagePlan) -> StagePlan:
     n = plans[0].n
     assert all(p.n == n for p in plans)
@@ -198,17 +240,22 @@ def concat_plans(*plans: StagePlan) -> StagePlan:
 
 
 def apply_stages(x, plan: StagePlan, masks_dev=None):
-    """Run the plan's stages on device.  ``masks_dev`` lets the caller
-    pass pre-uploaded mask arrays (tuple, same order)."""
+    """Run the plan's stages on device, over the LAST axis of ``x`` (any
+    leading batch dims share the masks — e.g. delivery moves three payload
+    lanes through one network).  ``masks_dev`` lets the caller pass
+    pre-uploaded mask arrays (tuple, same order)."""
     import jax.numpy as jnp
 
     n = plan.n
+    lead = x.shape[:-1]
     if masks_dev is None:
-        masks_dev = tuple(jnp.asarray(m) for m in plan.masks)
+        masks_dev = plan.device_masks()
     for dist, kind, mask in zip(plan.dists, plan.kinds, masks_dev):
         if kind == "swap":
-            sw = jnp.flip(x.reshape(-1, 2, dist), axis=1).reshape(n)
+            sw = jnp.flip(
+                x.reshape(*lead, -1, 2, dist), axis=-2
+            ).reshape(*lead, n)
         else:  # roll: take the value `dist` to the left
-            sw = jnp.roll(x, dist)
+            sw = jnp.roll(x, dist, axis=-1)
         x = jnp.where(mask, sw, x)
     return x

@@ -60,9 +60,7 @@ class NeighborSumPlan:
     stages: StagePlan
 
     def device_masks(self):
-        import jax.numpy as jnp
-
-        return tuple(jnp.asarray(m) for m in self.stages.masks)
+        return self.stages.device_masks()
 
 
 def _next_pow2(x: int) -> int:

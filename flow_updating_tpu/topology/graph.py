@@ -245,13 +245,17 @@ class Topology:
         return self.dst[lo:hi]
 
     def device_arrays(self, coloring: bool = False,
-                      segment_ell: bool = False):
+                      segment_ell: bool = False,
+                      delivery_benes: bool = False):
         """Device-resident pytree of the arrays the round kernel consumes.
 
         ``coloring=True`` additionally materializes the edge coloring (only
         needed by the fast synchronous pairwise mode).  ``segment_ell=True``
         materializes the degree-bucketed out-edge ELL matrices used by the
-        scatter-free segment reductions (``cfg.segment_impl='ell'``)."""
+        scatter-free segment reductions (``cfg.segment_impl='ell'``).
+        ``delivery_benes=True`` plans the reverse-edge permutation as a
+        Beneš network (``cfg.delivery='benes'`` — message delivery without
+        the scalar-gather lowering, see ops/permute.py)."""
         import jax.numpy as jnp
 
         edge_color = None
@@ -265,6 +269,15 @@ class Topology:
             ell = self.ell_buckets()
             ell_edge_mats = tuple(jnp.asarray(m) for m in ell.edge_mats)
             ell_inv_perm = jnp.asarray(ell.inv_perm)
+        rev_plan = None
+        rev_masks = ()
+        delay_rev = None
+        if delivery_benes:
+            from flow_updating_tpu.ops.permute import padded_perm_plan
+
+            rev_plan = padded_perm_plan(self.rev)
+            rev_masks = rev_plan.device_masks()
+            delay_rev = jnp.asarray(self.delay[self.rev])
         link = {}
         if self.has_link_model:
             # pad entry L: serialization 0 (never the max), not shared
@@ -291,6 +304,9 @@ class Topology:
             num_colors=num_colors,
             ell_edge_mats=ell_edge_mats,
             ell_inv_perm=ell_inv_perm,
+            rev_plan=rev_plan,
+            rev_masks=rev_masks,
+            delay_rev=delay_rev,
             **link,
         )
 
@@ -344,6 +360,10 @@ class TopoArrays:
     link_ser_rounds: object = None   # (L+1,) f32 one-message cost in rounds
     link_shared: object = None       # (L+1,) bool — False = FATPIPE / pad
     lat_rounds: object = None        # (E,) f32 route latency in rounds
+    # gather-free message delivery (cfg.delivery='benes')
+    rev_masks: tuple = ()            # Beneš stage masks for the rev perm
+    delay_rev: object = None         # (E,) i32 = delay[rev] (static)
+    rev_plan: object = flax.struct.field(pytree_node=False, default=None)
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

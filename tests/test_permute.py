@@ -130,6 +130,50 @@ def test_neighbor_sum_benes_exact(make):
     np.testing.assert_array_equal(a_benes, a_gather)
 
 
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_delivery_benes_matches_gather(variant):
+    """delivery='benes' routes the rev pull through the network; results
+    must be bit-identical to the gather formulation (same values move,
+    delivery is select-only either way)."""
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+
+    topo = gen.erdos_renyi(200, avg_degree=5.0, seed=11)
+    outs = {}
+    for delivery in ("gather", "benes"):
+        cfg = RoundConfig.reference(
+            variant=variant, delay_depth=2, delivery=delivery,
+            dtype="float64",
+        )
+        arrays = topo.device_arrays(delivery_benes=(delivery == "benes"))
+        out = run_rounds(init_state(topo, cfg), arrays, cfg, 120)
+        outs[delivery] = np.asarray(node_estimates(out, arrays))
+    np.testing.assert_array_equal(outs["benes"], outs["gather"])
+
+
+def test_delivery_benes_with_contention_matches_gather():
+    """Under contention the dynamic delay rides a payload lane through the
+    same network."""
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+    from tests.test_contention import star_topology
+
+    topo = star_topology(n_leaves=6, ser_rounds=3.0)
+    D = topo.contended_max_delay()
+    outs = {}
+    for delivery in ("gather", "benes"):
+        cfg = RoundConfig.reference(
+            variant="collectall", delay_depth=D, contention=True,
+            delivery=delivery, dtype="float64",
+        )
+        arrays = topo.device_arrays(delivery_benes=(delivery == "benes"))
+        out = run_rounds(init_state(topo, cfg), arrays, cfg, 200)
+        outs[delivery] = np.asarray(node_estimates(out, arrays))
+    np.testing.assert_array_equal(outs["benes"], outs["gather"])
+
+
 def test_node_kernel_benes_converges_like_xla():
     """Iterated rounds: same trajectory up to XLA fusion reassociation."""
     from flow_updating_tpu.models import sync
