@@ -259,9 +259,13 @@ def parse_args(argv=None):
     ap.add_argument("--kernel", default="node", choices=("node", "edge"),
                     help="fast-path kernel: node-collapsed SpMV recurrence "
                          "(models/sync.py) or the general edge kernel")
-    ap.add_argument("--spmv", default="xla",
-                    choices=("xla", "pallas", "benes"),
-                    help="neighbor-sum implementation for --kernel node")
+    ap.add_argument("--spmv", default="auto",
+                    choices=("auto", "xla", "pallas", "benes"),
+                    help="neighbor-sum implementation for --kernel node. "
+                         "'auto': measure xla, and on TPU also the "
+                         "gather-free benes network (XLA's dynamic gather "
+                         "lowers to a scalar loop there — BENCH_NOTES.md), "
+                         "then headline the faster")
     ap.add_argument("--segment", default="auto",
                     choices=("auto", "segment", "ell"),
                     help="per-node reduction layout for --kernel edge")
@@ -285,8 +289,36 @@ def run_bench(args) -> dict:
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 
-    tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=args.spmv,
-                      segment=args.segment)
+    spmv = args.spmv
+    alt = None
+    if spmv == "auto":
+        spmv = "xla"
+        tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=spmv,
+                          segment=args.segment)
+        if args.kernel == "node" and tpu["platform"] in ("tpu", "axon"):
+            # the gather-free permutation-network path exists because the
+            # XLA gather is TPU's bottleneck; measure it too, headline the
+            # faster, keep the loser's numbers in extras.  Contained: a
+            # failure here (plan OOM, tunnel wedge mid-measure) must never
+            # discard the xla result already in hand — and without the C++
+            # router the 16M-element plan would fall back to a pure-Python
+            # recursion that takes hours, so skip it outright.
+            from flow_updating_tpu import native
+
+            if native.available():
+                try:
+                    alt = measure_tpu(topo, args.rounds, kernel="node",
+                                      spmv="benes")
+                except Exception as e:  # keep the xla headline
+                    alt = {"error": f"{type(e).__name__}: {e}"[:300]}
+                if (alt.get("rounds_per_sec", 0)
+                        > tpu["rounds_per_sec"]):
+                    tpu, alt = alt, tpu
+            else:
+                alt = {"error": "native benes router unavailable; skipped"}
+    else:
+        tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=spmv,
+                          segment=args.segment)
     conv = None if args.skip_convergence else measure_rounds_to_rmse(topo)
 
     des = None if args.skip_des else measure_des_baseline(
@@ -321,6 +353,11 @@ def run_bench(args) -> dict:
             "rounds_to_1e-6_rmse": conv,
             "tpu": {k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in tpu.items()},
+            "spmv_alternative": (
+                None if alt is None else
+                {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in alt.items()}
+            ),
             "baseline_rounds_per_sec": (
                 round(base_rps, 4) if base_rps else None
             ),

@@ -204,12 +204,17 @@ class PaddedPermPlan:
         return self.stages.device_masks()
 
 
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x, floored at 2 (network minimum)."""
+    return 1 << max(x - 1, 1).bit_length()
+
+
 def padded_perm_plan(perm: np.ndarray) -> PaddedPermPlan:
     """Beneš plan for ``y = x[perm]`` with arbitrary (non-power-of-two)
     length; the network is padded to the next power of two."""
     perm = np.asarray(perm, np.int64)
     n = len(perm)
-    P = 1 << max(n - 1, 1).bit_length()
+    P = next_pow2(n)
     full = np.concatenate([perm, np.arange(n, P, dtype=np.int64)])
     return PaddedPermPlan(n=n, stages=benes_plan(full))
 

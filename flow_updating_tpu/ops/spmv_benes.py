@@ -42,6 +42,7 @@ from flow_updating_tpu.ops.permute import (
     benes_plan,
     concat_plans,
     fill_forward_stages,
+    next_pow2,
     spread_plan,
 )
 
@@ -63,10 +64,6 @@ class NeighborSumPlan:
         return self.stages.device_masks()
 
 
-def _next_pow2(x: int) -> int:
-    return 1 << max(x - 1, 1).bit_length()
-
-
 def plan_neighbor_sum(mats: tuple, m1: int) -> NeighborSumPlan:
     """Plan the network for the NodeKernel's ELL matrices.
 
@@ -81,7 +78,7 @@ def plan_neighbor_sum(mats: tuple, m1: int) -> NeighborSumPlan:
     # synthetic block: every value present at least once
     aug = np.concatenate([np.arange(m1, dtype=np.int64), idx_flat])
     Ea = len(aug)
-    P = _next_pow2(max(Ea, m1, 2))
+    P = next_pow2(max(Ea, m1))
 
     order = np.argsort(aug, kind="stable")
     g = aug[order]
