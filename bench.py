@@ -309,8 +309,8 @@ def run_bench(args) -> dict:
                 try:
                     alt = measure_tpu(topo, args.rounds, kernel="node",
                                       spmv="benes")
-                except Exception as e:  # keep the xla headline
-                    alt = {"error": f"{type(e).__name__}: {e}"[:300]}
+                except Exception as exc:  # keep the xla headline
+                    alt = {"error": f"{type(exc).__name__}: {exc}"[:300]}
                 if (alt.get("rounds_per_sec", 0)
                         > tpu["rounds_per_sec"]):
                     tpu, alt = alt, tpu

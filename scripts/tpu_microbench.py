@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""TPU data-movement microbenchmarks + Mosaic gather support probes.
+
+Runs on the ambient backend (intended: the real TPU).  Three sections:
+
+1. ``--probe-mosaic``: which gather forms Mosaic/Pallas actually compiles
+   (round-3 findings, reproduced): arbitrary ``x_ref[idx]`` int indexing
+   is rejected ("Cannot do int indexing on TPU"); ``take_along_axis`` is
+   supported only as ``tpu.dynamic_gather`` — axis=0 at (8,128) tiles
+   only, axis=1 (lane gather) at (S,128) for any S but lane dim exactly
+   128.
+2. ``--spmv``: per-round cost of the node kernel's neighbor-sum paths
+   (xla gather vs benes permutation network) at a chosen fat-tree scale,
+   measured with the R-vs-2R difference (tunnel launch overhead cancels,
+   bench.make_runner closures).
+3. ``--passes``: raw cost of one roll+select pass and one swap pass at
+   a given power-of-two size — the unit cost model behind the Beneš
+   design (BENCH_NOTES.md accounting).
+
+Each section prints one JSON line; safe to run sections independently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def probe_mosaic() -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    results = {}
+
+    def try_case(name, build):
+        try:
+            build()
+            results[name] = "ok"
+        except Exception as e:
+            results[name] = f"{type(e).__name__}: {str(e).splitlines()[0][:120]}"
+
+    def int_indexing():
+        def kern(x_ref, i_ref, o_ref):
+            o_ref[...] = x_ref[i_ref[...]]
+
+        x = jnp.arange(1024.0)
+        i = jnp.zeros((8, 128), jnp.int32)
+        pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        )(x, i).block_until_ready()
+
+    try_case("x_ref[int_idx]", int_indexing)
+
+    rng = np.random.default_rng(0)
+    for axis in (0, 1):
+        for shape in ((8, 128), (1024, 128), (8192, 128), (256, 512)):
+            def tal(axis=axis, shape=shape):
+                def kern(x_ref, i_ref, o_ref):
+                    o_ref[...] = jnp.take_along_axis(
+                        x_ref[...], i_ref[...], axis=axis
+                    )
+
+                x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+                i = jnp.asarray(rng.integers(
+                    0, shape[axis], size=shape).astype(np.int32))
+                out = pl.pallas_call(
+                    kern,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+                )(x, i)
+                ref = np.take_along_axis(
+                    np.asarray(x), np.asarray(i), axis=axis)
+                assert np.array_equal(np.asarray(out), ref), "wrong results"
+
+            try_case(f"take_along_axis[axis={axis},{shape}]", tal)
+    return {"mosaic": results}
+
+
+def _timed(run, r):
+    t0 = time.perf_counter()
+    run(r)
+    return time.perf_counter() - t0
+
+
+def spmv(k: int) -> dict:
+    """xla-vs-benes node-kernel comparison via bench.measure_tpu (inherits
+    the adaptive R-vs-2R timing AND the tunnel launch-time cap)."""
+    from bench import measure_tpu
+    from flow_updating_tpu import native
+    from flow_updating_tpu.topology.generators import fat_tree
+
+    import jax
+
+    topo = fat_tree(k, seed=0)
+    out = {"k": k, "nodes": topo.num_nodes, "edges": topo.num_edges,
+           "platform": jax.devices()[0].platform}
+    variants = ["xla"]
+    if native.available():
+        variants.append("benes")
+    else:
+        out["benes"] = {"error": "native benes router unavailable; "
+                                 "pure-Python routing takes hours — skipped"}
+    for spmv_name in variants:
+        out[spmv_name] = {
+            key: val for key, val in measure_tpu(
+                topo, 32, kernel="node", spmv=spmv_name
+            ).items()
+            if key in ("rounds_per_sec", "per_round_s", "compile_s",
+                       "rounds", "rmse_after")
+        }
+    return out
+
+
+def passes(log2n: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << log2n
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    mask = jnp.asarray(rng.integers(0, 2, size=n).astype(bool))
+
+    def chain(body):
+        import functools
+
+        @functools.partial(jax.jit, static_argnames="k")
+        def f(x, k):
+            return jax.lax.fori_loop(0, k, lambda _, v: body(v), x)
+
+        def run(k):
+            np.asarray(f(x, k)[:2])
+
+        run(4)
+        run(12)
+        t4 = time.perf_counter(); run(4); t4 = time.perf_counter() - t4
+        t12 = time.perf_counter(); run(12); t12 = time.perf_counter() - t12
+        return (t12 - t4) / 8
+
+    roll = chain(lambda v: jnp.where(mask, jnp.roll(v, 1024), v))
+    swap = chain(lambda v: jnp.where(
+        mask, jnp.flip(v.reshape(-1, 2, 1024), axis=1).reshape(n), v))
+    return {
+        "n": n,
+        "roll_select_pass_ms": round(roll * 1e3, 4),
+        "swap_select_pass_ms": round(swap * 1e3, 4),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-mosaic", action="store_true")
+    ap.add_argument("--spmv", type=int, metavar="K",
+                    help="fat-tree arity for the spmv comparison")
+    ap.add_argument("--passes", type=int, metavar="LOG2N",
+                    help="log2 size for the unit-pass timing")
+    args = ap.parse_args()
+    ran = False
+    if args.probe_mosaic:
+        print(json.dumps(probe_mosaic()))
+        ran = True
+    if args.spmv:
+        print(json.dumps(spmv(args.spmv)))
+        ran = True
+    if args.passes:
+        print(json.dumps(passes(args.passes)))
+        ran = True
+    if not ran:
+        print(json.dumps({"error": "pick --probe-mosaic / --spmv K / "
+                                   "--passes LOG2N"}))
+
+
+if __name__ == "__main__":
+    main()
