@@ -297,7 +297,7 @@ def build_parser() -> argparse.ArgumentParser:
                      help="node-kernel neighbor-sum implementation "
                           "(pallas keeps the vector VMEM-resident)")
     run.add_argument("--segment", default="auto",
-                     choices=("auto", "segment", "ell"),
+                     choices=("auto", "segment", "ell", "benes"),
                      help="edge-kernel per-node reduction layout: jax.ops "
                           "segment primitives vs scatter-free degree-"
                           "bucketed ELL gather+row-reduce")

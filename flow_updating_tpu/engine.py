@@ -170,11 +170,12 @@ class Engine:
                     self.config, delay_depth=depth
                 )
         if self.mesh is not None:
-            if self.config.use_segment_ell:
+            if self.config.use_segment_ell or self.config.use_segment_benes:
                 raise ValueError(
-                    "segment_impl='ell' is single-device only (the ELL "
-                    "matrices index the global edge list); with a mesh, "
-                    "GSPMD lowers the segment path's collectives instead"
+                    f"segment_impl={self.config.segment_impl!r} is single-"
+                    "device only (the layouts index the global edge list); "
+                    "with a mesh, GSPMD lowers the segment path's "
+                    "collectives instead"
                 )
             if self.config.delivery == "benes":
                 raise ValueError(
@@ -193,6 +194,7 @@ class Engine:
             self._topo_arrays = self.topology.device_arrays(
                 coloring=self.config.needs_coloring,
                 segment_ell=self.config.use_segment_ell,
+                segment_benes=self.config.use_segment_benes,
                 delivery_benes=self.config.delivery == "benes",
             )
 

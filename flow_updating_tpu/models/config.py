@@ -101,7 +101,11 @@ class RoundConfig:
     #                                    scatter-based lowering) | 'ell'
     #                                    (degree-bucketed out-edge ELL
     #                                    gather + row-reduce, scatter-free;
-    #                                    ops/segment.py) | 'auto' (= segment)
+    #                                    ops/segment.py) | 'benes'
+    #                                    (permutation-network segmented
+    #                                    scans + broadcasts, no gather OR
+    #                                    scatter; ops/seg_benes.py — the
+    #                                    TPU path) | 'auto' (= segment)
 
     def __post_init__(self):
         if self.variant not in (COLLECTALL, PAIRWISE):
@@ -129,12 +133,12 @@ class RoundConfig:
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas", "benes"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
-        if self.segment_impl not in ("auto", "segment", "ell"):
+        if self.segment_impl not in ("auto", "segment", "ell", "benes"):
             raise ValueError(f"unknown segment_impl {self.segment_impl!r}")
-        if self.segment_impl == "ell" and self.kernel == "node":
+        if self.segment_impl in ("ell", "benes") and self.kernel == "node":
             raise ValueError(
-                "segment_impl='ell' selects the edge kernel's reduction "
-                "layout; the node kernel has its own (spmv='xla'|'pallas')"
+                "segment_impl selects the edge kernel's reduction layout; "
+                "the node kernel has its own (spmv='xla'|'pallas'|'benes')"
             )
         if self.contention and self.kernel != "edge":
             raise ValueError(
@@ -167,6 +171,11 @@ class RoundConfig:
         """Materialize the ELL out-edge matrices for scatter-free
         per-node reductions in the edge kernel."""
         return self.segment_impl == "ell"
+
+    @property
+    def use_segment_benes(self) -> bool:
+        """Plan the permutation-network segmented reductions/broadcasts."""
+        return self.segment_impl == "benes"
 
     @property
     def needs_coloring(self) -> bool:

@@ -51,35 +51,68 @@ from flow_updating_tpu.ops.segscan import segmented_affine_scan
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
-# Per-node reductions over out-edges dispatch on the topology arrays: when
-# the degree-bucketed out-edge ELL matrices are materialized
-# (device_arrays(segment_ell=True), selected by cfg.segment_impl='ell'
-# through Engine._prepare_arrays / the CLI --segment flag), every reduction
-# is a scatter-free gather + row-reduce; otherwise the jax.ops segment
-# primitives (scatter-based lowering) are used.
+# Per-node reductions over out-edges dispatch on the topology arrays:
+# * cfg.segment_impl='benes' (device_arrays(segment_benes=True)) routes
+#   every reduction through the permutation-network segmented scan
+#   (ops/seg_benes.py) — no gather, no scatter, the TPU path;
+# * cfg.segment_impl='ell' (device_arrays(segment_ell=True)) uses the
+#   degree-bucketed out-edge ELL gather + row-reduce;
+# * otherwise the jax.ops segment primitives (scatter-based lowering).
+# Node->edge broadcasts (`x[src]`) follow the same dispatch via _bcast.
 
 def _seg_sum(x, topo, N):
+    if topo.seg_plan is not None:
+        from flow_updating_tpu.ops.seg_benes import seg_reduce
+
+        return seg_reduce(x, "sum", topo.seg_plan, topo.seg_dist,
+                          topo.seg_extract_masks)
     if topo.ell_edge_mats is not None:
         return ell_segment_sum(x, topo)
     return segment_sum(x, topo.src, N)
 
 
 def _seg_min(x, topo, N, identity):
+    if topo.seg_plan is not None:
+        from flow_updating_tpu.ops.seg_benes import seg_reduce
+
+        return seg_reduce(x, "min", topo.seg_plan, topo.seg_dist,
+                          topo.seg_extract_masks)
     if topo.ell_edge_mats is not None:
         return ell_segment_min(x, topo, identity)
     return segment_min(x, topo.src, N)
 
 
 def _seg_max(x, topo, N, identity):
+    if topo.seg_plan is not None:
+        from flow_updating_tpu.ops.seg_benes import seg_reduce
+
+        return seg_reduce(x, "max", topo.seg_plan, topo.seg_dist,
+                          topo.seg_extract_masks)
     if topo.ell_edge_mats is not None:
         return ell_segment_max(x, topo, identity)
     return segment_max(x, topo.src, N)
 
 
 def _seg_all(pred, topo, N):
+    if topo.seg_plan is not None:
+        from flow_updating_tpu.ops.seg_benes import seg_reduce
+
+        return seg_reduce(pred, "all", topo.seg_plan, topo.seg_dist,
+                          topo.seg_extract_masks)
     if topo.ell_edge_mats is not None:
         return ell_segment_all(pred, topo)
     return segment_all(pred, topo.src, N)
+
+
+def _bcast(x, topo):
+    """Node array -> per-out-edge array (the ``x[src]`` gather; planned
+    network when segment_impl='benes')."""
+    if topo.seg_plan is not None:
+        from flow_updating_tpu.ops.seg_benes import broadcast
+
+        return broadcast(x, topo.seg_plan, topo.seg_dist,
+                         topo.seg_place_masks)
+    return x[topo.src]
 
 
 def node_estimates(state: FlowUpdatingState, topo) -> jnp.ndarray:
@@ -122,7 +155,7 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
     pending_valid = state.pending_valid | hit
     buf_valid = state.buf_valid.at[slot].set(False)
 
-    receiver_alive = state.alive[topo.src]
+    receiver_alive = _bcast(state.alive, topo)
     candidates = pending_valid[0] & receiver_alive         # head slot ready
 
     if cfg.drain == 0:
@@ -138,14 +171,17 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
         # ping-pong (sustained oscillation at pending_depth > 1).
         process = jnp.zeros_like(candidates)
         remaining = candidates
-        prio = jnp.mod(topo.edge_rank - state.t, jnp.maximum(topo.out_deg[topo.src], 1))
+        deg_e = (topo.deg_e if topo.deg_e is not None
+                 else _bcast(topo.out_deg, topo))
+        prio = jnp.mod(topo.edge_rank - state.t, jnp.maximum(deg_e, 1))
         for _ in range(cfg.drain):
             skey = jnp.where(remaining, pending_stamp[0], _I32_MAX)
             oldest = _seg_min(skey, topo, N, _I32_MAX)
-            tie = remaining & (skey == oldest[topo.src]) & (skey < _I32_MAX)
+            tie = (remaining & (skey == _bcast(oldest, topo))
+                   & (skey < _I32_MAX))
             key = jnp.where(tie, prio, _I32_MAX)
             best = _seg_min(key, topo, N, _I32_MAX)
-            pick = tie & (key == best[topo.src]) & (key < _I32_MAX)
+            pick = tie & (key == _bcast(best, topo)) & (key < _I32_MAX)
             process = process | pick
             remaining = remaining & ~pick
 
@@ -228,8 +264,8 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
         # ``collectall.py:109-113``).
         est_sum = _seg_sum(state.est, topo, N)
         avg = (estimate + est_sum) / (topo.out_deg + 1).astype(dt)
-        fire_e = fire_n[src]
-        avg_e = avg[src]
+        fire_e = _bcast(fire_n, topo)
+        avg_e = _bcast(avg, topo)
         new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
         new_est = jnp.where(fire_e, avg_e, state.est)
         msg_est = avg_e
@@ -284,7 +320,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
         else:
             # Faithful message-based dynamics.
             stale = stamp < (t - cfg.timeout)
-            fire_e = (trigger | stale) & state.alive[src]
+            fire_e = (trigger | stale) & _bcast(state.alive, topo)
             # Sequential-within-tick semantics: each firing out-edge applies
             # x -> (x + est)/2 to the node's running estimate, in edge order
             # (the reference's for-loop over stale neighbors,
@@ -295,7 +331,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             )
             seg_start = topo.edge_rank == 0
             A, B = segmented_affine_scan(a, b, seg_start)
-            run_est = A * estimate[src] + B  # estimate after processing edge e
+            run_est = A * _bcast(estimate, topo) + B  # est after edge e
             avg_e = run_est                  # == the 2-party average at firing e
             new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
             new_est = jnp.where(fire_e, avg_e, state.est)
@@ -306,8 +342,15 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             # running estimate at the segment end (identity maps pass it
             # through).
             fire_any = _seg_max(fire_e.astype(jnp.int32), topo, N, 0) > 0
-            seg_end = jnp.maximum(topo.row_start[1:] - 1, 0)
-            final_est = run_est[seg_end]
+            if topo.seg_plan is not None:
+                from flow_updating_tpu.ops.seg_benes import extract_row_ends
+
+                final_est = extract_row_ends(
+                    run_est, topo.seg_plan, topo.seg_extract_masks
+                )
+            else:
+                seg_end = jnp.maximum(topo.row_start[1:] - 1, 0)
+                final_est = run_est[seg_end]
             last_avg = jnp.where(fire_any, final_est, last_avg)
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
 

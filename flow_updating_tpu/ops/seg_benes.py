@@ -1,0 +1,165 @@
+"""Gather/scatter-free per-node reductions and broadcasts for the edge kernel.
+
+``cfg.segment_impl='benes'`` — the faithful-mode counterpart of the node
+kernel's permutation-network SpMV.  The edge kernel's hot graph ops are:
+
+* **segment reduce** (sum/min/max/all over each node's out-edges) — XLA
+  lowers ``jax.ops.segment_*`` to scatters, which serialize on TPU;
+* **broadcast** (``x[src]``: node value to every out-edge) — a dynamic
+  gather, a scalar loop on TPU.
+
+Both are static graph structure, so both become switching circuits
+(:mod:`flow_updating_tpu.ops.permute`):
+
+    reduce(x)    = extract_benes( segmented_scan(x) )[:N]
+    broadcast(v) = fill_forward( place_benes(v) )[:E]
+
+The segmented Hillis-Steele scan needs NO stored masks at all — stage
+k's condition is ``edge_rank >= 2**k`` and fill-forward's is bit k of
+``edge_rank``, both computed on the fly from one static (P,) int32 array
+and fused into the select by XLA.  Only the two Beneš permutations
+(row-end -> node extraction; node -> row-head placement) carry stored
+masks, planned once per topology:
+
+* extraction maps each deg>0 node's row end to the node id, and each
+  deg-0 node to a dedicated identity slot in the padding region
+  (initialized to the reduction's identity, untouched by the scan since
+  its distance is 0);
+* placement maps node v to ``row_start[v]`` (its run head); every
+  position's run head is a row start, so fill-forward never reads a
+  junk slot.
+
+All stages are dtype-agnostic (roll/flip/select), so int32 drain keys
+ride the network unconverted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.ops.permute import (
+    StagePlan,
+    apply_stages,
+    benes_plan,
+    next_pow2,
+)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class SegmentedPlan:
+    """Host-side plan (identity-hashed: rides jit as a static field; the
+    Beneš masks and the dist array travel as pytree leaves)."""
+
+    N: int               # node count (reduce output length)
+    E: int               # directed edge count (broadcast output length)
+    P: int               # power-of-two circuit width >= E + #deg0
+    scan_bits: int       # stages in the segmented scan (bit_length(maxdeg-1))
+    fill_bits: int       # stages in fill-forward (same bound)
+    extract: StagePlan   # row-end -> node id permutation
+    place: StagePlan     # node id -> row-head permutation
+
+    def device_leaves(self):
+        """(extract_masks, place_masks) ready for TopoArrays."""
+        return (self.extract.device_masks(), self.place.device_masks())
+
+
+def plan_segments(row_start: np.ndarray, out_deg: np.ndarray,
+                  edge_rank: np.ndarray) -> tuple[SegmentedPlan, np.ndarray]:
+    """Build the plan from the topology's CSR structure.
+
+    Returns ``(plan, dist)`` where ``dist`` is the (P,) int32 array the
+    on-the-fly scan/fill masks derive from (edge_rank padded with 0)."""
+    N = len(out_deg)
+    E = len(edge_rank)
+    deg0 = np.flatnonzero(out_deg == 0)
+    P = next_pow2(E + len(deg0))
+    maxdeg = int(out_deg.max()) if N else 1
+    bits = max(maxdeg - 1, 0).bit_length()
+
+    dist = np.zeros(P, np.int32)
+    dist[:E] = edge_rank
+
+    def complete(partial: np.ndarray) -> np.ndarray:
+        """Fill the -1 outputs of a partial injective map with the unused
+        sources (any order) to make a full permutation."""
+        used = np.zeros(len(partial), bool)
+        used[partial[partial >= 0]] = True
+        out = partial.copy()
+        out[out < 0] = np.flatnonzero(~used)
+        return out
+
+    # extraction: out[u] = scan[row_end[u]] (deg>0) | identity slot (deg0);
+    # outputs [N, P) soak up the remaining sources (sliced off)
+    perm = np.full(P, -1, np.int64)
+    pos = np.asarray(out_deg, np.int64) > 0
+    perm[np.flatnonzero(pos)] = row_start[1:][pos] - 1
+    perm[deg0] = E + np.arange(len(deg0), dtype=np.int64)
+    extract = benes_plan(complete(perm))
+
+    # placement: out[row_start[v]] = x[v] for deg>0 v; all other outputs
+    # take leftover sources (junk — never a run head, never read)
+    perm2 = np.full(P, -1, np.int64)
+    perm2[row_start[:-1][pos]] = np.flatnonzero(pos)
+    place = benes_plan(complete(perm2))
+
+    plan = SegmentedPlan(N=N, E=E, P=P, scan_bits=bits, fill_bits=bits,
+                         extract=extract, place=place)
+    return plan, dist
+
+
+def _identity_for(op: str, dtype):
+    import jax.numpy as jnp
+
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    if op == "all":
+        return jnp.ones((), jnp.bool_)
+    info = (jnp.iinfo(dtype) if jnp.issubdtype(dtype, jnp.integer)
+            else jnp.finfo(dtype))
+    return jnp.asarray(info.max if op == "min" else info.min, dtype)
+
+
+def _combine(op: str):
+    import jax.numpy as jnp
+
+    return {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum,
+            "all": jnp.logical_and}[op]
+
+
+def seg_reduce(x, op: str, plan: SegmentedPlan, dist, extract_masks):
+    """Per-node reduction of the (E,) edge array ``x`` -> (N,)."""
+    import jax.numpy as jnp
+
+    ident = _identity_for(op, x.dtype)
+    comb = _combine(op)
+    z = jnp.full((plan.P,), ident, x.dtype).at[: plan.E].set(x)
+    for k in range(plan.scan_bits):
+        d = 1 << k
+        taken = jnp.where(dist >= d, jnp.roll(z, d), ident)
+        z = comb(z, taken)
+    out = apply_stages(z, plan.extract, extract_masks)
+    return out[: plan.N]
+
+
+def extract_row_ends(x, plan: SegmentedPlan, extract_masks):
+    """(E,) edge array -> (N,) values at each node's LAST out-edge (the
+    ``x[row_start[1:] - 1]`` gather; deg-0 nodes read 0)."""
+    import jax.numpy as jnp
+
+    z = jnp.zeros((plan.P,), x.dtype).at[: plan.E].set(x)
+    return apply_stages(z, plan.extract, extract_masks)[: plan.N]
+
+
+def broadcast(v, plan: SegmentedPlan, dist, place_masks):
+    """Node array (N,) -> per-out-edge array (E,) (the ``v[src]``
+    gather, gather-free)."""
+    import jax.numpy as jnp
+
+    z = jnp.zeros((plan.P,), v.dtype).at[: plan.N].set(v)
+    z = apply_stages(z, plan.place, place_masks)
+    for k in range(plan.fill_bits):
+        d = 1 << k
+        z = jnp.where((dist >> k) & 1 != 0, jnp.roll(z, d), z)
+    return z[: plan.E]

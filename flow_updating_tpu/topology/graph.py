@@ -246,7 +246,8 @@ class Topology:
 
     def device_arrays(self, coloring: bool = False,
                       segment_ell: bool = False,
-                      delivery_benes: bool = False):
+                      delivery_benes: bool = False,
+                      segment_benes: bool = False):
         """Device-resident pytree of the arrays the round kernel consumes.
 
         ``coloring=True`` additionally materializes the edge coloring (only
@@ -269,6 +270,19 @@ class Topology:
             ell = self.ell_buckets()
             ell_edge_mats = tuple(jnp.asarray(m) for m in ell.edge_mats)
             ell_inv_perm = jnp.asarray(ell.inv_perm)
+        deg_e = jnp.asarray(self.out_deg[self.src])
+        seg_plan = None
+        seg_dist = None
+        seg_extract_masks = ()
+        seg_place_masks = ()
+        if segment_benes:
+            from flow_updating_tpu.ops.seg_benes import plan_segments
+
+            seg_plan, dist = plan_segments(
+                self.row_start, self.out_deg, self.edge_rank
+            )
+            seg_dist = jnp.asarray(dist)
+            seg_extract_masks, seg_place_masks = seg_plan.device_leaves()
         rev_plan = None
         rev_masks = ()
         delay_rev = None
@@ -307,6 +321,11 @@ class Topology:
             rev_plan=rev_plan,
             rev_masks=rev_masks,
             delay_rev=delay_rev,
+            deg_e=deg_e,
+            seg_plan=seg_plan,
+            seg_dist=seg_dist,
+            seg_extract_masks=seg_extract_masks,
+            seg_place_masks=seg_place_masks,
             **link,
         )
 
@@ -364,6 +383,16 @@ class TopoArrays:
     rev_masks: tuple = ()            # Beneš stage masks for the rev perm
     delay_rev: object = None         # (E,) i32 = delay[rev] (static)
     rev_plan: object = flax.struct.field(pytree_node=False, default=None)
+    # gather/scatter-free segment reductions + broadcasts
+    # (cfg.segment_impl='benes'; ops/seg_benes.py)
+    deg_e: object = None             # (E,) i32 out_deg[src], baked at build
+    #                                  (deliver's drain priority modulus — a
+    #                                  topology constant; never recomputed
+    #                                  through the broadcast network)
+    seg_dist: object = None          # (P,) i32 edge_rank padded (free masks)
+    seg_extract_masks: tuple = ()    # row-end -> node Beneš masks
+    seg_place_masks: tuple = ()      # node -> row-head Beneš masks
+    seg_plan: object = flax.struct.field(pytree_node=False, default=None)
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,128 @@
+"""Permutation-network segmented reductions / broadcasts (ops/seg_benes.py).
+
+``segment_impl='benes'`` must agree with the jax.ops segment primitives:
+exactly for min/max/all and the broadcasts (pure data movement), and to
+reassociation tolerance for sums (the scan adds in a different order).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.ops.seg_benes import (
+    broadcast,
+    extract_row_ends,
+    plan_segments,
+    seg_reduce,
+)
+from flow_updating_tpu.topology import generators as gen
+
+rng = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module", params=["er", "ba", "star", "with_deg0"])
+def planned(request):
+    if request.param == "er":
+        topo = gen.erdos_renyi(300, avg_degree=6.0, seed=1)
+    elif request.param == "ba":
+        topo = gen.barabasi_albert(250, m=3, seed=2)
+    elif request.param == "star":
+        topo = gen.ring(2, k=1, seed=0)  # trivial 2-node
+    else:
+        # an isolated (degree-0) node exercises the identity-slot path
+        from flow_updating_tpu.topology.graph import build_topology
+
+        topo = build_topology(
+            6, [(0, 1), (1, 2), (2, 3), (3, 4)],
+            values=np.arange(6.0), warn_asymmetric=False,
+        )
+        assert (topo.out_deg == 0).any()
+    plan, dist = plan_segments(topo.row_start, topo.out_deg, topo.edge_rank)
+    import jax.numpy as jnp
+
+    return topo, plan, jnp.asarray(dist), plan.device_leaves()
+
+
+def test_seg_reduce_matches_segment_ops(planned):
+    import jax.ops
+
+    topo, plan, dist, (extract_m, _) = planned
+    N, E = topo.num_nodes, topo.num_edges
+    x = jnp.asarray(rng.normal(size=E))
+    xi = jnp.asarray(rng.integers(-1000, 1000, size=E).astype(np.int32))
+    xb = jnp.asarray(rng.integers(0, 2, size=E).astype(bool))
+    seg = jnp.asarray(topo.src)
+
+    got = seg_reduce(x, "sum", plan, dist, extract_m)
+    want = jax.ops.segment_sum(x, seg, N)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0, atol=1e-12)
+    got = seg_reduce(xi, "min", plan, dist, extract_m)
+    want = jax.ops.segment_min(xi, seg, N)
+    deg = np.asarray(topo.out_deg)
+    # deg-0 nodes: ours reads the int32 max identity; jax.ops returns max too
+    np.testing.assert_array_equal(np.asarray(got)[deg > 0],
+                                  np.asarray(want)[deg > 0])
+    got = seg_reduce(xi, "max", plan, dist, extract_m)
+    want = jax.ops.segment_max(xi, seg, N)
+    np.testing.assert_array_equal(np.asarray(got)[deg > 0],
+                                  np.asarray(want)[deg > 0])
+    got = seg_reduce(xb, "all", plan, dist, extract_m)
+    want = jax.ops.segment_min(xb.astype(np.int32), seg, N) > 0
+    np.testing.assert_array_equal(np.asarray(got)[deg > 0],
+                                  np.asarray(want)[deg > 0])
+    # deg-0 nodes read the identity
+    assert np.all(np.asarray(seg_reduce(x, "sum", plan, dist,
+                                        extract_m))[deg == 0] == 0.0)
+
+
+def test_broadcast_and_extract_match_gathers(planned):
+    topo, plan, dist, (extract_m, place_m) = planned
+    v = jnp.asarray(rng.normal(size=topo.num_nodes))
+    got = broadcast(v, plan, dist, place_m)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(v)[topo.src])
+    x = jnp.asarray(rng.normal(size=topo.num_edges))
+    got = extract_row_ends(x, plan, extract_m)
+    deg = np.asarray(topo.out_deg)
+    want = np.asarray(x)[np.maximum(topo.row_start[1:] - 1, 0)]
+    np.testing.assert_array_equal(np.asarray(got)[deg > 0], want[deg > 0])
+
+
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_rounds_with_segment_benes_match(variant):
+    """Faithful-mode rounds with segment_impl='benes' track the segment
+    path to float64 reassociation tolerance."""
+    topo = gen.erdos_renyi(200, avg_degree=5.0, seed=9)
+    outs = {}
+    for impl in ("segment", "benes"):
+        cfg = RoundConfig.reference(
+            variant=variant, delay_depth=2, segment_impl=impl,
+            dtype="float64",
+        )
+        arrays = topo.device_arrays(segment_benes=(impl == "benes"))
+        out = run_rounds(init_state(topo, cfg), arrays, cfg, 150)
+        outs[impl] = np.asarray(node_estimates(out, arrays))
+    np.testing.assert_allclose(outs["benes"], outs["segment"],
+                               rtol=0, atol=1e-10)
+    assert np.abs(outs["benes"] - topo.true_mean).max() < 0.2
+
+
+def test_full_benes_stack(variant="pairwise"):
+    """Everything at once: segment + delivery networks, FIFO queue,
+    faithful dynamics — still converging, still conserving mass."""
+    from flow_updating_tpu.utils.metrics import rmse
+
+    topo = gen.erdos_renyi(150, avg_degree=5.0, seed=3)
+    cfg = RoundConfig.reference(
+        variant=variant, delay_depth=2, segment_impl="benes",
+        delivery="benes", dtype="float64",
+    )
+    arrays = topo.device_arrays(segment_benes=True, delivery_benes=True)
+    out = run_rounds(init_state(topo, cfg), arrays, cfg, 1500)
+    est = np.asarray(node_estimates(out, arrays))
+    assert float(rmse(est, topo.true_mean)) < 1e-4
