@@ -251,16 +251,26 @@ def apply_stages(x, plan: StagePlan, masks_dev=None):
     pre-uploaded mask arrays (tuple, same order)."""
     import jax.numpy as jnp
 
+    import jax
+
     n = plan.n
-    lead = x.shape[:-1]
     if masks_dev is None:
         masks_dev = plan.device_masks()
     for dist, kind, mask in zip(plan.dists, plan.kinds, masks_dev):
         if kind == "swap":
-            sw = jnp.flip(
-                x.reshape(*lead, -1, 2, dist), axis=-2
-            ).reshape(*lead, n)
+            # Swap within pairs at power-of-two ``dist`` is the butterfly
+            # x[p] <- x[p ^ dist]; express it as two rolls + selects.
+            # The direct form — reshape(..., -1, 2, dist) + flip — costs
+            # ~300 us per 2M-element stage on TPU (5.2 ms at dist=1: the
+            # sub-lane flip forces a scalar relayout) while a roll is a
+            # pair of aligned slice-copies (~13-30 us); the iota fuses
+            # into the selects for free.
+            hi = (jax.lax.iota(jnp.int32, n) & dist) != 0
+            x = jnp.where(
+                mask & hi,
+                jnp.roll(x, dist, axis=-1),
+                jnp.where(mask & ~hi, jnp.roll(x, -dist, axis=-1), x),
+            )
         else:  # roll: take the value `dist` to the left
-            sw = jnp.roll(x, dist, axis=-1)
-        x = jnp.where(mask, sw, x)
+            x = jnp.where(mask, jnp.roll(x, dist, axis=-1), x)
     return x
