@@ -293,9 +293,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "semantics; gather avoids TPU scatters, benes "
                           "avoids TPU gathers too)")
     run.add_argument("--spmv", default="xla",
-                     choices=("xla", "pallas", "benes"),
+                     choices=("xla", "pallas", "benes", "benes_fused"),
                      help="node-kernel neighbor-sum implementation "
-                          "(pallas keeps the vector VMEM-resident)")
+                          "(benes_fused batches the permutation-network "
+                          "stages into Pallas HBM passes)")
     run.add_argument("--segment", default="auto",
                      choices=("auto", "segment", "ell", "benes"),
                      help="edge-kernel per-node reduction layout: jax.ops "

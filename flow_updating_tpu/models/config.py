@@ -95,7 +95,10 @@ class RoundConfig:
     #                                    'benes' (gather-free permutation
     #                                    network, ops/spmv_benes.py — the
     #                                    TPU path; XLA's dynamic gather
-    #                                    lowers to a scalar loop there)
+    #                                    lowers to a scalar loop there) |
+    #                                    'benes_fused' (same network, up
+    #                                    to 32 stages per HBM pass via
+    #                                    Pallas, ops/pallas_fused.py)
     segment_impl: str = "auto"         # edge-kernel per-node reductions:
     #                                    'segment' (jax.ops segment_* —
     #                                    scatter-based lowering) | 'ell'
@@ -131,7 +134,7 @@ class RoundConfig:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.delivery not in ("gather", "scatter", "benes"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
-        if self.spmv not in ("xla", "pallas", "benes"):
+        if self.spmv not in ("xla", "pallas", "benes", "benes_fused"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
         if self.segment_impl not in ("auto", "segment", "ell", "benes"):
             raise ValueError(f"unknown segment_impl {self.segment_impl!r}")

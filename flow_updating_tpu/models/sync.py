@@ -103,7 +103,7 @@ class NodeKernel:
         self.cfg = cfg
         import math
 
-        if cfg.spmv in ("pallas", "benes"):
+        if cfg.spmv in ("pallas", "benes", "benes_fused"):
             if mesh is not None:
                 # a config-validity error: the CLI's build/resume handlers
                 # turn ValueError into a clean "invalid flag combination"
@@ -155,10 +155,11 @@ class NodeKernel:
 
         ns_plan = None
         ns_masks = ()
-        if cfg.spmv == "benes":
+        if cfg.spmv in ("benes", "benes_fused"):
             from flow_updating_tpu.ops.spmv_benes import plan_neighbor_sum
 
-            ns_plan = plan_neighbor_sum(tuple(mats), M + 1)
+            ns_plan = plan_neighbor_sum(tuple(mats), M + 1,
+                                        fused=cfg.spmv == "benes_fused")
             ns_masks = ns_plan.device_masks()
         self.arrays = NodeSyncArrays(
             value=jnp.asarray(value, dt),
@@ -244,7 +245,7 @@ def node_round_step(
         from flow_updating_tpu.ops.pallas_spmv import neighbor_sum_pallas
 
         A_cur = neighbor_sum_pallas(avg, arrs.mats)
-    elif cfg.spmv == "benes":
+    elif cfg.spmv in ("benes", "benes_fused"):
         from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
 
         A_cur = neighbor_sum_benes(avg, arrs.ns_plan, arrs.ns_masks)

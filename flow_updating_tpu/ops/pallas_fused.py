@@ -1,0 +1,350 @@
+"""Fused permutation-network passes: many stages per HBM round trip.
+
+The XLA form of a Beneš/barrel-shifter stage (`permute.apply_stages`)
+materializes two `jnp.roll`s plus selects per stage — ~600 us per stage
+at 8M elements on a v5e, so the ~91-stage network costs ~47 ms/round.
+But a stage is ~40 us of *compute*; the rest is HBM traffic.  This
+module executes the same stages inside Pallas kernels so that one pass
+over HBM applies up to 32 stages (measured ~125 us/pass + ~17 us/stage
+at 33 MB):
+
+* the flat ``(P,)`` array is viewed as ``(P/128, 128)`` — TPU's native
+  lane tiling; a power-of-two element distance ``d`` becomes a row
+  distance ``d/128`` (d >= 128) or a lane distance (d < 128);
+* **local pass**: a run of swap stages whose pair blocks fit inside one
+  ``R``-row grid block.  The butterfly ``x[p] <- x[p ^ d]`` is two
+  ``pltpu.roll``s + selects in VMEM (rows) or lane-rolls (d < 128);
+* **window pass**: a run of roll stages.  Rolls move data forward
+  across block boundaries, so the kernel loads the previous block as a
+  halo (two input BlockSpecs on the same array) and applies the run on
+  the 2R-row window; valid as long as the run's total row distance is
+  <= R (halo-consumption argument in :func:`plan_fused`);
+* **wide pass**: a single stage whose distance exceeds the block.
+  Because block size divides the distance, the partner element lives at
+  the same offset of a partner *block*: a second input BlockSpec with
+  index map ``i ^ (d/B)`` (swap) or ``max(i - d/B, 0)`` (roll) — one
+  select, no roll at all.
+
+Stage masks for a local/window pass are bitpacked on the host into one
+``uint32`` plane (bit j = stage j of the pass), so a 32-stage pass
+reads 4 mask bytes per element instead of 32.
+
+Planner input is the host :class:`flow_updating_tpu.ops.permute.StagePlan`;
+results are bit-identical to `apply_stages` (asserted in tests, and on
+real TPU by the microbench).  Off-TPU the kernels run in Pallas
+interpret mode with `jnp.roll` (tests); production CPU paths should
+keep using the XLA form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.ops.permute import StagePlan
+
+LANE = 128
+MAX_STAGES_PER_PASS = 32
+DEFAULT_BLOCK_ROWS = 2048
+# below this the (rows, 128) view degenerates; callers should use the
+# XLA apply_stages path instead
+MIN_P = LANE * 8
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class PassSpec:
+    """One HBM round trip.  ``eq=False``: identity-hashed, jit-static."""
+
+    kind: str            # 'local' | 'window' | 'wide_swap' | 'wide_roll'
+    dists: tuple         # element distances, in stage order
+    block_dist: int      # wide passes: partner distance in blocks
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FusedPlan:
+    """Device-applicable pass sequence for one :class:`StagePlan`."""
+
+    P: int
+    rows: int
+    block_rows: int
+    grid: int
+    passes: tuple        # of PassSpec
+
+    def device_masks(self):
+        """Placeholder for interface parity; masks are built by
+        :func:`pack_masks` and travel as pytree leaves."""
+        raise TypeError("use pack_masks(stage_plan, fused_plan)")
+
+
+def _classify(kind: str, d: int, R: int) -> str:
+    """Pass flavor for one stage at block height ``R`` rows."""
+    rowd = d // LANE
+    if kind == "swap":
+        # pair block of 2*rowd rows must fit in (and align to) R rows
+        return "local" if (d < LANE or 2 * rowd <= R) else "wide_swap"
+    return "window" if rowd < R else "wide_roll"
+
+
+def plan_fused(plan: StagePlan,
+               block_rows: int = DEFAULT_BLOCK_ROWS) -> FusedPlan:
+    """Segment ``plan``'s stages into fused passes, preserving order.
+
+    Halo-consumption rule for window passes: let v be the first valid
+    row of the [prev; own] window (v=0 after load).  A roll at row
+    distance dr reads dr rows below, so v += dr; the own part (rows
+    >= R) stays exact while sum(dr) <= R.  Masked-on reads never hit
+    the invalid prefix because the stage masks never select a
+    wrapped-around source (spread/fill plans guarantee it — see
+    permute.spread_plan / fill_forward_stages).
+    """
+    P = plan.n
+    if P % LANE or P < MIN_P:
+        raise ValueError(f"fused plan needs P % {LANE} == 0 and P >= {MIN_P}")
+    rows = P // LANE
+    R = min(block_rows, rows)
+    if R & (R - 1):
+        # the local butterfly derives the pair half from the block-LOCAL
+        # row id; that equals the global bit test only when R is a
+        # multiple of every local 2*rowd — guaranteed by powers of two
+        raise ValueError(f"block_rows {R} must be a power of two")
+    if rows % R:
+        raise ValueError("rows must be a multiple of block_rows")
+
+    passes = []
+    cur_kind, cur_dists, cur_halo = None, [], 0
+
+    def flush():
+        nonlocal cur_kind, cur_dists, cur_halo
+        if cur_dists:
+            passes.append(PassSpec(kind=cur_kind, dists=tuple(cur_dists),
+                                   block_dist=0))
+        cur_kind, cur_dists, cur_halo = None, [], 0
+
+    for d, kind in zip(plan.dists, plan.kinds):
+        if kind == "swap" and d & (d - 1):
+            # the in-block butterfly and the wide xor partner both rely
+            # on power-of-two pair distances (true of every Benes plan)
+            raise ValueError(f"swap distance {d} is not a power of two")
+        if kind == "roll" and d >= LANE and d % LANE:
+            # the row-roll form shifts whole rows; a distance that is not
+            # a multiple of the lane width would be silently truncated
+            raise ValueError(
+                f"roll distance {d} >= {LANE} must be a multiple of {LANE}")
+        flavor = _classify(kind, d, R)
+        if flavor in ("wide_swap", "wide_roll"):
+            if (d // LANE) % R:
+                raise ValueError(
+                    f"wide stage distance {d} is not a multiple of the "
+                    f"block ({R * LANE} elements)")
+            flush()
+            passes.append(PassSpec(kind=flavor, dists=(d,),
+                                   block_dist=(d // LANE) // R))
+            continue
+        # halo cost: rolls consume their row distance (lane rolls carry
+        # one row); local swaps are exact within aligned pair blocks
+        halo = 0
+        if flavor == "window":
+            halo = max(d // LANE, 1)
+        if (cur_kind != flavor
+                or len(cur_dists) >= MAX_STAGES_PER_PASS
+                or (flavor == "window" and cur_halo + halo > R)):
+            flush()
+            cur_kind = flavor
+        cur_dists.append(d)
+        cur_halo += halo
+    flush()
+    return FusedPlan(P=P, rows=rows, block_rows=R, grid=rows // R,
+                     passes=tuple(passes))
+
+
+def pack_masks(plan: StagePlan, fused: FusedPlan):
+    """Host-side mask planes, one per pass, in pass order.
+
+    local/window passes: ``(rows, 128) uint32``, bit j = stage j of the
+    pass.  wide passes: ``(rows, 128) int8``.
+    """
+    planes = []
+    s = 0
+    for ps in fused.passes:
+        n_stages = len(ps.dists)
+        stage_masks = plan.masks[s: s + n_stages]
+        s += n_stages
+        if ps.kind in ("local", "window"):
+            plane = np.zeros(fused.P, np.uint32)
+            for j, m in enumerate(stage_masks):
+                plane |= m.astype(np.uint32) << j
+        else:
+            plane = stage_masks[0].astype(np.int8)
+        planes.append(plane.reshape(fused.rows, LANE))
+    assert s == len(plan.masks), "pass segmentation lost stages"
+    return tuple(planes)
+
+
+def device_mask_planes(plan: StagePlan, fused: FusedPlan):
+    import jax.numpy as jnp
+
+    return tuple(jnp.asarray(p) for p in pack_masks(plan, fused))
+
+
+def _interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _roll(x, shift: int, axis: int, size: int, interpret: bool):
+    """Non-negative circular roll; pltpu.roll on TPU, jnp.roll otherwise."""
+    shift %= size
+    if shift == 0:
+        return x
+    if interpret:
+        import jax.numpy as jnp
+
+        return jnp.roll(x, shift, axis=axis)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.roll(x, shift, axis)
+
+
+def _apply_stage_in_block(x, bit, d: int, kind: str, nrows: int,
+                          interpret: bool):
+    """One stage on a VMEM-resident ``(nrows, 128)`` window.
+
+    ``bit`` is the stage's bool mask for the window.  Flat semantics
+    (matching permute.apply_stages on the flattened array):
+
+    * swap, d >= 128: butterfly on the row index at dr = d/128;
+    * swap, d < 128: butterfly on the lane index;
+    * roll, d >= 128: take the value d/128 rows up;
+    * roll, d < 128: lane roll with a one-row carry into lanes < d.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shape = x.shape
+    if kind == "swap":
+        if d >= LANE:
+            dr = d // LANE
+            rowid = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            hi = (rowid & dr) != 0
+            fwd = _roll(x, dr, 0, nrows, interpret)
+            bwd = _roll(x, nrows - dr, 0, nrows, interpret)
+        else:
+            laneid = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+            hi = (laneid & d) != 0
+            fwd = _roll(x, d, 1, LANE, interpret)
+            bwd = _roll(x, LANE - d, 1, LANE, interpret)
+        return jnp.where(bit & hi, fwd, jnp.where(bit & ~hi, bwd, x))
+    # roll kind: value comes from d elements to the left (flat order)
+    if d >= LANE:
+        sw = _roll(x, d // LANE, 0, nrows, interpret)
+    else:
+        lr = _roll(x, d, 1, LANE, interpret)
+        carry = _roll(lr, 1, 0, nrows, interpret)
+        laneid = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        sw = jnp.where(laneid < d, carry, lr)
+    return jnp.where(bit, sw, x)
+
+
+def _local_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
+                interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    R = fused.block_rows
+
+    def kern(x_ref, m_ref, o_ref):
+        x = x_ref[...]
+        m = m_ref[...]
+        for j, d in enumerate(ps.dists):
+            bit = ((m >> j) & 1) != 0
+            x = _apply_stage_in_block(x, bit, d, "swap", R, interpret)
+        o_ref[...] = x
+
+    return pl.pallas_call(
+        kern,
+        grid=(fused.grid,),
+        in_specs=[pl.BlockSpec((R, LANE), lambda i: (i, 0)),
+                  pl.BlockSpec((R, LANE), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((R, LANE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret,
+    )(x2, mask_plane)
+
+
+def _window_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
+                 interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    R = fused.block_rows
+
+    def kern(xp_ref, xo_ref, mp_ref, mo_ref, o_ref):
+        w = jnp.concatenate([xp_ref[...], xo_ref[...]], axis=0)
+        m = jnp.concatenate([mp_ref[...], mo_ref[...]], axis=0)
+        for j, d in enumerate(ps.dists):
+            bit = ((m >> j) & 1) != 0
+            w = _apply_stage_in_block(w, bit, d, "roll", 2 * R, interpret)
+        o_ref[...] = w[R:]
+
+    prev = lambda i: (jnp.maximum(i - 1, 0), 0)
+    own = lambda i: (i, 0)
+    return pl.pallas_call(
+        kern,
+        grid=(fused.grid,),
+        in_specs=[pl.BlockSpec((R, LANE), prev),
+                  pl.BlockSpec((R, LANE), own),
+                  pl.BlockSpec((R, LANE), prev),
+                  pl.BlockSpec((R, LANE), own)],
+        out_specs=pl.BlockSpec((R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret,
+    )(x2, x2, mask_plane, mask_plane)
+
+
+def _wide_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
+               interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    R = fused.block_rows
+    D = ps.block_dist
+
+    def kern(a_ref, b_ref, m_ref, o_ref):
+        o_ref[...] = jnp.where(m_ref[...] != 0, b_ref[...], a_ref[...])
+
+    if ps.kind == "wide_swap":
+        partner = lambda i: (i ^ D, 0)
+    else:  # wide_roll: value comes D blocks up; wrapped sources are
+        # never mask-selected, so clamping at 0 is safe
+        partner = lambda i: (jnp.maximum(i - D, 0), 0)
+    own = lambda i: (i, 0)
+    return pl.pallas_call(
+        kern,
+        grid=(fused.grid,),
+        in_specs=[pl.BlockSpec((R, LANE), own),
+                  pl.BlockSpec((R, LANE), partner),
+                  pl.BlockSpec((R, LANE), own)],
+        out_specs=pl.BlockSpec((R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret,
+    )(x2, x2, mask_plane)
+
+
+_PASS_FNS = {"local": _local_pass, "window": _window_pass,
+             "wide_swap": _wide_pass, "wide_roll": _wide_pass}
+
+
+def apply_fused(x, fused: FusedPlan, mask_planes):
+    """Run every pass; drop-in equal to ``apply_stages(x, stage_plan)``
+    for a 1-D ``(P,)`` input.  ``mask_planes`` from
+    :func:`device_mask_planes` (pytree-carried by the caller)."""
+    interpret = _interpret()
+    x2 = x.reshape(fused.rows, LANE)
+    for ps, plane in zip(fused.passes, mask_planes):
+        x2 = _PASS_FNS[ps.kind](x2, plane, ps, fused, interpret)
+    return x2.reshape(fused.P)

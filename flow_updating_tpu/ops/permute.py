@@ -258,6 +258,11 @@ def apply_stages(x, plan: StagePlan, masks_dev=None):
         masks_dev = plan.device_masks()
     for dist, kind, mask in zip(plan.dists, plan.kinds, masks_dev):
         if kind == "swap":
+            if dist & (dist - 1):
+                # the xor-butterfly below pairs p with p ^ dist; only a
+                # power of two makes that the within-pairs exchange
+                raise ValueError(
+                    f"swap distance {dist} is not a power of two")
             # Swap within pairs at power-of-two ``dist`` is the butterfly
             # x[p] <- x[p ^ dist]; express it as two rolls + selects.
             # The direct form — reshape(..., -1, 2, dist) + flip — costs

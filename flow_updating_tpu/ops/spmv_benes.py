@@ -64,12 +64,45 @@ class NeighborSumPlan:
         return self.stages.device_masks()
 
 
-def plan_neighbor_sum(mats: tuple, m1: int) -> NeighborSumPlan:
+@dataclasses.dataclass(frozen=True, eq=False)
+class FusedNeighborSumPlan:
+    """:class:`NeighborSumPlan` whose stages run as fused Pallas passes
+    (``spmv='benes_fused'`` — see ops/pallas_fused.py).  Falls back to
+    the plain plan when the network is too small for the (rows, 128)
+    tiling."""
+
+    base: NeighborSumPlan
+    fused: object        # pallas_fused.FusedPlan
+
+    @property
+    def m1(self):
+        return self.base.m1
+
+    @property
+    def P(self):
+        return self.base.P
+
+    @property
+    def flat_begin(self):
+        return self.base.flat_begin
+
+    @property
+    def bucket_shapes(self):
+        return self.base.bucket_shapes
+
+    def device_masks(self):
+        from flow_updating_tpu.ops.pallas_fused import device_mask_planes
+
+        return device_mask_planes(self.base.stages, self.fused)
+
+
+def plan_neighbor_sum(mats: tuple, m1: int, fused: bool = False):
     """Plan the network for the NodeKernel's ELL matrices.
 
     ``mats``: per-bucket (rows, width) int32 neighbor-slot matrices in
     padded node space, pad value ``m1 - 1`` (the zero slot).  ``m1`` =
-    padded vector length + 1.
+    padded vector length + 1.  ``fused=True`` wraps the plan for the
+    fused-Pallas executor when the network is large enough.
     """
     bucket_shapes = tuple(m.shape for m in mats)
     flats = [np.asarray(m, np.int64).ravel() for m in mats]
@@ -99,10 +132,17 @@ def plan_neighbor_sum(mats: tuple, m1: int) -> NeighborSumPlan:
         [inv_order, np.arange(Ea, P, dtype=np.int64)]
     )
     benes = benes_plan(perm2)
-    return NeighborSumPlan(
+    plan = NeighborSumPlan(
         m1=m1, P=P, flat_begin=m1, bucket_shapes=bucket_shapes,
         stages=concat_plans(spread, fill, benes),
     )
+    if fused:
+        from flow_updating_tpu.ops.pallas_fused import MIN_P, plan_fused
+
+        if P >= MIN_P:
+            return FusedNeighborSumPlan(base=plan,
+                                        fused=plan_fused(plan.stages))
+    return plan
 
 
 def neighbor_sum_benes(x, plan: NeighborSumPlan, masks):
@@ -121,7 +161,12 @@ def neighbor_sum_benes(x, plan: NeighborSumPlan, masks):
     z = jnp.concatenate(
         [x, jnp.zeros((plan.P - plan.m1 + 1,), x.dtype)]
     )
-    z = apply_stages(z, plan.stages, masks)
+    if isinstance(plan, FusedNeighborSumPlan):
+        from flow_updating_tpu.ops.pallas_fused import apply_fused
+
+        z = apply_fused(z, plan.fused, masks)
+    else:
+        z = apply_stages(z, plan.stages, masks)
     parts = []
     off = plan.flat_begin
     for rows, w in plan.bucket_shapes:

@@ -103,7 +103,7 @@ def spmv(k: int) -> dict:
            "platform": jax.devices()[0].platform}
     variants = ["xla"]
     if native.available():
-        variants.append("benes")
+        variants += ["benes", "benes_fused"]
     else:
         out["benes"] = {"error": "native benes router unavailable; "
                                  "pure-Python routing takes hours — skipped"}
