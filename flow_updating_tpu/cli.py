@@ -288,10 +288,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="'reference' = faithful async dynamics; "
                           "'every_round' = fast synchronous mode")
     run.add_argument("--delivery", default="gather",
-                     choices=("gather", "scatter", "benes"),
+                     choices=("gather", "scatter", "benes", "benes_fused"),
                      help="message-delivery formulation (identical "
                           "semantics; gather avoids TPU scatters, benes "
-                          "avoids TPU gathers too)")
+                          "avoids TPU gathers too, benes_fused runs the "
+                          "benes network as fused Pallas passes — the "
+                          "fastest TPU form)")
     run.add_argument("--spmv", default="xla",
                      choices=("xla", "pallas", "benes", "benes_fused"),
                      help="node-kernel neighbor-sum implementation "

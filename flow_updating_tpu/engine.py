@@ -195,7 +195,9 @@ class Engine:
                 coloring=self.config.needs_coloring,
                 segment_ell=self.config.use_segment_ell,
                 segment_benes=self.config.use_segment_benes,
-                delivery_benes=self.config.delivery == "benes",
+                delivery_benes=(
+                    "fused" if self.config.delivery == "benes_fused"
+                    else self.config.delivery == "benes"),
             )
 
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":

@@ -79,7 +79,9 @@ class RoundConfig:
     kernel: str = "edge"               # 'edge' (general) | 'node' (collapsed
     #                                    SpMV recurrence; fast sync
     #                                    collect-all only, models/sync.py)
-    delivery: str = "gather"           # single-device message delivery:
+    delivery: str = "gather"           # single-device message delivery
+    #                                    ('benes_fused' = benes network via
+    #                                    fused Pallas passes):
     #                                    'gather' (receiver pulls through rev
     #                                    — elementwise over (D, E), no
     #                                    scatter) | 'scatter' (sender pushes;
@@ -132,7 +134,8 @@ class RoundConfig:
             )
         if self.kernel not in ("edge", "node"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
-        if self.delivery not in ("gather", "scatter", "benes"):
+        if self.delivery not in ("gather", "scatter", "benes",
+                                 "benes_fused"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas", "benes", "benes_fused"):
             raise ValueError(f"unknown spmv {self.spmv!r}")

@@ -433,8 +433,8 @@ def send_messages(
     t = state.t
     D = cfg.delay_depth
     delay = edge_delays(topo, cfg, send_mask)
-    if cfg.delivery in ("gather", "benes"):
-        if cfg.delivery == "benes":
+    if cfg.delivery in ("gather", "benes", "benes_fused"):
+        if cfg.delivery != "gather":
             # same receiver-pull formulation, but the rev permutation runs
             # through the planned Beneš network (ops/permute.py) instead of
             # a dynamic gather — on TPU the gather lowers to a scalar loop.

@@ -247,7 +247,7 @@ def _apply_stage_in_block(x, bit, d: int, kind: str, nrows: int,
     return jnp.where(bit, sw, x)
 
 
-def _local_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
+def _local_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
                 interpret: bool):
     import jax
     import jax.numpy as jnp
@@ -256,25 +256,27 @@ def _local_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
     R = fused.block_rows
 
     def kern(x_ref, m_ref, o_ref):
-        x = x_ref[...]
+        x = x_ref[0]
         m = m_ref[...]
         for j, d in enumerate(ps.dists):
             bit = ((m >> j) & 1) != 0
             x = _apply_stage_in_block(x, bit, d, "swap", R, interpret)
-        o_ref[...] = x
+        o_ref[0] = x
 
+    own = lambda b, i: (b, i, 0)
+    mown = lambda b, i: (i, 0)
     return pl.pallas_call(
         kern,
-        grid=(fused.grid,),
-        in_specs=[pl.BlockSpec((R, LANE), lambda i: (i, 0)),
-                  pl.BlockSpec((R, LANE), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((R, LANE), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        grid=(x3.shape[0], fused.grid),
+        in_specs=[pl.BlockSpec((1, R, LANE), own),
+                  pl.BlockSpec((R, LANE), mown)],
+        out_specs=pl.BlockSpec((1, R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
         interpret=interpret,
-    )(x2, mask_plane)
+    )(x3, mask_plane)
 
 
-def _window_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
+def _window_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
                  interpret: bool):
     import jax
     import jax.numpy as jnp
@@ -283,29 +285,31 @@ def _window_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
     R = fused.block_rows
 
     def kern(xp_ref, xo_ref, mp_ref, mo_ref, o_ref):
-        w = jnp.concatenate([xp_ref[...], xo_ref[...]], axis=0)
+        w = jnp.concatenate([xp_ref[0], xo_ref[0]], axis=0)
         m = jnp.concatenate([mp_ref[...], mo_ref[...]], axis=0)
         for j, d in enumerate(ps.dists):
             bit = ((m >> j) & 1) != 0
             w = _apply_stage_in_block(w, bit, d, "roll", 2 * R, interpret)
-        o_ref[...] = w[R:]
+        o_ref[0] = w[R:]
 
-    prev = lambda i: (jnp.maximum(i - 1, 0), 0)
-    own = lambda i: (i, 0)
+    prev = lambda b, i: (b, jnp.maximum(i - 1, 0), 0)
+    own = lambda b, i: (b, i, 0)
+    mprev = lambda b, i: (jnp.maximum(i - 1, 0), 0)
+    mown = lambda b, i: (i, 0)
     return pl.pallas_call(
         kern,
-        grid=(fused.grid,),
-        in_specs=[pl.BlockSpec((R, LANE), prev),
-                  pl.BlockSpec((R, LANE), own),
-                  pl.BlockSpec((R, LANE), prev),
-                  pl.BlockSpec((R, LANE), own)],
-        out_specs=pl.BlockSpec((R, LANE), own),
-        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        grid=(x3.shape[0], fused.grid),
+        in_specs=[pl.BlockSpec((1, R, LANE), prev),
+                  pl.BlockSpec((1, R, LANE), own),
+                  pl.BlockSpec((R, LANE), mprev),
+                  pl.BlockSpec((R, LANE), mown)],
+        out_specs=pl.BlockSpec((1, R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
         interpret=interpret,
-    )(x2, x2, mask_plane, mask_plane)
+    )(x3, x3, mask_plane, mask_plane)
 
 
-def _wide_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
+def _wide_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
                interpret: bool):
     import jax
     import jax.numpy as jnp
@@ -315,24 +319,25 @@ def _wide_pass(x2, mask_plane, ps: PassSpec, fused: FusedPlan,
     D = ps.block_dist
 
     def kern(a_ref, b_ref, m_ref, o_ref):
-        o_ref[...] = jnp.where(m_ref[...] != 0, b_ref[...], a_ref[...])
+        o_ref[0] = jnp.where(m_ref[...] != 0, b_ref[0], a_ref[0])
 
     if ps.kind == "wide_swap":
-        partner = lambda i: (i ^ D, 0)
+        partner = lambda b, i: (b, i ^ D, 0)
     else:  # wide_roll: value comes D blocks up; wrapped sources are
         # never mask-selected, so clamping at 0 is safe
-        partner = lambda i: (jnp.maximum(i - D, 0), 0)
-    own = lambda i: (i, 0)
+        partner = lambda b, i: (b, jnp.maximum(i - D, 0), 0)
+    own = lambda b, i: (b, i, 0)
+    mown = lambda b, i: (i, 0)
     return pl.pallas_call(
         kern,
-        grid=(fused.grid,),
-        in_specs=[pl.BlockSpec((R, LANE), own),
-                  pl.BlockSpec((R, LANE), partner),
-                  pl.BlockSpec((R, LANE), own)],
-        out_specs=pl.BlockSpec((R, LANE), own),
-        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        grid=(x3.shape[0], fused.grid),
+        in_specs=[pl.BlockSpec((1, R, LANE), own),
+                  pl.BlockSpec((1, R, LANE), partner),
+                  pl.BlockSpec((R, LANE), mown)],
+        out_specs=pl.BlockSpec((1, R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
         interpret=interpret,
-    )(x2, x2, mask_plane)
+    )(x3, x3, mask_plane)
 
 
 _PASS_FNS = {"local": _local_pass, "window": _window_pass,
@@ -341,10 +346,16 @@ _PASS_FNS = {"local": _local_pass, "window": _window_pass,
 
 def apply_fused(x, fused: FusedPlan, mask_planes):
     """Run every pass; drop-in equal to ``apply_stages(x, stage_plan)``
-    for a 1-D ``(P,)`` input.  ``mask_planes`` from
-    :func:`device_mask_planes` (pytree-carried by the caller)."""
+    over the last axis.  Leading batch dims share the mask planes (e.g.
+    delivery moves all payload lanes through one network).
+    ``mask_planes`` from :func:`device_mask_planes` (pytree-carried by
+    the caller)."""
     interpret = _interpret()
-    x2 = x.reshape(fused.rows, LANE)
+    lead = x.shape[:-1]
+    B = 1
+    for s in lead:
+        B *= s
+    x3 = x.reshape(B, fused.rows, LANE)
     for ps, plane in zip(fused.passes, mask_planes):
-        x2 = _PASS_FNS[ps.kind](x2, plane, ps, fused, interpret)
-    return x2.reshape(fused.P)
+        x3 = _PASS_FNS[ps.kind](x3, plane, ps, fused, interpret)
+    return x3.reshape(*lead, fused.P)

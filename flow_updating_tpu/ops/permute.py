@@ -204,19 +204,43 @@ class PaddedPermPlan:
         return self.stages.device_masks()
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class FusedPaddedPermPlan:
+    """:class:`PaddedPermPlan` whose stages run as fused Pallas passes
+    (``delivery='benes_fused'`` — see ops/pallas_fused.py)."""
+
+    n: int
+    stages: StagePlan
+    fused: object        # pallas_fused.FusedPlan
+
+    def device_masks(self):
+        from flow_updating_tpu.ops.pallas_fused import device_mask_planes
+
+        return device_mask_planes(self.stages, self.fused)
+
+
 def next_pow2(x: int) -> int:
     """Smallest power of two >= x, floored at 2 (network minimum)."""
     return 1 << max(x - 1, 1).bit_length()
 
 
-def padded_perm_plan(perm: np.ndarray) -> PaddedPermPlan:
+def padded_perm_plan(perm: np.ndarray, fused: bool = False):
     """Beneš plan for ``y = x[perm]`` with arbitrary (non-power-of-two)
-    length; the network is padded to the next power of two."""
+    length; the network is padded to the next power of two.
+    ``fused=True`` wraps the plan for the fused-Pallas executor when the
+    network is large enough."""
     perm = np.asarray(perm, np.int64)
     n = len(perm)
     P = next_pow2(n)
     full = np.concatenate([perm, np.arange(n, P, dtype=np.int64)])
-    return PaddedPermPlan(n=n, stages=benes_plan(full))
+    stages = benes_plan(full)
+    if fused:
+        from flow_updating_tpu.ops.pallas_fused import MIN_P, plan_fused
+
+        if P >= MIN_P:
+            return FusedPaddedPermPlan(n=n, stages=stages,
+                                       fused=plan_fused(stages))
+    return PaddedPermPlan(n=n, stages=stages)
 
 
 def apply_padded_perm(x, plan: PaddedPermPlan, masks_dev=None):
@@ -229,7 +253,14 @@ def apply_padded_perm(x, plan: PaddedPermPlan, masks_dev=None):
     if pad:
         width = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
         x = jnp.pad(x, width)
-    y = apply_stages(x, plan.stages, masks_dev)
+    if isinstance(plan, FusedPaddedPermPlan):
+        from flow_updating_tpu.ops.pallas_fused import apply_fused
+
+        if masks_dev is None:
+            masks_dev = plan.device_masks()
+        y = apply_fused(x, plan.fused, masks_dev)
+    else:
+        y = apply_stages(x, plan.stages, masks_dev)
     return y[..., : plan.n]
 
 

@@ -179,8 +179,10 @@ class Topology:
         """Degree-bucketed ELL adjacency for scatter-free neighbor sums.
 
         Nodes are permuted into ascending-degree order and grouped into
-        buckets whose padded width is the next power of two of their degree;
-        each bucket stores a dense ``(rows, width)`` neighbor-index matrix
+        buckets keyed by the next power of two of their degree; each
+        bucket stores a dense ``(rows, width)`` neighbor-index matrix
+        whose width is the bucket's true max degree (NOT the power-of-two
+        key — see the comment at the width computation below)
         (indices in *permuted* node space, padded with N → a zero slot).
         A neighbor sum then needs only per-bucket gathers + row reductions
         and one concatenate — no scatter, no segment ops.  This is the
@@ -208,9 +210,15 @@ class Topology:
         start = 0
         sorted_w = width[order]
         while start < N:
-            w = sorted_w[start]
-            end = int(np.searchsorted(sorted_w, w, side="right"))
+            wkey = sorted_w[start]
+            end = int(np.searchsorted(sorted_w, wkey, side="right"))
             rows = order[start:end]
+            # the power of two is only the GROUPING key (bounds bucket
+            # count at log2 maxdeg); the stored width is the bucket's true
+            # max degree — e.g. fat-tree switches (degree 160, key 256)
+            # would otherwise carry 37% pad slots, pushing the benes
+            # network width P at k=160 from 8.4M to 16.8M elements
+            w = int(deg[rows].max()) if wkey else 0
             if w == 0:
                 mat = np.empty((len(rows), 0), np.int32)
                 emat = np.empty((len(rows), 0), np.int32)
@@ -246,7 +254,7 @@ class Topology:
 
     def device_arrays(self, coloring: bool = False,
                       segment_ell: bool = False,
-                      delivery_benes: bool = False,
+                      delivery_benes=False,
                       segment_benes: bool = False):
         """Device-resident pytree of the arrays the round kernel consumes.
 
@@ -254,9 +262,12 @@ class Topology:
         needed by the fast synchronous pairwise mode).  ``segment_ell=True``
         materializes the degree-bucketed out-edge ELL matrices used by the
         scatter-free segment reductions (``cfg.segment_impl='ell'``).
-        ``delivery_benes=True`` plans the reverse-edge permutation as a
-        Beneš network (``cfg.delivery='benes'`` — message delivery without
-        the scalar-gather lowering, see ops/permute.py)."""
+        ``delivery_benes`` is tri-state: ``True`` plans the reverse-edge
+        permutation as a Beneš network (``cfg.delivery='benes'`` — message
+        delivery without the scalar-gather lowering, see ops/permute.py);
+        the string ``"fused"`` additionally routes it through the fused
+        Pallas executor (``cfg.delivery='benes_fused'``,
+        ops/pallas_fused.py); ``False`` keeps the gather formulation."""
         import jax.numpy as jnp
 
         edge_color = None
@@ -289,7 +300,8 @@ class Topology:
         if delivery_benes:
             from flow_updating_tpu.ops.permute import padded_perm_plan
 
-            rev_plan = padded_perm_plan(self.rev)
+            rev_plan = padded_perm_plan(self.rev,
+                                        fused=delivery_benes == "fused")
             rev_masks = rev_plan.device_masks()
             delay_rev = jnp.asarray(self.delay[self.rev])
         link = {}

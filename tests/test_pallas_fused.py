@@ -160,6 +160,33 @@ def test_real_spread_fill_through_fused():
     check_equal(plan)
 
 
+def test_batched_apply_fused():
+    # leading lanes share the mask planes (the delivery use case)
+    perm = rng.permutation(P)
+    plan = permute.benes_plan(perm)
+    fused = plan_fused(plan, block_rows=BLOCK_ROWS)
+    planes = device_mask_planes(plan, fused)
+    x = jnp.asarray(rng.normal(size=(3, plan.n)).astype(np.float32))
+    ref = permute.apply_stages(x, plan)
+    got = apply_fused(x, fused, planes)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_padded_perm_plan_fused_roundtrip():
+    from flow_updating_tpu.ops.permute import (
+        FusedPaddedPermPlan,
+        apply_padded_perm,
+        padded_perm_plan,
+    )
+
+    perm = rng.permutation(1500)   # pads to 2048
+    plan = padded_perm_plan(perm, fused=True)
+    assert isinstance(plan, FusedPaddedPermPlan)
+    x = jnp.asarray(rng.normal(size=(2, 1500)).astype(np.float32))
+    got = apply_padded_perm(x, plan)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x)[:, perm])
+
+
 def test_neighbor_sum_fused_matches_gather():
     from flow_updating_tpu.models import sync
     from flow_updating_tpu.models.config import RoundConfig

@@ -132,24 +132,27 @@ def test_neighbor_sum_benes_exact(make):
 
 @pytest.mark.parametrize("variant", ["collectall", "pairwise"])
 def test_delivery_benes_matches_gather(variant):
-    """delivery='benes' routes the rev pull through the network; results
-    must be bit-identical to the gather formulation (same values move,
-    delivery is select-only either way)."""
+    """delivery='benes' (and its fused-Pallas form) routes the rev pull
+    through the network; results must be bit-identical to the gather
+    formulation (same values move, delivery is select-only either way)."""
     from flow_updating_tpu.models.config import RoundConfig
     from flow_updating_tpu.models.rounds import node_estimates, run_rounds
     from flow_updating_tpu.models.state import init_state
 
     topo = gen.erdos_renyi(200, avg_degree=5.0, seed=11)
     outs = {}
-    for delivery in ("gather", "benes"):
+    for delivery in ("gather", "benes", "benes_fused"):
         cfg = RoundConfig.reference(
             variant=variant, delay_depth=2, delivery=delivery,
             dtype="float64",
         )
-        arrays = topo.device_arrays(delivery_benes=(delivery == "benes"))
+        arrays = topo.device_arrays(delivery_benes=(
+            "fused" if delivery == "benes_fused"
+            else delivery == "benes"))
         out = run_rounds(init_state(topo, cfg), arrays, cfg, 120)
         outs[delivery] = np.asarray(node_estimates(out, arrays))
     np.testing.assert_array_equal(outs["benes"], outs["gather"])
+    np.testing.assert_array_equal(outs["benes_fused"], outs["gather"])
 
 
 def test_delivery_benes_with_contention_matches_gather():
