@@ -89,7 +89,7 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
         cfg = RoundConfig.fast(variant="collectall", segment_impl=segment)
         arrays = topo.device_arrays(coloring=cfg.needs_coloring,
                                     segment_ell=cfg.use_segment_ell,
-                                    segment_benes=cfg.use_segment_benes)
+                                    segment_benes=cfg.segment_benes_mode)
         state = init_state(topo, cfg)
 
         def run(r):
@@ -268,7 +268,8 @@ def parse_args(argv=None):
                          "lowers to a scalar loop there — BENCH_NOTES.md), "
                          "then headline the faster")
     ap.add_argument("--segment", default="auto",
-                    choices=("auto", "segment", "ell", "benes"),
+                    choices=("auto", "segment", "ell", "benes",
+                             "benes_fused"),
                     help="per-node reduction layout for --kernel edge")
     ap.add_argument("--des-ticks", type=int, default=10,
                     help="timed baseline DES ticks (heap grows ~E per tick)")

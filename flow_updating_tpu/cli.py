@@ -300,7 +300,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "(benes_fused batches the permutation-network "
                           "stages into Pallas HBM passes)")
     run.add_argument("--segment", default="auto",
-                     choices=("auto", "segment", "ell", "benes"),
+                     choices=("auto", "segment", "ell", "benes",
+                              "benes_fused"),
                      help="edge-kernel per-node reduction layout: jax.ops "
                           "segment primitives vs scatter-free degree-"
                           "bucketed ELL gather+row-reduce")

@@ -177,9 +177,10 @@ class Engine:
                     "with a mesh, GSPMD lowers the segment path's "
                     "collectives instead"
                 )
-            if self.config.delivery == "benes":
+            if self.config.delivery in ("benes", "benes_fused"):
                 raise ValueError(
-                    "delivery='benes' is single-device only (the network "
+                    f"delivery={self.config.delivery!r} is "
+                    "single-device only (the network "
                     "masks index the global edge list); with a mesh, use "
                     "delivery='gather' or the shard_map halo kernel"
                 )
@@ -194,10 +195,8 @@ class Engine:
             self._topo_arrays = self.topology.device_arrays(
                 coloring=self.config.needs_coloring,
                 segment_ell=self.config.use_segment_ell,
-                segment_benes=self.config.use_segment_benes,
-                delivery_benes=(
-                    "fused" if self.config.delivery == "benes_fused"
-                    else self.config.delivery == "benes"),
+                segment_benes=self.config.segment_benes_mode,
+                delivery_benes=self.config.delivery_benes_mode,
             )
 
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":

@@ -139,12 +139,15 @@ class RoundConfig:
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas", "benes", "benes_fused"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
-        if self.segment_impl not in ("auto", "segment", "ell", "benes"):
+        if self.segment_impl not in ("auto", "segment", "ell", "benes",
+                                     "benes_fused"):
             raise ValueError(f"unknown segment_impl {self.segment_impl!r}")
-        if self.segment_impl in ("ell", "benes") and self.kernel == "node":
+        if (self.segment_impl in ("ell", "benes", "benes_fused")
+                and self.kernel == "node"):
             raise ValueError(
                 "segment_impl selects the edge kernel's reduction layout; "
-                "the node kernel has its own (spmv='xla'|'pallas'|'benes')"
+                "the node kernel has its own "
+                "(spmv='xla'|'pallas'|'benes'|'benes_fused')"
             )
         if self.contention and self.kernel != "edge":
             raise ValueError(
@@ -181,7 +184,23 @@ class RoundConfig:
     @property
     def use_segment_benes(self) -> bool:
         """Plan the permutation-network segmented reductions/broadcasts."""
+        return self.segment_impl in ("benes", "benes_fused")
+
+    @property
+    def segment_benes_mode(self):
+        """Value for ``Topology.device_arrays(segment_benes=...)``:
+        ``False`` | ``True`` | ``"fused"``."""
+        if self.segment_impl == "benes_fused":
+            return "fused"
         return self.segment_impl == "benes"
+
+    @property
+    def delivery_benes_mode(self):
+        """Value for ``Topology.device_arrays(delivery_benes=...)``:
+        ``False`` | ``True`` | ``"fused"``."""
+        if self.delivery == "benes_fused":
+            return "fused"
+        return self.delivery == "benes"
 
     @property
     def needs_coloring(self) -> bool:

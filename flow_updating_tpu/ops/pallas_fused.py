@@ -352,10 +352,7 @@ def apply_fused(x, fused: FusedPlan, mask_planes):
     the caller)."""
     interpret = _interpret()
     lead = x.shape[:-1]
-    B = 1
-    for s in lead:
-        B *= s
-    x3 = x.reshape(B, fused.rows, LANE)
+    x3 = x.reshape(-1, fused.rows, LANE)
     for ps, plane in zip(fused.passes, mask_planes):
         x3 = _PASS_FNS[ps.kind](x3, plane, ps, fused, interpret)
     return x3.reshape(*lead, fused.P)

@@ -59,18 +59,28 @@ class SegmentedPlan:
     fill_bits: int       # stages in fill-forward (same bound)
     extract: StagePlan   # row-end -> node id permutation
     place: StagePlan     # node id -> row-head permutation
+    extract_fused: object = None   # pallas_fused.FusedPlan, or None
+    place_fused: object = None     # (segment_impl='benes_fused')
 
     def device_leaves(self):
         """(extract_masks, place_masks) ready for TopoArrays."""
+        if self.extract_fused is not None:
+            from flow_updating_tpu.ops.pallas_fused import device_mask_planes
+
+            return (device_mask_planes(self.extract, self.extract_fused),
+                    device_mask_planes(self.place, self.place_fused))
         return (self.extract.device_masks(), self.place.device_masks())
 
 
 def plan_segments(row_start: np.ndarray, out_deg: np.ndarray,
-                  edge_rank: np.ndarray) -> tuple[SegmentedPlan, np.ndarray]:
+                  edge_rank: np.ndarray,
+                  fused: bool = False) -> tuple[SegmentedPlan, np.ndarray]:
     """Build the plan from the topology's CSR structure.
 
     Returns ``(plan, dist)`` where ``dist`` is the (P,) int32 array the
-    on-the-fly scan/fill masks derive from (edge_rank padded with 0)."""
+    on-the-fly scan/fill masks derive from (edge_rank padded with 0).
+    ``fused=True`` runs both permutations through the fused-Pallas
+    executor when the circuit is large enough."""
     N = len(out_deg)
     E = len(edge_rank)
     deg0 = np.flatnonzero(out_deg == 0)
@@ -104,9 +114,28 @@ def plan_segments(row_start: np.ndarray, out_deg: np.ndarray,
     perm2[row_start[:-1][pos]] = np.flatnonzero(pos)
     place = benes_plan(complete(perm2))
 
+    extract_fused = place_fused = None
+    if fused:
+        from flow_updating_tpu.ops.pallas_fused import MIN_P, plan_fused
+
+        if P >= MIN_P:
+            extract_fused = plan_fused(extract)
+            place_fused = plan_fused(place)
     plan = SegmentedPlan(N=N, E=E, P=P, scan_bits=bits, fill_bits=bits,
-                         extract=extract, place=place)
+                         extract=extract, place=place,
+                         extract_fused=extract_fused,
+                         place_fused=place_fused)
     return plan, dist
+
+
+def _apply(z, stages: StagePlan, fused_plan, masks):
+    """One permutation application: fused-Pallas when planned, XLA
+    stage form otherwise."""
+    if fused_plan is not None:
+        from flow_updating_tpu.ops.pallas_fused import apply_fused
+
+        return apply_fused(z, fused_plan, masks)
+    return apply_stages(z, stages, masks)
 
 
 def _identity_for(op: str, dtype):
@@ -139,7 +168,7 @@ def seg_reduce(x, op: str, plan: SegmentedPlan, dist, extract_masks):
         d = 1 << k
         taken = jnp.where(dist >= d, jnp.roll(z, d), ident)
         z = comb(z, taken)
-    out = apply_stages(z, plan.extract, extract_masks)
+    out = _apply(z, plan.extract, plan.extract_fused, extract_masks)
     return out[: plan.N]
 
 
@@ -149,7 +178,8 @@ def extract_row_ends(x, plan: SegmentedPlan, extract_masks):
     import jax.numpy as jnp
 
     z = jnp.zeros((plan.P,), x.dtype).at[: plan.E].set(x)
-    return apply_stages(z, plan.extract, extract_masks)[: plan.N]
+    return _apply(z, plan.extract, plan.extract_fused,
+                  extract_masks)[: plan.N]
 
 
 def broadcast(v, plan: SegmentedPlan, dist, place_masks):
@@ -158,7 +188,7 @@ def broadcast(v, plan: SegmentedPlan, dist, place_masks):
     import jax.numpy as jnp
 
     z = jnp.zeros((plan.P,), v.dtype).at[: plan.N].set(v)
-    z = apply_stages(z, plan.place, place_masks)
+    z = _apply(z, plan.place, plan.place_fused, place_masks)
     for k in range(plan.fill_bits):
         d = 1 << k
         z = jnp.where((dist >> k) & 1 != 0, jnp.roll(z, d), z)

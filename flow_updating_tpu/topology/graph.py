@@ -255,14 +255,16 @@ class Topology:
     def device_arrays(self, coloring: bool = False,
                       segment_ell: bool = False,
                       delivery_benes=False,
-                      segment_benes: bool = False):
+                      segment_benes=False):
         """Device-resident pytree of the arrays the round kernel consumes.
 
         ``coloring=True`` additionally materializes the edge coloring (only
         needed by the fast synchronous pairwise mode).  ``segment_ell=True``
         materializes the degree-bucketed out-edge ELL matrices used by the
         scatter-free segment reductions (``cfg.segment_impl='ell'``).
-        ``delivery_benes`` is tri-state: ``True`` plans the reverse-edge
+        ``segment_benes`` follows the same tri-state convention as
+        ``delivery_benes``, selecting the fused executor for the segment
+        networks with ``"fused"``.  ``delivery_benes`` is tri-state: ``True`` plans the reverse-edge
         permutation as a Beneš network (``cfg.delivery='benes'`` — message
         delivery without the scalar-gather lowering, see ops/permute.py);
         the string ``"fused"`` additionally routes it through the fused
@@ -290,7 +292,8 @@ class Topology:
             from flow_updating_tpu.ops.seg_benes import plan_segments
 
             seg_plan, dist = plan_segments(
-                self.row_start, self.out_deg, self.edge_rank
+                self.row_start, self.out_deg, self.edge_rank,
+                fused=segment_benes == "fused",
             )
             seg_dist = jnp.asarray(dist)
             seg_extract_masks, seg_place_masks = seg_plan.device_leaves()

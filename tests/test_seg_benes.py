@@ -99,16 +99,18 @@ def test_rounds_with_segment_benes_match(variant):
     path to float64 reassociation tolerance."""
     topo = gen.erdos_renyi(200, avg_degree=5.0, seed=9)
     outs = {}
-    for impl in ("segment", "benes"):
+    for impl in ("segment", "benes", "benes_fused"):
         cfg = RoundConfig.reference(
             variant=variant, delay_depth=2, segment_impl=impl,
             dtype="float64",
         )
-        arrays = topo.device_arrays(segment_benes=(impl == "benes"))
+        arrays = topo.device_arrays(segment_benes=cfg.segment_benes_mode)
         out = run_rounds(init_state(topo, cfg), arrays, cfg, 150)
         outs[impl] = np.asarray(node_estimates(out, arrays))
     np.testing.assert_allclose(outs["benes"], outs["segment"],
                                rtol=0, atol=1e-10)
+    # the fused executor moves the same values: bit-equal to plain benes
+    np.testing.assert_array_equal(outs["benes_fused"], outs["benes"])
     assert np.abs(outs["benes"] - topo.true_mean).max() < 0.2
 
 
@@ -119,10 +121,11 @@ def test_full_benes_stack(variant="pairwise"):
 
     topo = gen.erdos_renyi(150, avg_degree=5.0, seed=3)
     cfg = RoundConfig.reference(
-        variant=variant, delay_depth=2, segment_impl="benes",
-        delivery="benes", dtype="float64",
+        variant=variant, delay_depth=2, segment_impl="benes_fused",
+        delivery="benes_fused", dtype="float64",
     )
-    arrays = topo.device_arrays(segment_benes=True, delivery_benes=True)
+    arrays = topo.device_arrays(segment_benes=cfg.segment_benes_mode,
+                                delivery_benes=cfg.delivery_benes_mode)
     out = run_rounds(init_state(topo, cfg), arrays, cfg, 1500)
     est = np.asarray(node_estimates(out, arrays))
     assert float(rmse(est, topo.true_mean)) < 1e-4
