@@ -62,14 +62,37 @@ class PassSpec:
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
-class FusedPlan:
-    """Device-applicable pass sequence for one :class:`StagePlan`."""
+class Geometry:
+    """Block geometry shared by every pass flavor."""
 
     P: int
     rows: int
     block_rows: int
     grid: int
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FusedPlan:
+    """Device-applicable pass sequence for one :class:`StagePlan`."""
+
+    geom: Geometry
     passes: tuple        # of PassSpec
+
+    @property
+    def P(self):
+        return self.geom.P
+
+    @property
+    def rows(self):
+        return self.geom.rows
+
+    @property
+    def block_rows(self):
+        return self.geom.block_rows
+
+    @property
+    def grid(self):
+        return self.geom.grid
 
     def device_masks(self):
         """Placeholder for interface parity; masks are built by
@@ -98,18 +121,8 @@ def plan_fused(plan: StagePlan,
     wrapped-around source (spread/fill plans guarantee it — see
     permute.spread_plan / fill_forward_stages).
     """
-    P = plan.n
-    if P % LANE or P < MIN_P:
-        raise ValueError(f"fused plan needs P % {LANE} == 0 and P >= {MIN_P}")
-    rows = P // LANE
-    R = min(block_rows, rows)
-    if R & (R - 1):
-        # the local butterfly derives the pair half from the block-LOCAL
-        # row id; that equals the global bit test only when R is a
-        # multiple of every local 2*rowd — guaranteed by powers of two
-        raise ValueError(f"block_rows {R} must be a power of two")
-    if rows % R:
-        raise ValueError("rows must be a multiple of block_rows")
+    geom = geometry(plan.n, block_rows)
+    P, rows, R = geom.P, geom.rows, geom.block_rows
 
     passes = []
     cur_kind, cur_dists, cur_halo = None, [], 0
@@ -154,8 +167,7 @@ def plan_fused(plan: StagePlan,
         cur_dists.append(d)
         cur_halo += halo
     flush()
-    return FusedPlan(P=P, rows=rows, block_rows=R, grid=rows // R,
-                     passes=tuple(passes))
+    return FusedPlan(geom=geom, passes=tuple(passes))
 
 
 def pack_masks(plan: StagePlan, fused: FusedPlan):
@@ -237,14 +249,7 @@ def _apply_stage_in_block(x, bit, d: int, kind: str, nrows: int,
             bwd = _roll(x, LANE - d, 1, LANE, interpret)
         return jnp.where(bit & hi, fwd, jnp.where(bit & ~hi, bwd, x))
     # roll kind: value comes from d elements to the left (flat order)
-    if d >= LANE:
-        sw = _roll(x, d // LANE, 0, nrows, interpret)
-    else:
-        lr = _roll(x, d, 1, LANE, interpret)
-        carry = _roll(lr, 1, 0, nrows, interpret)
-        laneid = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-        sw = jnp.where(laneid < d, carry, lr)
-    return jnp.where(bit, sw, x)
+    return jnp.where(bit, _flat_roll(x, d, nrows, interpret), x)
 
 
 def _local_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
@@ -263,11 +268,13 @@ def _local_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
             x = _apply_stage_in_block(x, bit, d, "swap", R, interpret)
         o_ref[0] = x
 
-    own = lambda b, i: (b, i, 0)
-    mown = lambda b, i: (i, 0)
+    # batch axis innermost: consecutive grid steps share the mask
+    # block index, so the pipeline skips its re-fetch across lanes
+    own = lambda i, b: (b, i, 0)
+    mown = lambda i, b: (i, 0)
     return pl.pallas_call(
         kern,
-        grid=(x3.shape[0], fused.grid),
+        grid=(fused.grid, x3.shape[0]),
         in_specs=[pl.BlockSpec((1, R, LANE), own),
                   pl.BlockSpec((R, LANE), mown)],
         out_specs=pl.BlockSpec((1, R, LANE), own),
@@ -292,13 +299,13 @@ def _window_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
             w = _apply_stage_in_block(w, bit, d, "roll", 2 * R, interpret)
         o_ref[0] = w[R:]
 
-    prev = lambda b, i: (b, jnp.maximum(i - 1, 0), 0)
-    own = lambda b, i: (b, i, 0)
-    mprev = lambda b, i: (jnp.maximum(i - 1, 0), 0)
-    mown = lambda b, i: (i, 0)
+    prev = lambda i, b: (b, jnp.maximum(i - 1, 0), 0)
+    own = lambda i, b: (b, i, 0)
+    mprev = lambda i, b: (jnp.maximum(i - 1, 0), 0)
+    mown = lambda i, b: (i, 0)
     return pl.pallas_call(
         kern,
-        grid=(x3.shape[0], fused.grid),
+        grid=(fused.grid, x3.shape[0]),
         in_specs=[pl.BlockSpec((1, R, LANE), prev),
                   pl.BlockSpec((1, R, LANE), own),
                   pl.BlockSpec((R, LANE), mprev),
@@ -322,15 +329,15 @@ def _wide_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
         o_ref[0] = jnp.where(m_ref[...] != 0, b_ref[0], a_ref[0])
 
     if ps.kind == "wide_swap":
-        partner = lambda b, i: (b, i ^ D, 0)
+        partner = lambda i, b: (b, i ^ D, 0)
     else:  # wide_roll: value comes D blocks up; wrapped sources are
         # never mask-selected, so clamping at 0 is safe
-        partner = lambda b, i: (b, jnp.maximum(i - D, 0), 0)
-    own = lambda b, i: (b, i, 0)
-    mown = lambda b, i: (i, 0)
+        partner = lambda i, b: (b, jnp.maximum(i - D, 0), 0)
+    own = lambda i, b: (b, i, 0)
+    mown = lambda i, b: (i, 0)
     return pl.pallas_call(
         kern,
-        grid=(x3.shape[0], fused.grid),
+        grid=(fused.grid, x3.shape[0]),
         in_specs=[pl.BlockSpec((1, R, LANE), own),
                   pl.BlockSpec((1, R, LANE), partner),
                   pl.BlockSpec((R, LANE), mown)],
@@ -342,6 +349,129 @@ def _wide_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
 
 _PASS_FNS = {"local": _local_pass, "window": _window_pass,
              "wide_swap": _wide_pass, "wide_roll": _wide_pass}
+
+
+def geometry(P: int, block_rows: int = DEFAULT_BLOCK_ROWS) -> Geometry:
+    if P % LANE or P < MIN_P:
+        raise ValueError(f"geometry needs P % {LANE} == 0 and P >= {MIN_P}")
+    rows = P // LANE
+    R = min(block_rows, rows)
+    if R & (R - 1) or rows % R:
+        raise ValueError("block_rows must be a power of two dividing rows")
+    return Geometry(P=P, rows=rows, block_rows=R, grid=rows // R)
+
+
+def halo_rows(dists) -> int:
+    """Window-halo consumption of a stage run, in rows: a roll at
+    distance d reads d/LANE rows below (a lane-distance stage's one-row
+    carry costs a full row).  Single source of truth for the planner
+    gate and the runtime guards."""
+    return sum(max(d // LANE, 1) for d in dists)
+
+
+def _flat_roll(x, d: int, nrows: int, interpret: bool):
+    """Flat-order forward roll by ``d`` elements on a (nrows, 128) view
+    (the roll branch of :func:`_apply_stage_in_block`, shared by the
+    dist-plane passes)."""
+    import jax
+    import jax.numpy as jnp
+
+    if d >= LANE:
+        return _roll(x, d // LANE, 0, nrows, interpret)
+    lr = _roll(x, d, 1, LANE, interpret)
+    carry = _roll(lr, 1, 0, nrows, interpret)
+    laneid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(laneid < d, carry, lr)
+
+
+def segscan_pass(x, dist_plane, dists: tuple, op: str, geom: Geometry):
+    """Segmented Hillis-Steele scan: for each d in ``dists`` (ascending
+    powers of two), ``x = comb(x, where(dist >= d, flat_roll(x, d),
+    identity))``.  One HBM pass; masks derive from the static ``dist``
+    plane in-kernel.  Valid while sum(dists) <= block elements (the
+    window halo argument of :func:`plan_fused`; ``dist[p] >= d`` implies
+    ``p >= d``, so wrapped sources are never selected)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if sum(max(d // LANE, 1) for d in dists) > geom.block_rows:
+        # rows, not elements: a lane-distance stage's one-row carry
+        # consumes a full row of halo (same rule as plan_fused)
+        raise ValueError("scan stages exceed the window halo budget")
+    interpret = _interpret()
+    R = geom.block_rows
+
+    comb = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}[op]
+    # python literal (a traced scalar would be a captured constant,
+    # which pallas_call rejects)
+    if op == "sum":
+        ident = 0
+    elif jnp.issubdtype(x.dtype, jnp.integer):
+        info = jnp.iinfo(x.dtype)
+        ident = int(info.max if op == "min" else info.min)
+    else:
+        info = jnp.finfo(x.dtype)
+        ident = float(info.max if op == "min" else info.min)
+
+    def kern(xp_ref, xo_ref, dp_ref, do_ref, o_ref):
+        w = jnp.concatenate([xp_ref[0], xo_ref[0]], axis=0)
+        dv = jnp.concatenate([dp_ref[...], do_ref[...]], axis=0)
+        for d in dists:
+            taken = jnp.where(dv >= d, _flat_roll(w, d, 2 * R, interpret),
+                              ident)
+            w = comb(w, taken)
+        o_ref[0] = w[R:]
+
+    return _dist_window_call(kern, x, dist_plane, geom, interpret)
+
+
+def fill_pass(x, dist_plane, dists: tuple, geom: Geometry):
+    """Fill-forward: for each d=2^k in ``dists``, ``x = where(bit k of
+    dist, flat_roll(x, d), x)`` — run heads copied over their runs in
+    one HBM pass."""
+    import jax.numpy as jnp
+
+    if halo_rows(dists) > geom.block_rows:
+        raise ValueError("fill stages exceed the window halo budget")
+    interpret = _interpret()
+    R = geom.block_rows
+
+    def kern(xp_ref, xo_ref, dp_ref, do_ref, o_ref):
+        w = jnp.concatenate([xp_ref[0], xo_ref[0]], axis=0)
+        dv = jnp.concatenate([dp_ref[...], do_ref[...]], axis=0)
+        for d in dists:
+            bit = (dv & d) != 0
+            w = jnp.where(bit, _flat_roll(w, d, 2 * R, interpret), w)
+        o_ref[0] = w[R:]
+
+    return _dist_window_call(kern, x, dist_plane, geom, interpret)
+
+
+def _dist_window_call(kern, x, dist_plane, geom: Geometry, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    R = geom.block_rows
+    x3 = x.reshape(1, geom.rows, LANE)
+    d2 = dist_plane.reshape(geom.rows, LANE)
+    prev = lambda i, b: (b, jnp.maximum(i - 1, 0), 0)
+    own = lambda i, b: (b, i, 0)
+    mprev = lambda i, b: (jnp.maximum(i - 1, 0), 0)
+    mown = lambda i, b: (i, 0)
+    out = pl.pallas_call(
+        kern,
+        grid=(geom.grid, 1),
+        in_specs=[pl.BlockSpec((1, R, LANE), prev),
+                  pl.BlockSpec((1, R, LANE), own),
+                  pl.BlockSpec((R, LANE), mprev),
+                  pl.BlockSpec((R, LANE), mown)],
+        out_specs=pl.BlockSpec((1, R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
+        interpret=interpret,
+    )(x3, x3, d2, d2)
+    return out.reshape(geom.P)
 
 
 def apply_fused(x, fused: FusedPlan, mask_planes):

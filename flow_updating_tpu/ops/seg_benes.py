@@ -61,6 +61,7 @@ class SegmentedPlan:
     place: StagePlan     # node id -> row-head permutation
     extract_fused: object = None   # pallas_fused.FusedPlan, or None
     place_fused: object = None     # (segment_impl='benes_fused')
+    geom: object = None            # pallas_fused.Geometry: fused scan/fill
 
     def device_leaves(self):
         """(extract_masks, place_masks) ready for TopoArrays."""
@@ -114,17 +115,28 @@ def plan_segments(row_start: np.ndarray, out_deg: np.ndarray,
     perm2[row_start[:-1][pos]] = np.flatnonzero(pos)
     place = benes_plan(complete(perm2))
 
-    extract_fused = place_fused = None
+    extract_fused = place_fused = geom = None
     if fused:
-        from flow_updating_tpu.ops.pallas_fused import MIN_P, plan_fused
+        from flow_updating_tpu.ops.pallas_fused import (
+            MIN_P,
+            geometry,
+            halo_rows,
+            plan_fused,
+        )
 
         if P >= MIN_P:
             extract_fused = plan_fused(extract)
             place_fused = plan_fused(place)
+            g = geometry(P)
+            # the scan/fill runs fuse only while their summed halo fits
+            # the window (pallas_fused.halo_rows — the same rule the
+            # passes enforce); falls back to the XLA loop otherwise
+            if halo_rows(1 << k for k in range(bits)) <= g.block_rows:
+                geom = g
     plan = SegmentedPlan(N=N, E=E, P=P, scan_bits=bits, fill_bits=bits,
                          extract=extract, place=place,
                          extract_fused=extract_fused,
-                         place_fused=place_fused)
+                         place_fused=place_fused, geom=geom)
     return plan, dist
 
 
@@ -164,10 +176,21 @@ def seg_reduce(x, op: str, plan: SegmentedPlan, dist, extract_masks):
     ident = _identity_for(op, x.dtype)
     comb = _combine(op)
     z = jnp.full((plan.P,), ident, x.dtype).at[: plan.E].set(x)
-    for k in range(plan.scan_bits):
-        d = 1 << k
-        taken = jnp.where(dist >= d, jnp.roll(z, d), ident)
-        z = comb(z, taken)
+    if plan.geom is not None and plan.scan_bits:
+        from flow_updating_tpu.ops.pallas_fused import segscan_pass
+
+        dists = tuple(1 << k for k in range(plan.scan_bits))
+        if op == "all":
+            # Mosaic-friendly: booleans scan as int32 min (ident 1)
+            z = segscan_pass(z.astype(jnp.int32), dist, dists, "min",
+                             plan.geom) != 0
+        else:
+            z = segscan_pass(z, dist, dists, op, plan.geom)
+    else:
+        for k in range(plan.scan_bits):
+            d = 1 << k
+            taken = jnp.where(dist >= d, jnp.roll(z, d), ident)
+            z = comb(z, taken)
     out = _apply(z, plan.extract, plan.extract_fused, extract_masks)
     return out[: plan.N]
 
@@ -189,7 +212,13 @@ def broadcast(v, plan: SegmentedPlan, dist, place_masks):
 
     z = jnp.zeros((plan.P,), v.dtype).at[: plan.N].set(v)
     z = _apply(z, plan.place, plan.place_fused, place_masks)
-    for k in range(plan.fill_bits):
-        d = 1 << k
-        z = jnp.where((dist >> k) & 1 != 0, jnp.roll(z, d), z)
+    if plan.geom is not None and plan.fill_bits:
+        from flow_updating_tpu.ops.pallas_fused import fill_pass
+
+        dists = tuple(1 << k for k in range(plan.fill_bits))
+        z = fill_pass(z, dist, dists, plan.geom)
+    else:
+        for k in range(plan.fill_bits):
+            d = 1 << k
+            z = jnp.where((dist >> k) & 1 != 0, jnp.roll(z, d), z)
     return z[: plan.E]

@@ -187,6 +187,52 @@ def test_padded_perm_plan_fused_roundtrip():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(x)[:, perm])
 
 
+def _random_segment_fixture():
+    """Synthetic segment ranks (runs of random lengths) + input."""
+    runs = rng.integers(1, 50, size=400)
+    rank = np.concatenate([np.arange(r) for r in runs])[:P]
+    rank = np.pad(rank, (0, P - len(rank)))
+    dist = jnp.asarray(rank.astype(np.int32))
+    x = jnp.asarray(rng.normal(size=P).astype(np.float32))
+    dists = tuple(1 << k for k in range(int(rank.max()).bit_length()))
+    return dist, x, dists
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+def test_segscan_pass_matches_xla_loop(op):
+    from flow_updating_tpu.ops.pallas_fused import geometry, segscan_pass
+
+    geom = geometry(P, block_rows=BLOCK_ROWS)
+    dist, x, dists = _random_segment_fixture()
+
+    comb = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}[op]
+    ident = {"sum": 0.0, "min": np.finfo(np.float32).max,
+             "max": np.finfo(np.float32).min}[op]
+    ref = x
+    for d in dists:
+        taken = jnp.where(dist >= d, jnp.roll(ref, d), ident)
+        ref = comb(ref, taken)
+    got = segscan_pass(x, dist, dists, op, geom)
+    if op == "sum":
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6)
+    else:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_fill_pass_matches_xla_loop():
+    from flow_updating_tpu.ops.pallas_fused import fill_pass, geometry
+
+    geom = geometry(P, block_rows=BLOCK_ROWS)
+    dist, x, dists = _random_segment_fixture()
+
+    ref = x
+    for k, d in enumerate(dists):
+        ref = jnp.where((dist >> k) & 1 != 0, jnp.roll(ref, d), ref)
+    got = fill_pass(x, dist, dists, geom)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
 def test_neighbor_sum_fused_matches_gather():
     from flow_updating_tpu.models import sync
     from flow_updating_tpu.models.config import RoundConfig
