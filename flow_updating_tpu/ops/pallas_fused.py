@@ -57,8 +57,10 @@ class PassSpec:
     """One HBM round trip.  ``eq=False``: identity-hashed, jit-static."""
 
     kind: str            # 'local' | 'window' | 'wide_swap' | 'wide_roll'
+    #                      | 'wide_swap2' | 'wide_roll2' (two merged stages)
     dists: tuple         # element distances, in stage order
     block_dist: int      # wide passes: partner distance in blocks
+    block_dist2: int = 0  # wide2 passes: second stage's block distance
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -167,7 +169,25 @@ def plan_fused(plan: StagePlan,
         cur_dists.append(d)
         cur_halo += halo
     flush()
-    return FusedPlan(geom=geom, passes=tuple(passes))
+    # pairwise-merge adjacent single-stage wide passes of the same kind:
+    # 2 stages per HBM round trip via 4 input blocks (source offsets
+    # {0, D1, D2, D1+D2}) instead of 2x(2 blocks) — fewer passes AND
+    # less traffic
+    merged = []
+    for ps in passes:
+        prev = merged[-1] if merged else None
+        if (prev is not None
+                and prev.kind in ("wide_swap", "wide_roll")
+                and ps.kind == prev.kind):
+            merged[-1] = PassSpec(
+                kind=prev.kind + "2",
+                dists=prev.dists + ps.dists,
+                block_dist=prev.block_dist,
+                block_dist2=ps.block_dist,
+            )
+            continue
+        merged.append(ps)
+    return FusedPlan(geom=geom, passes=tuple(merged))
 
 
 def pack_masks(plan: StagePlan, fused: FusedPlan):
@@ -186,6 +206,9 @@ def pack_masks(plan: StagePlan, fused: FusedPlan):
             plane = np.zeros(fused.P, np.uint32)
             for j, m in enumerate(stage_masks):
                 plane |= m.astype(np.uint32) << j
+        elif ps.kind in ("wide_swap2", "wide_roll2"):
+            plane = (stage_masks[0].astype(np.int8)
+                     | (stage_masks[1].astype(np.int8) << 1))
         else:
             plane = stage_masks[0].astype(np.int8)
         planes.append(plane.reshape(fused.rows, LANE))
@@ -347,8 +370,59 @@ def _wide_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
     )(x3, x3, mask_plane)
 
 
+def _wide2_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
+                interpret: bool):
+    """Two merged wide stages in one round trip.
+
+    Dataflow: stage 1 maps p from p+off1(p) (off1 in {0, D1} by mask
+    bit 0), stage 2 from p+off2(p) (off2 in {0, D2} by bit 1), so the
+    final source block offset is one of {0, D1, D2, D1+D2} (roll; xor
+    for swaps).  The kernel reconstructs stage 1's result at both the
+    own block and the D2-partner block — the latter needs stage 1's
+    mask bit at that partner, hence the second mask spec."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    R = fused.block_rows
+    D1, D2 = ps.block_dist, ps.block_dist2
+
+    def kern(x0_ref, x1_ref, x2_ref, x12_ref, m_ref, m2_ref, o_ref):
+        m1_own = (m_ref[...] & 1) != 0
+        m1_shift = (m2_ref[...] & 1) != 0
+        m2_own = (m_ref[...] & 2) != 0
+        s1_own = jnp.where(m1_own, x1_ref[0], x0_ref[0])
+        s1_shift = jnp.where(m1_shift, x12_ref[0], x2_ref[0])
+        o_ref[0] = jnp.where(m2_own, s1_shift, s1_own)
+
+    if ps.kind == "wide_swap2":
+        at = lambda D: (lambda i, b, D=D: (b, i ^ D, 0))
+        mat = lambda i, b: (i ^ D2, 0)
+    else:
+        at = lambda D: (lambda i, b, D=D: (b, jnp.maximum(i - D, 0), 0))
+        mat = lambda i, b: (jnp.maximum(i - D2, 0), 0)
+    own = lambda i, b: (b, i, 0)
+    mown = lambda i, b: (i, 0)
+    return pl.pallas_call(
+        kern,
+        grid=(fused.grid, x3.shape[0]),
+        in_specs=[pl.BlockSpec((1, R, LANE), own),
+                  pl.BlockSpec((1, R, LANE), at(D1)),
+                  pl.BlockSpec((1, R, LANE), at(D2)),
+                  pl.BlockSpec((1, R, LANE), at(D1 + D2)
+                               if ps.kind == "wide_roll2"
+                               else (lambda i, b: (b, i ^ D1 ^ D2, 0))),
+                  pl.BlockSpec((R, LANE), mown),
+                  pl.BlockSpec((R, LANE), mat)],
+        out_specs=pl.BlockSpec((1, R, LANE), own),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
+        interpret=interpret,
+    )(x3, x3, x3, x3, mask_plane, mask_plane)
+
+
 _PASS_FNS = {"local": _local_pass, "window": _window_pass,
-             "wide_swap": _wide_pass, "wide_roll": _wide_pass}
+             "wide_swap": _wide_pass, "wide_roll": _wide_pass,
+             "wide_swap2": _wide2_pass, "wide_roll2": _wide2_pass}
 
 
 def geometry(P: int, block_rows: int = DEFAULT_BLOCK_ROWS) -> Geometry:
@@ -391,13 +465,9 @@ def segscan_pass(x, dist_plane, dists: tuple, op: str, geom: Geometry):
     plane in-kernel.  Valid while sum(dists) <= block elements (the
     window halo argument of :func:`plan_fused`; ``dist[p] >= d`` implies
     ``p >= d``, so wrapped sources are never selected)."""
-    import jax
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
 
-    if sum(max(d // LANE, 1) for d in dists) > geom.block_rows:
-        # rows, not elements: a lane-distance stage's one-row carry
-        # consumes a full row of halo (same rule as plan_fused)
+    if halo_rows(dists) > geom.block_rows:
         raise ValueError("scan stages exceed the window halo budget")
     interpret = _interpret()
     R = geom.block_rows

@@ -73,12 +73,22 @@ def test_local_swaps_all_dists():
 
 
 def test_wide_swaps():
-    # pair block exceeds the 16-row grid block -> two-input-block pass
+    # pair block exceeds the 16-row grid block -> partner-block passes;
+    # two adjacent wide stages merge into one 4-block pass
     dists = [LANE * BLOCK_ROWS, LANE * BLOCK_ROWS * 2]
     plan = random_stage_plan(P, [("swap", d) for d in dists])
     fused = check_equal(plan)
-    assert [ps.kind for ps in fused.passes] == ["wide_swap", "wide_swap"]
-    assert [ps.block_dist for ps in fused.passes] == [1, 2]
+    assert [ps.kind for ps in fused.passes] == ["wide_swap2"]
+    assert (fused.passes[0].block_dist, fused.passes[0].block_dist2) == (1, 2)
+
+
+def test_wide_swaps_odd_run():
+    # three adjacent wide swaps: one merged pair + one single
+    dists = [LANE * BLOCK_ROWS, LANE * BLOCK_ROWS * 2,
+             LANE * BLOCK_ROWS]
+    plan = random_stage_plan(P, [("swap", d) for d in dists])
+    fused = check_equal(plan)
+    assert [ps.kind for ps in fused.passes] == ["wide_swap2", "wide_swap"]
 
 
 def test_windowed_rolls():
@@ -103,7 +113,15 @@ def test_wide_rolls():
     dists = [LANE * BLOCK_ROWS, LANE * BLOCK_ROWS * 2]
     plan = random_stage_plan(P, [("roll", d) for d in dists])
     fused = check_equal(plan)
-    assert [ps.kind for ps in fused.passes] == ["wide_roll", "wide_roll"]
+    assert [ps.kind for ps in fused.passes] == ["wide_roll2"]
+
+
+def test_wide_roll2_then_narrow():
+    # a merged wide pair followed by a windowed roll keeps stage order
+    dists = [LANE * BLOCK_ROWS * 2, LANE * BLOCK_ROWS, 128]
+    plan = random_stage_plan(P, [("roll", d) for d in dists])
+    fused = check_equal(plan)
+    assert [ps.kind for ps in fused.passes] == ["wide_roll2", "window"]
 
 
 def test_mixed_plan_order_preserved():
@@ -145,7 +163,7 @@ def test_real_benes_plan_through_fused():
     fused = check_equal(plan)
     # middle columns are narrow, outer columns wide at this block size
     assert any(ps.kind == "local" for ps in fused.passes)
-    assert any(ps.kind == "wide_swap" for ps in fused.passes)
+    assert any(ps.kind.startswith("wide_swap") for ps in fused.passes)
 
 
 def test_real_spread_fill_through_fused():
