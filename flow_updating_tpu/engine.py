@@ -134,9 +134,18 @@ class Engine:
                 )
             from flow_updating_tpu.models import sync
 
-            self._node_kernel = sync.NodeKernel(
-                self.topology, self.config, mesh=self.mesh
-            )
+            if self.mesh is not None and self.config.spmv == "benes_fused":
+                from flow_updating_tpu.parallel.spmv_sharded import (
+                    ShardedNodeKernel,
+                )
+
+                self._node_kernel = ShardedNodeKernel(
+                    self.topology, self.config, self.mesh
+                )
+            else:
+                self._node_kernel = sync.NodeKernel(
+                    self.topology, self.config, mesh=self.mesh
+                )
             self._topo_arrays = None
             return
         if latency_scale > 0.0:
@@ -418,20 +427,35 @@ class Engine:
                 self._topo_arrays,
                 auto.topo_sharding(self.mesh, self._topo_arrays),
             )
+        # compare the node-axis SIZE, not shape[0]: the sharded fused
+        # kernel's state is (S, M/S) while the single-device kernel's is
+        # (M,) — both carry padded_size node slots
         expect = (self._node_kernel.padded_size if cfg.kernel == "node"
                   else (self._padded_topology.num_nodes
                         if self.mesh is not None else self.topology.num_nodes))
-        got = state.S.shape[0] if cfg.kernel == "node" else state.value.shape[0]
+        got = state.S.size if cfg.kernel == "node" else state.value.shape[0]
         if got != expect:
             raise ValueError(
                 f"checkpoint state has node axis {got} but this engine's "
                 f"layout expects {expect} — restore with the same "
                 "mesh/padding it was saved under"
             )
+        if cfg.kernel == "node":
+            # layout check runs mesh or not: a sharded (S, M/S) state is
+            # NOT interchangeable with the single-device (M,) layout even
+            # when the total node-slot count matches
+            template = self._node_kernel.init_state()
+            if state.S.shape != template.S.shape:
+                raise ValueError(
+                    f"checkpoint node state has shape {state.S.shape} "
+                    f"but this engine's kernel uses {template.S.shape} — "
+                    "the sharded fused kernel's interleaved layout is not "
+                    "interchangeable with the single-device layout; "
+                    "restore under the configuration it was saved with"
+                )
         if self.mesh is not None:
             if cfg.kernel == "node":
-                # NodeKernel.init_state carries the placement; reuse it
-                template = self._node_kernel.init_state()
+                # the kernel's init_state carries the placement; reuse it
                 import jax
 
                 state = jax.device_put(

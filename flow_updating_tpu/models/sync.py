@@ -108,10 +108,16 @@ class NodeKernel:
                 # a config-validity error: the CLI's build/resume handlers
                 # turn ValueError into a clean "invalid flag combination"
                 # exit (cli.py:cmd_run)
+                hint = (
+                    "use parallel.spmv_sharded.ShardedNodeKernel (the "
+                    "shard_map fused-circuit path)"
+                    if cfg.spmv == "benes_fused"
+                    else "use spmv='xla' with a mesh (GSPMD handles the "
+                         "collective)"
+                )
                 raise ValueError(
-                    f"spmv={cfg.spmv!r} has no SPMD partitioning path yet; "
-                    "use spmv='xla' with a mesh (GSPMD handles the "
-                    "collective)"
+                    f"spmv={cfg.spmv!r} has no GSPMD partitioning path; "
+                    + hint
                 )
         if cfg.spmv == "pallas":
             from flow_updating_tpu.ops.pallas_spmv import BLOCK_ROWS

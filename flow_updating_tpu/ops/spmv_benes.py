@@ -133,14 +133,35 @@ def plan_neighbor_sum(mats: tuple, m1: int, fused: bool = False):
         wrapped = _wrap_fused(base_cached)
         _plan_cache[key] = wrapped
         return wrapped
-    bucket_shapes = tuple(m.shape for m in mats)
+    spread, fill, benes, P = plan_sections(mats, m1)
+    plan = NeighborSumPlan(
+        m1=m1, P=P, flat_begin=m1,
+        bucket_shapes=tuple(m.shape for m in mats),
+        stages=concat_plans(spread, fill, benes),
+    )
+    _plan_cache[(key[0], False)] = plan
+    out = plan
+    if fused:
+        out = _wrap_fused(plan)
+        _plan_cache[key] = out
+    while len(_plan_cache) > 8:   # bound held host memory (masks are big)
+        _plan_cache.pop(next(iter(_plan_cache)))
+    return out
+
+
+def plan_sections(mats: tuple, m1: int, min_width: int = 0):
+    """The three network sections (spread, fill, benes StagePlans) plus
+    the common width ``P`` for one set of ELL matrices.  Exposed
+    separately so the sharded planner can pad per-shard sections to a
+    common stage skeleton before concatenation (``min_width`` floors P,
+    e.g. at the fused executor's minimum)."""
     flats = [np.asarray(m, np.int64).ravel() for m in mats]
     idx_flat = (np.concatenate(flats) if flats
                 else np.zeros(0, np.int64))
     # synthetic block: every value present at least once
     aug = np.concatenate([np.arange(m1, dtype=np.int64), idx_flat])
     Ea = len(aug)
-    P = next_pow2(max(Ea, m1))
+    P = next_pow2(max(Ea, m1, min_width))
 
     order = np.argsort(aug, kind="stable")
     g = aug[order]
@@ -161,18 +182,27 @@ def plan_neighbor_sum(mats: tuple, m1: int, fused: bool = False):
         [inv_order, np.arange(Ea, P, dtype=np.int64)]
     )
     benes = benes_plan(perm2)
-    plan = NeighborSumPlan(
-        m1=m1, P=P, flat_begin=m1, bucket_shapes=bucket_shapes,
-        stages=concat_plans(spread, fill, benes),
-    )
-    _plan_cache[(key[0], False)] = plan
-    out = plan
-    if fused:
-        out = _wrap_fused(plan)
-        _plan_cache[key] = out
-    while len(_plan_cache) > 8:   # bound held host memory (masks are big)
-        _plan_cache.pop(next(iter(_plan_cache)))
-    return out
+    return spread, fill, benes, P
+
+
+def pad_roll_section(plan: StagePlan, target_dists: tuple) -> StagePlan:
+    """Extend a roll-stage section to a canonical dist list by inserting
+    all-false-mask (no-op) stages; existing stages must appear in
+    ``target_dists`` in order."""
+    it = iter(zip(plan.dists, plan.masks))
+    nxt = next(it, None)
+    masks = []
+    for d in target_dists:
+        if nxt is not None and nxt[0] == d:
+            masks.append(nxt[1])
+            nxt = next(it, None)
+        else:
+            masks.append(np.zeros(plan.n, bool))
+    if nxt is not None:
+        raise ValueError("section dists not a subsequence of target")
+    return StagePlan(n=plan.n, dists=tuple(target_dists),
+                     kinds=("roll",) * len(target_dists),
+                     masks=tuple(masks))
 
 
 def _wrap_fused(plan: NeighborSumPlan):

@@ -1,0 +1,364 @@
+"""Multi-chip node-collapsed kernel with a sharded fused-network SpMV.
+
+The single-device node kernel's only graph op is the neighbor sum
+(models/sync.py); its multi-chip GSPMD form keeps the gather and lets
+XLA all-gather the avg vector over ICI.  This module is the
+circuit-based equivalent: the gather-free permutation network
+(ops/spmv_benes.py, executed by ops/pallas_fused.py), sharded by hand
+with ``shard_map``:
+
+* **Round-robin degree-interleaved node partition.**  Nodes live in the
+  ELL ascending-degree order (padded so every bucket's row count is a
+  multiple of S); shard ``s`` owns padded rows ``s::S`` of every bucket.
+  Every shard therefore holds the SAME per-bucket row counts and
+  widths — and, crucially, per-shard networks of the SAME width P.
+* **Identical pass skeletons.**  Each shard routes its own network
+  (its local ELL rows against the global node vector), but the stage
+  *structure* must be jit-static and shared.  The Beneš section's shape
+  is fixed by P; the spread/fill sections are padded to canonical
+  full-width dist lists with all-false (no-op) stages
+  (``spmv_benes.pad_roll_section``) so every shard runs the same pass
+  sequence with different masks.
+* **Stacked mask planes.**  Per-pass mask planes stack on a leading
+  (S, ...) axis sharded over the mesh; inside ``shard_map`` each shard
+  sees exactly its own planes.
+* **One collective per round.**  The avg vector is all-gathered over
+  the mesh axis (4 bytes/node/round — identical volume to the GSPMD
+  gather path) and re-interleaved to global padded order with static
+  reshapes; everything else is local circuits.
+
+Use :class:`ShardedNodeKernel` directly (``sync.NodeKernel`` raises a
+pointer here when given ``spmv='benes_fused'`` with a mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.parallel.mesh import NODE_AXIS
+from flow_updating_tpu.topology.graph import Topology
+
+P = jax.sharding.PartitionSpec
+shard_map = jax.shard_map
+
+_sharded_plan_cache: dict = {}
+
+
+@flax.struct.dataclass
+class ShardedSpmvArrays:
+    """Constants, stacked per shard on the leading axis."""
+
+    value: jnp.ndarray      # (S, M/S)
+    inv_depp1: jnp.ndarray  # (S, M/S)
+    deg: jnp.ndarray        # (S, M/S)
+    mask_planes: tuple      # per pass: (S, rows, 128)
+    plan: object = flax.struct.field(pytree_node=False, default=None)
+    #                         static _ShardedPlan (identity-hashed)
+
+
+class _ShardedPlan:
+    """Identity-hashed static plan shared by every shard."""
+
+    def __init__(self, fused, bucket_shapes, bucket_offs, m1, num_shards):
+        self.fused = fused                  # pallas_fused.FusedPlan
+        self.bucket_shapes = bucket_shapes  # LOCAL (rows/S, w) per bucket
+        self.bucket_offs = bucket_offs      # global padded offsets per bucket
+        self.m1 = m1                        # global padded vector len + 1
+        self.num_shards = num_shards
+
+
+def plan_sharded_spmv(mats: tuple, m1: int, num_shards: int):
+    """Per-shard fused plans with a common skeleton + stacked masks.
+
+    ``mats``: the GLOBAL padded ELL matrices (every row count a multiple
+    of ``num_shards``); shard s owns rows ``s::num_shards``.
+    """
+    from flow_updating_tpu.ops.pallas_fused import (
+        MIN_P,
+        pack_masks,
+        plan_fused,
+    )
+    from flow_updating_tpu.ops.permute import concat_plans
+    from flow_updating_tpu.ops.spmv_benes import (
+        _mats_key,
+        pad_roll_section,
+        plan_sections,
+    )
+
+    S = num_shards
+    key = (_mats_key(mats, m1), S)
+    cached = _sharded_plan_cache.get(key)
+    if cached is not None:
+        return cached
+    sections = []
+    for s in range(S):
+        mats_s = tuple(np.ascontiguousarray(m[s::S]) for m in mats)
+        sections.append(plan_sections(mats_s, m1, min_width=MIN_P))
+    widths = {sec[3] for sec in sections}
+    assert len(widths) == 1, f"shards disagree on network width: {widths}"
+    Pw = widths.pop()
+
+    # canonical full-width dist lists (descending for spread, ascending
+    # for fill) — supersequences of every shard's actual stages
+    kmax = Pw.bit_length() - 1
+    spread_dists = tuple(1 << k for k in range(kmax - 1, -1, -1))
+    fill_dists = tuple(1 << k for k in range(kmax))
+
+    stage_plans = []
+    for spread, fill, benes, _ in sections:
+        stage_plans.append(concat_plans(
+            pad_roll_section(spread, spread_dists),
+            pad_roll_section(fill, fill_dists),
+            benes,
+        ))
+    skeleton = (stage_plans[0].dists, stage_plans[0].kinds)
+    for sp in stage_plans[1:]:
+        assert (sp.dists, sp.kinds) == skeleton, "shard skeletons diverged"
+
+    fused = plan_fused(stage_plans[0])
+    # pack on the HOST (numpy) and stack there: materializing per-shard
+    # device planes before the sharded device_put would transiently
+    # triple HBM on one chip at the 1M-node scale
+    per_shard_planes = [pack_masks(sp, fused) for sp in stage_plans]
+    stacked = tuple(
+        np.stack([per_shard_planes[s][i] for s in range(S)])
+        for i in range(len(per_shard_planes[0]))
+    )
+    local_shapes = tuple((m.shape[0] // S, m.shape[1]) for m in mats)
+    out = (fused, stacked, local_shapes)
+    _sharded_plan_cache[key] = out
+    while len(_sharded_plan_cache) > 2:   # stacked planes are big
+        _sharded_plan_cache.pop(next(iter(_sharded_plan_cache)))
+    return out
+
+
+class ShardedNodeKernel:
+    """Node-collapsed fast collect-all over a device mesh, SpMV as
+    per-shard fused circuits.  Mirrors :class:`models.sync.NodeKernel`'s
+    recurrence exactly (tests assert equality with the single-device
+    kernel)."""
+
+    def __init__(self, topo: Topology, cfg: RoundConfig, mesh):
+        from flow_updating_tpu.models import sync
+
+        sync._check_cfg(cfg)
+        if cfg.spmv != "benes_fused":
+            raise ValueError("ShardedNodeKernel is the spmv='benes_fused' "
+                             "mesh path")
+        self.topo = topo
+        self.cfg = cfg
+        self.mesh = mesh
+        S = mesh.devices.size
+
+        # reuse the single-device kernel's padding/remapping machinery
+        # (row_multiple=S makes every bucket's row count divisible by S);
+        # spmv='xla' here only to skip its own plan construction
+        import dataclasses
+
+        # pin the throwaway base kernel's arrays to host CPU: its
+        # unsharded ELL matrices would otherwise spike one chip's HBM at
+        # the 1M-node scale before the sharded copies are placed
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            base = sync.NodeKernel(
+                topo, dataclasses.replace(cfg, spmv="xla"),
+                row_multiple=S)
+        M = base.padded_size
+        self.padded_size = M
+        # keep only the host readback indices; holding the base kernel
+        # would pin a full unsharded device copy of the ELL matrices
+        self._pos_of_real = base._pos_of_real
+        self._perm = base._perm
+        mats_np = tuple(np.asarray(m) for m in base.arrays.mats)
+        fused, planes, local_shapes = plan_sharded_spmv(mats_np, M + 1, S)
+
+        offs = np.concatenate(
+            [[0], np.cumsum([m.shape[0] for m in mats_np])]
+        ).astype(np.int64)
+        plan = _ShardedPlan(fused=fused, bucket_shapes=local_shapes,
+                            bucket_offs=tuple(int(o) for o in offs),
+                            m1=M + 1, num_shards=S)
+        self._plan = plan
+
+        def interleave_local(x):
+            # global padded (M,) -> (S, M/S): shard s takes rows s::S of
+            # each bucket, buckets concatenated
+            parts = []
+            for b in range(len(mats_np)):
+                blk = x[offs[b]: offs[b + 1]]
+                parts.append(blk.reshape(-1, S).T)   # (S, rows/S)
+            return np.concatenate(parts, axis=1)
+
+        dt = cfg.jnp_dtype
+        value = np.asarray(base.arrays.value)
+        deg = np.asarray(base.arrays.deg)
+        inv = np.asarray(base.arrays.inv_depp1)
+        del base
+        # host arrays -> one sharded device_put each (never a full
+        # unsharded device copy)
+        import jax.sharding as jsh
+
+        ns = lambda spec: jsh.NamedSharding(mesh, spec)
+        put = lambda x, spec: jax.device_put(np.ascontiguousarray(x),
+                                             ns(spec))
+        self.arrays = ShardedSpmvArrays(
+            value=put(interleave_local(value).astype(dt),
+                      P(NODE_AXIS, None)),
+            inv_depp1=put(interleave_local(inv).astype(dt),
+                          P(NODE_AXIS, None)),
+            deg=put(interleave_local(deg).astype(dt), P(NODE_AXIS, None)),
+            mask_planes=tuple(
+                put(p, P(NODE_AXIS, None, None)) for p in planes
+            ),
+            plan=plan,
+        )
+
+    def init_state(self):
+        from flow_updating_tpu.models.sync import NodeSyncState
+
+        import jax.sharding as jsh
+
+        S = self._plan.num_shards
+        M = self.padded_size
+        z = jax.device_put(
+            jnp.zeros((S, M // S), self.cfg.jnp_dtype),
+            jsh.NamedSharding(self.mesh, P(NODE_AXIS, None)),
+        )
+        return NodeSyncState(t=jnp.zeros((), jnp.int32), S=z, G=z,
+                             avg_prev=z, A_prev=z)
+
+    def run(self, state, num_rounds: int):
+        return _run_sharded(state, self.arrays, self.cfg, self.mesh,
+                            num_rounds)
+
+    def _uninterleave(self, x_l: np.ndarray) -> np.ndarray:
+        """(S, M/S) local-layout array -> (M,) global padded order."""
+        plan = self._plan
+        out = np.zeros(self.padded_size, x_l.dtype)
+        col = 0
+        for b, (rows, _) in enumerate(plan.bucket_shapes):
+            lo = plan.bucket_offs[b]
+            blk = x_l[:, col: col + rows]            # (S, rows)
+            out[lo: lo + rows * plan.num_shards] = blk.T.reshape(-1)
+            col += rows
+        return out
+
+    def _unpermute(self, padded: np.ndarray) -> np.ndarray:
+        out = np.empty(self.topo.num_nodes, padded.dtype)
+        out[self._perm] = padded[self._pos_of_real]
+        return out
+
+    def estimates(self, state) -> np.ndarray:
+        """Per-node estimates in original node order (same readback
+        convention as NodeKernel: value + G)."""
+        return self._unpermute(self._uninterleave(
+            np.asarray(self.arrays.value + state.G)))
+
+    def last_avg(self, state) -> np.ndarray:
+        return self._unpermute(
+            self._uninterleave(np.asarray(state.avg_prev)))
+
+    def run_streamed(self, state, num_rounds: int, observe_every: int,
+                     emit):
+        """Chunked host-side observer with the same emit payload as
+        sync.run_rounds_node_streamed (metrics over communicating
+        nodes)."""
+        if num_rounds % observe_every:
+            raise ValueError("num_rounds must be a multiple of "
+                             "observe_every")
+        mean = float(self.topo.true_mean)
+        deg = np.asarray(self.arrays.deg)
+        real = deg > 0
+        cnt = max(int(real.sum()), 1)
+        for _ in range(num_rounds // observe_every):
+            state = self.run(state, observe_every)
+            if emit is not None:
+                est = np.asarray(self.arrays.value + state.G)
+                err = np.where(real, est - mean, 0.0)
+                emit({
+                    "t": int(state.t),
+                    "rmse": float(np.sqrt((err * err).sum() / cnt)),
+                    "max_abs_err": float(np.abs(err).max()),
+                    "mass": float(np.where(real, est, 0.0).sum()),
+                    "fired_total": int(state.t) * cnt,
+                })
+        return state
+
+
+def _neighbor_sum_local(avg_glob, planes_l, plan: _ShardedPlan):
+    """Per-shard circuit: global padded avg -> local rows' neighbor
+    sums.  Mirrors spmv_benes.neighbor_sum_benes with local buckets."""
+    from flow_updating_tpu.ops.pallas_fused import apply_fused
+
+    z = jnp.concatenate([
+        avg_glob,
+        jnp.zeros((plan.fused.P - plan.m1 + 1,), avg_glob.dtype),
+    ])
+    z = apply_fused(z, plan.fused, planes_l)
+    parts = []
+    off = plan.m1
+    for rows, w in plan.bucket_shapes:
+        if w == 0:
+            parts.append(jnp.zeros((rows,), avg_glob.dtype))
+        else:
+            blk = z[off: off + rows * w].reshape(rows, w)
+            parts.append(jnp.sum(blk, axis=1))
+            off += rows * w
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def _interleave_global(gathered, plan: _ShardedPlan):
+    """(S, M/S) all-gathered local avgs -> (M,) global padded order."""
+    parts = []
+    col = 0
+    for rows, _ in plan.bucket_shapes:
+        blk = gathered[:, col: col + rows]          # (S, rows)
+        parts.append(blk.T.reshape(-1))             # (rows*S,)
+        col += rows
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "mesh", "num_rounds"))
+def _run_sharded(state, arrays: ShardedSpmvArrays, cfg: RoundConfig,
+                 mesh, num_rounds: int):
+    plan = arrays.plan
+
+    def body(value_l, inv_l, deg_l, planes_l, st):
+        value_l, inv_l, deg_l = (a[0] for a in (value_l, inv_l, deg_l))
+        planes_l = tuple(p[0] for p in planes_l)
+        st = jax.tree.map(lambda x: x[0] if x.ndim == 2 else x, st)
+
+        def step(st, _):
+            avg_l = (value_l - st.S + st.A_prev) * inv_l
+            gathered = jax.lax.all_gather(avg_l, NODE_AXIS)   # (S, M/S)
+            avg_glob = _interleave_global(gathered, plan)
+            A_cur = _neighbor_sum_local(avg_glob, planes_l, plan)
+            S_next = -st.G - A_cur + deg_l * st.avg_prev
+            G_next = -st.S - deg_l * avg_l + st.A_prev
+            return st.replace(t=st.t + 1, S=S_next, G=G_next,
+                              avg_prev=avg_l, A_prev=A_cur), None
+
+        out, _ = jax.lax.scan(step, st, None, length=num_rounds)
+        return jax.tree.map(
+            lambda x: x[None] if x.ndim == 1 else x, out)
+
+    sh = P(NODE_AXIS, None)
+    plane_specs = tuple(P(NODE_AXIS, None, None) for _ in
+                        arrays.mask_planes)
+    state_spec = jax.tree.map(
+        lambda x: sh if x.ndim == 2 else P(), state,
+    )
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(sh, sh, sh, plane_specs, state_spec),
+        out_specs=state_spec,
+        check_vma=False,
+    )(arrays.value, arrays.inv_depp1, arrays.deg, arrays.mask_planes,
+      state)
