@@ -39,6 +39,23 @@ def test_sharded_matches_single_device(topo_name):
                                rtol=0, atol=1e-9)
 
 
+def test_odd_shard_count():
+    # 3 shards: row counts pad to multiples of 3, skeletons still align
+    topo = gen.erdos_renyi(300, avg_degree=5.0, seed=12)
+    mesh = make_mesh(3)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="benes_fused", dtype="float64")
+    ks = ShardedNodeKernel(topo, cfg, mesh)
+    out_s = ks.run(ks.init_state(), 15)
+
+    import dataclasses
+
+    k1 = sync.NodeKernel(topo, dataclasses.replace(cfg, spmv="xla"))
+    out_1 = k1.run(k1.init_state(), 15)
+    np.testing.assert_allclose(ks.estimates(out_s), k1.estimates(out_1),
+                               rtol=0, atol=1e-9)
+
+
 def test_sharded_converges_to_mean():
     topo = gen.erdos_renyi(400, avg_degree=8.0, seed=9)
     mesh = make_mesh(4)
