@@ -243,29 +243,55 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     t = state.t
     src = topo.src
 
-    flows_sum = _seg_sum(state.flow, topo, N)
-    estimate = state.value - flows_sum
-
     ticks = state.ticks
     stamp = state.stamp
     recv = state.recv
     last_avg = state.last_avg
     fired_ctr = state.fired
 
+    # collect-all needs up to three same-structure reductions of the
+    # current state (flow sum, est sum, all-heard); with the planned
+    # segment networks they share one batched extraction application
+    # (ops/seg_benes.seg_reduce_multi) instead of paying it three times
+    all_heard = None
+    if topo.seg_plan is not None and cfg.variant == COLLECTALL:
+        from flow_updating_tpu.ops.seg_benes import seg_reduce_multi
+
+        xs = [(state.flow, "sum"), (state.est, "sum")]
+        if cfg.fire_policy != "every_round":
+            xs.append((recv, "all"))
+        red = seg_reduce_multi(xs, topo.seg_plan, topo.seg_dist,
+                               topo.seg_extract_masks)
+        flows_sum, est_sum = red[0], red[1]
+        if cfg.fire_policy != "every_round":
+            all_heard = red[2]
+    else:
+        flows_sum = _seg_sum(state.flow, topo, N)
+        est_sum = (_seg_sum(state.est, topo, N)
+                   if cfg.variant == COLLECTALL else None)
+    estimate = state.value - flows_sum
+
     if cfg.variant == COLLECTALL:
         ticks = ticks + 1
         if cfg.fire_policy == "every_round":
             fire_n = state.alive
         else:
-            all_heard = _seg_all(recv, topo, N)
+            if all_heard is None:
+                all_heard = _seg_all(recv, topo, N)
             fire_n = (all_heard | (ticks >= cfg.timeout)) & state.alive
         # avg over self + ALL neighbors' last-known estimates (unheard
         # neighbors contribute their defaultdict 0.0, as in the reference,
         # ``collectall.py:109-113``).
-        est_sum = _seg_sum(state.est, topo, N)
         avg = (estimate + est_sum) / (topo.out_deg + 1).astype(dt)
-        fire_e = _bcast(fire_n, topo)
-        avg_e = _bcast(avg, topo)
+        if topo.seg_plan is not None:
+            from flow_updating_tpu.ops.seg_benes import broadcast_multi
+
+            fire_e, avg_e = broadcast_multi(
+                [fire_n, avg], topo.seg_plan, topo.seg_dist,
+                topo.seg_place_masks)
+        else:
+            fire_e = _bcast(fire_n, topo)
+            avg_e = _bcast(avg, topo)
         new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
         new_est = jnp.where(fire_e, avg_e, state.est)
         msg_est = avg_e

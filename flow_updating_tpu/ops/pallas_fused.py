@@ -519,12 +519,15 @@ def fill_pass(x, dist_plane, dists: tuple, geom: Geometry):
 
 
 def _dist_window_call(kern, x, dist_plane, geom: Geometry, interpret: bool):
+    """Leading batch dims share the dist plane (batch axis innermost so
+    the pipeline reuses the resident dist block across lanes)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     R = geom.block_rows
-    x3 = x.reshape(1, geom.rows, LANE)
+    lead = x.shape[:-1]
+    x3 = x.reshape(-1, geom.rows, LANE)
     d2 = dist_plane.reshape(geom.rows, LANE)
     prev = lambda i, b: (b, jnp.maximum(i - 1, 0), 0)
     own = lambda i, b: (b, i, 0)
@@ -532,7 +535,7 @@ def _dist_window_call(kern, x, dist_plane, geom: Geometry, interpret: bool):
     mown = lambda i, b: (i, 0)
     out = pl.pallas_call(
         kern,
-        grid=(geom.grid, 1),
+        grid=(geom.grid, x3.shape[0]),
         in_specs=[pl.BlockSpec((1, R, LANE), prev),
                   pl.BlockSpec((1, R, LANE), own),
                   pl.BlockSpec((R, LANE), mprev),
@@ -541,7 +544,7 @@ def _dist_window_call(kern, x, dist_plane, geom: Geometry, interpret: bool):
         out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
         interpret=interpret,
     )(x3, x3, d2, d2)
-    return out.reshape(geom.P)
+    return out.reshape(*lead, geom.P)
 
 
 def apply_fused(x, fused: FusedPlan, mask_planes):

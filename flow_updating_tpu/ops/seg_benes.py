@@ -205,6 +205,102 @@ def extract_row_ends(x, plan: SegmentedPlan, extract_masks):
                   extract_masks)[: plan.N]
 
 
+def seg_reduce_multi(xs_ops, plan: SegmentedPlan, dist, extract_masks):
+    """Several per-node reductions sharing one batched extraction.
+
+    ``xs_ops``: sequence of ``(x (E,), op)``.  All 'sum' lanes run as one
+    batched scan pass; 'all' scans as int-min; the scanned lanes then
+    ride ONE batched extraction application (the expensive ~2log2P-stage
+    part), sharing its mask-plane traffic.  Returns the (N,) results in
+    input order.  Falls back to per-call :func:`seg_reduce` when the
+    plan has no fused geometry.
+    """
+    import jax.numpy as jnp
+
+    if plan.geom is None or not plan.scan_bits:
+        return [seg_reduce(x, op, plan, dist, extract_masks)
+                for x, op in xs_ops]
+    from flow_updating_tpu.ops.pallas_fused import segscan_pass
+
+    dt = jnp.result_type(*[x.dtype for x, _ in xs_ops], jnp.float32)
+    dists = tuple(1 << k for k in range(plan.scan_bits))
+
+    lanes = [None] * len(xs_ops)
+    # integer sums stay on the exact per-call path (the shared float
+    # lane dtype would round above 2^24 in f32), like min/max below
+    sums = [(i, x) for i, (x, op) in enumerate(xs_ops)
+            if op == "sum" and jnp.issubdtype(x.dtype, jnp.floating)]
+    if sums:
+        z = jnp.stack([
+            jnp.zeros((plan.P,), dt).at[: plan.E].set(x.astype(dt))
+            for _, x in sums
+        ])
+        z = segscan_pass(z, dist, dists, "sum", plan.geom)
+        for (i, _), zi in zip(sums, z):
+            lanes[i] = zi
+    for i, (x, op) in enumerate(xs_ops):
+        if op == "sum":
+            continue
+        if op == "all":
+            # booleans scan exactly as a float min over {0, 1}
+            z = jnp.ones((plan.P,), dt).at[: plan.E].set(
+                x.astype(jnp.int32).astype(dt))
+            lanes[i] = segscan_pass(z, dist, dists, "min", plan.geom)
+        else:
+            # min/max over arbitrary values could lose precision in the
+            # shared float lane dtype (e.g. int32 keys in f32) — run the
+            # exact per-op path and splice its result in afterwards
+            lanes[i] = None
+    batched = [ln for ln in lanes if ln is not None]
+    if not batched:
+        return [seg_reduce(x, op, plan, dist, extract_masks)
+                for x, op in xs_ops]
+    out = _apply(jnp.stack(batched), plan.extract, plan.extract_fused,
+                 extract_masks)[:, : plan.N]
+    results = []
+    j = 0
+    for i, (x, op) in enumerate(xs_ops):
+        if lanes[i] is None:
+            results.append(seg_reduce(x, op, plan, dist, extract_masks))
+            continue
+        r = out[j]
+        j += 1
+        if op == "all":
+            r = r != 0
+        else:
+            r = r.astype(x.dtype)
+        results.append(r)
+    return results
+
+
+def broadcast_multi(vs, plan: SegmentedPlan, dist, place_masks):
+    """Several node->edge broadcasts through one batched placement +
+    fill-forward.  ``vs``: sequence of (N,) arrays; returns the (E,)
+    results in input order."""
+    import jax.numpy as jnp
+
+    if plan.geom is None:
+        return [broadcast(v, plan, dist, place_masks) for v in vs]
+    from flow_updating_tpu.ops.pallas_fused import fill_pass
+
+    dt = jnp.result_type(*[v.dtype for v in vs], jnp.float32)
+    z = jnp.stack([
+        jnp.zeros((plan.P,), dt).at[: plan.N].set(v.astype(dt)) for v in vs
+    ])
+    z = _apply(z, plan.place, plan.place_fused, place_masks)
+    if plan.fill_bits:
+        dists = tuple(1 << k for k in range(plan.fill_bits))
+        z = fill_pass(z, dist, dists, plan.geom)
+    out = z[:, : plan.E]
+    results = []
+    for v, r in zip(vs, out):
+        if v.dtype == jnp.bool_:
+            results.append(r > 0.5)
+        else:
+            results.append(r.astype(v.dtype))
+    return results
+
+
 def broadcast(v, plan: SegmentedPlan, dist, place_masks):
     """Node array (N,) -> per-out-edge array (E,) (the ``v[src]``
     gather, gather-free)."""
