@@ -114,6 +114,42 @@ def test_rounds_with_segment_benes_match(variant):
     assert np.abs(outs["benes"] - topo.true_mean).max() < 0.2
 
 
+def test_hub_degree_fused_scan_exact():
+    """A hub whose scan run spans many rows (degree 2999 -> 12 scan
+    stages, halo 38 rows) stays exact through the fused dist-plane
+    scan.  At this width the network is a single grid block, where the
+    clamped prev window IS the circular wrap — also covered."""
+    from flow_updating_tpu.ops.seg_benes import plan_segments, seg_reduce
+
+    n = 3000
+    edges = [(0, i) for i in range(1, n)] + [(i, 0) for i in range(1, n)]
+    from flow_updating_tpu.topology.graph import build_topology
+
+    topo = build_topology(n, edges, values=np.arange(n, dtype=float))
+    plan, dist = plan_segments(topo.row_start, topo.out_deg,
+                               topo.edge_rank, fused=True)
+    assert plan.geom is not None and plan.scan_bits == 12
+    em, _ = plan.device_leaves()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=topo.num_edges))
+    got = np.asarray(seg_reduce(x, "sum", plan, jnp.asarray(dist), em))
+    import jax.ops
+
+    want = np.asarray(jax.ops.segment_sum(x, jnp.asarray(topo.src),
+                                          num_segments=n))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_segscan_pass_halo_guard_raises():
+    from flow_updating_tpu.ops.pallas_fused import geometry, segscan_pass
+
+    geom = geometry(128 * 64, block_rows=16)
+    dist = jnp.zeros(128 * 64, jnp.int32)
+    x = jnp.zeros(128 * 64, jnp.float32)
+    too_long = tuple(1 << k for k in range(13))  # halo 4096 rows > 16
+    with pytest.raises(ValueError, match="halo budget"):
+        segscan_pass(x, dist, too_long, "sum", geom)
+
+
 def test_full_benes_stack(variant="pairwise"):
     """Everything at once: segment + delivery networks, FIFO queue,
     faithful dynamics — still converging, still conserving mass."""
