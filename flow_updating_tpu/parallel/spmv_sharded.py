@@ -229,8 +229,12 @@ class ShardedNodeKernel:
             jnp.zeros((S, M // S), self.cfg.jnp_dtype),
             jsh.NamedSharding(self.mesh, P(NODE_AXIS, None)),
         )
-        return NodeSyncState(t=jnp.zeros((), jnp.int32), S=z, G=z,
-                             avg_prev=z, A_prev=z)
+        # t replicates over the mesh: a single-device-committed scalar
+        # next to mesh-committed leaves would make jit refuse the state
+        # (checkpoint restore device_puts every leaf to this template)
+        t = jax.device_put(jnp.zeros((), jnp.int32),
+                           jsh.NamedSharding(self.mesh, P()))
+        return NodeSyncState(t=t, S=z, G=z, avg_prev=z, A_prev=z)
 
     def run(self, state, num_rounds: int):
         return _run_sharded(state, self.arrays, self.cfg, self.mesh,
