@@ -14,7 +14,10 @@ import logging
 import os
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+try:
+    import flow_updating_tpu  # noqa: F401  (pip install -e . preferred)
+except ImportError:  # running from a source checkout without install
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from flow_updating_tpu import Engine, RoundConfig
 from flow_updating_tpu.cli import _select_backend

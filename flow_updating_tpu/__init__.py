@@ -20,13 +20,14 @@ simulator) as an idiomatic JAX/XLA framework:
   halo exchange for cross-shard edges.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from flow_updating_tpu.topology.graph import Topology, build_topology
 from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.state import FlowUpdatingState, init_state
 from flow_updating_tpu.models.rounds import round_step, run_rounds, node_estimates
 from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.actor import TopoView, VectorActor
 
 __all__ = [
     "Topology",
@@ -38,5 +39,7 @@ __all__ = [
     "run_rounds",
     "node_estimates",
     "Engine",
+    "VectorActor",
+    "TopoView",
     "__version__",
 ]

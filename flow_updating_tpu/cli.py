@@ -334,7 +334,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--msg-bytes", type=float, default=104.0,
                      help="simulated message wire size; adds the "
                           "size/bandwidth serialization term to latency-"
-                          "warped delays (reference: ~104 B)")
+                          "warped delays. Default 104 is measured from "
+                          "the reference PDU: FlowUpdatingMsg.size() = "
+                          "5 doubles + ids + overhead (flowupdating-"
+                          "collectall.py:13-19); the protocol's PDU is "
+                          "fixed-size, so a constant is exact")
     run.add_argument("--drop-rate", type=float, default=0.0,
                      help="per-message loss probability (fault injection)")
     run.add_argument("--rounds", type=int, default=None,

@@ -95,12 +95,48 @@ class Engine:
         return self
 
     def register_actor(self, name: str, fn=None) -> "Engine":
-        """Register a deployable function name.  The built-in gossip "actors"
-        are selected via ``RoundConfig.variant``; arbitrary Python callables
-        are not supported (there is no per-actor execution here), so ``fn``
-        is accepted for API compatibility and recorded only."""
+        """Register a deployable actor.
+
+        ``fn=None`` selects the built-in gossip protocols (variant via
+        ``RoundConfig.variant``) — the reference's
+        ``register_actor("peer", Peer)`` maps to this plus config.
+
+        ``fn`` may also be a :class:`~flow_updating_tpu.models.actor.
+        VectorActor`: the vetted extension point for custom protocols,
+        written as pure population-wide array functions and scanned
+        under ``jit`` like the built-in kernels (see ``models/actor.py``
+        for the contract and the per-actor-class rationale).
+
+        Anything else raises: per-actor Python bytecode (the reference's
+        ``Peer`` class, ``flowupdating-collectall.py:156``) cannot
+        execute on a TPU, and silently recording it would make users
+        think their callable runs."""
+        from flow_updating_tpu.models.actor import VectorActor
+
+        if fn is not None and not isinstance(fn, VectorActor):
+            raise TypeError(
+                f"register_actor({name!r}): got {type(fn).__name__}; "
+                "per-actor Python callables cannot execute on TPU.  Pass "
+                "None to select the built-in protocols "
+                "(RoundConfig.variant), or express the protocol as a "
+                "flow_updating_tpu.models.actor.VectorActor — pure "
+                "(N,)/(E,) array functions scanned under jit"
+            )
         self._registered[name] = fn
         return self
+
+    @property
+    def _custom_actor(self):
+        for fn in self._registered.values():
+            if fn is not None:
+                return fn
+        return None
+
+    @property
+    def _node_like(self) -> bool:
+        """Dispatch through the node-kernel interface (built-in
+        node-collapsed kernel, or an ActorKernel driving a VectorActor)."""
+        return self.config.kernel == "node" or self._custom_actor is not None
 
     def load_deployment(self, path: str, function: str | None = None) -> "Engine":
         if function is None and len(self._registered) == 1:
@@ -125,6 +161,21 @@ class Engine:
 
     def _prepare_arrays(self, latency_scale: float = 0.0) -> None:
         """Device arrays for the configured kernel (no fresh state)."""
+        if self._custom_actor is not None:
+            from flow_updating_tpu.models.actor import ActorKernel
+
+            if latency_scale > 0.0 or self.topology.max_delay > 1:
+                raise ValueError(
+                    "VectorActor rounds are unit-delay synchronous; "
+                    "latency-warped delivery applies to the built-in "
+                    "edge kernel only")
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "VectorActor is single-device; shard the protocol "
+                    "explicitly with parallel.sharded for multi-chip")
+            self._node_kernel = ActorKernel(self.topology, self._custom_actor)
+            self._topo_arrays = None
+            return
         if self.config.kernel == "node":
             if latency_scale > 0.0 or self.topology.max_delay > 1:
                 raise ValueError(
@@ -212,7 +263,7 @@ class Engine:
         """Resolve deployment(+platform) into topology + fresh state."""
         self._resolve_topology(latency_scale)
         self._prepare_arrays(latency_scale)
-        if self.config.kernel == "node":
+        if self._node_like:
             self.state = self._node_kernel.init_state()
         elif self.mesh is not None:
             from flow_updating_tpu.parallel import auto
@@ -268,7 +319,7 @@ class Engine:
         names = self.topology.names or tuple(
             str(i) for i in range(self.topology.num_nodes)
         )
-        if self.config.kernel == "node":
+        if self._node_like:
             value = self.topology.values
             last_avg = self._node_kernel.last_avg(self.state)
         else:
@@ -283,7 +334,7 @@ class Engine:
     def estimates(self) -> np.ndarray:
         if self.state is None:
             raise RuntimeError("engine not built")
-        if self.config.kernel == "node":
+        if self._node_like:
             return self._node_kernel.estimates(self.state)
         est = np.asarray(node_estimates(self.state, self._topo_arrays))
         return est[: self._n_real] if self._n_real is not None else est
@@ -399,6 +450,11 @@ class Engine:
 
         if self.state is None:
             raise RuntimeError("engine not built — nothing to checkpoint")
+        if self._custom_actor is not None:
+            raise NotImplementedError(
+                "checkpointing a VectorActor run is not supported (the "
+                "state pytree layout is user-defined); snapshot the "
+                "carry with numpy/orbax directly")
         save_checkpoint(
             path, self.state, self.config, topo=self.topology,
             extra={"clock": self._clock, "killed": self._killed},
@@ -411,6 +467,9 @@ class Engine:
         allocate fresh state (``build()`` is not required first)."""
         from flow_updating_tpu.utils.checkpoint import load_checkpoint
 
+        if self._custom_actor is not None:
+            raise NotImplementedError(
+                "restoring into a VectorActor run is not supported")
         self._resolve_topology()
         state, cfg, extra = load_checkpoint(path, topo=self.topology)
         self.config = cfg
@@ -473,7 +532,7 @@ class Engine:
     # ---- execution -------------------------------------------------------
     def _advance(self, n: int) -> None:
         """Dispatch ``n`` compiled rounds to the configured kernel."""
-        if self.config.kernel == "node":
+        if self._node_like:
             self.state = self._node_kernel.run(self.state, n)
         else:
             self.state = run_rounds(
@@ -500,7 +559,7 @@ class Engine:
         if emit is None:
             emit = _log_stream_sample  # stable identity -> jit cache reuse
         if not self._killed and n > 0:
-            if self.config.kernel == "node":
+            if self._node_like:
                 self.state = self._node_kernel.run_streamed(
                     self.state, n, observe_every, emit
                 )

@@ -89,7 +89,7 @@ def get_lib():
         i64, i64, i32p, i32p, i32p, i32p, i64p, f64p,
         ctypes.c_int32, i64, i64, f64p, f64p,
         i64, ctypes.c_double, f64p,
-        i64, i32p, i64, f64p, u8p, f64p, i64,
+        i64, i32p, i64, f64p, u8p, f64p, i64, i64,
     ]
     _lib = lib
     return _lib
@@ -254,12 +254,17 @@ def des_run_traj(topo, variant: str = "collectall", timeout: int = 50,
 
 def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
                     ticks: int = 1000, obs_every: int = 10,
-                    clamp_d: int = 0):
+                    clamp_d: int = 0, visit_seed: int = -1):
     """DES with the shared-link contention model (same model as the
     vectorized kernel's ``models.rounds.edge_delays`` — per-tick
     bottleneck fair share over SHARED links, FATPIPE exempt; see
     funative.cpp ``LinkModel``).  ``clamp_d`` mirrors the ring-buffer
     clamp of a ``delay_depth``-bounded run (0 = unclamped).
+
+    ``visit_seed >= 0`` re-shuffles the within-tick node visit order
+    every tick (mt19937 stream) — used to measure how much trajectory
+    spread is pure event-ordering noise; ``-1`` keeps the fixed
+    deterministic order.
 
     Returns (rmse trajectory, estimates, last_avg, events)."""
     lib = get_lib()
@@ -293,6 +298,6 @@ def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
         obs_every, float(topo.true_mean), _ptr(rmse, ctypes.c_double),
         K, _ptr(elinks, ctypes.c_int32), len(ser),
         _ptr(ser, ctypes.c_double), _ptr(shared, ctypes.c_uint8),
-        _ptr(latr, ctypes.c_double), clamp_d,
+        _ptr(latr, ctypes.c_double), clamp_d, int(visit_seed),
     )
     return rmse[: ticks // obs_every], est, last_avg, int(events)

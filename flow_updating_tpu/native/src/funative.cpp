@@ -337,7 +337,8 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
                         const double* values, int32_t variant, int64_t timeout,
                         int64_t ticks, double* est_out, double* last_avg_out,
                         int64_t obs_every, double mean, double* rmse_out,
-                        const LinkModel& lm = LinkModel()) {
+                        const LinkModel& lm = LinkModel(),
+                        int64_t visit_seed = -1) {
   // Per-edge ledgers, exactly the per-neighbor dicts of a reference Peer.
   std::vector<double> flow((size_t)E, 0.0), est((size_t)E, 0.0);
   std::vector<uint8_t> recv((size_t)E, 0);          // collect-all
@@ -434,8 +435,20 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
     send(t, e);
   };
 
+  // Within-tick node visit order.  The reference's SimGrid scheduler
+  // wakes actors in an order the protocol does not control; visit_seed
+  // >= 0 re-shuffles the order every tick so callers can MEASURE how
+  // much of any oracle-vs-kernel trajectory gap is ordering noise
+  // (tests/test_contention.py).  visit_seed < 0 keeps the fixed 0..n-1
+  // order (bit-stable baseline).
+  std::vector<int64_t> visit((size_t)n);
+  for (int64_t v = 0; v < n; ++v) visit[(size_t)v] = v;
+  std::mt19937_64 vrng(visit_seed >= 0 ? (uint64_t)visit_seed : 0);
+
   for (int64_t t = 0; t < ticks; ++t) {
-    for (int64_t v = 0; v < n; ++v) {
+    if (visit_seed >= 0) std::shuffle(visit.begin(), visit.end(), vrng);
+    for (int64_t vi = 0; vi < n; ++vi) {
+      int64_t v = visit[(size_t)vi];
       // drain at most one deliverable message
       if (!mailbox[v].empty() && mailbox[v].top().arrival <= t) {
         Msg m = mailbox[v].top();
@@ -519,7 +532,7 @@ int64_t fu_des_run_contend(
     double* est_out, double* last_avg_out, int64_t obs_every, double mean,
     double* rmse_out, int64_t K, const int32_t* edge_links, int64_t L,
     const double* link_ser_rounds, const uint8_t* link_shared,
-    const double* lat_rounds, int64_t clamp_d) {
+    const double* lat_rounds, int64_t clamp_d, int64_t visit_seed) {
   LinkModel lm;
   lm.K = K;
   lm.edge_links = edge_links;
@@ -530,7 +543,7 @@ int64_t fu_des_run_contend(
   lm.clamp_d = clamp_d;
   return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
                   timeout, ticks, est_out, last_avg_out, obs_every, mean,
-                  rmse_out, lm);
+                  rmse_out, lm, visit_seed);
 }
 
 }  // extern "C"

@@ -201,6 +201,20 @@ def pack_masks(plan: StagePlan, fused: FusedPlan):
     for ps in fused.passes:
         n_stages = len(ps.dists)
         stage_masks = plan.masks[s: s + n_stages]
+        if ps.kind in ("window", "wide_roll", "wide_roll2"):
+            # The kernels clamp/duplicate block 0 where apply_stages'
+            # jnp.roll wraps circularly, so a roll-stage mask that selects
+            # a wrapped-around source (p < d reading from p - d + P) would
+            # silently corrupt data.  All in-repo plan producers satisfy
+            # this no-wrap invariant; verify it so a violating plan fails
+            # loudly here instead.
+            for j, (d, m) in enumerate(zip(ps.dists, stage_masks)):
+                if m[:d].any():
+                    raise ValueError(
+                        f"roll stage {s + j} (distance {d}) selects a "
+                        f"wrapped-around source: mask is set below index "
+                        f"{d}; fused kernels do not implement circular "
+                        f"wrap (use the XLA apply_stages path)")
         s += n_stages
         if ps.kind in ("local", "window"):
             plane = np.zeros(fused.P, np.uint32)

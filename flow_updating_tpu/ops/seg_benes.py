@@ -226,10 +226,13 @@ def seg_reduce_multi(xs_ops, plan: SegmentedPlan, dist, extract_masks):
     dists = tuple(1 << k for k in range(plan.scan_bits))
 
     lanes = [None] * len(xs_ops)
-    # integer sums stay on the exact per-call path (the shared float
-    # lane dtype would round above 2^24 in f32), like min/max below
+    # only lanes already in the common dtype batch together: integer
+    # sums would round above 2^24 in f32, and a f32 lane scanned in a
+    # wider common dtype (mixed f32/f64 inputs) then cast back would
+    # break bit-equality with the per-call path — both ride the exact
+    # per-call path below, like min/max
     sums = [(i, x) for i, (x, op) in enumerate(xs_ops)
-            if op == "sum" and jnp.issubdtype(x.dtype, jnp.floating)]
+            if op == "sum" and x.dtype == dt]
     if sums:
         z = jnp.stack([
             jnp.zeros((plan.P,), dt).at[: plan.E].set(x.astype(dt))

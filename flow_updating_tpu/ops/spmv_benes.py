@@ -104,6 +104,7 @@ def _mats_key(mats: tuple, m1: int):
 
     h = hashlib.sha1()
     for m in mats:
+        h.update(m.dtype.str.encode())
         h.update(np.ascontiguousarray(m))
     return (m1, tuple(m.shape for m in mats), h.hexdigest())
 

@@ -28,8 +28,10 @@ The round math itself is shared with the single-device kernel
 (:func:`flow_updating_tpu.models.rounds.deliver_phase` /
 :func:`~flow_updating_tpu.models.rounds.fire_core` run unchanged on local
 shard views); only message *delivery* differs.  The fast synchronous
-pairwise mode is the one exception (its direct two-sided exchange reads the
-remote endpoint's estimate, see ``rounds.py``) — use the GSPMD path for it.
+pairwise mode has its own round body (:func:`_local_round_fastpair`): its
+direct two-sided exchange needs the remote endpoint's *current estimate*,
+so for cut edges that value (not a message payload) rides the same halo
+machinery; build the plan with ``plan_sharding(..., coloring=True)``.
 """
 
 from __future__ import annotations
@@ -65,6 +67,9 @@ class PlanArrays:
     tshard: jnp.ndarray      # (S, Eb) i32 — shard owning rev(edge)
     tlocal: jnp.ndarray      # (S, Eb) i32 — rev(edge)'s slot there (Eb = none)
     halo_idx: jnp.ndarray    # (S, H) i32 — slots of cut edges (Eb = padding)
+    edge_color: jnp.ndarray | None = None  # (S, Eb) i32, -1 on padding
+    #                          (present iff the plan was built with
+    #                           coloring=True — fast synchronous pairwise)
 
 
 @flax.struct.dataclass
@@ -110,6 +115,7 @@ class ShardPlan:
     alive0: np.ndarray  # (S, Nb) bool initial liveness (False on padding)
     perm_offsets: tuple = ()         # nonzero shard offsets with cut edges
     perm_tables: PermTables | None = None  # per-offset ppermute routing
+    num_colors: int = 0              # >0 iff built with coloring=True
     order: np.ndarray | None = None  # partition node order (new -> original
     #                                  id); None = identity (contiguous ids)
 
@@ -148,7 +154,8 @@ class ShardPlan:
 
 
 def plan_sharding(topo: Topology, num_shards: int,
-                  partition: str = "contiguous") -> ShardPlan:
+                  partition: str = "contiguous",
+                  coloring: bool = False) -> ShardPlan:
     """Partition nodes into contiguous blocks and edges with their source.
 
     ``partition='bfs'`` renumbers nodes by BFS order first
@@ -160,6 +167,11 @@ def plan_sharding(topo: Topology, num_shards: int,
     Local node ``Nb-1`` of every shard is a dummy (dead, value 0) that owns
     the padded edge slots, so padding can never fire or send.
     """
+    if coloring:
+        # compute (and cache) on the ORIGINAL topology BEFORE any reorder;
+        # reorder_topology carries the cache through, so the sharded run
+        # fires the exact matching sequence of the single-device kernel
+        topo.edge_coloring()
     order = None
     if partition == "bfs":
         from flow_updating_tpu.topology.graph import (
@@ -200,6 +212,13 @@ def plan_sharding(topo: Topology, num_shards: int,
     delay[owner_shard, owner_pos] = topo.delay
     tshard[owner_shard, owner_pos] = rev_shard
     tlocal[owner_shard, owner_pos] = rev_pos
+
+    edge_color = None
+    num_colors = 0
+    if coloring:
+        col, num_colors = topo.edge_coloring()
+        edge_color = np.full((S, Eb), -1, np.int32)
+        edge_color[owner_shard, owner_pos] = col
 
     # local CSR (padded slots all belong to the dummy row at the end)
     out_deg = np.zeros((S, Nb), np.int32)
@@ -282,11 +301,13 @@ def plan_sharding(topo: Topology, num_shards: int,
         tshard=tshard,
         tlocal=tlocal,
         halo_idx=halo_idx,
+        edge_color=edge_color,
     )
     return ShardPlan(
         topo=topo, num_shards=S, cap=cap, Nb=Nb, Eb=Eb, H=H, arrays=arrays,
         halo=halo, values=values, alive0=alive0,
         perm_offsets=tuple(offsets), perm_tables=perm_tables, order=order,
+        num_colors=num_colors,
     )
 
 
@@ -305,10 +326,10 @@ def init_plan_state(
 ) -> FlowUpdatingState:
     """Fresh sharded state: every leaf carries a leading (S,) shard axis and
     is placed with its block on its device."""
-    if cfg.needs_coloring:
-        raise NotImplementedError(
-            "fast synchronous pairwise reads the remote endpoint's estimate; "
-            "use the GSPMD path (flow_updating_tpu.parallel.auto) for it"
+    if cfg.needs_coloring and plan.num_colors == 0:
+        raise ValueError(
+            "fast synchronous pairwise needs the edge coloring in the "
+            "plan: build it with plan_sharding(..., coloring=True)"
         )
     S, Nb, Eb, D = plan.num_shards, plan.Nb, plan.Eb, cfg.delay_depth
     dt = cfg.jnp_dtype
@@ -429,13 +450,96 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
     )
 
 
+def _local_round_fastpair(st: FlowUpdatingState, pl: PlanArrays,
+                          halo: HaloTables, perm: PermTables,
+                          cfg: RoundConfig, Eb: int, S: int, offsets: tuple,
+                          halo_mode: str, num_colors: int):
+    """One fast-synchronous-pairwise round on one shard's block.
+
+    Mirrors the single-device matching-gossip branch
+    (``models/rounds.py:304-345``): round ``t`` fires color class
+    ``t % C``; matched endpoints average *directly* (no messages, no ring
+    buffer).  ``x_u`` and the sender-side validity bit of every CUT edge
+    ride the existing halo machinery — the only cross-device traffic — so
+    each edge sees its remote endpoint's current estimate; intra-shard
+    partners are read through the local reverse slot.  Both shards of a
+    cut pair compute the identical average from the identical (x_u, x_v),
+    so the flow deltas are exactly antisymmetric, as on one device.
+    """
+    me = jax.lax.axis_index(NODE_AXIS)
+    dt = st.flow.dtype
+    t = st.t
+    Nb = st.value.shape[0]
+    half = jnp.asarray(0.5, dt)
+
+    est_n = st.value - jax.ops.segment_sum(
+        st.flow, pl.src_local, num_segments=Nb)
+    x_u = est_n[pl.src_local]                       # (Eb,)
+    valid_u = st.alive[pl.src_local] & st.edge_ok   # sender-side half of
+    #                                                 the matched predicate
+
+    # partner state: local reverse slot, then overwrite cut slots from halo
+    is_local = (pl.tshard == me) & (pl.tlocal < Eb)
+    lr = jnp.minimum(pl.tlocal, Eb - 1)
+    x_v = jnp.where(is_local, x_u[lr], jnp.asarray(0, dt))
+    valid_v = is_local & valid_u[lr]
+
+    if halo_mode == "ppermute":
+        for di in range(len(offsets)):
+            sidx = perm.send_idx[di]
+            in_r = sidx < Eb
+            slc = jnp.minimum(sidx, Eb - 1)
+            payload = jnp.stack([
+                x_u[slc], (valid_u[slc] & in_r).astype(dt)])
+            pairs = [(s, (s + offsets[di]) % S) for s in range(S)]
+            got = jax.lax.ppermute(payload, NODE_AXIS, pairs)
+            rt = perm.recv_tlocal[di]
+            tgt = jnp.where(got[1] > 0.5, jnp.minimum(rt, Eb), Eb)
+            arrived = jnp.zeros((Eb + 1,), bool).at[tgt].set(
+                True, mode="drop")[:Eb]
+            xin = jnp.zeros((Eb + 1,), dt).at[tgt].set(
+                got[0], mode="drop")[:Eb]
+            x_v = jnp.where(arrived, xin, x_v)
+            valid_v = valid_v | arrived
+    else:
+        hidx = jnp.minimum(pl.halo_idx, Eb - 1)
+        in_range = pl.halo_idx < Eb
+        g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(-1)
+        a_x = g(x_u[hidx])
+        a_ok = g(valid_u[hidx] & in_range)
+        mine = a_ok & (halo.tshard == me)
+        tgt = jnp.where(mine, halo.tlocal, Eb)
+        arrived = jnp.zeros((Eb + 1,), bool).at[tgt].set(
+            True, mode="drop")[:Eb]
+        xin = jnp.zeros((Eb + 1,), dt).at[tgt].set(a_x, mode="drop")[:Eb]
+        x_v = jnp.where(arrived, xin, x_v)
+        valid_v = valid_v | arrived
+
+    matched = ((pl.edge_color == t % num_colors)
+               & valid_u & valid_v)
+    avg_e = (x_u + x_v) * half
+    flow = jnp.where(matched, st.flow + (x_u - x_v) * half, st.flow)
+    est_e = jnp.where(matched, avg_e, st.est)
+    stamp = jnp.where(matched, t, st.stamp)
+    fire_any = jax.ops.segment_max(
+        matched.astype(jnp.int32), pl.src_local, num_segments=Nb) > 0
+    node_avg = jax.ops.segment_sum(
+        jnp.where(matched, avg_e, jnp.asarray(0, dt)), pl.src_local,
+        num_segments=Nb)
+    last_avg = jnp.where(fire_any, node_avg, st.last_avg)
+    return st.replace(
+        t=t + 1, flow=flow, est=est_e, stamp=stamp, last_avg=last_avg,
+        fired=st.fired + fire_any.astype(jnp.int32),
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "num_rounds", "Eb", "offsets",
-                     "halo_mode"),
+                     "halo_mode", "num_colors"),
 )
 def _run_sharded(state, arrays, halo, perm, cfg, mesh, num_rounds, Eb,
-                 offsets, halo_mode):
+                 offsets, halo_mode, num_colors=0):
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
@@ -448,6 +552,11 @@ def _run_sharded(state, arrays, halo, perm, cfg, mesh, num_rounds, Eb,
         pm = jax.tree.map(lambda x: x[0], pm_s)
 
         def step(s, _):
+            if cfg.needs_coloring:
+                return _local_round_fastpair(
+                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
+                    num_colors,
+                ), None
             return _local_round(
                 s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
             ), None
@@ -480,10 +589,10 @@ def run_rounds_sharded(
     O(cut) traffic — the default and the multi-pod path) or ``'allgather'``
     (broadcast; one collective, competitive at small S).
     """
-    if cfg.needs_coloring:
-        raise NotImplementedError(
-            "fast synchronous pairwise reads the remote endpoint's estimate; "
-            "use the GSPMD path (flow_updating_tpu.parallel.auto) for it"
+    if cfg.needs_coloring and plan.num_colors == 0:
+        raise ValueError(
+            "fast synchronous pairwise needs the edge coloring in the "
+            "plan: build it with plan_sharding(..., coloring=True)"
         )
     if halo not in ("ppermute", "allgather"):
         raise ValueError(f"unknown halo mode {halo!r}")
@@ -497,7 +606,7 @@ def run_rounds_sharded(
     plan_arrays, halo_tables, perm = arrays
     return _run_sharded(
         state, plan_arrays, halo_tables, perm, cfg, mesh, num_rounds,
-        plan.Eb, plan.perm_offsets, halo,
+        plan.Eb, plan.perm_offsets, halo, plan.num_colors,
     )
 
 

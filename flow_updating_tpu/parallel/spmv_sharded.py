@@ -101,7 +101,9 @@ def plan_sharded_spmv(mats: tuple, m1: int, num_shards: int):
         mats_s = tuple(np.ascontiguousarray(m[s::S]) for m in mats)
         sections.append(plan_sections(mats_s, m1, min_width=MIN_P))
     widths = {sec[3] for sec in sections}
-    assert len(widths) == 1, f"shards disagree on network width: {widths}"
+    if len(widths) != 1:
+        # runtime-input-dependent invariant: must survive `python -O`
+        raise ValueError(f"shards disagree on network width: {widths}")
     Pw = widths.pop()
 
     # canonical full-width dist lists (descending for spread, ascending
@@ -119,7 +121,10 @@ def plan_sharded_spmv(mats: tuple, m1: int, num_shards: int):
         ))
     skeleton = (stage_plans[0].dists, stage_plans[0].kinds)
     for sp in stage_plans[1:]:
-        assert (sp.dists, sp.kinds) == skeleton, "shard skeletons diverged"
+        if (sp.dists, sp.kinds) != skeleton:
+            raise ValueError(
+                "shard stage skeletons diverged; per-shard routing would "
+                "be silently wrong")
 
     fused = plan_fused(stage_plans[0])
     # pack on the HOST (numpy) and stack there: materializing per-shard

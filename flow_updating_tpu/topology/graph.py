@@ -499,7 +499,7 @@ def reorder_topology(topo: Topology, order: np.ndarray) -> Topology:
     np.cumsum(out_deg, out=row_start[1:])
     edge_rank = (np.arange(E, dtype=np.int64) - row_start[src]).astype(np.int32)
     pick_e = lambda a: None if a is None else a[e_order]
-    return dataclasses.replace(
+    out = dataclasses.replace(
         topo,
         src=src,
         dst=dst,
@@ -518,6 +518,14 @@ def reorder_topology(topo: Topology, order: np.ndarray) -> Topology:
         edge_links=pick_e(topo.edge_links),
         lat_rounds=pick_e(topo.lat_rounds),
     )
+    # a coloring is a property of the (undirected) edges, invariant under
+    # renumbering — carry the cache through so a reordered partition runs
+    # the SAME matching sequence as the original topology (exact parity)
+    cached = getattr(topo, "_edge_coloring", None)
+    if cached is not None:
+        col, c = cached
+        object.__setattr__(out, "_edge_coloring", (col[e_order], c))
+    return out
 
 
 def build_topology(

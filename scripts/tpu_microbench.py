@@ -112,8 +112,8 @@ def spmv(k: int) -> dict:
             key: val for key, val in measure_tpu(
                 topo, 32, kernel="node", spmv=spmv_name
             ).items()
-            if key in ("rounds_per_sec", "per_round_s", "compile_s",
-                       "rounds", "rmse_after")
+            if key in ("rounds_per_sec", "per_round_s", "plan_s",
+                       "compile_s", "rounds", "rmse_after")
         }
     return out
 

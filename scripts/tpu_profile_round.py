@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Attribute the node-kernel round's per-round cost (VERDICT r4 item 2).
+
+Decomposes ms/round at a given scale into:
+  * the SpMV alone (the permutation network / gather — the suspected
+    dominant term; BENCH_NOTES "TPU per-round cost accounting"),
+  * the elementwise recurrence alone (avg/S/G updates with the SpMV
+    replaced by identity — the HBM-stream floor),
+  * the full round (their fusion; gaps vs sum = launch/scheduling),
+all via the R-vs-2R chained-scan difference under the tunnel launch cap,
+and optionally records a ``jax.profiler`` trace of one chunk
+(``--trace DIR``) for op-level drill-down.
+
+Writes one JSON line per (spmv, part) to stdout; bank the output into
+PROFILE_TPU_r4.json when run live.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MAX_LAUNCH_S = 20.0
+
+
+def _time_chain(step, state, r0: int):
+    """seconds/iteration of ``step`` via scan-chain R-vs-2R difference."""
+    import jax
+    import numpy as np
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def chain(s, n):
+        return jax.lax.scan(lambda c, _: (step(c), None), s, None,
+                            length=n)[0]
+
+    def run(n):
+        out = chain(state, n)
+        np.asarray(jax.tree.leaves(out)[0].ravel()[:2])  # force completion
+
+    r = r0
+    while True:
+        run(r), run(2 * r)
+        t0 = time.perf_counter(); run(r); t1 = time.perf_counter()
+        run(2 * r); t2 = time.perf_counter()
+        if (t2 - t1) - (t1 - t0) > 0.05 or (t2 - t1) * 8 > MAX_LAUNCH_S:
+            break
+        r *= 8
+    return max(((t2 - t1) - (t1 - t0)) / r, 1e-9), r
+
+
+def profile(k: int, spmv: str, trace_dir: str | None) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from flow_updating_tpu.models import sync
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.topology.generators import fat_tree
+
+    topo = fat_tree(k, seed=0)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv=spmv)
+    kern = sync.NodeKernel(topo, cfg)
+    st = kern.init_state()
+    arrs = kern.arrays
+    rows = []
+
+    def emit(part, step, carrier, r0=32):
+        per_s, r = _time_chain(step, carrier, r0)
+        row = {"k": k, "nodes": topo.num_nodes, "spmv": spmv, "part": part,
+               "ms_per_iter": round(per_s * 1e3, 4), "iters_timed": r,
+               "platform": jax.devices()[0].platform}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # 1. full round
+    emit("full_round", lambda s: sync.node_round_step(s, arrs, cfg), st)
+
+    # 2. SpMV alone (same input shape/dtype as the round feeds it)
+    x0 = st.avg_prev + jnp.asarray(0, st.avg_prev.dtype)
+    if spmv in ("benes", "benes_fused"):
+        from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
+
+        emit("spmv_only",
+             lambda x: neighbor_sum_benes(x, arrs.ns_plan, arrs.ns_masks),
+             x0)
+    else:
+        emit("spmv_only", lambda x: sync.neighbor_sum(x, arrs.mats), x0)
+
+    # 3. elementwise recurrence with the SpMV cut out (A := avg): the
+    #    pure O(N)-stream floor of the round
+    def elementwise_only(s):
+        avg = (arrs.value - s.S + s.A_prev) * arrs.inv_depp1
+        A_cur = avg
+        return s.replace(t=s.t + 1, S=-s.G - A_cur + arrs.deg * s.avg_prev,
+                         G=-s.S - arrs.deg * avg + s.A_prev,
+                         avg_prev=avg, A_prev=A_cur)
+
+    emit("elementwise_only", elementwise_only, st, r0=256)
+
+    if trace_dir:
+        import numpy as np
+
+        with jax.profiler.trace(trace_dir):
+            out = kern.run(st, 16)
+            np.asarray(out.S[:2])
+        print(json.dumps({"trace": trace_dir, "spmv": spmv, "rounds": 16}),
+              flush=True)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=160)
+    ap.add_argument("--spmv", default="benes_fused,benes,xla",
+                    help="comma list; order = measurement order")
+    ap.add_argument("--trace", default=None,
+                    help="profiler trace output dir (one chunk per spmv)")
+    args = ap.parse_args()
+    for s in args.spmv.split(","):
+        td = os.path.join(args.trace, s) if args.trace else None
+        profile(args.k, s.strip(), td)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,19 +190,52 @@ def test_contention_matches_des_oracle(variant):
     )
     vec_off = _vec_curve(topo, cfg_off, ticks, obs)
     assert not np.array_equal(vec, vec_off)
-    des, _, _, events = native.des_run_contend(
-        topo, variant, timeout=50, ticks=ticks, obs_every=obs, clamp_d=D
-    )
-    assert events > 0
+    # Decomposed bounds (VERDICT r3 item 7).  Running the DES with a
+    # per-tick SHUFFLED node visit order (visit_seed >= 0) measures how
+    # much trajectory spread is pure event-ordering noise:
+    #
+    # * pairwise IS ordering noise: the 8-seed shuffled band spans
+    #   0.79-1.13x of the fixed-order run, and the vectorized kernel
+    #   lands INSIDE the band at both thresholds (measured: vec 560/680
+    #   vs bands [440,560]/[550,690]).  Asserted: within the measured
+    #   shuffled band, +- one observation sample.
+    # * collect-all is NOT ordering noise: all 8 shuffled orders give
+    #   bit-identical rounds-to-threshold (all-heard/timeout firing is
+    #   visit-order-invariant), yet vec runs 1.31-1.40x slower — only
+    #   under contention (the same platform uncontended matches within
+    #   1.5%).  Consistent with the bulk-synchronous kernel firing
+    #   timeouts in lockstep, which maximizes concurrent link load every
+    #   round, where the DES's staggered firing spreads it.  Asserted:
+    #   vec never faster than the DES and within 1.45x.
+    seeds = [-1] + list(range(8))
+    curves = {
+        s: native.des_run_contend(
+            topo, variant, timeout=50, ticks=ticks, obs_every=obs,
+            clamp_d=D, visit_seed=s,
+        )[0]
+        for s in seeds
+    }
+    if variant == "collectall":
+        base = _rounds_to(curves[-1], obs, 1e-3)
+        for s in seeds[1:]:
+            assert _rounds_to(curves[s], obs, 1e-3) == base, (
+                "collect-all became visit-order-sensitive — re-derive "
+                "the decomposition bounds"
+            )
     for th in (1e-2, 1e-3):
         r_vec = _rounds_to(vec, obs, th)
-        r_des = _rounds_to(des, obs, th)
-        assert r_vec is not None and r_des is not None
-        ratio = r_vec / r_des
-        # Wider band than the unit-delay dynamics-parity bound (1.5x):
-        # latency-warped delays amplify within-tick event-ordering
-        # differences between the bulk-synchronous kernel and the
-        # sequential DES (measured 0.6-1.1x across variants; PARITY.md).
-        assert 1 / 2.0 <= ratio <= 2.0, (
-            f"{variant} th={th}: vec {r_vec} vs DES {r_des} ({ratio:.2f})"
-        )
+        band = [_rounds_to(curves[s], obs, th) for s in seeds]
+        assert r_vec is not None and all(b is not None for b in band)
+        lo, hi = min(band), max(band)
+        if variant == "pairwise":
+            assert lo - obs <= r_vec <= hi + obs, (
+                f"pairwise th={th}: vec {r_vec} outside the DES ordering-"
+                f"noise band [{lo}, {hi}] — a real model gap, not noise"
+            )
+        else:
+            ratio = r_vec / band[0]
+            assert 1.0 <= ratio <= 1.45, (
+                f"collectall th={th}: vec {r_vec} vs DES {band[0]} "
+                f"({ratio:.2f}) — outside the documented synchronized-"
+                f"firing band"
+            )

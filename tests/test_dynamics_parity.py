@@ -60,8 +60,18 @@ TOPOLOGIES = {
 @pytest.mark.parametrize("topo_name", list(TOPOLOGIES))
 @pytest.mark.parametrize("variant", ["collectall", "pairwise"])
 def test_faithful_trajectory_matches_des(topo_name, variant):
-    """rounds-to-RMSE within 1.5x of the DES (both directions) at every
-    threshold, with the faithful-mode default pending_depth=2."""
+    """rounds-to-RMSE close to the DES at every threshold, faithful-mode
+    default pending_depth=2.
+
+    Asserted band [0.75, 1.2] sits just outside the measured calibration
+    (VERDICT r3 item 7 asked the 1.5x slack be tightened to it): across
+    all 12 (topology, variant, threshold) cells the measured ratios are
+    1.000 on the ring and grid-collectall (sample-exact), 1.045-1.062 on
+    the message-reordering cells, and one fast outlier 0.793 (er100
+    collect-all at 1e-4: the vectorized kernel converges *faster* — the
+    oldest-first drain beats the DES's arrival order there).  A
+    regression past either edge now fails instead of hiding in the old
+    +-1.5x band."""
     topo = TOPOLOGIES[topo_name]()
     des, *_ = native.des_run_traj(
         topo, variant, timeout=50, ticks=TICKS, obs_every=OBS
@@ -75,7 +85,7 @@ def test_faithful_trajectory_matches_des(topo_name, variant):
         assert r_des is not None, f"DES never reached {th}"
         assert r_vec is not None, f"vectorized never reached {th}"
         ratio = r_vec / r_des
-        assert 1 / 1.5 <= ratio <= 1.5, (
+        assert 0.75 <= ratio <= 1.2, (
             f"{topo_name}/{variant} th={th}: DES {r_des} vs vec {r_vec} "
             f"rounds (ratio {ratio:.3f})"
         )

@@ -126,13 +126,32 @@ def test_bfs_partition_matches_and_cuts_less():
     assert rep["cut_edges"] > 0 and rep["num_offsets"] >= 1
 
 
-def test_sharded_rejects_fast_pairwise():
+def test_sharded_fast_pairwise_needs_colored_plan():
     topo = erdos_renyi(64, avg_degree=4.0, seed=0)
     cfg = RoundConfig.fast(variant="pairwise")
     mesh = make_mesh(8)
-    plan = sharded.plan_sharding(topo, 8)
-    with pytest.raises(NotImplementedError):
+    plan = sharded.plan_sharding(topo, 8)  # no coloring
+    with pytest.raises(ValueError, match="coloring=True"):
         sharded.init_plan_state(plan, cfg, mesh)
+
+
+@pytest.mark.parametrize("partition", ["contiguous", "bfs"])
+@pytest.mark.parametrize("halo", ["ppermute", "allgather"])
+def test_sharded_fast_pairwise_matches_single_device(partition, halo):
+    """VERDICT r3 item 9: the halo kernel's direct two-sided exchange.
+    Exact trajectory parity (same matching sequence — the coloring is
+    computed once and carried through any partition reorder)."""
+    cfg = RoundConfig.fast(variant="pairwise", dtype="float64")
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    ref = _single_device_estimates(topo, cfg, 40)
+    mesh = make_mesh(8)
+    plan = sharded.plan_sharding(topo, 8, partition=partition, coloring=True)
+    state = sharded.init_plan_state(plan, cfg, mesh)
+    out = sharded.run_rounds_sharded(state, plan, cfg, mesh, 40, halo=halo)
+    est = sharded.gather_estimates(out, plan)
+    np.testing.assert_allclose(est, ref, atol=1e-9)
+    # mass conservation through the cross-shard exchange
+    assert np.sum(est) == pytest.approx(np.sum(topo.values), rel=1e-12)
 
 
 def test_plan_cut_fraction_and_padding():
