@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""One-contact TPU measurement session for round 4.
+
+The axon tunnel wedges for hours after any killed TPU process, so a
+successful probe must be exploited immediately and in strict priority
+order, banking each result to a repo JSON artifact the moment it exists
+(BENCH_NOTES.md runbook; VERDICT r3 items 1, 2, 4):
+
+  1. microbench --spmv 96   — cheap canary: catches a Mosaic compile
+     problem in the fused kernels at 233k nodes; also records the
+     plan_s/compile_s split (item 4).
+  2. microbench --spmv 160  — the headline scale: xla vs benes vs
+     benes_fused at 1.056M nodes.
+  3. bench.py               — the full headline with --spmv auto
+     (vs_baseline against the baseline of record).
+  4. profile_round --k 160  — per-round cost attribution (spmv vs
+     elementwise floor) for the roofline-gap work (item 2).
+  5. microbench --spmv 40   — the small-scale compile-cost row
+     completing the k=40/96/160 compile-time table.
+
+Every step is a *sequential* subprocess with NO timeout — timeout-killing
+a mid-compile TPU process is what wedges the tunnel (memory: tunnel
+discipline).  The tunnel itself kills >60 s on-device executions; all
+launch sizes here respect bench.py's MAX_LAUNCH_S.  A step that exits
+nonzero is recorded and the session continues (transient compile-helper
+SIGKILLs are common — step 1 is retried once).
+
+Usage: python scripts/tpu_r4_session.py [--skip-probe]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def _run(cmd: list[str], log_name: str) -> tuple[int, str]:
+    """Run to completion (NO timeout — see module doc), tee to a log."""
+    log_path = os.path.join(REPO, f"_tpu_session_{log_name}.log")
+    t0 = time.time()
+    with open(log_path, "w") as lf:
+        p = subprocess.run(cmd, cwd=REPO, stdout=lf,
+                           stderr=subprocess.STDOUT)
+    out = open(log_path).read()
+    print(f"[{log_name}] rc={p.returncode} {time.time()-t0:.0f}s "
+          f"({len(out)}B log)", flush=True)
+    return p.returncode, out
+
+
+def _json_lines(text: str) -> list[dict]:
+    rows = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+    return rows
+
+
+def _bank(path: str, payload) -> None:
+    with open(os.path.join(REPO, path), "w") as f:
+        json.dump(payload, f, indent=1)
+    print(f"banked {path}", flush=True)
+
+
+def probe() -> bool:
+    try:
+        p = subprocess.run(
+            [PY, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=290)
+    except subprocess.TimeoutExpired:
+        print("probe: still wedged (290s)", flush=True)
+        return False
+    plat = (p.stdout.split() or [""])[-1]
+    ok = p.returncode == 0 and plat in ("tpu", "axon")
+    print(f"probe: rc={p.returncode} platform={plat!r} -> "
+          f"{'LIVE' if ok else 'not a TPU'}", flush=True)
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-probe", action="store_true")
+    args = ap.parse_args()
+
+    if not args.skip_probe and not probe():
+        return 3
+
+    session: dict = {"started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime()),
+                     "steps": {}}
+
+    # -- 1. canary at k=96 (retry once: transient helper SIGKILLs) -------
+    for attempt in (1, 2):
+        rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "96"],
+                       f"micro96_a{attempt}")
+        rows = _json_lines(out)
+        if rc == 0 and rows:
+            break
+    session["steps"]["micro96"] = {"rc": rc, "rows": rows}
+    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+    if rc != 0 or not rows:  # rc=0 with no parseable rows proves nothing
+        print("canary failed twice — banking what exists and stopping "
+              "before a wedged tunnel eats the session", flush=True)
+        return 4
+
+    # -- 2. headline scale k=160 ----------------------------------------
+    rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "160"],
+                   "micro160")
+    session["steps"]["micro160"] = {"rc": rc, "rows": _json_lines(out)}
+    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+
+    # -- 3. full headline bench -----------------------------------------
+    rc, out = _run([PY, "bench.py"], "bench")
+    rows = _json_lines(out)
+    if rows:
+        _bank("BENCH_TPU_r4.json", rows[-1])
+    session["steps"]["bench"] = {"rc": rc, "have_json": bool(rows)}
+    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+
+    # -- 4. per-round attribution ---------------------------------------
+    rc, out = _run([PY, "scripts/tpu_profile_round.py", "--k", "160"],
+                   "profile160")
+    session["steps"]["profile160"] = {"rc": rc, "rows": _json_lines(out)}
+    _bank("PROFILE_TPU_r4.json", session["steps"]["profile160"])
+
+    # -- 5. small-scale compile row -------------------------------------
+    rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "40"],
+                   "micro40")
+    session["steps"]["micro40"] = {"rc": rc, "rows": _json_lines(out)}
+    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+
+    print("session complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,45 @@ def main() -> None:
     rmse = jnp.sqrt(jnp.sum(err * err) / cnt)
     print(f"RMSE {float(rmse):.17g} PROC {jax.process_index()}", flush=True)
 
+    # shard_map halo kernel, fast synchronous pairwise (round 4): the
+    # direct two-sided exchange must also run unchanged across processes
+    from flow_updating_tpu.parallel import sharded
+
+    cfgp = RoundConfig.fast(variant="pairwise", dtype="float64")
+    plan = sharded.plan_sharding(topo, mesh.devices.size, partition="bfs",
+                                 coloring=True)
+    stp = sharded.init_plan_state(plan, cfgp, mesh)
+    outp = sharded.run_rounds_sharded(stp, plan, cfgp, mesh, 4)
+    rmse_p = fastpair_rmse(outp, plan, mesh, topo.true_mean)
+    print(f"RMSEFP {float(rmse_p):.17g} PROC {jax.process_index()}",
+          flush=True)
+
+
+def fastpair_rmse(state, plan, mesh, mean):
+    """Replicated RMSE of per-node estimates from the sharded (S, Nb)
+    layout, computed entirely on device (host readback of a sharded
+    global array is not addressable across processes)."""
+    import jax
+    import jax.numpy as jnp
+
+    P = jax.sharding.PartitionSpec
+    src_local = jax.device_put(
+        jnp.asarray(plan.arrays.src_local),
+        jax.sharding.NamedSharding(mesh, P("nodes", None)))
+
+    @jax.jit
+    def f(flow, value, alive, src):
+        Nb = value.shape[1]
+        sums = jax.vmap(
+            lambda fl, s: jax.ops.segment_sum(fl, s, num_segments=Nb)
+        )(flow, src)
+        est = value - sums
+        cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
+        err = jnp.where(alive, est - mean, 0.0)
+        return jnp.sqrt(jnp.sum(err * err) / cnt)
+
+    return f(state.flow, state.value, state.alive, src_local)
+
 
 if __name__ == "__main__":
     main()

@@ -82,12 +82,15 @@ def test_two_process_cpu_run():
             pytest.fail("multi-process child timed out")
         assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
         outs.append(out)
-    rmses = []
+    rmses, rmses_fp = [], []
     for out in outs:
         line = next(l for l in out.splitlines() if l.startswith("RMSE "))
         rmses.append(float(line.split()[1]))
-    # both processes see the same fully-replicated scalar
+        line = next(l for l in out.splitlines() if l.startswith("RMSEFP "))
+        rmses_fp.append(float(line.split()[1]))
+    # both processes see the same fully-replicated scalars
     assert rmses[0] == rmses[1]
+    assert rmses_fp[0] == rmses_fp[1]
 
     # single-process 8-device reference (the conftest backend)
     from flow_updating_tpu.models.config import RoundConfig
@@ -105,3 +108,15 @@ def test_two_process_cpu_run():
     est = np.asarray(node_estimates(out, arrays))[:n_real]
     ref_rmse = float(np.sqrt(np.mean((est - topo.true_mean) ** 2)))
     assert rmses[0] == pytest.approx(ref_rmse, abs=1e-12)
+
+    # fast-pairwise halo kernel reference (single-process, same mesh size)
+    from flow_updating_tpu.parallel import sharded
+
+    cfgp = RoundConfig.fast(variant="pairwise", dtype="float64")
+    plan = sharded.plan_sharding(topo, mesh.devices.size, partition="bfs",
+                                 coloring=True)
+    stp = sharded.init_plan_state(plan, cfgp, mesh)
+    outp = sharded.run_rounds_sharded(stp, plan, cfgp, mesh, 4)
+    est_fp = sharded.gather_estimates(outp, plan)
+    ref_fp = float(np.sqrt(np.mean((est_fp - topo.true_mean) ** 2)))
+    assert rmses_fp[0] == pytest.approx(ref_fp, abs=1e-12)

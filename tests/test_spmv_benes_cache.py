@@ -1,0 +1,61 @@
+"""Cross-process (disk) plan cache for routed neighbor-sum networks
+(VERDICT r3 item 4: k=160 routing costs ~55 s/process; measurement
+sessions run several processes on one topology)."""
+
+import numpy as np
+import pytest
+
+import flow_updating_tpu.ops.spmv_benes as sb
+from flow_updating_tpu.models import sync
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.topology.generators import fat_tree
+
+
+def test_disk_cache_roundtrip_bit_identical(tmp_path, monkeypatch):
+    monkeypatch.setenv("FU_PLAN_CACHE", str(tmp_path))
+    sb._plan_cache.clear()
+    topo = fat_tree(8, seed=0)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv="benes")
+    k1 = sync.NodeKernel(topo, cfg)
+    files = list(tmp_path.iterdir())
+    assert files, "plan was not persisted"
+    sb._plan_cache.clear()  # force the disk path
+    k2 = sync.NodeKernel(topo, cfg)
+    p1, p2 = k1.arrays.ns_plan, k2.arrays.ns_plan
+    assert (p1.m1, p1.P, p1.flat_begin, p1.bucket_shapes) == (
+        p2.m1, p2.P, p2.flat_begin, p2.bucket_shapes)
+    assert p1.stages.dists == p2.stages.dists
+    assert p1.stages.kinds == p2.stages.kinds
+    for a, b in zip(p1.stages.masks, p2.stages.masks):
+        np.testing.assert_array_equal(a, b)
+    s1 = k1.run(k1.init_state(), 8)
+    s2 = k2.run(k2.init_state(), 8)
+    np.testing.assert_array_equal(np.asarray(s1.S), np.asarray(s2.S))
+
+
+def test_disk_cache_disabled_and_corrupt(tmp_path, monkeypatch):
+    # disabled: nothing may be written anywhere (cwd pinned to an empty
+    # dir; XDG redirected so the user cache can't absorb a regression)
+    work = tmp_path / "cwd"; work.mkdir()
+    xdg = tmp_path / "xdg"; xdg.mkdir()
+    monkeypatch.chdir(work)
+    monkeypatch.setenv("XDG_CACHE_HOME", str(xdg))
+    monkeypatch.setenv("FU_PLAN_CACHE", "0")
+    sb._plan_cache.clear()
+    topo = fat_tree(8, seed=0)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv="benes")
+    sync.NodeKernel(topo, cfg)
+    assert not list(work.iterdir()), "disabled cache wrote into cwd"
+    assert not list(xdg.rglob("*.npz")), "disabled cache wrote into XDG"
+    # corrupt file: must warn + replan, never raise
+    cache = tmp_path / "cache"
+    monkeypatch.setenv("FU_PLAN_CACHE", str(cache))
+    sb._plan_cache.clear()
+    k = sync.NodeKernel(topo, cfg)
+    path = list(cache.iterdir())[0]
+    path.write_bytes(b"not an npz")
+    sb._plan_cache.clear()
+    k2 = sync.NodeKernel(topo, cfg)  # replans from scratch
+    np.testing.assert_array_equal(
+        np.asarray(k.run(k.init_state(), 4).S),
+        np.asarray(k2.run(k2.init_state(), 4).S))
