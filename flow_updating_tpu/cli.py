@@ -335,10 +335,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="simulated message wire size; adds the "
                           "size/bandwidth serialization term to latency-"
                           "warped delays. Default 104 is measured from "
-                          "the reference PDU: FlowUpdatingMsg.size() = "
-                          "5 doubles + ids + overhead (flowupdating-"
-                          "collectall.py:13-19); the protocol's PDU is "
-                          "fixed-size, so a constant is exact")
+                          "the reference PDU: FlowUpdatingMsg.size() "
+                          "sums sys.getsizeof over (sender, flow, "
+                          "estimate) (flowupdating-collectall.py:13-19); "
+                          "the PDU's fields are fixed-size, so the "
+                          "constant is exact for this protocol")
     run.add_argument("--drop-rate", type=float, default=0.0,
                      help="per-message loss probability (fault injection)")
     run.add_argument("--rounds", type=int, default=None,
