@@ -41,13 +41,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PY = sys.executable
 
 
+def _session_env() -> dict:
+    """Child env: persistent XLA compilation cache shared across the
+    session's processes — the k=160 fused-path compile is paid once, not
+    per step (the routed-plan disk cache covers the host side the same
+    way)."""
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.expanduser("~/.cache/flow_updating_tpu/xla"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    return env
+
+
 def _run(cmd: list[str], log_name: str) -> tuple[int, str]:
     """Run to completion (NO timeout — see module doc), tee to a log."""
     log_path = os.path.join(REPO, f"_tpu_session_{log_name}.log")
     t0 = time.time()
     with open(log_path, "w") as lf:
         p = subprocess.run(cmd, cwd=REPO, stdout=lf,
-                           stderr=subprocess.STDOUT)
+                           stderr=subprocess.STDOUT, env=_session_env())
     out = open(log_path).read()
     print(f"[{log_name}] rc={p.returncode} {time.time()-t0:.0f}s "
           f"({len(out)}B log)", flush=True)
