@@ -152,6 +152,18 @@ def main() -> int:
     session["steps"]["micro40"] = {"rc": rc, "rows": _json_lines(out)}
     _bank("MICROBENCH_TPU_r4.json", session["steps"])
 
+    # -- 6. faithful-path (edge kernel) secondary headline at k=96 ------
+    # full async fidelity (1 msg/round drain, FIFO, timeouts) with the
+    # fused delivery/segment circuits — never TPU-timed before r4
+    rc, out = _run([PY, "bench.py", "--kernel", "edge", "--fire-policy",
+                    "reference", "--fat-tree-k", "96", "--skip-des",
+                    "--skip-convergence"],
+                   "edge96")
+    rows = _json_lines(out)
+    session["steps"]["edge96"] = {"rc": rc,
+                                  "result": rows[-1] if rows else None}
+    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+
     print("session complete", flush=True)
     return 0
 
