@@ -27,7 +27,11 @@ from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.state import FlowUpdatingState, init_state
 from flow_updating_tpu.models.rounds import round_step, run_rounds, node_estimates
 from flow_updating_tpu.engine import Engine
-from flow_updating_tpu.models.actor import TopoView, VectorActor
+from flow_updating_tpu.models.actor import (
+    TopoView,
+    VectorActor,
+    push_sum_actor,
+)
 
 __all__ = [
     "Topology",
@@ -41,5 +45,6 @@ __all__ = [
     "Engine",
     "VectorActor",
     "TopoView",
+    "push_sum_actor",
     "__version__",
 ]

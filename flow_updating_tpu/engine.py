@@ -169,11 +169,9 @@ class Engine:
                     "VectorActor rounds are unit-delay synchronous; "
                     "latency-warped delivery applies to the built-in "
                     "edge kernel only")
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "VectorActor is single-device; shard the protocol "
-                    "explicitly with parallel.sharded for multi-chip")
-            self._node_kernel = ActorKernel(self.topology, self._custom_actor)
+            self._node_kernel = ActorKernel(self.topology,
+                                            self._custom_actor,
+                                            mesh=self.mesh)
             self._topo_arrays = None
             return
         if self.config.kernel == "node":

@@ -93,11 +93,22 @@ class VectorActor:
 
 class ActorKernel:
     """Drives a :class:`VectorActor` with the NodeKernel interface the
-    Engine dispatches on (init_state / run / estimates / last_avg)."""
+    Engine dispatches on (init_state / run / estimates / last_avg).
 
-    def __init__(self, topology, actor: VectorActor):
+    ``mesh`` (a ``jax.sharding.Mesh`` over the node axis) turns on
+    multi-chip GSPMD execution: the view's node/edge arrays and every
+    state/outbox leaf whose leading axis divides the mesh are sharded
+    over it, and XLA places the cross-shard collectives the user's
+    ``round`` implies (the ``send`` gather and ``sum_to_dst`` segment
+    reduction become all-gather/reduce-scatter patterns, exactly as for
+    the built-in kernels' GSPMD path).  Leaves that do not divide are
+    replicated — still correct, just not distributed.
+    """
+
+    def __init__(self, topology, actor: VectorActor, mesh=None):
         self.topology = topology
         self.actor = actor
+        self.mesh = mesh
         self.padded_size = topology.num_nodes
         deg = np.bincount(
             np.asarray(topology.dst), minlength=topology.num_nodes)
@@ -109,6 +120,15 @@ class ActorKernel:
             rev=jnp.asarray(np.asarray(topology.rev), jnp.int32),
             degree=jnp.asarray(deg, jnp.int32),
         )
+        if mesh is not None:
+            # TopoView is a plain (non-pytree) static container; place
+            # its array fields explicitly
+            self.view = dataclasses.replace(
+                self.view,
+                **{f: jax.device_put(getattr(self.view, f),
+                                     self._sharding_for(
+                                         getattr(self.view, f)))
+                   for f in ("src", "dst", "rev", "degree")})
         view = self.view
         act = self.actor
 
@@ -122,13 +142,32 @@ class ActorKernel:
         self._run = jax.jit(_scan, static_argnums=1)
         self._estimate = jax.jit(lambda c: act.estimate(c[0], view))
 
+    def _sharding_for(self, x):
+        """Leading-axis node sharding when it divides the mesh, else
+        replicated (correct either way under GSPMD)."""
+        from flow_updating_tpu.parallel.mesh import NODE_AXIS
+
+        P = jax.sharding.PartitionSpec
+        nd = jnp.ndim(x)
+        if nd >= 1 and x.shape[0] % self.mesh.devices.size == 0:
+            spec = P(NODE_AXIS, *([None] * (nd - 1)))
+        else:
+            spec = P()
+        return jax.sharding.NamedSharding(self.mesh, spec)
+
     def init_state(self):
         values = jnp.asarray(self.topology.values, jnp.float32)
+        if self.mesh is not None:
+            values = jax.device_put(values, self._sharding_for(values))
         carry = self.actor.init(values, self.view)
         if not (isinstance(carry, tuple) and len(carry) == 2):
             raise TypeError(
                 f"VectorActor {self.actor.name!r}: init must return "
                 "(state, outbox)")
+        if self.mesh is not None:
+            carry = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x),
+                                         self._sharding_for(x)), carry)
         return carry
 
     def run(self, carry, n: int):
@@ -162,3 +201,34 @@ class ActorKernel:
 
     def last_avg(self, carry):
         return self.estimates(carry)
+
+
+def push_sum_actor() -> VectorActor:
+    """Deterministic Push-Sum (Kempe et al. 2003) as the canonical
+    :class:`VectorActor` reference implementation — the living
+    documentation of the contract, used by the tests, the driver dryrun
+    and the README.  Each node keeps ``(s, w)``; every round it splits
+    both equally over ``{self} ∪ out-neighbors``; ``s / w`` converges to
+    the mean.  Mass-conserving, so it exercises outbox->inbox delivery
+    and the dst-segmented reduction end to end."""
+
+    def init(values, view: TopoView):
+        z = jnp.zeros((view.num_edges,), values.dtype)
+        return ({"s": values, "w": jnp.ones_like(values)},
+                {"s": z, "w": z})
+
+    def round_(state, inbox, view: TopoView):
+        # assemble this round's totals: retained share + everything heard
+        s = state["s"] + view.sum_to_dst(inbox["s"])
+        w = state["w"] + view.sum_to_dst(inbox["w"])
+        # split over {self} ∪ out-neighbors: keep one share, send one
+        # per out-edge (the retained share is next round's state)
+        share = 1.0 / (view.degree.astype(jnp.float32) + 1.0)
+        return ({"s": s * share, "w": w * share},
+                {"s": view.send(s * share), "w": view.send(w * share)})
+
+    def estimate(state, view: TopoView):
+        return state["s"] / state["w"]
+
+    return VectorActor(init=init, round=round_, estimate=estimate,
+                       name="push-sum")
