@@ -449,10 +449,16 @@ class Engine:
         if self.state is None:
             raise RuntimeError("engine not built — nothing to checkpoint")
         if self._custom_actor is not None:
-            raise NotImplementedError(
-                "checkpointing a VectorActor run is not supported (the "
-                "state pytree layout is user-defined); snapshot the "
-                "carry with numpy/orbax directly")
+            from flow_updating_tpu.utils.checkpoint import (
+                save_actor_checkpoint,
+            )
+
+            save_actor_checkpoint(
+                path, self.state, self._custom_actor.name,
+                topo=self.topology,
+                extra={"clock": self._clock, "killed": self._killed},
+            )
+            return self
         save_checkpoint(
             path, self.state, self.config, topo=self.topology,
             extra={"clock": self._clock, "killed": self._killed},
@@ -461,13 +467,27 @@ class Engine:
 
     def restore_checkpoint(self, path: str) -> "Engine":
         """Resume from a checkpoint taken on the *same* topology (verified
-        by content fingerprint).  Restores state, config and clock; does not
-        allocate fresh state (``build()`` is not required first)."""
+        by content fingerprint).  Restores state, config and clock;
+        ``build()`` is not required first.  Built-in kernels restore
+        without allocating fresh state; a VectorActor restore DOES run
+        the actor's ``init`` once — the fresh carry is the structural
+        template the archive is validated against."""
         from flow_updating_tpu.utils.checkpoint import load_checkpoint
 
         if self._custom_actor is not None:
-            raise NotImplementedError(
-                "restoring into a VectorActor run is not supported")
+            from flow_updating_tpu.utils.checkpoint import (
+                load_actor_checkpoint,
+            )
+
+            self._resolve_topology()
+            self._prepare_arrays()
+            template = self._node_kernel.init_state()
+            self.state, extra = load_actor_checkpoint(
+                path, template, self._custom_actor.name,
+                topo=self.topology)
+            self._clock = float(extra.get("clock", 0.0))
+            self._killed = bool(extra.get("killed", False))
+            return self
         self._resolve_topology()
         state, cfg, extra = load_checkpoint(path, topo=self.topology)
         self.config = cfg

@@ -59,6 +59,29 @@ def topology_fingerprint(topo) -> dict:
     }
 
 
+def _write_archive(path: str, manifest: dict, arrays: dict) -> None:
+    """Single durability-critical write path for every checkpoint flavor:
+    compressed npz with the JSON manifest as a uint8 buffer, written to a
+    pid-suffixed temp file and atomically renamed."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f, __manifest__=np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8
+            ), **arrays,
+        )
+    os.replace(tmp, path)
+
+
+def _read_manifest(z) -> dict:
+    manifest = json.loads(bytes(z["__manifest__"]).decode())
+    if manifest["format_version"] != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest['format_version']} != "
+            f"{FORMAT_VERSION}")
+    return manifest
+
+
 def save_checkpoint(
     path: str,
     state: FlowUpdatingState,
@@ -91,14 +114,7 @@ def save_checkpoint(
         "num_colors": coloring[1] if coloring is not None else None,
         "extra": extra or {},
     }
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(
-            f, __manifest__=np.frombuffer(
-                json.dumps(manifest).encode(), dtype=np.uint8
-            ), **arrays,
-        )
-    os.replace(tmp, path)
+    _write_archive(path, manifest, arrays)
 
 
 def load_checkpoint(
@@ -111,12 +127,7 @@ def load_checkpoint(
     match — a checkpoint can never be resumed against a different graph.
     """
     with np.load(path) as z:
-        manifest = json.loads(bytes(z["__manifest__"]).decode())
-        if manifest["format_version"] != FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint format {manifest['format_version']} != "
-                f"{FORMAT_VERSION}"
-            )
+        manifest = _read_manifest(z)
         fields = {}
         aux_color = None
         for key in z.files:
@@ -180,3 +191,109 @@ def load_checkpoint(
 
     state = state_cls(**fields)
     return state, cfg, manifest.get("extra", {})
+
+
+# ---- VectorActor carries (user-defined pytrees) -------------------------
+#
+# A custom actor's state is an arbitrary pytree, so the archive keys are
+# the jax keystr paths of its leaves, and restore is TEMPLATE-based: the
+# caller passes a freshly-initialized carry from the SAME actor code, and
+# every template leaf is filled from the archive (exact key-set, shape
+# and dtype match required).  This binds a checkpoint to the actor's
+# current structure the same way the fingerprint binds it to the graph —
+# a protocol change between save and restore fails loudly instead of
+# unflattening garbage.
+
+def save_actor_checkpoint(path, carry, actor_name: str, topo=None,
+                          extra: dict | None = None) -> None:
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_leaves_with_path(carry)
+    arrays = {}
+    for kp, v in leaves:
+        arrays[f"leaf{jtu.keystr(kp)}"] = np.asarray(jax.device_get(v))
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "state_class": "ActorCarry",
+        "actor": actor_name,
+        "topology": topology_fingerprint(topo) if topo is not None else None,
+        "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+        "extra": extra or {},
+    }
+    _write_archive(path, manifest, arrays)
+
+
+def load_actor_checkpoint(path, template, actor_name: str, topo=None):
+    """Restore a carry saved by :func:`save_actor_checkpoint`.
+
+    ``template``: a freshly-initialized carry from the same actor on the
+    same topology — its structure defines what the archive must contain.
+    Returns ``(carry, extra)``; leaves keep the template's device
+    placement (sharded templates re-place restored leaves).
+    """
+    import jax.tree_util as jtu
+
+    with np.load(path) as z:
+        manifest = _read_manifest(z)
+        if manifest.get("state_class") != "ActorCarry":
+            raise ValueError(
+                f"not a VectorActor checkpoint "
+                f"(state_class={manifest.get('state_class')!r})")
+        if manifest.get("actor") != actor_name:
+            raise ValueError(
+                f"checkpoint was saved by actor {manifest.get('actor')!r}, "
+                f"restoring under {actor_name!r}")
+        saved = {k: z[k] for k in z.files if k.startswith("leaf")}
+    if topo is not None and manifest.get("topology"):
+        fp = topology_fingerprint(topo)
+        if fp != manifest["topology"]:
+            raise ValueError(
+                "actor checkpoint was taken on a different topology")
+
+    paths, treedef = jtu.tree_flatten_with_path(template)
+    want = {f"leaf{jtu.keystr(kp)}" for kp, _ in paths}
+    if want != set(saved):
+        raise ValueError(
+            "actor checkpoint structure does not match the current "
+            f"actor's init: missing {sorted(want - set(saved))}, "
+            f"unexpected {sorted(set(saved) - want)} (the protocol "
+            "changed since the save?)")
+    saved_dtypes = manifest.get("dtypes", {})
+    leaves = []
+    for kp, tleaf in paths:
+        key = f"leaf{jtu.keystr(kp)}"
+        arr = saved[key]
+        # shape/dtype from metadata only — never np.asarray(tleaf): that
+        # would gather a sharded template to host (and raise outright on
+        # non-fully-addressable multi-process arrays)
+        tshape = np.shape(tleaf)
+        tdtype = np.dtype(getattr(tleaf, "dtype", np.asarray(tleaf).dtype))
+        if arr.shape != tshape:
+            raise ValueError(
+                f"actor checkpoint leaf {jtu.keystr(kp)} has shape "
+                f"{arr.shape}, current actor expects {tshape}")
+        man_dtype = saved_dtypes.get(key)
+        if man_dtype is not None and str(arr.dtype) != man_dtype:
+            raise ValueError(
+                f"actor checkpoint leaf {jtu.keystr(kp)} dtype "
+                f"{arr.dtype} does not match its manifest entry "
+                f"{man_dtype!r} (corrupt archive?)")
+        canonical = jax.dtypes.canonicalize_dtype(arr.dtype)
+        if canonical != arr.dtype:
+            warnings.warn(
+                f"actor leaf {jtu.keystr(kp)} saved as {arr.dtype}, "
+                f"canonicalized to {canonical} — resume is NOT bit-exact",
+                stacklevel=2)
+            arr = arr.astype(canonical)
+        if np.dtype(canonical) != tdtype:
+            raise ValueError(
+                f"actor checkpoint leaf {jtu.keystr(kp)} restores as "
+                f"{canonical}, but the current actor's init produces "
+                f"{tdtype} — the protocol's precision changed since "
+                "the save")
+        dev = jax.numpy.asarray(arr)
+        sh = getattr(tleaf, "sharding", None)
+        if sh is not None:
+            dev = jax.device_put(dev, sh)
+        leaves.append(dev)
+    return jtu.tree_unflatten(treedef, leaves), manifest.get("extra", {})
