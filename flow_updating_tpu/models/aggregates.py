@@ -1,0 +1,66 @@
+"""Derived aggregates on top of the mean kernel: COUNT and SUM.
+
+The reference estimates only the average.  The Flow-Updating literature
+(Jesus/Baquero/Almeida) derives the other classical gossip aggregates
+from it, and they fall out of this framework for free because the
+kernels take arbitrary per-node inputs:
+
+* **count** (network size): one designated root contributes 1, everyone
+  else 0; the converged mean is ``1/N``, so ``N = 1/mean``.  Fully
+  decentralized — every node ends up knowing the size.
+* **sum**: ``sum = mean * N`` — one value run and one indicator run.
+  Both runs share the topology's structure, so any routed permutation
+  network is a content-keyed cache hit (``ops/spmv_benes``); the ELL
+  layout and jit programs are rebuilt per run (values differ).
+
+These are estimates with the same convergence behavior as the underlying
+mean; run enough rounds for the topology's mixing time (the ``rmse``
+from a mean run is the natural stopping signal).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+
+
+def _mean_estimates(topo, cfg: RoundConfig, rounds: int) -> np.ndarray:
+    # dispatch and array-building mirror the Engine exactly
+    # (engine.py::_prepare_arrays): kernel selection is cfg.kernel, and
+    # the edge kernel's arrays carry every layout the config opted into
+    if cfg.kernel == "node":
+        from flow_updating_tpu.models import sync
+
+        k = sync.NodeKernel(topo, cfg)
+        return k.estimates(k.run(k.init_state(), rounds))
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+
+    arrays = topo.device_arrays(
+        coloring=cfg.needs_coloring,
+        segment_ell=cfg.use_segment_ell,
+        segment_benes=cfg.segment_benes_mode,
+        delivery_benes=cfg.delivery_benes_mode,
+    )
+    out = run_rounds(init_state(topo, cfg), arrays, cfg, rounds)
+    return np.asarray(node_estimates(out, arrays))
+
+
+def estimate_count(topo, cfg: RoundConfig | None = None,
+                   rounds: int = 1000, root: int = 0) -> np.ndarray:
+    """Per-node estimates of the network size N (root-indicator mean)."""
+    cfg = cfg or RoundConfig.fast(variant="collectall", kernel="node")
+    ind = np.zeros(topo.num_nodes)
+    ind[int(root)] = 1.0
+    mean = _mean_estimates(topo.with_values(ind), cfg, rounds)
+    # mean -> 1/N; guard the not-yet-mixed zeros far from the root
+    return np.where(mean > 0, 1.0 / np.maximum(mean, 1e-30), np.inf)
+
+
+def estimate_sum(topo, cfg: RoundConfig | None = None,
+                 rounds: int = 1000, root: int = 0) -> np.ndarray:
+    """Per-node estimates of the global sum (mean x estimated N)."""
+    cfg = cfg or RoundConfig.fast(variant="collectall", kernel="node")
+    mean = _mean_estimates(topo, cfg, rounds)
+    return mean * estimate_count(topo, cfg, rounds, root)

@@ -88,3 +88,19 @@ def test_long_horizon_faithful_edge_kernel_soak():
     assert abs(est.sum() - topo.values.sum()) / abs(
         topo.values.sum()) < 1e-12
     assert np.abs(est - topo.true_mean).max() < 1e-9
+
+
+def test_count_and_sum_aggregates():
+    """Derived aggregates (models/aggregates.py): COUNT via the
+    root-indicator mean, SUM via mean x count — the classical
+    Flow-Updating derivations, exact at convergence."""
+    from flow_updating_tpu.models.aggregates import (
+        estimate_count,
+        estimate_sum,
+    )
+
+    topo = erdos_renyi(256, avg_degree=8.0, seed=4)
+    n_est = estimate_count(topo, rounds=400)
+    np.testing.assert_allclose(n_est, 256.0, rtol=1e-3)
+    s_est = estimate_sum(topo, rounds=400)
+    np.testing.assert_allclose(s_est, topo.values.sum(), rtol=1e-3)
