@@ -137,12 +137,24 @@ def cmd_run(args) -> int:
     from flow_updating_tpu.engine import Engine
 
     cfg = _make_config(args)
+    if getattr(args, "multichip", "auto") == "halo":
+        if not args.shards:
+            raise SystemExit(
+                "--multichip halo needs --shards N (it is a multi-chip "
+                "distribution strategy)")
+        if getattr(args, "save_checkpoint", None) or args.resume:
+            raise SystemExit(
+                "--multichip halo does not support checkpointing yet; "
+                "drop --save-checkpoint/--resume or use --multichip auto")
     mesh = None
     if args.shards:
         from flow_updating_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(args.shards)
-    engine = Engine(config=cfg, mesh=mesh)
+    engine = Engine(config=cfg, mesh=mesh,
+                    multichip=getattr(args, "multichip", "auto"),
+                    halo=getattr(args, "halo", "ppermute"),
+                    partition=getattr(args, "partition", "bfs"))
     engine.set_topology(_build_topology(args))
     if args.resume:
         # restore allocates no fresh state; the checkpoint's config governs
@@ -198,8 +210,11 @@ def cmd_run(args) -> int:
         else:
             cb = None
             if event_log:
+                import numpy as np
+
+                # halo-mode state carries one lockstep clock per shard
                 cb = lambda e: event_log.emit(
-                    "watch", t=int(e.state.t), **{
+                    "watch", t=int(np.asarray(e.state.t).ravel()[0]), **{
                         k: v for k, v in e.global_values().items()
                     },
                 )
@@ -305,6 +320,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="edge-kernel per-node reduction layout: jax.ops "
                           "segment primitives vs scatter-free degree-"
                           "bucketed ELL gather+row-reduce")
+    run.add_argument("--multichip", default="auto",
+                     choices=("auto", "halo"),
+                     help="distribution strategy under --shards: 'auto' "
+                          "= GSPMD (XLA places collectives), 'halo' = "
+                          "explicitly scheduled shard_map halo-exchange "
+                          "kernel (edge kernel only)")
+    run.add_argument("--halo", default="ppermute",
+                     choices=("ppermute", "allgather"),
+                     help="halo kernel's cut-edge exchange collective")
+    run.add_argument("--partition", default="bfs",
+                     choices=("bfs", "contiguous"),
+                     help="halo kernel's node partition order")
     run.add_argument("--shards", type=int, default=0,
                      help="shard the node axis over N devices (GSPMD over a "
                           "jax Mesh; 0 = single device)")

@@ -65,15 +65,30 @@ class Engine:
     """Driver for one simulation/aggregation run."""
 
     def __init__(self, argv=None, config: RoundConfig | None = None,
-                 mesh=None):
+                 mesh=None, multichip: str = "auto",
+                 halo: str = "ppermute", partition: str = "bfs"):
         # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
         # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
         # accepts a ready RoundConfig here.  ``mesh`` (a jax.sharding.Mesh
-        # over the 'nodes' axis) turns on multi-chip GSPMD execution: the
-        # node axis is sharded and XLA places the cross-shard collectives.
+        # over the 'nodes' axis) turns on multi-chip execution.
+        #
+        # ``multichip`` selects the distribution strategy under a mesh:
+        #   'auto' — GSPMD (annotate shardings, XLA places collectives);
+        #            the node kernel with spmv='benes_fused' uses the
+        #            shard_map fused-circuit kernel.
+        #   'halo' — the explicitly scheduled shard_map halo-exchange
+        #            kernel (parallel/sharded.py): edges live with their
+        #            source shard, only cut-edge payloads cross chips
+        #            (``halo``: 'ppermute' point-to-point or 'allgather'
+        #            broadcast; ``partition``: 'bfs' or 'contiguous').
+        if multichip not in ("auto", "halo"):
+            raise ValueError(f"unknown multichip mode {multichip!r}")
         self.argv = list(argv) if argv else []
         self.config = config or RoundConfig.fast()
         self.mesh = mesh
+        self.multichip = multichip
+        self.halo = halo
+        self.partition = partition
         self.platform: Platform | None = None
         self.deployment: Deployment | None = None
         self.topology: Topology | None = None
@@ -83,6 +98,7 @@ class Engine:
         self._clock = 0.0
         self._killed = False
         self._n_real: int | None = None   # real node count when mesh-padded
+        self._halo_plan = None
         self.netzone_root = _NetzoneShim(self)
 
     # ---- setup -----------------------------------------------------------
@@ -133,6 +149,11 @@ class Engine:
         return None
 
     @property
+    def _halo_mode(self) -> bool:
+        return self.mesh is not None and self.multichip == "halo" \
+            and self._custom_actor is None
+
+    @property
     def _node_like(self) -> bool:
         """Dispatch through the node-kernel interface (built-in
         node-collapsed kernel, or an ActorKernel driving a VectorActor)."""
@@ -164,6 +185,11 @@ class Engine:
         if self._custom_actor is not None:
             from flow_updating_tpu.models.actor import ActorKernel
 
+            if self.mesh is not None and self.multichip == "halo":
+                raise ValueError(
+                    "multichip='halo' drives the built-in edge kernel; "
+                    "custom VectorActors distribute via GSPMD — use "
+                    "multichip='auto'")
             if latency_scale > 0.0 or self.topology.max_delay > 1:
                 raise ValueError(
                     "VectorActor rounds are unit-delay synchronous; "
@@ -172,6 +198,31 @@ class Engine:
             self._node_kernel = ActorKernel(self.topology,
                                             self._custom_actor,
                                             mesh=self.mesh)
+            self._topo_arrays = None
+            return
+        if self.mesh is not None and self.multichip == "halo":
+            if self.config.kernel == "node":
+                raise ValueError(
+                    "multichip='halo' drives the edge kernel "
+                    "(per-edge state partitioned by source shard); the "
+                    "node kernel distributes via GSPMD or the sharded "
+                    "fused-circuit kernel — use multichip='auto'"
+                )
+            if latency_scale > 0.0 or self.config.contention:
+                raise NotImplementedError(
+                    "the halo kernel runs unit-delay/static-delay rounds; "
+                    "latency-warped + contention fidelity runs are "
+                    "single-device (platform-scale)"
+                )
+            from flow_updating_tpu.parallel import sharded
+
+            self._halo_plan = sharded.plan_sharding(
+                self.topology, self.mesh.devices.size,
+                partition=self.partition,
+                coloring=self.config.needs_coloring,
+            )
+            self._halo_arrays = sharded.plan_device_arrays(
+                self._halo_plan, self.mesh)
             self._topo_arrays = None
             return
         if self.config.kernel == "node":
@@ -261,7 +312,12 @@ class Engine:
         """Resolve deployment(+platform) into topology + fresh state."""
         self._resolve_topology(latency_scale)
         self._prepare_arrays(latency_scale)
-        if self._node_like:
+        if self._halo_mode:
+            from flow_updating_tpu.parallel import sharded
+
+            self.state = sharded.init_plan_state(
+                self._halo_plan, self.config, self.mesh, seed=seed)
+        elif self._node_like:
             self.state = self._node_kernel.init_state()
         elif self.mesh is not None:
             from flow_updating_tpu.parallel import auto
@@ -317,7 +373,13 @@ class Engine:
         names = self.topology.names or tuple(
             str(i) for i in range(self.topology.num_nodes)
         )
-        if self._node_like:
+        if self._halo_mode:
+            from flow_updating_tpu.parallel import sharded
+
+            value = self.topology.values
+            last_avg = sharded.gather_node_array(
+                self.state.last_avg, self._halo_plan)
+        elif self._node_like:
             value = self.topology.values
             last_avg = self._node_kernel.last_avg(self.state)
         else:
@@ -332,6 +394,10 @@ class Engine:
     def estimates(self) -> np.ndarray:
         if self.state is None:
             raise RuntimeError("engine not built")
+        if self._halo_mode:
+            from flow_updating_tpu.parallel import sharded
+
+            return sharded.gather_estimates(self.state, self._halo_plan)
         if self._node_like:
             return self._node_kernel.estimates(self.state)
         est = np.asarray(node_estimates(self.state, self._topo_arrays))
@@ -342,15 +408,29 @@ class Engine:
         est = self.estimates()
         err = est - self.topology.true_mean
         report = {
-            "t": int(self.state.t),
+            # halo-mode state carries one lockstep clock per shard
+            "t": int(np.asarray(self.state.t).ravel()[0]),
             "rmse": float(np.sqrt(np.mean(err * err))),
             "max_abs_err": float(np.max(np.abs(err))),
             "mass_residual": float(est.sum() - self.topology.values.sum()),
         }
-        if self.config.kernel == "edge":
+        if self.config.kernel == "edge" and not self._halo_mode:
             flow = np.asarray(self.state.flow)[: self.topology.num_edges]
             report["antisymmetry_residual"] = float(
                 np.max(np.abs(flow + flow[self.topology.rev]))
+            )
+        elif self._halo_mode:
+            # edge flows live in per-shard slots; pair them through the
+            # plan's reverse routing (tshard/tlocal) to check the
+            # invariant across shard boundaries too
+            pl = self._halo_plan
+            flow = np.asarray(self.state.flow)
+            ts = np.asarray(pl.arrays.tshard)
+            tl = np.asarray(pl.arrays.tlocal)
+            real = tl < pl.Eb
+            rev_flow = flow[ts[real], tl[real]]
+            report["antisymmetry_residual"] = float(
+                np.max(np.abs(flow[real] + rev_flow))
             )
         return report
 
@@ -360,6 +440,15 @@ class Engine:
             raise ValueError(
                 f"{what} needs per-edge state; the node-collapsed kernel is "
                 "exactly the fault-free fast path — use kernel='edge'"
+            )
+        if self._halo_mode:
+            # the (S, Nb)/(S, Eb) block layout does not accept global
+            # node/edge ids; silently scattering into the shard axis
+            # would corrupt state
+            raise NotImplementedError(
+                f"{what} is not supported on the halo kernel's blocked "
+                "layout yet — use the GSPMD path (multichip='auto') for "
+                "fault-injection runs"
             )
 
     def _node_ids(self, nodes) -> np.ndarray:
@@ -448,6 +537,11 @@ class Engine:
 
         if self.state is None:
             raise RuntimeError("engine not built — nothing to checkpoint")
+        if self._halo_mode:
+            raise NotImplementedError(
+                "checkpointing the halo kernel's (S, .) block layout is "
+                "not supported yet; run it single-device or via GSPMD "
+                "for checkpointed runs")
         if self._custom_actor is not None:
             from flow_updating_tpu.utils.checkpoint import (
                 save_actor_checkpoint,
@@ -474,6 +568,10 @@ class Engine:
         template the archive is validated against."""
         from flow_updating_tpu.utils.checkpoint import load_checkpoint
 
+        if self._halo_mode:
+            raise NotImplementedError(
+                "restoring into the halo kernel's layout is not "
+                "supported yet")
         if self._custom_actor is not None:
             from flow_updating_tpu.utils.checkpoint import (
                 load_actor_checkpoint,
@@ -550,7 +648,13 @@ class Engine:
     # ---- execution -------------------------------------------------------
     def _advance(self, n: int) -> None:
         """Dispatch ``n`` compiled rounds to the configured kernel."""
-        if self._node_like:
+        if self._halo_mode:
+            from flow_updating_tpu.parallel import sharded
+
+            self.state = sharded.run_rounds_sharded(
+                self.state, self._halo_plan, self.config, self.mesh, n,
+                arrays=self._halo_arrays, halo=self.halo)
+        elif self._node_like:
             self.state = self._node_kernel.run(self.state, n)
         else:
             self.state = run_rounds(
@@ -576,6 +680,33 @@ class Engine:
             self.build()
         if emit is None:
             emit = _log_stream_sample  # stable identity -> jit cache reuse
+        if self._halo_mode:
+            # no fused streamed program for the halo kernel: chunk
+            # between samples.  Samples follow the streamed contract of
+            # the other kernels (models/rounds._observe_chunk): ABSOLUTE
+            # state clock, alive-masked rmse/mass, real fired counts.
+            from flow_updating_tpu.parallel import sharded
+
+            done = 0
+            while done < n and not self._killed:
+                take = min(int(observe_every), n - done)
+                self._advance(take)
+                done += take
+                est = self.estimates()
+                alive = sharded.gather_node_array(
+                    self.state.alive, self._halo_plan).astype(bool)
+                cnt = max(int(alive.sum()), 1)
+                err = np.where(alive, est - self.topology.true_mean, 0.0)
+                emit({
+                    "t": int(np.asarray(self.state.t).ravel()[0]),
+                    "rmse": float(np.sqrt(np.sum(err * err) / cnt)),
+                    "max_abs_err": float(np.max(np.abs(err))),
+                    "mass": float(est[alive].sum()),
+                    "fired_total": int(sharded.gather_node_array(
+                        self.state.fired, self._halo_plan).sum()),
+                })
+            self._clock += n * TICK_INTERVAL
+            return self
         if not self._killed and n > 0:
             if self._node_like:
                 self.state = self._node_kernel.run_streamed(
