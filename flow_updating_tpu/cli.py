@@ -137,15 +137,10 @@ def cmd_run(args) -> int:
     from flow_updating_tpu.engine import Engine
 
     cfg = _make_config(args)
-    if getattr(args, "multichip", "auto") == "halo":
-        if not args.shards:
-            raise SystemExit(
-                "--multichip halo needs --shards N (it is a multi-chip "
-                "distribution strategy)")
-        if getattr(args, "save_checkpoint", None) or args.resume:
-            raise SystemExit(
-                "--multichip halo does not support checkpointing yet; "
-                "drop --save-checkpoint/--resume or use --multichip auto")
+    if getattr(args, "multichip", "auto") == "halo" and not args.shards:
+        raise SystemExit(
+            "--multichip halo needs --shards N (it is a multi-chip "
+            "distribution strategy)")
     mesh = None
     if args.shards:
         from flow_updating_tpu.parallel.mesh import make_mesh

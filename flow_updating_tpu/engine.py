@@ -538,10 +538,19 @@ class Engine:
         if self.state is None:
             raise RuntimeError("engine not built — nothing to checkpoint")
         if self._halo_mode:
-            raise NotImplementedError(
-                "checkpointing the halo kernel's (S, .) block layout is "
-                "not supported yet; run it single-device or via GSPMD "
-                "for checkpointed runs")
+            # gather the blocked layout back to the CANONICAL
+            # single-device state: the checkpoint is then a standard one,
+            # restorable on ANY execution mode (single-device, GSPMD, or
+            # another halo mesh)
+            from flow_updating_tpu.parallel import sharded
+
+            canon = sharded.gather_full_state(
+                self.state, self._halo_plan, self.topology)
+            save_checkpoint(
+                path, canon, self.config, topo=self.topology,
+                extra={"clock": self._clock, "killed": self._killed},
+            )
+            return self
         if self._custom_actor is not None:
             from flow_updating_tpu.utils.checkpoint import (
                 save_actor_checkpoint,
@@ -569,9 +578,17 @@ class Engine:
         from flow_updating_tpu.utils.checkpoint import load_checkpoint
 
         if self._halo_mode:
-            raise NotImplementedError(
-                "restoring into the halo kernel's layout is not "
-                "supported yet")
+            from flow_updating_tpu.parallel import sharded
+
+            self._resolve_topology()
+            state, cfg, extra = load_checkpoint(path, topo=self.topology)
+            self.config = cfg
+            self._prepare_arrays()
+            self.state = sharded.scatter_full_state(
+                state, self._halo_plan, self.topology, cfg, self.mesh)
+            self._clock = float(extra.get("clock", float(state.t)))
+            self._killed = bool(extra.get("killed", False))
+            return self
         if self._custom_actor is not None:
             from flow_updating_tpu.utils.checkpoint import (
                 load_actor_checkpoint,

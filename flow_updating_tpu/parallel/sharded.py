@@ -118,6 +118,10 @@ class ShardPlan:
     num_colors: int = 0              # >0 iff built with coloring=True
     order: np.ndarray | None = None  # partition node order (new -> original
     #                                  id); None = identity (contiguous ids)
+    edge_shard: np.ndarray | None = None  # (E,) owner shard per (possibly
+    edge_slot: np.ndarray | None = None   # reordered) global edge + slot —
+    #                                  the blocked-layout <-> global edge
+    #                                  bijection (checkpoint gather/scatter)
 
     @property
     def cut_fraction(self) -> float:
@@ -308,6 +312,8 @@ def plan_sharding(topo: Topology, num_shards: int,
         halo=halo, values=values, alive0=alive0,
         perm_offsets=tuple(offsets), perm_tables=perm_tables, order=order,
         num_colors=num_colors,
+        edge_shard=owner_shard.astype(np.int32),
+        edge_slot=owner_pos.astype(np.int32),
     )
 
 
@@ -637,3 +643,144 @@ def _unpermute(x: np.ndarray, plan: ShardPlan) -> np.ndarray:
     out = np.empty_like(x)
     out[plan.order] = x
     return out
+
+
+def _edge_map_to_original(plan: ShardPlan, orig_topo) -> np.ndarray:
+    """(E,) map: ORIGINAL edge index -> index into the plan's (possibly
+    BFS-reordered) global edge order.  Identity when no reorder."""
+    if plan.order is None:
+        return np.arange(plan.topo.num_edges, dtype=np.int64)
+    # reordered edge r = (src', dst') is original pair
+    # (order[src'], order[dst']); locate it in the original sorted list
+    rt, ot = plan.topo, orig_topo
+    o_src = plan.order[rt.src.astype(np.int64)]
+    o_dst = plan.order[rt.dst.astype(np.int64)]
+    keys = ot.src.astype(np.int64) * ot.num_nodes + ot.dst
+    want = o_src * ot.num_nodes + o_dst
+    pos = np.searchsorted(keys, want)
+    # clip before the equality probe: an out-of-range key must surface as
+    # the diagnostic below, not an IndexError
+    probe = np.minimum(pos, len(keys) - 1)
+    if not np.array_equal(keys[probe], want):
+        raise ValueError("plan topology is not a renumbering of the "
+                         "original (edge sets differ)")
+    # pos[r] = original index of reordered edge r; invert
+    inv = np.empty_like(pos)
+    inv[pos] = np.arange(len(pos), dtype=np.int64)
+    return inv
+
+
+def gather_full_state(state: FlowUpdatingState, plan: ShardPlan,
+                      orig_topo) -> FlowUpdatingState:
+    """The blocked (S, .) halo state as a CANONICAL single-device
+    :class:`FlowUpdatingState` in ``orig_topo``'s node/edge order — the
+    layout ``init_state`` produces, so the result checkpoints and
+    restores through the standard path (and can resume on any execution
+    mode).  The PRNG key collapses to shard 0's (drop-rate streams are
+    not bit-continued across layouts)."""
+    import jax
+
+    if plan.edge_shard is None:
+        raise ValueError("plan lacks the edge ownership map")
+    e_of_orig = _edge_map_to_original(plan, orig_topo)
+    es = plan.edge_shard[e_of_orig]
+    ep = plan.edge_slot[e_of_orig]
+    host = jax.device_get(state)
+
+    def edge(x):          # (S, Eb) -> (E,) original order
+        return np.asarray(x)[es, ep]
+
+    def edge_planes(x):   # (S, K, Eb) -> (K, E)
+        return np.asarray(x)[es, :, ep].T
+
+    def node(x):
+        return gather_node_array(x, plan)
+
+    return FlowUpdatingState(
+        t=np.asarray(host.t).ravel()[0],
+        value=node(host.value),
+        flow=edge(host.flow),
+        est=edge(host.est),
+        recv=edge(host.recv),
+        ticks=node(host.ticks),
+        stamp=edge(host.stamp),
+        last_avg=node(host.last_avg),
+        fired=node(host.fired),
+        alive=node(host.alive),
+        edge_ok=edge(host.edge_ok),
+        pending_flow=edge_planes(host.pending_flow),
+        pending_est=edge_planes(host.pending_est),
+        pending_valid=edge_planes(host.pending_valid),
+        pending_stamp=edge_planes(host.pending_stamp),
+        buf_flow=edge_planes(host.buf_flow),
+        buf_est=edge_planes(host.buf_est),
+        buf_valid=edge_planes(host.buf_valid),
+        key=np.asarray(host.key)[0],
+    )
+
+
+def scatter_full_state(state: FlowUpdatingState, plan: ShardPlan,
+                       orig_topo, cfg: RoundConfig,
+                       mesh: jax.sharding.Mesh) -> FlowUpdatingState:
+    """Inverse of :func:`gather_full_state`: distribute a canonical
+    single-device state into the plan's blocked layout (device-placed).
+    Padding slots take the fresh-init values (dead dummies, zero
+    ledgers)."""
+    import jax
+
+    template = jax.device_get(init_plan_state(plan, cfg, mesh))
+    e_of_orig = _edge_map_to_original(plan, orig_topo)
+    es = plan.edge_shard[e_of_orig]
+    ep = plan.edge_slot[e_of_orig]
+    S, cap = plan.num_shards, plan.cap
+    N = orig_topo.num_nodes
+    # node arrays: original order -> partition order -> (S, cap) blocks
+    norder = (plan.order if plan.order is not None
+              else np.arange(N, dtype=np.int64))
+
+    def node(canon, tmpl):
+        out = np.array(tmpl)
+        flat = np.asarray(canon)[norder]           # partition order
+        pad = np.zeros(S * cap - N, flat.dtype)
+        out[:, :cap] = np.concatenate([flat, pad]).reshape(S, cap)
+        return out
+
+    def edge(canon, tmpl):
+        out = np.array(tmpl)
+        out[es, ep] = np.asarray(canon)
+        return out
+
+    def edge_planes(canon, tmpl):
+        out = np.array(tmpl)
+        out[es, :, ep] = np.asarray(canon).T
+        return out
+
+    new = FlowUpdatingState(
+        t=np.full((S,), int(np.asarray(state.t)), np.int32),
+        value=node(state.value, template.value),
+        flow=edge(state.flow, template.flow),
+        est=edge(state.est, template.est),
+        recv=edge(state.recv, template.recv),
+        ticks=node(state.ticks, template.ticks),
+        stamp=edge(state.stamp, template.stamp),
+        last_avg=node(state.last_avg, template.last_avg),
+        fired=node(state.fired, template.fired),
+        alive=node(state.alive, template.alive),
+        edge_ok=edge(state.edge_ok, template.edge_ok),
+        pending_flow=edge_planes(state.pending_flow, template.pending_flow),
+        pending_est=edge_planes(state.pending_est, template.pending_est),
+        pending_valid=edge_planes(state.pending_valid,
+                                  template.pending_valid),
+        pending_stamp=edge_planes(state.pending_stamp,
+                                  template.pending_stamp),
+        buf_flow=edge_planes(state.buf_flow, template.buf_flow),
+        buf_est=edge_planes(state.buf_est, template.buf_est),
+        buf_valid=edge_planes(state.buf_valid, template.buf_valid),
+        # per-shard independent streams, like init_plan_state: tiling the
+        # single key would correlate every shard's stochastic decisions
+        key=np.asarray(jax.vmap(
+            lambda i: jax.random.fold_in(
+                jnp.asarray(state.key, jnp.uint32), i)
+        )(jnp.arange(S))),
+    )
+    return jax.device_put(new, _sharding_tree(new, mesh))

@@ -172,35 +172,46 @@ def child(n_devices: int) -> None:
                 "hlo_collective_bytes": hlo_collective_bytes(hlo),
             })
 
-        # -- shard_map halo kernel (edge state), both exchanges ---------
+        # -- shard_map halo kernel (edge state), both exchanges, both
+        #    fast protocol modes (collect-all messages; pairwise's direct
+        #    endpoint-estimate exchange) -------------------------------
         if mesh is not None:
-            ref_state = init_state(topo, cfg)
-            ref_arrays = topo.device_arrays(coloring=cfg.needs_coloring)
-            eref = np.asarray(node_estimates(
-                run_rounds(ref_state, ref_arrays, cfg, 4), ref_arrays))
-            plan = sharded.plan_sharding(topo, S, partition="bfs")
-            planned = plan.collective_bytes_per_round()
-            for halo in ("ppermute", "allgather"):
-                st = sharded.init_plan_state(plan, cfg, mesh)
+            for pcfg, pname in (
+                (cfg, ""),
+                (RoundConfig.fast(variant="pairwise"), "_fastpair"),
+            ):
+                ref_state = init_state(topo, pcfg)
+                ref_arrays = topo.device_arrays(
+                    coloring=pcfg.needs_coloring)
+                eref = np.asarray(node_estimates(
+                    run_rounds(ref_state, ref_arrays, pcfg, 4),
+                    ref_arrays))
+                plan = sharded.plan_sharding(
+                    topo, S, partition="bfs",
+                    coloring=pcfg.needs_coloring)
+                planned = plan.collective_bytes_per_round()
+                for halo in ("ppermute", "allgather"):
+                    st = sharded.init_plan_state(plan, pcfg, mesh)
 
-                def run(s, n, _h=halo):
-                    return sharded.run_rounds_sharded(
-                        s, plan, cfg, mesh, n, halo=_h)
+                    def run(s, n, _h=halo, _c=pcfg, _p=plan):
+                        return sharded.run_rounds_sharded(
+                            s, _p, _c, mesh, n, halo=_h)
 
-                spr = _time_scan(run, st, 8)
-                hlo = (jax.jit(lambda s: run(s, 8))
-                       .lower(st).compile().as_text())
-                est = sharded.gather_estimates(run(st, 4), plan)
-                np.testing.assert_allclose(est, eref, atol=1e-5)
-                results.append({
-                    "path": f"halo_{halo}", "topology": tname, "shards": S,
-                    "rounds_per_sec": round(1.0 / spr, 2),
-                    "hlo_collective_bytes": hlo_collective_bytes(hlo),
-                    "planned_bytes": {
-                        "per_round": planned[f"{halo}_bytes"],
-                        "cut_fraction": planned["cut_fraction"],
-                    },
-                })
+                    spr = _time_scan(run, st, 8)
+                    hlo = (jax.jit(lambda s: run(s, 8))
+                           .lower(st).compile().as_text())
+                    est = sharded.gather_estimates(run(st, 4), plan)
+                    np.testing.assert_allclose(est, eref, atol=1e-5)
+                    results.append({
+                        "path": f"halo_{halo}{pname}", "topology": tname,
+                        "shards": S,
+                        "rounds_per_sec": round(1.0 / spr, 2),
+                        "hlo_collective_bytes": hlo_collective_bytes(hlo),
+                        "planned_bytes": {
+                            "per_round": planned[f"{halo}_bytes"],
+                            "cut_fraction": planned["cut_fraction"],
+                        },
+                    })
 
     print("RESULTS " + json.dumps(results))
 

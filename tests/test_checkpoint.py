@@ -163,3 +163,56 @@ def test_revive_in_session(small6):
     e.add_watcher(run_until=400.0, time_interval=100.0)
     e.run_until(400.0)
     assert int(e.state.t) == 400
+
+
+def test_halo_mode_checkpoint_is_canonical_and_cross_restorable():
+    """Halo-mode checkpoints gather to the canonical single-device layout:
+    save under the halo engine, restore (a) into a fresh halo engine —
+    estimates bit-equal — and (b) into a SINGLE-DEVICE engine, which then
+    continues the run (cross-mode resume)."""
+    import jax
+    import pytest as _pytest
+
+    if jax.device_count() < 8:
+        _pytest.skip("needs the 8-device CPU mesh")
+    import tempfile
+
+    import numpy as np
+
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.parallel.mesh import make_mesh
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    e = Engine(config=cfg, mesh=make_mesh(8), multichip="halo")
+    e.set_topology(topo).register_actor("peer")
+    e.build()
+    e.run_rounds(23)
+    ref_est = e.estimates()
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/halo.npz"
+        e.save_checkpoint(path)
+
+        # (a) fresh halo engine (different partition to prove layout
+        # independence), restore, bit-equal estimates, keeps running
+        e2 = Engine(config=cfg, mesh=make_mesh(8), multichip="halo",
+                    partition="contiguous")
+        e2.set_topology(topo).register_actor("peer")
+        e2.restore_checkpoint(path)
+        # the STATE round-trips bit-exactly; estimates are a DERIVED sum
+        # whose association differs per layout (per-shard partials vs a
+        # flat reduction) — one ulp of slack, nothing more
+        np.testing.assert_allclose(e2.estimates(), ref_est, atol=1e-12)
+        e2.run_rounds(40)
+
+        # (b) single-device engine restores the same file and continues
+        e3 = Engine(config=cfg)
+        e3.set_topology(topo).register_actor("peer")
+        e3.restore_checkpoint(path)
+        np.testing.assert_allclose(e3.estimates(), ref_est, atol=1e-12)
+        e3.run_rounds(40)
+        # both continuations converge onto the same trajectory
+        np.testing.assert_allclose(e2.estimates(), e3.estimates(),
+                                   atol=1e-9)
