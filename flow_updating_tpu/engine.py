@@ -200,7 +200,7 @@ class Engine:
                                             mesh=self.mesh)
             self._topo_arrays = None
             return
-        if self.mesh is not None and self.multichip == "halo":
+        if self._halo_mode:
             if self.config.kernel == "node":
                 raise ValueError(
                     "multichip='halo' drives the edge kernel "

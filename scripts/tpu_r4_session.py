@@ -86,18 +86,14 @@ def _bank(path: str, payload) -> None:
 
 
 def probe() -> bool:
-    try:
-        p = subprocess.run(
-            [PY, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=290)
-    except subprocess.TimeoutExpired:
-        print("probe: still wedged (290s)", flush=True)
-        return False
-    plat = (p.stdout.split() or [""])[-1]
-    ok = p.returncode == 0 and plat in ("tpu", "axon")
-    print(f"probe: rc={p.returncode} platform={plat!r} -> "
-          f"{'LIVE' if ok else 'not a TPU'}", flush=True)
-    return ok
+    # one probe implementation for the whole repo: bench.py's subprocess
+    # probe (290 s budget, wedge-safe, reads the final stdout token)
+    sys.path.insert(0, REPO)
+    from bench import _probe_tpu
+
+    status, detail = _probe_tpu()
+    print(f"probe: {status} ({detail})", flush=True)
+    return status == "ok"
 
 
 def main() -> int:

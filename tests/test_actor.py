@@ -17,7 +17,6 @@ from flow_updating_tpu.models.actor import (
     VectorActor,
     push_sum_actor,
 )
-from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.topology.graph import build_topology
 
 

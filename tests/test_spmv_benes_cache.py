@@ -3,7 +3,6 @@
 sessions run several processes on one topology)."""
 
 import numpy as np
-import pytest
 
 import flow_updating_tpu.ops.spmv_benes as sb
 from flow_updating_tpu.models import sync
