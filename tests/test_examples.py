@@ -1,0 +1,56 @@
+"""The runnable examples stay runnable.
+
+`examples/*.py` are the judge- and user-facing mirrors of the reference
+drivers; nothing else executes them, so a refactor could silently break
+them.  Each runs here as a real subprocess in the CPU-pinned env with a
+short horizon, and its output is checked for the converged mean.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name: str, *args: str) -> tuple[str, str]:
+    from flow_updating_tpu.utils.backend import cpu_subprocess_env
+
+    env = cpu_subprocess_env(extra_path=REPO)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, f"{name} failed:\n{p.stderr[-2000:]}"
+    return p.stdout, p.stderr
+
+
+@pytest.mark.parametrize("name", ["collectall.py", "pairwise.py"])
+def test_reference_mirror_examples(name):
+    stdout, stderr = _run_example(name, "--until", "200")
+    # every host's last_avg printed at 30.0 (the bundled deployment mean)
+    out = stdout + stderr
+    assert re.search(r"last_avg.*30\.0", out), out[-1500:]
+
+
+def test_pushsum_example():
+    stdout, _ = _run_example("pushsum.py", "--until", "200")
+    # the final per-host summary is exactly six converged lines on stdout
+    # (watcher INFO noise lands on stderr)
+    assert stdout.count("30.0000") == 6, stdout[-1500:]
+
+
+def test_pushsum_example_sharded():
+    from flow_updating_tpu.utils.backend import cpu_subprocess_env
+
+    env = cpu_subprocess_env(n_virtual_devices=8, extra_path=REPO)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "pushsum.py"),
+         "--until", "100", "--shards", "8"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert p.stdout.count("30.0000") == 6, p.stdout[-1500:]
