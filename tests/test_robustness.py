@@ -127,3 +127,15 @@ def test_sharded_halo_long_horizon_invariants():
     assert abs(est.sum() - topo.values.sum()) / abs(
         topo.values.sum()) < 1e-12
     assert np.abs(est - topo.true_mean).max() < 1e-9
+
+
+def test_count_aggregate_on_faithful_kernel():
+    """The aggregate derivations hold on the faithful asynchronous
+    dynamics too (slower mixing — needs the longer horizon)."""
+    from flow_updating_tpu.models.aggregates import estimate_count
+
+    topo = erdos_renyi(64, avg_degree=6.0, seed=1)
+    cfg = RoundConfig.reference(variant="collectall", delay_depth=2,
+                                dtype="float64")
+    n_est = estimate_count(topo, cfg=cfg, rounds=1500)
+    np.testing.assert_allclose(n_est, 64.0, rtol=1e-4)
