@@ -21,7 +21,9 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import re
 import os
 import subprocess
 import sys
@@ -450,6 +452,38 @@ def _probe_tpu(timeout_s: float = 290.0):
     return ("ok", plat) if plat in ("tpu", "axon") else ("other", plat)
 
 
+def _live_tpu_of_record() -> dict | None:
+    """Best banked live-TPU headline-scale measurement (microbench session
+    artifact), so a tunnel-wedged CPU fallback still carries the verified
+    TPU number with its provenance instead of losing it to the wedge."""
+    def _round_no(path):
+        m = re.search(r"_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    arts = sorted(glob.glob(os.path.join(REPO, "MICROBENCH_TPU_r*.json")),
+                  key=_round_no, reverse=True)
+    for art_path in arts:   # newest round first; skip unparsable/old-schema
+        try:
+            with open(art_path) as f:
+                row = json.load(f)["micro160"]["rows"][0]
+            paths = {n: v for n, v in row.items() if isinstance(v, dict)
+                     and "rounds_per_sec" in v}
+            name, best = max(paths.items(),
+                             key=lambda kv: kv[1]["rounds_per_sec"])
+            rps = best["rounds_per_sec"]
+            base = recorded_baseline(int(row["k"]))
+            return {
+                "artifact": os.path.basename(art_path),
+                "nodes": row["nodes"],
+                "spmv": name,
+                "rounds_per_sec": round(rps, 2),
+                "vs_baseline": round(rps / base, 2) if base else None,
+            }
+        except (OSError, KeyError, ValueError, IndexError, TypeError):
+            continue
+    return None
+
+
 def _run_child(extra_args, cpu_pinned: bool, timeout_s: float = 5400.0):
     """Re-exec this script with a settled backend, capturing its output.
 
@@ -577,6 +611,9 @@ def main():
         result["ok"] = False
         result["degraded"] = "tpu_unavailable_cpu_fallback"
         result.setdefault("extra", {})["tpu_failure"] = tpu_failure
+        live = _live_tpu_of_record()
+        if live:
+            result["extra"]["verified_tpu_of_record"] = live
         print(json.dumps(result))
         return
 

@@ -1,0 +1,47 @@
+"""bench.py fallback provenance (round 4).
+
+The axon tunnel can wedge between a live measurement session and the
+driver's end-of-round ``bench.py`` run; the CPU-fallback JSON must then
+carry the banked live-TPU number of record (``MICROBENCH_TPU_r4.json``)
+so a degraded run never silently loses the verified headline.  The
+reference has no analogue (it publishes no numbers — SURVEY.md §6);
+this guards the framework's own honest-reporting contract (ADVICE r2).
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_live_tpu_of_record_shape(bench):
+    live = bench._live_tpu_of_record()
+    if live is None:
+        pytest.skip("no live-TPU artifact banked in this checkout")
+    # pin the concrete values of the checked-in r4 artifact so a
+    # selection-logic regression (wrong path, wrong key) fails loudly;
+    # a later round banking a new artifact updates these on purpose
+    if live["artifact"] == "MICROBENCH_TPU_r4.json":
+        assert live["spmv"] == "benes_fused"
+        assert live["rounds_per_sec"] == 281.48
+        assert live["nodes"] == 1056000
+        assert live["vs_baseline"] == 162.71
+    else:  # artifact from a newer round: structural checks only
+        assert live["rounds_per_sec"] > 0
+        assert live["nodes"] > 0
+
+
+def test_live_tpu_of_record_missing_artifact(bench, monkeypatch):
+    monkeypatch.setattr(bench, "REPO", "/nonexistent")
+    assert bench._live_tpu_of_record() is None
