@@ -27,7 +27,12 @@ from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.state import FlowUpdatingState, init_state
 from flow_updating_tpu.models.rounds import round_step, run_rounds, node_estimates
 from flow_updating_tpu.engine import Engine
-from flow_updating_tpu.models.aggregates import estimate_count, estimate_sum
+from flow_updating_tpu.models.aggregates import (
+    estimate_count,
+    estimate_max,
+    estimate_min,
+    estimate_sum,
+)
 from flow_updating_tpu.models.actor import (
     TopoView,
     VectorActor,
@@ -48,6 +53,8 @@ __all__ = [
     "TopoView",
     "push_sum_actor",
     "estimate_count",
+    "estimate_max",
+    "estimate_min",
     "estimate_sum",
     "__version__",
 ]

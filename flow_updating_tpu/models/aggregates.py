@@ -1,4 +1,4 @@
-"""Derived aggregates on top of the mean kernel: COUNT and SUM.
+"""Derived aggregates on top of the mean kernel: COUNT, SUM, MIN, MAX.
 
 The reference estimates only the average.  The Flow-Updating literature
 (Jesus/Baquero/Almeida) derives the other classical gossip aggregates
@@ -13,12 +13,22 @@ kernels take arbitrary per-node inputs:
   network is a content-keyed cache hit (``ops/spmv_benes``); the ELL
   layout and jit programs are rebuilt per run (values differ).
 
+* **min / max**: extrema propagation — each round every node keeps the
+  extremum of itself and its neighbors.  Unlike the mean family this is
+  *exact* after (eccentricity) rounds, not an estimate; the fixed point
+  is detected on device and the loop stops there (``lax.while_loop``,
+  bounded by N rounds).  This completes the classical gossip aggregate
+  suite (Jesus/Baquero/Almeida survey: AVG / COUNT / SUM / MIN / MAX).
+
 These are estimates with the same convergence behavior as the underlying
-mean; run enough rounds for the topology's mixing time (the ``rmse``
-from a mean run is the natural stopping signal).
+mean (min/max excepted — exact at the fixed point); run enough rounds
+for the topology's mixing time (the ``rmse`` from a mean run is the
+natural stopping signal).
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import numpy as np
 
@@ -64,3 +74,71 @@ def estimate_sum(topo, cfg: RoundConfig | None = None,
     cfg = cfg or RoundConfig.fast(variant="collectall", kernel="node")
     mean = _mean_estimates(topo, cfg, rounds)
     return mean * estimate_count(topo, cfg, rounds, root)
+
+
+def _propagate_jit(mode: str):
+    """Module-level jitted propagation loop (one cached program per
+    (mode, shapes, n) — repeat calls retrace nothing)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from flow_updating_tpu.ops.segment import segment_max, segment_min
+
+    seg = segment_min if mode == "min" else segment_max
+    comb = jnp.minimum if mode == "min" else jnp.maximum
+
+    @partial(jax.jit, static_argnames=("n",))
+    def run(x0, src, dst, n):
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < n)
+
+        def body(carry):
+            x, _, it = carry
+            # gather each edge's value at its dst endpoint and reduce
+            # over the sorted src axis (the repo's sorted-segment
+            # convention, ops/segment.py); symmetrized edges make this
+            # identical to reducing src values over dst.  Empty segments
+            # fill with the reduce identity (+/-inf), which comb() then
+            # ignores — isolated nodes keep their own value
+            xn = comb(x, seg(x[dst], src, num_segments=n))
+            return xn, jnp.any(xn != x), it + 1
+
+        out, _, _ = lax.while_loop(
+            cond, body, (x0, jnp.asarray(True), jnp.asarray(0)))
+        return out
+
+    return run
+
+
+def _propagate_extremum(topo, mode: str) -> np.ndarray:
+    """Exact extrema propagation to the fixed point (<= N rounds, stops
+    at the first unchanged round — i.e. after eccentricity+1 rounds).
+
+    One round = a neighbor gather + segment reduce; this is the same
+    O(E) edge traversal as one mean round, but runs only
+    ``diameter+1`` times, so the plain XLA gather is the right tool
+    (no permutation network needed for a cold path this short).
+    """
+    import jax.numpy as jnp
+
+    run = _PROPAGATE.setdefault(mode, _propagate_jit(mode))
+    out = run(jnp.asarray(topo.values), jnp.asarray(topo.src),
+              jnp.asarray(topo.dst), topo.num_nodes)
+    return np.asarray(out)
+
+
+_PROPAGATE: dict = {}
+
+
+def estimate_min(topo) -> np.ndarray:
+    """Per-node global minimum — exact once propagation reaches the
+    fixed point (per connected component)."""
+    return _propagate_extremum(topo, "min")
+
+
+def estimate_max(topo) -> np.ndarray:
+    """Per-node global maximum — exact once propagation reaches the
+    fixed point (per connected component)."""
+    return _propagate_extremum(topo, "max")

@@ -106,6 +106,43 @@ def test_count_and_sum_aggregates():
     np.testing.assert_allclose(s_est, topo.values.sum(), rtol=1e-3)
 
 
+def test_min_max_aggregates():
+    """MIN/MAX via extrema propagation (models/aggregates.py): exact at
+    the fixed point, reached in eccentricity rounds, stopping on device
+    when a round changes nothing."""
+    from flow_updating_tpu.models.aggregates import (
+        estimate_max,
+        estimate_min,
+    )
+
+    topo = erdos_renyi(256, avg_degree=8.0, seed=4)
+    # propagation copies values verbatim (in the run dtype — f64 under
+    # the suite's x64 mode), so the result is bit-equal to the extremum
+    # of the inputs cast to that dtype
+    lo = estimate_min(topo)
+    hi = estimate_max(topo)
+    np.testing.assert_array_equal(
+        lo, np.full(256, topo.values.astype(lo.dtype).min()))
+    np.testing.assert_array_equal(
+        hi, np.full(256, topo.values.astype(hi.dtype).max()))
+
+
+def test_min_max_disconnected_components():
+    """On a disconnected graph every node converges to its *component's*
+    extremum — propagation cannot leak across components, and an
+    isolated node keeps its own value (mirrors the disconnected-mean
+    tests above)."""
+    from flow_updating_tpu.models.aggregates import estimate_max, estimate_min
+
+    topo, _ = _disconnected()
+    np.testing.assert_array_equal(
+        estimate_min(topo),
+        np.float32([3.0, 3.0, 3.0, 10.0, 10.0, 10.0, 99.0]))
+    np.testing.assert_array_equal(
+        estimate_max(topo),
+        np.float32([9.0, 9.0, 9.0, 30.0, 30.0, 30.0, 99.0]))
+
+
 def test_sharded_halo_long_horizon_invariants():
     """2k rounds through the shard_map halo kernel (ppermute): mass and
     antisymmetry must hold at the end, not just over the short parity
