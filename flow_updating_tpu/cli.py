@@ -185,8 +185,14 @@ def cmd_run(args) -> int:
 
     import jax
 
+    until_rmse_result = None
     with trace(args.profile):
-        if args.stream:
+        if args.until_rmse is not None:
+            until_rmse_result = engine.run_until_rmse(
+                args.until_rmse, max_rounds=args.max_rounds)
+            if event_log:
+                event_log.emit("until_rmse", **until_rmse_result)
+        elif args.stream:
             emit = None
             if event_log:
                 emit = lambda m: event_log.emit("watch", **m)
@@ -223,6 +229,8 @@ def cmd_run(args) -> int:
         jax.effects_barrier()
 
     report = engine.convergence_report()
+    if until_rmse_result is not None:
+        report["until_rmse"] = until_rmse_result
     report["true_mean"] = engine.topology.true_mean
     report["nodes"] = engine.topology.num_nodes
     report["edges"] = engine.topology.num_edges
@@ -366,6 +374,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="per-message loss probability (fault injection)")
     run.add_argument("--rounds", type=int, default=None,
                      help="run exactly N rounds (no watcher)")
+    run.add_argument("--until-rmse", type=float, default=None,
+                     metavar="THRESH",
+                     help="run until estimate RMSE <= THRESH (chunked "
+                          "compiled launches; overrides --rounds/--until)")
+    run.add_argument("--max-rounds", type=int, default=100_000,
+                     help="round budget for --until-rmse")
     run.add_argument("--until", type=float, default=1000.0,
                      help="watcher horizon in simulated seconds "
                           "(reference: 1000)")

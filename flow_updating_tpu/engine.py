@@ -686,6 +686,51 @@ class Engine:
         self._clock += n * TICK_INTERVAL
         return self
 
+    def run_until_rmse(
+        self, threshold: float, max_rounds: int = 100_000,
+        chunk: int = 64,
+    ) -> dict:
+        """Advance until the estimate RMSE vs the true mean is at or
+        below ``threshold`` (the driver contract SURVEY §7 step 3 names
+        ``run(rounds | until_rmse)``; the threshold metric is
+        BASELINE.json's rounds-to-RMSE).  State advances in compiled
+        ``chunk``-round launches with one device→host RMSE check between
+        launches, so the convergence test never enters the jitted
+        program (no data-dependent control flow under jit).
+
+        Returns ``{"rounds", "t", "rmse", "converged"}`` — ``rounds`` is
+        the number executed by THIS call.  The RMSE is measured against
+        the static deployment mean, so it is meaningful only while the
+        node population is intact (no ``kill_nodes`` churn); a churned
+        run should watch :meth:`convergence_report` directly instead.
+        """
+        if threshold <= 0:
+            raise ValueError("threshold must be positive")
+        if chunk <= 0:
+            raise ValueError("chunk must be positive")
+        if max_rounds < 0:
+            raise ValueError("max_rounds must be >= 0")
+        if self.state is None:
+            self.build()
+
+        def _rmse() -> float:
+            err = self.estimates() - self.topology.true_mean
+            return float(np.sqrt(np.mean(err * err)))
+
+        done = 0
+        rmse = _rmse()   # a state already at the threshold runs 0 rounds
+        while rmse > threshold and done < max_rounds and not self._killed:
+            take = min(int(chunk), max_rounds - done)
+            self.run_rounds(take)
+            done += take
+            rmse = _rmse()
+        return {
+            "rounds": done,
+            "t": int(np.asarray(self.state.t).ravel()[0]),
+            "rmse": rmse,
+            "converged": rmse <= threshold,
+        }
+
     def run_streamed(
         self, n: int, observe_every: int = 10, emit=None
     ) -> "Engine":

@@ -28,7 +28,7 @@ natural stopping signal).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -76,6 +76,7 @@ def estimate_sum(topo, cfg: RoundConfig | None = None,
     return mean * estimate_count(topo, cfg, rounds, root)
 
 
+@lru_cache(maxsize=None)
 def _propagate_jit(mode: str):
     """Module-level jitted propagation loop (one cached program per
     (mode, shapes, n) — repeat calls retrace nothing)."""
@@ -123,13 +124,10 @@ def _propagate_extremum(topo, mode: str) -> np.ndarray:
     """
     import jax.numpy as jnp
 
-    run = _PROPAGATE.setdefault(mode, _propagate_jit(mode))
+    run = _propagate_jit(mode)
     out = run(jnp.asarray(topo.values), jnp.asarray(topo.src),
               jnp.asarray(topo.dst), topo.num_nodes)
     return np.asarray(out)
-
-
-_PROPAGATE: dict = {}
 
 
 def estimate_min(topo) -> np.ndarray:

@@ -37,6 +37,18 @@ def test_run_reference_small6(capsys):
     assert abs(rep["mass_residual"]) < 0.1
 
 
+def test_run_until_rmse_flag(capsys):
+    rc, rep = _run(capsys, [
+        "run", "--backend", "cpu", "--generator", "ring:64:2",
+        "--fire-policy", "every_round", "--until-rmse", "1e-6",
+        "--max-rounds", "5000",
+    ])
+    assert rc == 0
+    assert rep["until_rmse"]["converged"]
+    assert rep["rmse"] <= 1e-6
+    assert rep["until_rmse"]["rounds"] <= 5000
+
+
 def test_run_fast_generator_rounds(capsys):
     rc, rep = _run(capsys, [
         "run", "--generator", "ring:64:2", "--fire-policy", "every_round",

@@ -47,6 +47,38 @@ def test_run_until_partial_horizon_executes_all_rounds():
     assert int(e.state.t) == 100
 
 
+def test_run_until_rmse_converges_and_reports():
+    """run_until_rmse (SURVEY §7 step 3: run(rounds | until_rmse)):
+    chunked advance to the threshold, honest report fields."""
+    # threshold sits above the f32 fixed-point floor for values ~30
+    # (~4e-6 on small6); 1e-6-level thresholds need unit-scale values
+    # (see the CLI test on ring:64)
+    e = _engine()
+    rep = e.run_until_rmse(1e-4, max_rounds=5000, chunk=32)
+    assert rep["converged"] and rep["rmse"] <= 1e-4
+    assert 0 < rep["rounds"] <= 5000 and rep["rounds"] % 32 == 0
+    assert rep["t"] == rep["rounds"]  # fresh engine: clock == rounds run
+    # already converged: the pre-loop RMSE check runs zero rounds
+    rep2 = e.run_until_rmse(1e-4, max_rounds=5000, chunk=32)
+    assert rep2["converged"] and rep2["rounds"] == 0
+
+
+def test_run_until_rmse_budget_exhaustion_is_honest():
+    e = _engine()
+    rep = e.run_until_rmse(1e-30, max_rounds=64, chunk=32)
+    assert not rep["converged"] and rep["rounds"] == 64
+
+
+def test_run_until_rmse_validates_args():
+    import pytest
+
+    e = _engine()
+    with pytest.raises(ValueError):
+        e.run_until_rmse(0.0)
+    with pytest.raises(ValueError):
+        e.run_until_rmse(1e-6, chunk=0)
+
+
 def test_watcher_callback_fires_once_at_coinciding_end():
     calls = []
     e = _engine()
