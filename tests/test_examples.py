@@ -36,6 +36,22 @@ def test_reference_mirror_examples(name):
     assert re.search(r"last_avg.*30\.0", out), out[-1500:]
 
 
+def test_aggregates_example():
+    stdout, _ = _run_example(
+        "aggregates.py", "--generator", "erdos_renyi:512",
+        "--rounds", "500")
+    # every aggregate line prints "NAME estimate (true X)": assert each
+    # estimate against the truth printed on its own line, not against
+    # RNG-stream-dependent constants
+    rows = re.findall(r"^(\w+)\s+([\d.]+)\s+\(true ([\d.]+)\)", stdout, re.M)
+    got = {k: float(v) for k, v, _ in rows}
+    true = {k: float(t) for k, _, t in rows}
+    assert set(true) == {"AVG", "COUNT", "SUM", "MIN", "MAX"}, stdout[-1500:]
+    for k in true:
+        tol = 1e-3 * max(1.0, abs(true[k]))
+        assert abs(got[k] - true[k]) <= tol, (k, got[k], true[k])
+
+
 def test_pushsum_example():
     stdout, _ = _run_example("pushsum.py", "--until", "200")
     # the final per-host summary is exactly six converged lines on stdout
