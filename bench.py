@@ -258,8 +258,12 @@ def recorded_baseline(k: int) -> float | None:
 
 def record_baseline(k: int, entry: dict) -> None:
     """Persist a measured DES baseline — but never replace a recorded entry
-    with a lower-quality one (fewer ticks x repeats; ADVICE r2 found a
-    2-tick sample silently overwriting a better measurement)."""
+    with a lower-quality one.  Quality is (ticks x repeats) first (ADVICE
+    r2: a 2-tick sample silently overwrote a better measurement), then
+    measured spread as the tiebreak: at equal counts, a noisier run must
+    not displace a cleaner one (round 4: a CPU fallback running alongside
+    a test suite re-measured k160 at spread 71% and halved the recorded
+    1.73 r/s baseline of record, inflating every vs_baseline ratio)."""
     data = {}
     try:
         with open(MEASURED_PATH) as f:
@@ -269,6 +273,10 @@ def record_baseline(k: int, entry: dict) -> None:
     old = data.get(f"k{k}", {}).get("des", {})
     quality = lambda d: d.get("ticks", 0) * d.get("repeats", 1)
     if quality(old) > quality(entry["des"]):
+        return
+    if (quality(old) == quality(entry["des"]) and old
+            and old.get("spread_pct", float("inf"))
+            <= entry["des"].get("spread_pct", float("inf"))):
         return
     data[f"k{k}"] = entry
     try:
@@ -466,6 +474,8 @@ def _live_tpu_of_record() -> dict | None:
         try:
             with open(art_path) as f:
                 row = json.load(f)["micro160"]["rows"][0]
+            if row.get("platform") != "tpu":   # never report CPU rows
+                continue                       # as a TPU number
             paths = {n: v for n, v in row.items() if isinstance(v, dict)
                      and "rounds_per_sec" in v}
             name, best = max(paths.items(),

@@ -96,10 +96,23 @@ def probe() -> bool:
     return status == "ok"
 
 
+ALL_STEPS = ("micro96", "micro160", "bench", "profile160", "micro40",
+             "edge96")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-probe", action="store_true")
+    ap.add_argument("--steps", default=",".join(ALL_STEPS),
+                    help="comma-separated subset to run (a follow-up "
+                         "contact after a mid-session wedge should skip "
+                         "the already-banked steps, e.g. "
+                         "--steps bench,profile160,micro40,edge96)")
     args = ap.parse_args()
+    steps = [s.strip() for s in args.steps.split(",") if s.strip()]
+    unknown = set(steps) - set(ALL_STEPS)
+    if unknown:
+        ap.error(f"unknown steps {sorted(unknown)}; have {ALL_STEPS}")
 
     if not args.skip_probe and not probe():
         return 3
@@ -107,58 +120,100 @@ def main() -> int:
     session: dict = {"started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                   time.gmtime()),
                      "steps": {}}
+    # a follow-up session merges into the already-banked artifact rather
+    # than discarding the earlier contact's measurements
+    micro_path = os.path.join(REPO, "MICROBENCH_TPU_r4.json")
+    if os.path.exists(micro_path):
+        try:
+            with open(micro_path) as f:
+                banked = json.load(f)
+            if isinstance(banked, dict):
+                session["steps"].update(banked)
+        except (OSError, json.JSONDecodeError):
+            pass
+
+    def _keep(step: str, record: dict, good: bool) -> None:
+        """Bank a step's result — but never let a failed or degraded
+        re-run clobber a previously banked success (the artifact carries
+        the round's verified numbers of record; see
+        bench._live_tpu_of_record)."""
+        prior = session["steps"].get(step)
+        if good or not prior:
+            session["steps"][step] = record
+        _bank("MICROBENCH_TPU_r4.json", session["steps"])
+
+    def _tpu_rows(rc: int, rows: list) -> bool:
+        """Microbench goodness: clean exit AND rows measured on the TPU
+        — a CPU-run microbench (silent backend fallback, --skip-probe
+        misuse) must not displace banked TPU rows."""
+        return rc == 0 and bool(rows) and all(
+            r.get("platform") == "tpu" for r in rows)
 
     # -- 1. canary at k=96 (retry once: transient helper SIGKILLs) -------
-    for attempt in (1, 2):
-        rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "96"],
-                       f"micro96_a{attempt}")
-        rows = _json_lines(out)
-        if rc == 0 and rows:
-            break
-    session["steps"]["micro96"] = {"rc": rc, "rows": rows}
-    _bank("MICROBENCH_TPU_r4.json", session["steps"])
-    if rc != 0 or not rows:  # rc=0 with no parseable rows proves nothing
-        print("canary failed twice — banking what exists and stopping "
-              "before a wedged tunnel eats the session", flush=True)
-        return 4
+    if "micro96" in steps:
+        for attempt in (1, 2):
+            rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "96"],
+                           f"micro96_a{attempt}")
+            rows = _json_lines(out)
+            if rc == 0 and rows:
+                break
+        _keep("micro96", {"rc": rc, "rows": rows}, _tpu_rows(rc, rows))
+        if rc != 0 or not rows:  # rc=0 with no rows proves nothing
+            print("canary failed twice — banking what exists and stopping "
+                  "before a wedged tunnel eats the session", flush=True)
+            return 4
 
     # -- 2. headline scale k=160 ----------------------------------------
-    rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "160"],
-                   "micro160")
-    session["steps"]["micro160"] = {"rc": rc, "rows": _json_lines(out)}
-    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+    if "micro160" in steps:
+        rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "160"],
+                       "micro160")
+        rows = _json_lines(out)
+        _keep("micro160", {"rc": rc, "rows": rows}, _tpu_rows(rc, rows))
 
     # -- 3. full headline bench -----------------------------------------
-    rc, out = _run([PY, "bench.py"], "bench")
-    rows = _json_lines(out)
-    if rows:
-        _bank("BENCH_TPU_r4.json", rows[-1])
-    session["steps"]["bench"] = {"rc": rc, "have_json": bool(rows)}
-    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+    if "bench" in steps:
+        rc, out = _run([PY, "bench.py"], "bench")
+        rows = _json_lines(out)
+        # only bank a live TPU result under the TPU artifact name; a
+        # CPU fallback (ok:false) must not shadow/claim the TPU slot
+        live = bool(rows) and rows[-1].get("backend") == "tpu" \
+            and bool(rows[-1].get("ok"))
+        if live:
+            _bank("BENCH_TPU_r4.json", rows[-1])
+        _keep("bench", {"rc": rc, "result": rows[-1] if rows else None},
+              live)
 
     # -- 4. per-round attribution ---------------------------------------
-    rc, out = _run([PY, "scripts/tpu_profile_round.py", "--k", "160"],
-                   "profile160")
-    session["steps"]["profile160"] = {"rc": rc, "rows": _json_lines(out)}
-    _bank("PROFILE_TPU_r4.json", session["steps"]["profile160"])
+    if "profile160" in steps:
+        rc, out = _run([PY, "scripts/tpu_profile_round.py", "--k", "160"],
+                       "profile160")
+        rows = _json_lines(out)
+        good = rc == 0 and bool(rows)
+        _keep("profile160", {"rc": rc, "rows": rows}, good)
+        if good or not os.path.exists(os.path.join(REPO,
+                                                   "PROFILE_TPU_r4.json")):
+            _bank("PROFILE_TPU_r4.json", session["steps"]["profile160"])
 
     # -- 5. small-scale compile row -------------------------------------
-    rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "40"],
-                   "micro40")
-    session["steps"]["micro40"] = {"rc": rc, "rows": _json_lines(out)}
-    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+    if "micro40" in steps:
+        rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "40"],
+                       "micro40")
+        rows = _json_lines(out)
+        _keep("micro40", {"rc": rc, "rows": rows}, _tpu_rows(rc, rows))
 
     # -- 6. faithful-path (edge kernel) secondary headline at k=96 ------
     # full async fidelity (1 msg/round drain, FIFO, timeouts) with the
     # fused delivery/segment circuits — never TPU-timed before r4
-    rc, out = _run([PY, "bench.py", "--kernel", "edge", "--fire-policy",
-                    "reference", "--fat-tree-k", "96", "--skip-des",
-                    "--skip-convergence"],
-                   "edge96")
-    rows = _json_lines(out)
-    session["steps"]["edge96"] = {"rc": rc,
-                                  "result": rows[-1] if rows else None}
-    _bank("MICROBENCH_TPU_r4.json", session["steps"])
+    if "edge96" in steps:
+        rc, out = _run([PY, "bench.py", "--kernel", "edge", "--fire-policy",
+                        "reference", "--fat-tree-k", "96", "--skip-des",
+                        "--skip-convergence"],
+                       "edge96")
+        rows = _json_lines(out)
+        live = bool(rows) and rows[-1].get("backend") == "tpu" \
+            and bool(rows[-1].get("ok"))
+        _keep("edge96", {"rc": rc, "result": rows[-1] if rows else None},
+              live)
 
     print("session complete", flush=True)
     return 0

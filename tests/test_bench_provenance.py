@@ -45,3 +45,36 @@ def test_live_tpu_of_record_shape(bench):
 def test_live_tpu_of_record_missing_artifact(bench, monkeypatch):
     monkeypatch.setattr(bench, "REPO", "/nonexistent")
     assert bench._live_tpu_of_record() is None
+
+
+def _entry(rps, ticks=10, repeats=3, spread=20.0):
+    return {"des_rounds_per_sec": rps, "nodes": 1, "edges": 1,
+            "des": {"rounds_per_sec": rps, "ticks": ticks,
+                    "repeats": repeats, "spread_pct": spread}}
+
+
+def test_record_baseline_quality_guards(bench, monkeypatch, tmp_path):
+    """A recorded baseline is only replaced by a measurement of strictly
+    higher quality: more ticks x repeats, or equal counts with LOWER
+    spread (round 4: a noisy CPU-contended fallback re-measurement must
+    not displace the clean baseline of record)."""
+    path = tmp_path / "measured.json"
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(path))
+
+    bench.record_baseline(160, _entry(1.73, spread=20.6))
+    assert bench.recorded_baseline(160) == 1.73
+    # equal counts, worse spread: rejected
+    bench.record_baseline(160, _entry(0.83, spread=71.2))
+    assert bench.recorded_baseline(160) == 1.73
+    # equal counts, equal spread: rejected (not strictly better)
+    bench.record_baseline(160, _entry(0.9, spread=20.6))
+    assert bench.recorded_baseline(160) == 1.73
+    # equal counts, better spread: accepted
+    bench.record_baseline(160, _entry(1.8, spread=5.0))
+    assert bench.recorded_baseline(160) == 1.8
+    # fewer ticks x repeats: rejected even with tiny spread
+    bench.record_baseline(160, _entry(2.5, ticks=2, repeats=1, spread=1.0))
+    assert bench.recorded_baseline(160) == 1.8
+    # more ticks x repeats: accepted regardless of spread
+    bench.record_baseline(160, _entry(1.6, ticks=20, repeats=3, spread=44.0))
+    assert bench.recorded_baseline(160) == 1.6
