@@ -302,12 +302,15 @@ def parse_args(argv=None):
                     help="fast-path kernel: node-collapsed SpMV recurrence "
                          "(models/sync.py) or the general edge kernel")
     ap.add_argument("--spmv", default="auto",
-                    choices=("auto", "xla", "pallas", "benes", "benes_fused"),
+                    choices=("auto", "xla", "pallas", "benes", "benes_fused",
+                             "structured"),
                     help="neighbor-sum implementation for --kernel node. "
                          "'auto': measure xla, and on TPU also the "
-                         "gather-free benes network (XLA's dynamic gather "
-                         "lowers to a scalar loop there — BENCH_NOTES.md), "
-                         "then headline the faster")
+                         "closed-form stencil (topologies with a structure "
+                         "descriptor) and the gather-free benes network "
+                         "(XLA's dynamic gather lowers to a scalar loop "
+                         "there — BENCH_NOTES.md), then headline the "
+                         "fastest")
     ap.add_argument("--segment", default="auto",
                     choices=("auto", "segment", "ell", "benes",
                              "benes_fused"),
@@ -349,9 +352,16 @@ def run_bench(args) -> dict:
             # recursion that takes hours, so skip it outright.
             from flow_updating_tpu import native
 
+            cands = []
+            if topo.structure is not None:
+                # the closed-form stencil: no routing plan at all, so it
+                # goes first — cheapest to measure, expected fastest
+                cands.append("structured")
             if native.available():
+                cands += ["benes_fused", "benes"]
+            if cands:
                 alt = {}
-                for cand in ("benes_fused", "benes"):
+                for cand in cands:
                     try:
                         got = measure_tpu(topo, args.rounds, kernel="node",
                                           spmv=cand)

@@ -313,10 +313,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "benes network as fused Pallas passes — the "
                           "fastest TPU form)")
     run.add_argument("--spmv", default="xla",
-                     choices=("xla", "pallas", "benes", "benes_fused"),
+                     choices=("xla", "pallas", "benes", "benes_fused",
+                              "structured"),
                      help="node-kernel neighbor-sum implementation "
                           "(benes_fused batches the permutation-network "
-                          "stages into Pallas HBM passes)")
+                          "stages into Pallas HBM passes; structured uses "
+                          "the generator's closed-form stencil — regular "
+                          "topologies only)")
     run.add_argument("--segment", default="auto",
                      choices=("auto", "segment", "ell", "benes",
                               "benes_fused"),

@@ -100,7 +100,11 @@ class RoundConfig:
     #                                    lowers to a scalar loop there) |
     #                                    'benes_fused' (same network, up
     #                                    to 32 stages per HBM pass via
-    #                                    Pallas, ops/pallas_fused.py)
+    #                                    Pallas, ops/pallas_fused.py) |
+    #                                    'structured' (closed-form stencil
+    #                                    for regular generator topologies,
+    #                                    ops/structured.py — requires
+    #                                    Topology.structure)
     segment_impl: str = "auto"         # edge-kernel per-node reductions:
     #                                    'segment' (jax.ops segment_* —
     #                                    scatter-based lowering) | 'ell'
@@ -137,7 +141,8 @@ class RoundConfig:
         if self.delivery not in ("gather", "scatter", "benes",
                                  "benes_fused"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
-        if self.spmv not in ("xla", "pallas", "benes", "benes_fused"):
+        if self.spmv not in ("xla", "pallas", "benes", "benes_fused",
+                             "structured"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
         if self.segment_impl not in ("auto", "segment", "ell", "benes",
                                      "benes_fused"):

@@ -69,6 +69,9 @@ class NodeSyncArrays:
     ns_masks: tuple = ()   # spmv='benes': permutation-network stage masks
     ns_plan: object = flax.struct.field(pytree_node=False, default=None)
     #                        static NeighborSumPlan (identity-hashed)
+    ns_struct: object = flax.struct.field(pytree_node=False, default=None)
+    #                        spmv='structured': closed-form adjacency
+    #                        descriptor (ops/structured.py; frozen+hashable)
 
 
 def _check_cfg(cfg: RoundConfig) -> None:
@@ -127,8 +130,12 @@ class NodeKernel:
             row_multiple = math.lcm(row_multiple, mesh.devices.size)
         self.row_multiple = row_multiple
         self.mesh = mesh
-        ell = topo.ell_buckets()
         dt = cfg.jnp_dtype
+        if cfg.spmv == "structured":
+            self._init_structured(topo, dt)
+            self._place_on_mesh()
+            return
+        ell = topo.ell_buckets()
 
         counts = [_ceil_to(c, row_multiple) for c in ell.row_counts]
         offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
@@ -175,19 +182,60 @@ class NodeKernel:
             ns_masks=ns_masks,
             ns_plan=ns_plan,
         )
-        if mesh is not None:
-            import jax.sharding as jsh
+        self._place_on_mesh()
 
-            ns = lambda spec: jsh.NamedSharding(mesh, spec)
-            from flow_updating_tpu.parallel.mesh import NODE_AXIS
-
-            ax = jsh.PartitionSpec(NODE_AXIS)
-            arrs_sh = NodeSyncArrays(
-                value=ns(ax), inv_depp1=ns(ax), deg=ns(ax),
-                mats=tuple(ns(jsh.PartitionSpec(NODE_AXIS, None))
-                           for _ in self.arrays.mats),
+    def _init_structured(self, topo: Topology, dt) -> None:
+        """spmv='structured': identity node order (no gather to bucket —
+        the ELL degree permutation would only obfuscate the stencil's
+        index arithmetic), padding appended at the tail."""
+        struct = topo.structure
+        if struct is None:
+            raise ValueError(
+                "spmv='structured' needs a topology whose generator "
+                "attached a closed-form adjacency descriptor (ring, "
+                "grid2d, complete, fat_tree); this topology has none — "
+                "use spmv='xla'|'benes'|'benes_fused'"
             )
-            self.arrays = jax.device_put(self.arrays, arrs_sh)
+        if struct.n != topo.num_nodes:
+            raise ValueError(
+                f"structure descriptor covers {struct.n} nodes but the "
+                f"topology has {topo.num_nodes}"
+            )
+        n = topo.num_nodes
+        self.padded_size = M = _ceil_to(n, self.row_multiple)
+        self._pos_of_real = np.arange(n, dtype=np.int64)
+        self._perm = np.arange(n, dtype=np.int64)
+        value = np.zeros(M, np.float64)
+        deg = np.zeros(M, np.float64)
+        value[:n] = topo.values
+        deg[:n] = topo.out_deg
+        self.arrays = NodeSyncArrays(
+            value=jnp.asarray(value, dt),
+            inv_depp1=jnp.asarray(1.0 / (deg + 1.0), dt),
+            deg=jnp.asarray(deg, dt),
+            mats=(),
+            ns_struct=struct,
+        )
+
+    def _place_on_mesh(self) -> None:
+        if self.mesh is None:
+            return
+        import jax.sharding as jsh
+
+        ns = lambda spec: jsh.NamedSharding(self.mesh, spec)
+        from flow_updating_tpu.parallel.mesh import NODE_AXIS
+
+        ax = jsh.PartitionSpec(NODE_AXIS)
+        arrs_sh = NodeSyncArrays(
+            value=ns(ax), inv_depp1=ns(ax), deg=ns(ax),
+            mats=tuple(ns(jsh.PartitionSpec(NODE_AXIS, None))
+                       for _ in self.arrays.mats),
+            ns_masks=tuple(ns(jsh.PartitionSpec())
+                           for _ in self.arrays.ns_masks),
+            ns_plan=self.arrays.ns_plan,
+            ns_struct=self.arrays.ns_struct,
+        )
+        self.arrays = jax.device_put(self.arrays, arrs_sh)
 
     def init_state(self) -> NodeSyncState:
         z = jnp.zeros((self.padded_size,), self.cfg.jnp_dtype)
@@ -255,6 +303,10 @@ def node_round_step(
         from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
 
         A_cur = neighbor_sum_benes(avg, arrs.ns_plan, arrs.ns_masks)
+    elif cfg.spmv == "structured":
+        from flow_updating_tpu.ops.structured import structured_neighbor_sum
+
+        A_cur = structured_neighbor_sum(avg, arrs.ns_struct)
     else:
         A_cur = neighbor_sum(avg, arrs.mats)
     S_next = -state.G - A_cur + arrs.deg * state.avg_prev

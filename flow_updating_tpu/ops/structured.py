@@ -1,0 +1,140 @@
+"""Closed-form neighbor sums for regular topologies — no gather at all.
+
+The node-collapsed fast kernel (``models/sync.py``) reduced the whole
+protocol round to one adjacency SpMV, and the permutation-network path
+(``ops/spmv_benes.py`` / ``ops/pallas_fused.py``) made that SpMV
+gather-free for *arbitrary* graphs — ~16 HBM passes at the 1M-node
+headline scale.  But the benchmark topologies themselves (BASELINE.json:
+fat-tree, ring; plus grid and complete) are *regular*: their adjacency
+is a product of index arithmetic, so A(x)[u] = Σ_{v∈N(u)} x[v] collapses
+to reshapes, rolls, broadcasts and small-axis reductions — a stencil,
+the shape TPUs were built for.  One or two streaming passes over HBM,
+zero stages, zero routing plan, zero plan/compile cost beyond XLA's
+normal fusion.
+
+This replaces the reference's per-message mailbox machinery
+(``/root/reference/flowupdating-collectall.py:66-85,116-125`` — one
+Python actor callback per message) with *the* idiomatic TPU form: the
+topology's generator proves its own structure at build time and the
+round kernel exploits it, the way a conv layer never materializes its
+im2col neighbor lists.
+
+Each descriptor is a frozen, hashable dataclass (jit-static) attached to
+:class:`~flow_updating_tpu.topology.graph.Topology.structure` by the
+generator that built the graph.  ``neighbor_sum`` takes and returns the
+``(n,)`` vector in ORIGINAL node order — the node kernel skips the ELL
+degree permutation entirely on this path (there is no gather to bucket
+for).  Exactness vs the generic gather form is asserted in
+``tests/test_structured.py`` for every descriptor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RingStruct:
+    """Ring lattice: i ~ i±1..±k (mod n).  A(x) = Σ_d roll(x,d)+roll(x,-d).
+
+    Only valid when ``n > 2k`` (below that the generator's declared edges
+    collapse under symmetrization-dedup and the roll form would double-
+    count); the generator enforces this before attaching.
+    """
+
+    n: int
+    k: int
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        acc = jnp.zeros_like(x)
+        for d in range(1, self.k + 1):
+            acc = acc + jnp.roll(x, d) + jnp.roll(x, -d)
+        return acc
+
+
+@dataclasses.dataclass(frozen=True)
+class Grid2dStruct:
+    """2-D grid, 4-neighborhood, non-periodic: pad-and-shift stencil."""
+
+    h: int
+    w: int
+
+    @property
+    def n(self) -> int:
+        return self.h * self.w
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        g = x.reshape(self.h, self.w)
+        acc = jnp.zeros_like(g)
+        if self.h > 1:
+            acc = acc.at[1:].add(g[:-1]).at[:-1].add(g[1:])
+        if self.w > 1:
+            acc = acc.at[:, 1:].add(g[:, :-1]).at[:, :-1].add(g[:, 1:])
+        return acc.reshape(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompleteStruct:
+    """Complete graph: A(x) = Σx − x.  One reduction, one subtract."""
+
+    n: int
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(x) - x
+
+
+@dataclasses.dataclass(frozen=True)
+class FatTreeStruct:
+    """Al-Fares k-ary fat-tree in the generator's node layout
+    (``topology/generators.py:fat_tree``): hosts ``(k, k/2, k/2)``,
+    edge switches ``(k, k/2)``, aggregation switches ``(k, k/2)``,
+    core switches ``(k/2, k/2)``, concatenated in that order.
+
+    Every adjacency class is a broadcast or a small-axis reduction:
+
+    * host (p,e,i)  ~ edge (p,e)                → broadcast
+    * edge (p,e)    ~ hosts (p,e,·) + aggs (p,·) → two row sums
+    * agg  (p,a)    ~ edges (p,·) + cores (a,·)  → two row sums
+    * core (a,c)    ~ aggs (·,a)                 → one column sum
+    """
+
+    k: int
+
+    @property
+    def half(self) -> int:
+        return self.k // 2
+
+    @property
+    def n(self) -> int:
+        return self.half * self.half * self.k + self.half * self.k * 2 \
+            + self.half * self.half
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        k, half = self.k, self.half
+        n_host = half * half * k
+        n_sw = half * k
+        xh = x[:n_host].reshape(k, half, half)
+        xe = x[n_host:n_host + n_sw].reshape(k, half)
+        xa = x[n_host + n_sw:n_host + 2 * n_sw].reshape(k, half)
+        xc = x[n_host + 2 * n_sw:].reshape(half, half)
+        a_host = jnp.broadcast_to(xe[:, :, None], (k, half, half))
+        a_edge = xh.sum(axis=2) + xa.sum(axis=1, keepdims=True)
+        a_agg = xe.sum(axis=1, keepdims=True) + xc.sum(axis=1)[None, :]
+        a_core = jnp.broadcast_to(xa.sum(axis=0)[:, None], (half, half))
+        return jnp.concatenate([
+            a_host.reshape(-1), a_edge.reshape(-1),
+            a_agg.reshape(-1), a_core.reshape(-1),
+        ])
+
+
+def structured_neighbor_sum(x: jnp.ndarray, struct) -> jnp.ndarray:
+    """Apply a structure descriptor to the first ``struct.n`` entries of a
+    (possibly padded) vector; padding slots get neighbor sum 0, matching
+    the generic path's zero-slot convention."""
+    n = struct.n
+    a = struct.neighbor_sum(x[:n])
+    if x.shape[0] == n:
+        return a
+    return jnp.concatenate([a, jnp.zeros((x.shape[0] - n,), x.dtype)])

@@ -12,8 +12,16 @@ large builds when available.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
+from flow_updating_tpu.ops.structured import (
+    CompleteStruct,
+    FatTreeStruct,
+    Grid2dStruct,
+    RingStruct,
+)
 from flow_updating_tpu.topology.graph import Topology, build_topology
 
 
@@ -31,7 +39,10 @@ def ring(n: int, k: int = 1, seed: int = 0, values=None) -> Topology:
     pairs = np.concatenate(
         [np.stack([i, (i + d) % n], axis=1) for d in range(1, k + 1)], axis=0
     )
-    return _finish(n, pairs, seed, values)
+    topo = _finish(n, pairs, seed, values)
+    if n > 2 * k:  # below this, symmetrization-dedup breaks the roll form
+        topo = dataclasses.replace(topo, structure=RingStruct(n=n, k=k))
+    return topo
 
 
 def grid2d(h: int, w: int, seed: int = 0, values=None) -> Topology:
@@ -39,12 +50,16 @@ def grid2d(h: int, w: int, seed: int = 0, values=None) -> Topology:
     idx = np.arange(h * w, dtype=np.int64).reshape(h, w)
     right = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
     down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
-    return _finish(h * w, np.concatenate([right, down]), seed, values)
+    topo = _finish(h * w, np.concatenate([right, down]), seed, values)
+    return dataclasses.replace(topo, structure=Grid2dStruct(h=h, w=w))
 
 
 def complete(n: int, seed: int = 0, values=None) -> Topology:
     i, j = np.triu_indices(n, k=1)
-    return _finish(n, np.stack([i, j], axis=1), seed, values)
+    topo = _finish(n, np.stack([i, j], axis=1), seed, values)
+    if n >= 2:
+        topo = dataclasses.replace(topo, structure=CompleteStruct(n=n))
+    return topo
 
 
 def erdos_renyi(n: int, avg_degree: float = 8.0, seed: int = 0, values=None) -> Topology:
@@ -156,7 +171,10 @@ def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True)
             # mean is then sum(host values) / all vertices, still a fixed
             # point of the same protocol.
             values[n_host:] = 0.0
-    return build_topology(n, pairs, values=values, seed=seed, warn_asymmetric=False)
+    topo = build_topology(
+        n, pairs, values=values, seed=seed, warn_asymmetric=False
+    )
+    return dataclasses.replace(topo, structure=FatTreeStruct(k=k))
 
 
 GENERATORS = {

@@ -67,6 +67,11 @@ class Topology:
     link_shared: np.ndarray | None = None    # (L,) bool — False = FATPIPE
     lat_rounds: np.ndarray | None = None     # (E,) f64 route latency in rounds
     #                                          (pre-scaled; no serialization)
+    structure: object | None = None          # closed-form adjacency descriptor
+    #                                          (ops/structured.py) attached by
+    #                                          regular-graph generators; lets
+    #                                          the node kernel compute A(x)
+    #                                          as a stencil (spmv='structured')
 
     @property
     def num_edges(self) -> int:

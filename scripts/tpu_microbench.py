@@ -101,7 +101,7 @@ def spmv(k: int) -> dict:
     topo = fat_tree(k, seed=0)
     out = {"k": k, "nodes": topo.num_nodes, "edges": topo.num_edges,
            "platform": jax.devices()[0].platform}
-    variants = ["xla"]
+    variants = ["xla", "structured"]
     if native.available():
         variants += ["benes", "benes_fused"]
     else:
