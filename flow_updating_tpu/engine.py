@@ -492,6 +492,7 @@ class Engine:
     def _edge_ids(self, links) -> np.ndarray:
         """Directed edge indices for (u, v) node pairs, both directions."""
         topo = self.topology
+        topo._require_edges("fail_links/heal_links (edge lookup)")
         keys = topo.src.astype(np.int64) * topo.num_nodes + topo.dst
         ids = []
         for u, v in links:

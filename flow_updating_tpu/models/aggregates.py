@@ -124,6 +124,7 @@ def _propagate_extremum(topo, mode: str) -> np.ndarray:
     """
     import jax.numpy as jnp
 
+    topo._require_edges(f"estimate_{mode} (extrema propagation)")
     run = _propagate_jit(mode)
     out = run(jnp.asarray(topo.values), jnp.asarray(topo.src),
               jnp.asarray(topo.dst), topo.num_nodes)

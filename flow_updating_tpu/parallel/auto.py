@@ -43,6 +43,7 @@ def pad_topology(topo: Topology, num_shards: int) -> tuple[Topology, int, int]:
     Returns (padded_topology, n_real, e_real).  Always pads at least one
     node so dummy edges can attach to a padded (never-firing) node.
     """
+    topo._require_edges("pad_topology (edge-kernel sharding)")
     N, E = topo.num_nodes, topo.num_edges
     Np = _ceil_to(N + 1, num_shards)
     Ep = _ceil_to(E, num_shards)
@@ -89,6 +90,9 @@ def pad_topology(topo: Topology, num_shards: int) -> tuple[Topology, int, int]:
         link_ser_rounds=None,
         link_shared=None,
         lat_rounds=None,
+        # a structure descriptor describes the UNpadded node set; carrying
+        # it through would only trip _init_structured's n-check downstream
+        structure=None,
     )
     return padded, N, E
 

@@ -171,6 +171,7 @@ def plan_sharding(topo: Topology, num_shards: int,
     Local node ``Nb-1`` of every shard is a dummy (dead, value 0) that owns
     the padded edge slots, so padding can never fire or send.
     """
+    topo._require_edges("plan_sharding (halo-exchange partitioning)")
     if coloring:
         # compute (and cache) on the ORIGINAL topology BEFORE any reorder;
         # reorder_topology carries the cache through, so the sharded run

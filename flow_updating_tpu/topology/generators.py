@@ -118,16 +118,47 @@ def barabasi_albert(n: int, m: int = 4, seed: int = 0, values=None) -> Topology:
     return _finish(n, np.concatenate(pairs), seed, values)
 
 
-def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True) -> Topology:
+def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True,
+             materialize_edges: bool = True) -> Topology:
     """Al-Fares k-ary fat-tree; all hosts *and* switches are graph vertices.
 
     Layout: hosts [0, k^3/4), edge switches, aggregation switches, core
     switches.  k must be even.  Vertex count = k^3/4 + 5k^2/4; edge count
     (undirected) = 3k^3/4.  k=160 gives ~1.056M vertices — the 1M-node
     benchmark config.
+
+    ``materialize_edges=False`` builds a *virtual* topology: node arrays
+    and the structure descriptor only, no edge list (3k^3/4 pairs is
+    ~6 GB of host int64 at k=640).  Degrees are analytic (hosts 1, every
+    switch k).  Only the node kernel's ``spmv='structured'`` path can run
+    it; edge-consuming layouts raise (``Topology._require_edges``).  This
+    is the 50M+-node single-chip configuration.
     """
     if k % 2:
         raise ValueError("fat-tree arity k must be even")
+    if not materialize_edges:
+        half = k // 2
+        n_host = half * half * k
+        n = n_host + half * k * 2 + half * half
+        if values is None:
+            rng = np.random.default_rng(seed + 1)
+            values = rng.uniform(0.0, 1.0, n)
+            if hosts_only_values:
+                values[n_host:] = 0.0
+        out_deg = np.full(n, k, np.int32)
+        out_deg[:n_host] = 1
+        empty_i32 = np.zeros((0,), np.int32)
+        return Topology(
+            num_nodes=n,
+            src=empty_i32, dst=empty_i32, rev=empty_i32,
+            out_deg=out_deg,
+            row_start=np.zeros(n + 1, np.int64),
+            edge_rank=empty_i32,
+            delay=empty_i32,
+            values=np.asarray(values, np.float64),
+            structure=FatTreeStruct(k=k),
+            virtual=True,
+        )
     half = k // 2
     n_host = half * half * k          # k^3/4
     n_edge_sw = half * k

@@ -72,6 +72,11 @@ class Topology:
     #                                          regular-graph generators; lets
     #                                          the node kernel compute A(x)
     #                                          as a stencil (spmv='structured')
+    virtual: bool = False                    # True = edge arrays deliberately
+    #                                          NOT materialized (mega-scale
+    #                                          regular graphs); only the
+    #                                          structured stencil can run —
+    #                                          edge-consuming layouts raise
 
     @property
     def num_edges(self) -> int:
@@ -114,8 +119,19 @@ class Topology:
     def true_mean(self) -> float:
         return float(self.values.mean())
 
+    def _require_edges(self, what: str) -> None:
+        if self.virtual:
+            raise ValueError(
+                f"{what} needs materialized edge arrays, but this topology "
+                "is virtual (generator called with materialize_edges=False "
+                "for mega-scale runs); only the node kernel with "
+                "spmv='structured' can execute it — rebuild with "
+                "materialize_edges=True for any other path"
+            )
+
     def edge_coloring(self) -> tuple[np.ndarray, int]:
         """Proper edge coloring (undirected; both directions share a color).
+        Requires materialized edges (raises on virtual topologies).
 
         Computed by repeated maximal-matching extraction (each pass picks
         every edge that is the lowest-indexed uncolored edge at *both*
@@ -136,6 +152,7 @@ class Topology:
         cached = getattr(self, "_edge_coloring", None)
         if cached is not None:
             return cached
+        self._require_edges("edge_coloring")
         E = self.num_edges
         if E >= 50_000:
             from flow_updating_tpu import native
@@ -199,6 +216,7 @@ class Topology:
         cached = getattr(self, "_ell_buckets", None)
         if cached is not None:
             return cached
+        self._require_edges("ell_buckets")
         N = self.num_nodes
         deg = self.out_deg.astype(np.int64)
         width = np.zeros(N, np.int64)
@@ -275,6 +293,7 @@ class Topology:
         the string ``"fused"`` additionally routes it through the fused
         Pallas executor (``cfg.delivery='benes_fused'``,
         ops/pallas_fused.py); ``False`` keeps the gather formulation."""
+        self._require_edges("device_arrays")
         import jax.numpy as jnp
 
         edge_color = None

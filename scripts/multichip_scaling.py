@@ -156,6 +156,22 @@ def child(n_devices: int) -> None:
             "hlo_collective_bytes": hlo_collective_bytes(hlo),
         })
 
+        # -- GSPMD node kernel, structured stencil SpMV -----------------
+        if topo.structure is not None:
+            scfg = dataclasses.replace(cfg, spmv="structured")
+            ks = sync.NodeKernel(topo, scfg, mesh=mesh)
+            st = ks.init_state()
+            spr = _time_scan(ks.run, st, 64)
+            hlo = (jax.jit(lambda s: ks.run(s, 64))
+                   .lower(st).compile().as_text())
+            est = ks.estimates(ks.run(st, 8))
+            np.testing.assert_allclose(est, ref_est, atol=1e-5)
+            results.append({
+                "path": "gspmd_structured", "topology": tname, "shards": S,
+                "rounds_per_sec": round(1.0 / spr, 2),
+                "hlo_collective_bytes": hlo_collective_bytes(hlo),
+            })
+
         # -- sharded fused-circuit SpMV (shard_map) ---------------------
         if mesh is not None:
             kb = ShardedNodeKernel(

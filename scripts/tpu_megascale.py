@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Mega-scale ladder: structured-stencil rounds/sec on virtual fat-trees.
+
+The structured SpMV (`ops/structured.py`) needs no edge arrays, so the
+node-count axis is bounded only by ~8 N-sized HBM vectors (+ host build
+of the value/degree arrays).  This ladder measures gossip rounds/sec at
+1M -> 66M nodes on ONE chip — the scaling-axis demonstration SURVEY.md
+§5 asks for (node count 6 -> 1M and beyond), far past what the edge-array
+paths can hold.
+
+Writes MEGASCALE_TPU_r4.json progressively (one row per scale, banked as
+soon as measured) so a mid-ladder tunnel wedge keeps earlier rows.  Each
+row: nodes, rounds/s via the R-vs-2R scan difference (bench.measure_tpu,
+launch-capped), fp32 state bytes, and a chunked convergence check
+(rmse after 3x diameter-ish rounds).
+
+Usage: python scripts/tpu_megascale.py [--ks 160,224,320,448,640]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "MEGASCALE_TPU_r4.json")
+
+
+def measure_one(k: int) -> dict:
+    import numpy as np
+
+    import jax
+
+    from bench import measure_tpu
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.sync import NodeKernel
+    from flow_updating_tpu.topology.generators import fat_tree
+    from flow_updating_tpu.utils.metrics import rmse
+
+    t0 = time.time()
+    topo = fat_tree(k, seed=0, materialize_edges=False)
+    build_s = time.time() - t0
+    row = {
+        "k": k,
+        "nodes": topo.num_nodes,
+        "undirected_edges_virtual": 3 * k ** 3 // 4,
+        "host_build_s": round(build_s, 2),
+        "state_mb_fp32": round(topo.num_nodes * 4 * 8 / 1e6, 1),
+        "platform": jax.devices()[0].platform,
+    }
+    m = measure_tpu(topo, 64, kernel="node", spmv="structured")
+    row.update({kk: m[kk] for kk in (
+        "rounds_per_sec", "per_round_s", "plan_s", "compile_s", "rounds")})
+
+    # convergence: run chunks until rmse < 1e-6 or the round budget ends
+    # (fat-tree diameter is 6; mixing needs a few hundred rounds at any k)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured")
+    kern = NodeKernel(topo, cfg)
+    st = kern.init_state()
+    budget, chunk, used = 4096, 256, 0
+    err = None
+    while used < budget:
+        st = kern.run(st, chunk)
+        used += chunk
+        err = float(rmse(kern.estimates(st), topo.true_mean))
+        if err < 1e-6:
+            break
+    row["rounds_to_rmse"] = {"rounds": used, "rmse": err,
+                             "converged": err is not None and err < 1e-6}
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ks", default="160,224,320,448,640")
+    args = ap.parse_args()
+
+    banked = {"what": "structured-stencil ladder on virtual fat-trees, "
+                      "one chip", "rows": []}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                prior = json.load(f)
+            if isinstance(prior, dict) and prior.get("rows"):
+                banked = prior
+        except (OSError, json.JSONDecodeError):
+            pass
+    have = {r.get("k") for r in banked["rows"] if "rounds_per_sec" in r}
+
+    for ks in args.ks.split(","):
+        k = int(ks)
+        if k in have:
+            print(f"k={k}: already banked, skipping", flush=True)
+            continue
+        try:
+            row = measure_one(k)
+        except Exception as exc:  # bank the failure, stop the ladder
+            row = {"k": k, "error": f"{type(exc).__name__}: {exc}"[:400]}
+            banked["rows"] = [r for r in banked["rows"] if r.get("k") != k]
+            banked["rows"].append(row)
+            with open(OUT, "w") as f:
+                json.dump(banked, f, indent=1)
+            print(json.dumps(row), flush=True)
+            return 1
+        banked["rows"] = [r for r in banked["rows"] if r.get("k") != k]
+        banked["rows"].append(row)
+        banked["rows"].sort(key=lambda r: r["k"])
+        with open(OUT, "w") as f:
+            json.dump(banked, f, indent=1)
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,18 +30,22 @@ sys.path.insert(0, REPO)
 MAX_LAUNCH_S = 20.0
 
 
-def _time_chain(step, state, r0: int):
-    """seconds/iteration of ``step`` via scan-chain R-vs-2R difference."""
+def _time_chain(step, state, aux, r0: int):
+    """seconds/iteration of ``step(carry, aux)`` via scan-chain R-vs-2R
+    difference.  ``aux`` (the kernel's constant arrays) is a jit ARGUMENT,
+    not a closure capture — captured jnp arrays embed as HLO constants and
+    the 25 MB ELL mats at k=160 blow the tunnel's remote_compile request
+    cap (observed: HTTP 413)."""
     import jax
     import numpy as np
 
     @functools.partial(jax.jit, static_argnames="n")
-    def chain(s, n):
-        return jax.lax.scan(lambda c, _: (step(c), None), s, None,
+    def chain(s, a, n):
+        return jax.lax.scan(lambda c, _: (step(c, a), None), s, None,
                             length=n)[0]
 
     def run(n):
-        out = chain(state, n)
+        out = chain(state, aux, n)
         np.asarray(jax.tree.leaves(out)[0].ravel()[:2])  # force completion
 
     r = r0
@@ -71,7 +75,7 @@ def profile(k: int, spmv: str, trace_dir: str | None) -> list[dict]:
     rows = []
 
     def emit(part, step, carrier, r0=32):
-        per_s, r = _time_chain(step, carrier, r0)
+        per_s, r = _time_chain(step, carrier, arrs, r0)
         row = {"k": k, "nodes": topo.num_nodes, "spmv": spmv, "part": part,
                "ms_per_iter": round(per_s * 1e3, 4), "iters_timed": r,
                "platform": jax.devices()[0].platform}
@@ -79,7 +83,7 @@ def profile(k: int, spmv: str, trace_dir: str | None) -> list[dict]:
         print(json.dumps(row), flush=True)
 
     # 1. full round
-    emit("full_round", lambda s: sync.node_round_step(s, arrs, cfg), st)
+    emit("full_round", lambda s, a: sync.node_round_step(s, a, cfg), st)
 
     # 2. SpMV alone (same input shape/dtype as the round feeds it)
     x0 = st.avg_prev + jnp.asarray(0, st.avg_prev.dtype)
@@ -87,18 +91,23 @@ def profile(k: int, spmv: str, trace_dir: str | None) -> list[dict]:
         from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
 
         emit("spmv_only",
-             lambda x: neighbor_sum_benes(x, arrs.ns_plan, arrs.ns_masks),
+             lambda x, a: neighbor_sum_benes(x, a.ns_plan, a.ns_masks),
              x0)
+    elif spmv == "structured":
+        from flow_updating_tpu.ops.structured import structured_neighbor_sum
+
+        emit("spmv_only",
+             lambda x, a: structured_neighbor_sum(x, a.ns_struct), x0)
     else:
-        emit("spmv_only", lambda x: sync.neighbor_sum(x, arrs.mats), x0)
+        emit("spmv_only", lambda x, a: sync.neighbor_sum(x, a.mats), x0)
 
     # 3. elementwise recurrence with the SpMV cut out (A := avg): the
     #    pure O(N)-stream floor of the round
-    def elementwise_only(s):
-        avg = (arrs.value - s.S + s.A_prev) * arrs.inv_depp1
+    def elementwise_only(s, a):
+        avg = (a.value - s.S + s.A_prev) * a.inv_depp1
         A_cur = avg
-        return s.replace(t=s.t + 1, S=-s.G - A_cur + arrs.deg * s.avg_prev,
-                         G=-s.S - arrs.deg * avg + s.A_prev,
+        return s.replace(t=s.t + 1, S=-s.G - A_cur + a.deg * s.avg_prev,
+                         G=-s.S - a.deg * avg + s.A_prev,
                          avg_prev=avg, A_prev=A_cur)
 
     emit("elementwise_only", elementwise_only, st, r0=256)
@@ -117,7 +126,7 @@ def profile(k: int, spmv: str, trace_dir: str | None) -> list[dict]:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--k", type=int, default=160)
-    ap.add_argument("--spmv", default="benes_fused,benes,xla",
+    ap.add_argument("--spmv", default="structured,benes_fused,benes,xla",
                     help="comma list; order = measurement order")
     ap.add_argument("--trace", default=None,
                     help="profiler trace output dir (one chunk per spmv)")

@@ -101,3 +101,67 @@ def test_structured_streamed_observer():
     jax.effects_barrier()
     assert [s["t"] for s in seen] == [10, 20, 30, 40]
     assert seen[-1]["rmse"] < seen[0]["rmse"]
+
+
+def test_aggregates_through_structured():
+    """COUNT/SUM ride the structured node kernel unchanged (with_values
+    preserves the descriptor)."""
+    from flow_updating_tpu.models.aggregates import (
+        estimate_count,
+        estimate_sum,
+    )
+
+    topo = G.ring(48, 2, seed=11)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured")
+    cnt = estimate_count(topo, cfg, rounds=400)
+    np.testing.assert_allclose(cnt, 48.0, rtol=1e-3)
+    s = estimate_sum(topo, cfg, rounds=400)
+    np.testing.assert_allclose(s, topo.values.sum(), rtol=1e-3)
+
+
+def test_virtual_fat_tree_matches_materialized():
+    """materialize_edges=False: same node data, same structured
+    trajectory; edge-consuming layouts raise."""
+    tv = G.fat_tree(8, seed=0, materialize_edges=False)
+    tm = G.fat_tree(8, seed=0)
+    assert tv.virtual and not tm.virtual
+    assert tv.num_nodes == tm.num_nodes and tv.num_edges == 0
+    np.testing.assert_array_equal(tv.out_deg, tm.out_deg)
+    np.testing.assert_allclose(tv.values, tm.values)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured", dtype="float64")
+    kv = NodeKernel(tv, cfg)
+    km = NodeKernel(tm, cfg)
+    np.testing.assert_allclose(
+        kv.estimates(kv.run(kv.init_state(), 40)),
+        km.estimates(km.run(km.init_state(), 40)), rtol=1e-12)
+    with pytest.raises(ValueError, match="materialize_edges"):
+        NodeKernel(tv, RoundConfig.fast(variant="collectall",
+                                        kernel="node", spmv="xla"))
+    with pytest.raises(ValueError, match="materialize_edges"):
+        tv.device_arrays()
+
+
+def test_virtual_guard_covers_all_edge_consumers():
+    """Every public edge-consuming entry point raises on a virtual
+    topology instead of silently operating on zero edges."""
+    from flow_updating_tpu.models.aggregates import (
+        estimate_max,
+        estimate_min,
+    )
+    from flow_updating_tpu.parallel.auto import pad_topology
+    from flow_updating_tpu.parallel.sharded import plan_sharding
+
+    tv = G.fat_tree(4, seed=0, materialize_edges=False)
+    for fn in (
+        lambda: estimate_min(tv),
+        lambda: estimate_max(tv),
+        lambda: pad_topology(tv, 2),
+        lambda: plan_sharding(tv, 2),
+        lambda: tv.edge_coloring(),
+        lambda: tv.ell_buckets(),
+        lambda: tv.device_arrays(),
+    ):
+        with pytest.raises(ValueError, match="materialize_edges"):
+            fn()
