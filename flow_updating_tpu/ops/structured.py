@@ -111,18 +111,38 @@ class FatTreeStruct:
         return self.half * self.half * self.k + self.half * self.k * 2 \
             + self.half * self.half
 
-    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+    def sections(self, x):
+        """View a node vector as its four class sections:
+        host (k, k/2, k/2), edge (k, k/2), agg (k, k/2), core (k/2, k/2)
+        — the generator's layout (numpy or jnp input)."""
         k, half = self.k, self.half
         n_host = half * half * k
         n_sw = half * k
-        xh = x[:n_host].reshape(k, half, half)
-        xe = x[n_host:n_host + n_sw].reshape(k, half)
-        xa = x[n_host + n_sw:n_host + 2 * n_sw].reshape(k, half)
-        xc = x[n_host + 2 * n_sw:].reshape(half, half)
-        a_host = jnp.broadcast_to(xe[:, :, None], (k, half, half))
+        return (
+            x[:n_host].reshape(k, half, half),
+            x[n_host:n_host + n_sw].reshape(k, half),
+            x[n_host + n_sw:n_host + 2 * n_sw].reshape(k, half),
+            x[n_host + 2 * n_sw:].reshape(half, half),
+        )
+
+    @staticmethod
+    def pod_local_sums(xh, xe, xa, xc):
+        """The stencil terms of any contiguous block of pods (``xc`` is
+        the full core grid — replicated in the pod-sharded kernel).
+        Returns (a_host, a_edge, a_agg, a_core_partial) where
+        ``a_core_partial[a] = Σ_{p∈block} xa[p, a]`` — summing partials
+        over all blocks (or psum over a pod mesh axis,
+        ``parallel/structured_sharded.py``) gives the core column sum."""
+        kb, h = xe.shape
+        a_host = jnp.broadcast_to(xe[:, :, None], (kb, h, h))
         a_edge = xh.sum(axis=2) + xa.sum(axis=1, keepdims=True)
         a_agg = xe.sum(axis=1, keepdims=True) + xc.sum(axis=1)[None, :]
-        a_core = jnp.broadcast_to(xa.sum(axis=0)[:, None], (half, half))
+        return a_host, a_edge, a_agg, xa.sum(axis=0)
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        xh, xe, xa, xc = self.sections(x)
+        a_host, a_edge, a_agg, part = self.pod_local_sums(xh, xe, xa, xc)
+        a_core = jnp.broadcast_to(part[:, None], xc.shape)
         return jnp.concatenate([
             a_host.reshape(-1), a_edge.reshape(-1),
             a_agg.reshape(-1), a_core.reshape(-1),

@@ -541,6 +541,10 @@ def reorder_topology(topo: Topology, order: np.ndarray) -> Topology:
         adopted=None,
         edge_links=pick_e(topo.edge_links),
         lat_rounds=pick_e(topo.lat_rounds),
+        # a structure descriptor indexes sections by the GENERATOR's node
+        # layout; after renumbering it would compute silently wrong
+        # stencil sums (same reasoning as pad_topology)
+        structure=None,
     )
     # a coloring is a property of the (undirected) edges, invariant under
     # renumbering — carry the cache through so a reordered partition runs

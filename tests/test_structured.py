@@ -165,3 +165,38 @@ def test_virtual_guard_covers_all_edge_consumers():
     ):
         with pytest.raises(ValueError, match="materialize_edges"):
             fn()
+
+
+def test_engine_checkpoint_roundtrip_structured(tmp_path):
+    """save -> restore -> continue equals an uninterrupted structured run
+    (restore adopts the archived config, so the identity layout travels
+    with the checkpoint)."""
+    import flow_updating_tpu as fu
+
+    topo = G.fat_tree(6, seed=3)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured")
+
+    path = str(tmp_path / "structured.npz")
+    a = fu.Engine(config=cfg).set_topology(topo).build().run_rounds(30)
+    a.save_checkpoint(path)
+    # restore into an engine configured with a DIFFERENT spmv: adoption
+    # of the archived config is what makes the layout travel
+    other = RoundConfig.fast(variant="collectall", kernel="node",
+                             spmv="xla")
+    b = fu.Engine(config=other).set_topology(topo).build()
+    b.restore_checkpoint(path)
+    assert b.config.spmv == "structured"
+    a.run_rounds(50)
+    b.run_rounds(50)
+    np.testing.assert_array_equal(a.estimates(), b.estimates())
+
+
+def test_reorder_drops_structure():
+    """reorder_topology renumbers nodes; the generator-layout descriptor
+    must not survive (it would compute silently wrong stencil sums)."""
+    from flow_updating_tpu.topology.graph import reorder_topology
+
+    topo = G.fat_tree(4, seed=0)
+    order = np.random.default_rng(0).permutation(topo.num_nodes)
+    assert reorder_topology(topo, order).structure is None
