@@ -86,6 +86,45 @@ class CompleteStruct:
 
 
 @dataclasses.dataclass(frozen=True)
+class Torus2dStruct:
+    """2-D torus (periodic 4-neighborhood): four rolls.  Requires
+    ``h, w >= 3`` (below that the wrap edges collapse under dedup)."""
+
+    h: int
+    w: int
+
+    @property
+    def n(self) -> int:
+        return self.h * self.w
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        g = x.reshape(self.h, self.w)
+        acc = (jnp.roll(g, 1, axis=0) + jnp.roll(g, -1, axis=0)
+               + jnp.roll(g, 1, axis=1) + jnp.roll(g, -1, axis=1))
+        return acc.reshape(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class HypercubeStruct:
+    """d-dimensional hypercube: neighbor i^(1<<b) for each bit b.  The
+    XOR-by-bit gather is a *flip* of one axis of the ``(2,)*d`` view —
+    d axis-reverses, no roll masks, no index math."""
+
+    d: int
+
+    @property
+    def n(self) -> int:
+        return 1 << self.d
+
+    def neighbor_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        g = x.reshape((2,) * self.d)
+        acc = jnp.zeros_like(g)
+        for axis in range(self.d):
+            acc = acc + jnp.flip(g, axis=axis)
+        return acc.reshape(-1)
+
+
+@dataclasses.dataclass(frozen=True)
 class FatTreeStruct:
     """Al-Fares k-ary fat-tree in the generator's node layout
     (``topology/generators.py:fat_tree``): hosts ``(k, k/2, k/2)``,

@@ -20,7 +20,9 @@ from flow_updating_tpu.ops.structured import (
     CompleteStruct,
     FatTreeStruct,
     Grid2dStruct,
+    HypercubeStruct,
     RingStruct,
+    Torus2dStruct,
 )
 from flow_updating_tpu.topology.graph import Topology, build_topology
 
@@ -52,6 +54,33 @@ def grid2d(h: int, w: int, seed: int = 0, values=None) -> Topology:
     down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
     topo = _finish(h * w, np.concatenate([right, down]), seed, values)
     return dataclasses.replace(topo, structure=Grid2dStruct(h=h, w=w))
+
+
+def torus2d(h: int, w: int, seed: int = 0, values=None) -> Topology:
+    """2-D torus (periodic 4-neighborhood)."""
+    idx = np.arange(h * w, dtype=np.int64).reshape(h, w)
+    right = np.stack([idx.ravel(), np.roll(idx, -1, axis=1).ravel()], axis=1)
+    down = np.stack([idx.ravel(), np.roll(idx, -1, axis=0).ravel()], axis=1)
+    topo = _finish(h * w, np.concatenate([right, down]), seed, values)
+    if h >= 3 and w >= 3:  # wrap edges dedup below this
+        topo = dataclasses.replace(topo, structure=Torus2dStruct(h=h, w=w))
+    return topo
+
+
+def hypercube(d: int, seed: int = 0, values=None) -> Topology:
+    """d-dimensional hypercube: 2^d nodes, node i ~ i^(1<<b)."""
+    if d < 1:
+        raise ValueError("hypercube dimension d must be >= 1")
+    i = np.arange(1 << d, dtype=np.int64)
+    # emit each undirected edge once (from its 0-bit endpoint), per the
+    # module convention — halves the symmetrize-sort input
+    pairs = np.concatenate(
+        [np.stack([lo, lo ^ (1 << b)], axis=1)
+         for b in range(d)
+         for lo in (i[(i >> b) & 1 == 0],)], axis=0
+    )
+    topo = _finish(1 << d, pairs, seed, values)
+    return dataclasses.replace(topo, structure=HypercubeStruct(d=d))
 
 
 def complete(n: int, seed: int = 0, values=None) -> Topology:
@@ -211,6 +240,8 @@ def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True,
 GENERATORS = {
     "ring": ring,
     "grid2d": grid2d,
+    "torus2d": torus2d,
+    "hypercube": hypercube,
     "complete": complete,
     "erdos_renyi": erdos_renyi,
     "barabasi_albert": barabasi_albert,

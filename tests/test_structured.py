@@ -22,6 +22,10 @@ def _cases():
         ("complete_17", G.complete(17, seed=3)),
         ("fat_tree_4", G.fat_tree(4, seed=4)),
         ("fat_tree_6", G.fat_tree(6, seed=5)),
+        ("torus_5x7", G.torus2d(5, 7, seed=6)),
+        ("torus_3x3", G.torus2d(3, 3, seed=6)),
+        ("hypercube_5", G.hypercube(5, seed=7)),
+        ("hypercube_1", G.hypercube(1, seed=7)),
     ]
 
 
@@ -44,6 +48,9 @@ def test_degenerate_ring_has_no_structure():
     roll form would double-count, so the generator must not attach it."""
     assert G.ring(4, 2, seed=0).structure is None
     assert G.ring(5, 2, seed=0).structure is not None
+    # same collapse for the torus below 3x3
+    assert G.torus2d(2, 5, seed=0).structure is None
+    assert G.torus2d(3, 3, seed=0).structure is not None
 
 
 @pytest.mark.parametrize("name,topo", _cases())
@@ -200,3 +207,8 @@ def test_reorder_drops_structure():
     topo = G.fat_tree(4, seed=0)
     order = np.random.default_rng(0).permutation(topo.num_nodes)
     assert reorder_topology(topo, order).structure is None
+
+
+def test_hypercube_rejects_d0():
+    with pytest.raises(ValueError, match="d must be >= 1"):
+        G.hypercube(0)
