@@ -137,10 +137,11 @@ def cmd_run(args) -> int:
     from flow_updating_tpu.engine import Engine
 
     cfg = _make_config(args)
-    if getattr(args, "multichip", "auto") == "halo" and not args.shards:
+    if getattr(args, "multichip", "auto") in ("halo", "pod") \
+            and not args.shards:
         raise SystemExit(
-            "--multichip halo needs --shards N (it is a multi-chip "
-            "distribution strategy)")
+            f"--multichip {args.multichip} needs --shards N (it is a "
+            "multi-chip distribution strategy)")
     mesh = None
     if args.shards:
         from flow_updating_tpu.parallel.mesh import make_mesh
@@ -327,11 +328,15 @@ def build_parser() -> argparse.ArgumentParser:
                           "segment primitives vs scatter-free degree-"
                           "bucketed ELL gather+row-reduce")
     run.add_argument("--multichip", default="auto",
-                     choices=("auto", "halo"),
+                     choices=("auto", "halo", "pod"),
                      help="distribution strategy under --shards: 'auto' "
                           "= GSPMD (XLA places collectives), 'halo' = "
                           "explicitly scheduled shard_map halo-exchange "
-                          "kernel (edge kernel only)")
+                          "kernel (edge kernel only), 'pod' = pod-sharded "
+                          "fat-tree stencil (node kernel, "
+                          "--spmv structured, fat_tree generator with "
+                          "shards dividing k; one (k/2,)-element psum "
+                          "per round)")
     run.add_argument("--halo", default="ppermute",
                      choices=("ppermute", "allgather"),
                      help="halo kernel's cut-edge exchange collective")

@@ -81,7 +81,11 @@ class Engine:
         #            source shard, only cut-edge payloads cross chips
         #            (``halo``: 'ppermute' point-to-point or 'allgather'
         #            broadcast; ``partition``: 'bfs' or 'contiguous').
-        if multichip not in ("auto", "halo"):
+        #   'pod'  — the pod-sharded fat-tree stencil
+        #            (parallel/structured_sharded.py): node kernel,
+        #            spmv='structured', fat-tree topologies with S | k;
+        #            one (k/2,)-element psum per round.
+        if multichip not in ("auto", "halo", "pod"):
             raise ValueError(f"unknown multichip mode {multichip!r}")
         self.argv = list(argv) if argv else []
         self.config = config or RoundConfig.fast()
@@ -154,6 +158,11 @@ class Engine:
             and self._custom_actor is None
 
     @property
+    def _pod_mode(self) -> bool:
+        return self.mesh is not None and self.multichip == "pod" \
+            and self._custom_actor is None
+
+    @property
     def _node_like(self) -> bool:
         """Dispatch through the node-kernel interface (built-in
         node-collapsed kernel, or an ActorKernel driving a VectorActor)."""
@@ -185,11 +194,11 @@ class Engine:
         if self._custom_actor is not None:
             from flow_updating_tpu.models.actor import ActorKernel
 
-            if self.mesh is not None and self.multichip == "halo":
+            if self.mesh is not None and self.multichip in ("halo", "pod"):
                 raise ValueError(
-                    "multichip='halo' drives the built-in edge kernel; "
-                    "custom VectorActors distribute via GSPMD — use "
-                    "multichip='auto'")
+                    f"multichip={self.multichip!r} drives a built-in "
+                    "kernel; custom VectorActors distribute via GSPMD — "
+                    "use multichip='auto'")
             if latency_scale > 0.0 or self.topology.max_delay > 1:
                 raise ValueError(
                     "VectorActor rounds are unit-delay synchronous; "
@@ -234,7 +243,20 @@ class Engine:
                 )
             from flow_updating_tpu.models import sync
 
-            if self.mesh is not None and self.config.spmv == "benes_fused":
+            if self._pod_mode:
+                from flow_updating_tpu.parallel.structured_sharded import (
+                    PodShardedFatTreeKernel,
+                )
+
+                if self.config.spmv != "structured":
+                    raise ValueError(
+                        "multichip='pod' runs the pod-sharded stencil; "
+                        "it requires spmv='structured'"
+                    )
+                self._node_kernel = PodShardedFatTreeKernel(
+                    self.topology, self.config, self.mesh
+                )
+            elif self.mesh is not None and self.config.spmv == "benes_fused":
                 from flow_updating_tpu.parallel.spmv_sharded import (
                     ShardedNodeKernel,
                 )
@@ -248,6 +270,11 @@ class Engine:
                 )
             self._topo_arrays = None
             return
+        if self._pod_mode:
+            raise ValueError(
+                "multichip='pod' drives the node kernel "
+                "(kernel='node', spmv='structured')"
+            )
         if latency_scale > 0.0:
             depth = max(self.config.delay_depth, self.topology.max_delay)
             if depth != self.config.delay_depth:
@@ -563,8 +590,16 @@ class Engine:
                 extra={"clock": self._clock, "killed": self._killed},
             )
             return self
+        if self._pod_mode:
+            # flatten pod sections to the canonical structured-NodeKernel
+            # layout (same convention as the halo gather above): the
+            # checkpoint is then a standard node-kernel one, restorable
+            # single-device, GSPMD, or on another pod mesh
+            state = self._node_kernel.to_canonical(self.state)
+        else:
+            state = self.state
         save_checkpoint(
-            path, self.state, self.config, topo=self.topology,
+            path, state, self.config, topo=self.topology,
             extra={"clock": self._clock, "killed": self._killed},
         )
         return self
@@ -633,6 +668,13 @@ class Engine:
                 f"layout expects {expect} — restore with the same "
                 "mesh/padding it was saved under"
             )
+        if self._pod_mode and cfg.kernel == "node":
+            # archives are canonical (flat structured-NodeKernel layout,
+            # see save_checkpoint); scatter sections onto the pod mesh
+            self.state = self._node_kernel.from_canonical(state)
+            self._clock = float(extra.get("clock", float(state.t)))
+            self._killed = bool(extra.get("killed", False))
+            return self
         if cfg.kernel == "node":
             # layout check runs mesh or not: a sharded (S, M/S) state is
             # NOT interchangeable with the single-device (M,) layout even
@@ -760,14 +802,16 @@ class Engine:
                     self.state.alive, self._halo_plan).astype(bool)
                 cnt = max(int(alive.sum()), 1)
                 err = np.where(alive, est - self.topology.true_mean, 0.0)
-                emit({
-                    "t": int(np.asarray(self.state.t).ravel()[0]),
-                    "rmse": float(np.sqrt(np.sum(err * err) / cnt)),
-                    "max_abs_err": float(np.max(np.abs(err))),
-                    "mass": float(est[alive].sum()),
-                    "fired_total": int(sharded.gather_node_array(
-                        self.state.fired, self._halo_plan).sum()),
-                })
+                from flow_updating_tpu.utils.metrics import observer_sample
+
+                emit(observer_sample(
+                    np.asarray(self.state.t).ravel()[0],
+                    np.sqrt(np.sum(err * err) / cnt),
+                    np.max(np.abs(err)),
+                    est[alive].sum(),
+                    sharded.gather_node_array(
+                        self.state.fired, self._halo_plan).sum(),
+                ))
             self._clock += n * TICK_INTERVAL
             return self
         if not self._killed and n > 0:

@@ -352,14 +352,11 @@ def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):
 )
 def _run_node_streamed(state, arrs, cfg, chunks, observe_every, mean, emit):
     def host_emit(t, rmse_v, max_err, mass, cnt):
+        from flow_updating_tpu.utils.metrics import observer_sample
+
         # in fast sync mode every communicating node fires every round
-        emit({
-            "t": int(t),
-            "rmse": float(rmse_v),
-            "max_abs_err": float(max_err),
-            "mass": float(mass),
-            "fired_total": int(t) * int(cnt),
-        })
+        emit(observer_sample(t, rmse_v, max_err, mass,
+                             int(t) * int(cnt)))
 
     def chunk_body(s, _):
         s = jax.lax.fori_loop(

@@ -122,6 +122,11 @@ class PodShardedFatTreeKernel:
 
         self._run_jit = _run
 
+    @property
+    def padded_size(self) -> int:
+        """Node-slot count: no padding — sections tile exactly."""
+        return self.topo.num_nodes
+
     def init_state(self) -> PodState:
         z = lambda: tuple(jnp.zeros_like(v) for v in self.value)
         return PodState(t=jnp.zeros((), jnp.int32), S=z(), G=z(),
@@ -131,6 +136,27 @@ class PodShardedFatTreeKernel:
         return self._run_jit(state, self.value, self.inv_depp1, self.deg,
                              num_rounds)
 
+    def run_streamed(self, state: PodState, num_rounds: int,
+                     observe_every: int, emit) -> PodState:
+        """Host-chunked observer; the emit record shape is
+        `utils.metrics.observer_sample` (shared with the node kernel's
+        sampler and the halo engine branch).  Metrics reduce ON DEVICE —
+        each sample transfers three scalars, never the O(N) estimate
+        vector (which at this kernel's design scale is gigabytes)."""
+        from flow_updating_tpu.utils.metrics import observer_sample
+
+        if num_rounds % observe_every:
+            raise ValueError(
+                "num_rounds must be a multiple of observe_every")
+        n = self.topo.num_nodes
+        mean = self.topo.true_mean
+        for _ in range(num_rounds // observe_every):
+            state = self.run(state, observe_every)
+            sq, mx, mass = _pod_sample(self.value, state.G, mean)
+            emit(observer_sample(state.t, np.sqrt(float(sq) / n), mx,
+                                 mass, int(state.t) * n))
+        return state
+
     def estimates(self, state: PodState) -> np.ndarray:
         """value + G per node, original (generator) node order."""
         est = tuple(v + g for v, g in zip(self.value, state.G))
@@ -138,6 +164,46 @@ class PodShardedFatTreeKernel:
 
     def last_avg(self, state: PodState) -> np.ndarray:
         return np.asarray(_flatten(state.avg_prev))
+
+    # ---- canonical (single-device structured NodeKernel) layout --------
+    # The structured NodeKernel stores (N,) vectors in generator order
+    # with no padding, so flattening sections IS the canonical layout:
+    # pod-mode checkpoints are standard node-kernel checkpoints,
+    # restorable by any execution mode (mirrors the halo kernel's
+    # gather-to-canonical convention, engine.save_checkpoint).
+
+    def to_canonical(self, state: PodState):
+        from flow_updating_tpu.models.sync import NodeSyncState
+
+        return NodeSyncState(
+            t=state.t, S=_flatten(state.S), G=_flatten(state.G),
+            avg_prev=_flatten(state.avg_prev),
+            A_prev=_flatten(state.A_prev),
+        )
+
+    def from_canonical(self, ns) -> PodState:
+        struct = self.topo.structure
+        sec = lambda v: tuple(
+            jax.device_put(s, jax.sharding.NamedSharding(self.mesh, sp))
+            for s, sp in zip(struct.sections(jnp.asarray(v)), self._specs))
+        return PodState(t=ns.t, S=sec(ns.S), G=sec(ns.G),
+                        avg_prev=sec(ns.avg_prev), A_prev=sec(ns.A_prev))
+
+
+@jax.jit
+def _pod_sample(value, G, mean):
+    """Device-side watcher reductions over the sections: returns
+    (sum of squared error, max abs error, mass) — three scalars."""
+    sq = 0.0
+    mx = 0.0
+    mass = 0.0
+    for v, g in zip(value, G):
+        est = v + g
+        err = est - mean
+        sq = sq + jnp.sum(err * err)
+        mx = jnp.maximum(mx, jnp.max(jnp.abs(err)))
+        mass = mass + jnp.sum(est)
+    return sq, mx, mass
 
 
 def _neighbor_sum_pod(x, axis_name: str):

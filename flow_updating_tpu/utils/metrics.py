@@ -37,6 +37,20 @@ def antisymmetry_residual(state, topo) -> jnp.ndarray:
     return jnp.max(jnp.abs(state.flow + state.flow[topo.rev]))
 
 
+def observer_sample(t, rmse_v, max_abs_err, mass, fired_total) -> dict:
+    """The streamed-observer emit record — ONE shape for every execution
+    mode (node kernel's debug-callback sampler, the halo engine branch,
+    the pod-sharded kernel), so the watcher contract can't drift between
+    copies.  All inputs host scalars."""
+    return {
+        "t": int(t),
+        "rmse": float(rmse_v),
+        "max_abs_err": float(max_abs_err),
+        "mass": float(mass),
+        "fired_total": int(fired_total),
+    }
+
+
 def convergence_report(state, topo, true_mean) -> dict:
     est = node_estimates(state, topo)
     err = est - jnp.asarray(true_mean, est.dtype)

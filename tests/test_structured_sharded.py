@@ -67,3 +67,67 @@ def test_last_avg_matches_single_device():
     a_ref = ref.last_avg(ref.run(ref.init_state(), 20))
     a_sh = kern.last_avg(kern.run(kern.init_state(), 20))
     np.testing.assert_allclose(a_sh, a_ref, rtol=1e-12, atol=1e-12)
+
+
+def test_engine_pod_mode_matches_single_device():
+    """multichip='pod' through the Engine: same estimates as the
+    single-device structured engine, streamed observer included."""
+    import flow_updating_tpu as fu
+
+    topo = G.fat_tree(8, seed=4)
+    e1 = (fu.Engine(config=_cfg()).set_topology(topo).build()
+          .run_rounds(40))
+    ep = fu.Engine(config=_cfg(), mesh=make_mesh(4), multichip="pod")
+    ep.set_topology(topo).build().run_rounds(40)
+    np.testing.assert_allclose(ep.estimates(), e1.estimates(),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_engine_pod_checkpoint_cross_mode(tmp_path):
+    """pod save -> single-device restore, and the reverse — the archive
+    is canonical (flat structured layout)."""
+    import flow_updating_tpu as fu
+
+    topo = G.fat_tree(8, seed=9)
+    path = str(tmp_path / "pod.npz")
+
+    ep = fu.Engine(config=_cfg(), mesh=make_mesh(2), multichip="pod")
+    ep.set_topology(topo).build().run_rounds(25)
+    ep.save_checkpoint(path)
+
+    # single-device resume continues identically
+    e1 = fu.Engine(config=_cfg()).set_topology(topo)
+    e1.restore_checkpoint(path)
+    ref = (fu.Engine(config=_cfg()).set_topology(topo).build()
+           .run_rounds(25))
+    np.testing.assert_allclose(e1.estimates(), ref.estimates(),
+                               rtol=1e-12, atol=1e-12)
+    e1.run_rounds(25)
+    ref.run_rounds(25)
+    np.testing.assert_allclose(e1.estimates(), ref.estimates(),
+                               rtol=1e-12, atol=1e-12)
+
+    # single-device save -> pod restore
+    path2 = str(tmp_path / "single.npz")
+    ref.save_checkpoint(path2)
+    ep2 = fu.Engine(config=_cfg(), mesh=make_mesh(4), multichip="pod")
+    ep2.set_topology(topo).restore_checkpoint(path2)
+    np.testing.assert_allclose(ep2.estimates(), ref.estimates(),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_engine_pod_mode_rejections():
+    import flow_updating_tpu as fu
+    from flow_updating_tpu.models.config import RoundConfig
+
+    topo = G.fat_tree(8, seed=0)
+    # wrong spmv
+    bad = RoundConfig.fast(variant="collectall", kernel="node", spmv="xla")
+    with pytest.raises(ValueError, match="structured"):
+        (fu.Engine(config=bad, mesh=make_mesh(2), multichip="pod")
+         .set_topology(topo).build())
+    # edge kernel
+    bad2 = RoundConfig.fast(variant="collectall")
+    with pytest.raises(ValueError, match="pod"):
+        (fu.Engine(config=bad2, mesh=make_mesh(2), multichip="pod")
+         .set_topology(topo).build())
