@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Mega-scale gossip: virtual fat-trees + the structured stencil.
+
+The reference simulates 6 peers (``actors.xml``).  This example runs the
+same protocol on a fat-tree with millions of vertices on ONE device by
+combining two ideas:
+
+* ``fat_tree(k, materialize_edges=False)`` — a *virtual* topology: node
+  arrays + the closed-form adjacency descriptor, no edge list (the
+  3k^3/4 edge pairs would be gigabytes at large k);
+* ``spmv='structured'`` — the neighbor sum as reshape/broadcast
+  stencil ops, so the round touches only ~8 N-sized vectors
+  (49 us/round at 1,056,000 nodes on a TPU v5e; BENCH_NOTES.md).
+
+With ``--shards S`` (S must divide k) it instead runs the pod-sharded
+kernel (``Engine(multichip='pod')``): one (k/2,)-element psum per round
+crosses chips — on a CPU mesh this demonstrates the ~500M-node
+multi-chip configuration at toy scale.
+
+Run:  python examples/megascale.py [--k 64] [--rounds 300] [--shards S]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+try:
+    import flow_updating_tpu  # noqa: F401  (pip install -e . preferred)
+except ImportError:  # running from a source checkout without install
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from flow_updating_tpu import Engine
+from flow_updating_tpu.cli import _select_backend
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.topology.generators import fat_tree
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=64,
+                    help="fat-tree arity (nodes = k^3/4 + 5k^2/4; "
+                         "k=64 -> 70,656, k=160 -> 1,056,000, "
+                         "k=640 -> 66M)")
+    ap.add_argument("--rounds", type=int, default=300)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="pod-shard over an S-device mesh (S | k); "
+                         "0 = single device")
+    ap.add_argument("--backend", default="cpu",
+                    choices=("auto", "cpu", "jax_tpu"))
+    args = ap.parse_args()
+    # a cpu --shards run needs that many virtual host devices
+    _select_backend(args.backend, n_virtual_devices=args.shards or None)
+
+    t0 = time.time()
+    topo = fat_tree(args.k, seed=0, materialize_edges=False)
+    print(f"virtual fat-tree k={args.k}: {topo.num_nodes:,} nodes, "
+          f"{3 * args.k ** 3 // 4:,} (un-materialized) undirected edges, "
+          f"built in {time.time() - t0:.2f}s host-side")
+
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured")
+    if args.shards:
+        from flow_updating_tpu.parallel.mesh import make_mesh
+
+        eng = Engine(config=cfg, mesh=make_mesh(args.shards),
+                     multichip="pod")
+    else:
+        eng = Engine(config=cfg)
+    eng.set_topology(topo).build()
+
+    t0 = time.time()
+    eng.run_rounds(args.rounds)
+    est = eng.estimates()
+    dt = time.time() - t0
+    rmse = float(np.sqrt(np.mean((est - topo.true_mean) ** 2)))
+    print(f"{args.rounds} rounds in {dt:.2f}s "
+          f"({args.rounds / dt:,.0f} rounds/s incl. compile), "
+          f"rmse vs true mean {topo.true_mean:.6f}: {rmse:.3g}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

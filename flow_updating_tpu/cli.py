@@ -34,12 +34,14 @@ import os
 import sys
 
 
-def _select_backend(name: str) -> None:
+def _select_backend(name: str, n_virtual_devices: int | None = None) -> None:
     """Pin the JAX backend.  Must run before any JAX backend initializes.
 
     ``jax_tpu``  — use the ambient TPU platform (axon/tpu plugin).
     ``cpu``      — force host CPU and deregister TPU plugin factories so
-                   nothing contends for (or hangs on) a TPU tunnel.
+                   nothing contends for (or hangs on) a TPU tunnel;
+                   ``n_virtual_devices`` requests that many virtual host
+                   devices (a ``--shards N`` run needs an N-device mesh).
     ``auto``     — leave discovery alone.
     """
     if name == "auto":
@@ -47,7 +49,7 @@ def _select_backend(name: str) -> None:
     if name == "cpu":
         from flow_updating_tpu.utils.backend import pin_cpu
 
-        pin_cpu()
+        pin_cpu(n_virtual_devices=n_virtual_devices)
     elif name == "jax_tpu":
         # Clear a CPU pin so TPU discovery can happen; an explicit TPU-ish
         # pin (tpu / axon tunnel) is kept as-is.
@@ -132,7 +134,8 @@ def _make_config(args):
 
 
 def cmd_run(args) -> int:
-    _select_backend(args.backend)
+    _select_backend(args.backend,
+                    n_virtual_devices=getattr(args, "shards", None) or None)
 
     from flow_updating_tpu.engine import Engine
 

@@ -70,3 +70,30 @@ def test_pushsum_example_sharded():
     )
     assert p.returncode == 0, p.stderr[-2000:]
     assert p.stdout.count("30.0000") == 6, p.stdout[-1500:]
+
+
+def test_megascale_example():
+    stdout, _ = _run_example("megascale.py", "--k", "16", "--rounds",
+                             "200")
+    assert re.search(r"rmse vs true mean .*: [0-9.e-]+", stdout)
+    rmse = float(stdout.rsplit(": ", 1)[1])
+    assert rmse < 1e-4
+
+
+def test_megascale_example_pod_sharded():
+    # clean env with NO inherited device-count flag: the example itself
+    # must request the virtual devices its --shards needs
+    from flow_updating_tpu.utils.backend import cpu_subprocess_env
+
+    env = cpu_subprocess_env(extra_path=REPO)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "megascale.py"),
+         "--k", "16", "--rounds", "200", "--shards", "4"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, f"megascale sharded failed:\n{p.stderr[-2000:]}"
+    rmse = float(p.stdout.rsplit(": ", 1)[1])
+    assert rmse < 1e-4
