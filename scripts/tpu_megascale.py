@@ -79,7 +79,19 @@ def measure_one(k: int) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ks", default="160,224,320,448,640")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="permit banking non-TPU rows (testing only; the "
+                         "artifact is the round's TPU number of record)")
     args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon") and not args.allow_cpu:
+        print(json.dumps({"error": f"backend is {platform!r}, not TPU — "
+                          "refusing to bank CPU rows into the TPU "
+                          "artifact (use --allow-cpu for wiring tests)"}))
+        return 2
 
     banked = {"what": "structured-stencil ladder on virtual fat-trees, "
                       "one chip", "rows": []}

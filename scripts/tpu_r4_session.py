@@ -97,7 +97,7 @@ def probe() -> bool:
 
 
 ALL_STEPS = ("micro96", "micro160", "bench", "profile160", "micro40",
-             "edge96")
+             "edge96", "edge96_fused", "megascale")
 
 
 def main() -> int:
@@ -201,19 +201,30 @@ def main() -> int:
         rows = _json_lines(out)
         _keep("micro40", {"rc": rc, "rows": rows}, _tpu_rows(rc, rows))
 
-    # -- 6. faithful-path (edge kernel) secondary headline at k=96 ------
-    # full async fidelity (1 msg/round drain, FIFO, timeouts) with the
-    # fused delivery/segment circuits — never TPU-timed before r4
-    if "edge96" in steps:
+    # -- 6/7. faithful-path (edge kernel) secondary headlines at k=96 ---
+    # full async fidelity (1 msg/round drain, FIFO, timeouts): once with
+    # the default segment layout (banked r4 first contact), once with the
+    # fused segment circuits — the faithful path's intended TPU layout
+    # (the default 'segment' is a scatter lowering, TPU's slowest form)
+    for step, extra in (("edge96", []),
+                        ("edge96_fused", ["--segment", "benes_fused"])):
+        if step not in steps:
+            continue
         rc, out = _run([PY, "bench.py", "--kernel", "edge", "--fire-policy",
                         "reference", "--fat-tree-k", "96", "--skip-des",
-                        "--skip-convergence"],
-                       "edge96")
+                        "--skip-convergence", *extra], step)
         rows = _json_lines(out)
         live = bool(rows) and rows[-1].get("backend") == "tpu" \
             and bool(rows[-1].get("ok"))
-        _keep("edge96", {"rc": rc, "result": rows[-1] if rows else None},
-              live)
+        _keep(step, {"rc": rc, "result": rows[-1] if rows else None}, live)
+
+    # -- 8. mega-scale ladder (virtual fat-trees, structured stencil) ---
+    # banks its own artifact progressively (MEGASCALE_TPU_r4.json) and
+    # itself refuses to bank non-TPU rows (tpu_megascale.py exits 2 on a
+    # CPU backend), so rc==0 here does imply TPU-measured rows
+    if "megascale" in steps:
+        rc, out = _run([PY, "scripts/tpu_megascale.py"], "megascale")
+        _keep("megascale", {"rc": rc}, rc == 0)
 
     print("session complete", flush=True)
     return 0
