@@ -51,7 +51,8 @@ MAX_LAUNCH_S = 20.0
 
 
 def make_runner(topo, kernel: str = "node", spmv: str = "xla",
-                segment: str = "auto", fire_policy: str = "fast"):
+                segment: str = "auto", fire_policy: str = "fast",
+                variant: str = "collectall"):
     """Build the fast collect-all measurement closure for one topology.
 
     Returns ``(run, read_est)``: ``run(r)`` executes an r-round compiled
@@ -65,13 +66,16 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
 
     from flow_updating_tpu.models.config import RoundConfig
 
+    # ValueError, not SystemExit: make_runner is called programmatically
+    # (microbench configs, ladder scripts) whose per-case containment
+    # catches Exception; the CLI wrapper turns these into clean exits
     if segment != "auto" and kernel != "edge":
-        raise SystemExit(
+        raise ValueError(
             "--segment selects the edge kernel's reduction layout; "
             "combine it with --kernel edge"
         )
     if fire_policy != "fast" and kernel != "edge":
-        raise SystemExit(
+        raise ValueError(
             "--fire-policy reference selects the faithful asynchronous "
             "dynamics, which only the edge kernel implements; combine it "
             "with --kernel edge"
@@ -80,6 +84,10 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
     if kernel == "node":
         from flow_updating_tpu.models import sync
 
+        if variant != "collectall":
+            raise ValueError(
+                "the node-collapsed kernel is collect-all only; pairwise "
+                "runs on the edge kernel (--kernel edge)")
         cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv=spmv)
         k = sync.NodeKernel(topo, cfg)
         state = k.init_state()
@@ -97,10 +105,10 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
         if fire_policy == "reference":
             # the faithful asynchronous dynamics (1 msg/round drain, FIFO
             # pending queue, 50-round timeouts) — the fidelity-path bench
-            cfg = RoundConfig.reference(variant="collectall",
+            cfg = RoundConfig.reference(variant=variant,
                                         segment_impl=segment)
         else:
-            cfg = RoundConfig.fast(variant="collectall",
+            cfg = RoundConfig.fast(variant=variant,
                                    segment_impl=segment)
         arrays = topo.device_arrays(coloring=cfg.needs_coloring,
                                     segment_ell=cfg.use_segment_ell,
@@ -118,7 +126,8 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
 
 def measure_tpu(topo, rounds: int, kernel: str = "node",
                 spmv: str = "xla", segment: str = "auto",
-                fire_policy: str = "fast") -> dict:
+                fire_policy: str = "fast",
+                variant: str = "collectall") -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: each executable launch carries a large fixed tunnel
@@ -134,7 +143,8 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
 
     t0 = time.perf_counter()
     run, read_est = make_runner(topo, kernel=kernel, spmv=spmv,
-                                segment=segment, fire_policy=fire_policy)
+                                segment=segment, fire_policy=fire_policy,
+                                variant=variant)
     plan_s = time.perf_counter() - t0  # host work: ELL build, Benes
     #                                    routing, fused-pass planning
 
@@ -173,6 +183,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         "fire_policy": fire_policy,
         "spmv": spmv if kernel == "node" else None,
         "segment": segment if kernel == "edge" else None,
+        "variant": variant,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }
@@ -590,7 +601,10 @@ def main():
             from flow_updating_tpu.utils.backend import pin_cpu
 
             pin_cpu()
-        result = run_bench(args)
+        try:
+            result = run_bench(args)
+        except ValueError as err:
+            raise SystemExit(f"invalid flag combination: {err}")
         print(json.dumps(result))
         return
 

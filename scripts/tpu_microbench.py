@@ -156,6 +156,51 @@ def passes(log2n: int) -> dict:
     }
 
 
+def configs() -> dict:
+    """The non-fat-tree BASELINE.json configs, TPU-timed: ER-10k
+    (collect-all fast node kernel + fast PAIRWISE edge kernel, the
+    'pairwise Flow-Updating, Erdős–Rényi 10k nodes' config) and BA-100k
+    collect-all (the degree-skewed scatter config).  Fat-tree rows live
+    in the --spmv tables; this closes the configs' TPU coverage."""
+    from bench import measure_tpu
+    from flow_updating_tpu import native
+    from flow_updating_tpu.topology.generators import (
+        barabasi_albert,
+        erdos_renyi,
+    )
+
+    import jax
+
+    out = {"platform": jax.devices()[0].platform, "rows": []}
+    fused = native.available()
+
+    er = erdos_renyi(10_000, avg_degree=8.0, seed=0)
+    ba = barabasi_albert(100_000, m=4, seed=0)
+    cases = [
+        ("er10k_collectall_node", er,
+         dict(kernel="node", spmv="benes_fused" if fused else "xla")),
+        ("er10k_pairwise_edge_fast", er,
+         dict(kernel="edge", variant="pairwise",
+              segment="benes_fused" if fused else "auto")),
+        ("ba100k_collectall_node", ba,
+         dict(kernel="node", spmv="benes_fused" if fused else "xla")),
+    ]
+    if fused:
+        # the xla-gather comparison row is only informative when the
+        # main BA row actually ran the fused path (otherwise identical)
+        cases.append(("ba100k_collectall_node_xla", ba,
+                      dict(kernel="node", spmv="xla")))
+    for name, topo, kw in cases:
+        row = {"name": name, "nodes": topo.num_nodes,
+               "edges": topo.num_edges, **kw}
+        try:
+            row.update(measure_tpu(topo, 64, **kw))
+        except Exception as exc:  # keep earlier rows
+            row["error"] = f"{type(exc).__name__}: {exc}"[:300]
+        out["rows"].append(row)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--probe-mosaic", action="store_true")
@@ -163,6 +208,8 @@ def main():
                     help="fat-tree arity for the spmv comparison")
     ap.add_argument("--passes", type=int, metavar="LOG2N",
                     help="log2 size for the unit-pass timing")
+    ap.add_argument("--configs", action="store_true",
+                    help="ER-10k / BA-100k BASELINE.json config rows")
     args = ap.parse_args()
     ran = False
     if args.probe_mosaic:
@@ -174,9 +221,12 @@ def main():
     if args.passes:
         print(json.dumps(passes(args.passes)))
         ran = True
+    if args.configs:
+        print(json.dumps(configs()))
+        ran = True
     if not ran:
         print(json.dumps({"error": "pick --probe-mosaic / --spmv K / "
-                                   "--passes LOG2N"}))
+                                   "--passes LOG2N / --configs"}))
 
 
 if __name__ == "__main__":

@@ -97,7 +97,7 @@ def probe() -> bool:
 
 
 ALL_STEPS = ("micro96", "micro160", "bench", "profile160", "micro40",
-             "edge96", "edge96_fused", "megascale")
+             "edge96", "edge96_fused", "megascale", "configs")
 
 
 def main() -> int:
@@ -225,6 +225,17 @@ def main() -> int:
     if "megascale" in steps:
         rc, out = _run([PY, "scripts/tpu_megascale.py"], "megascale")
         _keep("megascale", {"rc": rc}, rc == 0)
+
+    # -- 9. the non-fat-tree BASELINE.json configs (ER-10k, BA-100k) ----
+    if "configs" in steps:
+        rc, out = _run([PY, "scripts/tpu_microbench.py", "--configs"],
+                       "configs")
+        rows = _json_lines(out)
+        good = rc == 0 and bool(rows) \
+            and rows[-1].get("platform") == "tpu" \
+            and all("error" not in r for r in rows[-1].get("rows", []))
+        _keep("configs", {"rc": rc,
+                          "result": rows[-1] if rows else None}, good)
 
     print("session complete", flush=True)
     return 0
