@@ -5,6 +5,15 @@ from flow_updating_tpu.ops.segment import (
     segment_all,
 )
 from flow_updating_tpu.ops.segscan import segmented_affine_scan
+from flow_updating_tpu.ops.structured import (
+    CompleteStruct,
+    FatTreeStruct,
+    Grid2dStruct,
+    HypercubeStruct,
+    RingStruct,
+    Torus2dStruct,
+    structured_neighbor_sum,
+)
 
 __all__ = [
     "segment_sum",
@@ -12,4 +21,11 @@ __all__ = [
     "segment_max",
     "segment_all",
     "segmented_affine_scan",
+    "CompleteStruct",
+    "FatTreeStruct",
+    "Grid2dStruct",
+    "HypercubeStruct",
+    "RingStruct",
+    "Torus2dStruct",
+    "structured_neighbor_sum",
 ]

@@ -1,4 +1,7 @@
 from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.parallel.structured_sharded import (
+    PodShardedFatTreeKernel,
+)
 from flow_updating_tpu.parallel.auto import (
     pad_topology,
     init_sharded_state,
@@ -9,6 +12,7 @@ from flow_updating_tpu.parallel.auto import (
 
 __all__ = [
     "make_mesh",
+    "PodShardedFatTreeKernel",
     "pad_topology",
     "init_sharded_state",
     "shard_state",

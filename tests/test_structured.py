@@ -212,3 +212,25 @@ def test_reorder_drops_structure():
 def test_hypercube_rejects_d0():
     with pytest.raises(ValueError, match="d must be >= 1"):
         G.hypercube(0)
+
+
+def test_public_api_exports():
+    """The structured family is reachable from the package indexes."""
+    from flow_updating_tpu.ops import (
+        CompleteStruct,
+        FatTreeStruct,
+        Grid2dStruct,
+        HypercubeStruct,
+        RingStruct,
+        Torus2dStruct,
+        structured_neighbor_sum,
+    )
+    from flow_updating_tpu.parallel import PodShardedFatTreeKernel
+
+    assert FatTreeStruct(k=4).n == 36
+    assert HypercubeStruct(d=3).n == 8
+    assert Torus2dStruct(h=3, w=4).n == 12
+    assert {c.__name__ for c in (CompleteStruct, Grid2dStruct, RingStruct)} \
+        == {"CompleteStruct", "Grid2dStruct", "RingStruct"}
+    assert callable(structured_neighbor_sum)
+    assert PodShardedFatTreeKernel.__module__.endswith("structured_sharded")
