@@ -52,7 +52,7 @@ MAX_LAUNCH_S = 20.0
 
 def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 segment: str = "auto", fire_policy: str = "fast",
-                variant: str = "collectall"):
+                variant: str = "collectall", delivery: str = "gather"):
     """Build the fast collect-all measurement closure for one topology.
 
     Returns ``(run, read_est)``: ``run(r)`` executes an r-round compiled
@@ -80,6 +80,11 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
             "dynamics, which only the edge kernel implements; combine it "
             "with --kernel edge"
         )
+    if delivery != "gather" and kernel != "edge":
+        raise ValueError(
+            "--delivery selects the edge kernel's message-delivery "
+            "formulation; combine it with --kernel edge"
+        )
 
     if kernel == "node":
         from flow_updating_tpu.models import sync
@@ -106,13 +111,16 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
             # the faithful asynchronous dynamics (1 msg/round drain, FIFO
             # pending queue, 50-round timeouts) — the fidelity-path bench
             cfg = RoundConfig.reference(variant=variant,
-                                        segment_impl=segment)
+                                        segment_impl=segment,
+                                        delivery=delivery)
         else:
             cfg = RoundConfig.fast(variant=variant,
-                                   segment_impl=segment)
+                                   segment_impl=segment,
+                                   delivery=delivery)
         arrays = topo.device_arrays(coloring=cfg.needs_coloring,
                                     segment_ell=cfg.use_segment_ell,
-                                    segment_benes=cfg.segment_benes_mode)
+                                    segment_benes=cfg.segment_benes_mode,
+                                    delivery_benes=cfg.delivery_benes_mode)
         state = init_state(topo, cfg)
 
         def run(r):
@@ -127,7 +135,8 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
 def measure_tpu(topo, rounds: int, kernel: str = "node",
                 spmv: str = "xla", segment: str = "auto",
                 fire_policy: str = "fast",
-                variant: str = "collectall") -> dict:
+                variant: str = "collectall",
+                delivery: str = "gather") -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: each executable launch carries a large fixed tunnel
@@ -144,7 +153,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
     t0 = time.perf_counter()
     run, read_est = make_runner(topo, kernel=kernel, spmv=spmv,
                                 segment=segment, fire_policy=fire_policy,
-                                variant=variant)
+                                variant=variant, delivery=delivery)
     plan_s = time.perf_counter() - t0  # host work: ELL build, Benes
     #                                    routing, fused-pass planning
 
@@ -183,6 +192,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
         "fire_policy": fire_policy,
         "spmv": spmv if kernel == "node" else None,
         "segment": segment if kernel == "edge" else None,
+        "delivery": delivery if kernel == "edge" else None,
         "variant": variant,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
@@ -326,6 +336,9 @@ def parse_args(argv=None):
                     choices=("auto", "segment", "ell", "benes",
                              "benes_fused"),
                     help="per-node reduction layout for --kernel edge")
+    ap.add_argument("--delivery", default="gather",
+                    choices=("gather", "scatter", "benes", "benes_fused"),
+                    help="message-delivery formulation for --kernel edge")
     ap.add_argument("--des-ticks", type=int, default=10,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--des-repeats", type=int, default=3,
@@ -352,7 +365,8 @@ def run_bench(args) -> dict:
         spmv = "xla"
         tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=spmv,
                           segment=args.segment,
-                          fire_policy=args.fire_policy)
+                          fire_policy=args.fire_policy,
+                          delivery=args.delivery)
         if args.kernel == "node" and tpu["platform"] in ("tpu", "axon"):
             # the gather-free permutation-network path exists because the
             # XLA gather is TPU's bottleneck; measure it too, headline the
@@ -391,7 +405,8 @@ def run_bench(args) -> dict:
     else:
         tpu = measure_tpu(topo, args.rounds, kernel=args.kernel, spmv=spmv,
                           segment=args.segment,
-                          fire_policy=args.fire_policy)
+                          fire_policy=args.fire_policy,
+                          delivery=args.delivery)
     conv = None if args.skip_convergence else measure_rounds_to_rmse(topo)
 
     faithful = args.fire_policy == "reference"

@@ -207,7 +207,8 @@ def main() -> int:
     # fused segment circuits — the faithful path's intended TPU layout
     # (the default 'segment' is a scatter lowering, TPU's slowest form)
     for step, extra in (("edge96", []),
-                        ("edge96_fused", ["--segment", "benes_fused"])):
+                        ("edge96_fused", ["--segment", "benes_fused",
+                                          "--delivery", "benes_fused"])):
         if step not in steps:
             continue
         rc, out = _run([PY, "bench.py", "--kernel", "edge", "--fire-policy",
