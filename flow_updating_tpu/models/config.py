@@ -154,6 +154,12 @@ class RoundConfig:
                 "the node kernel has its own "
                 "(spmv='xla'|'pallas'|'benes'|'benes_fused')"
             )
+        if self.delivery != "gather" and self.kernel == "node":
+            raise ValueError(
+                "delivery selects the edge kernel's message-delivery "
+                "formulation; the node kernel has no per-edge messages — "
+                "its knob is spmv"
+            )
         if self.contention and self.kernel != "edge":
             raise ValueError(
                 "contention recomputes per-edge delays each round; only the "

@@ -234,3 +234,11 @@ def test_public_api_exports():
         == {"CompleteStruct", "Grid2dStruct", "RingStruct"}
     assert callable(structured_neighbor_sum)
     assert PodShardedFatTreeKernel.__module__.endswith("structured_sharded")
+
+
+def test_node_kernel_rejects_delivery_knob():
+    """delivery is an edge-kernel knob; the node kernel rejects it at
+    config validation (symmetric with segment_impl)."""
+    with pytest.raises(ValueError, match="delivery"):
+        RoundConfig.fast(variant="collectall", kernel="node",
+                         spmv="structured", delivery="benes")

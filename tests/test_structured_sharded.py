@@ -131,3 +131,15 @@ def test_engine_pod_mode_rejections():
     with pytest.raises(ValueError, match="pod"):
         (fu.Engine(config=bad2, mesh=make_mesh(2), multichip="pod")
          .set_topology(topo).build())
+
+
+def test_engine_pod_run_until_rmse():
+    """run_until_rmse works through the pod mode (host-chunked loop over
+    kernel.run + estimates)."""
+    import flow_updating_tpu as fu
+
+    topo = G.fat_tree(8, seed=7)
+    ep = fu.Engine(config=_cfg(), mesh=make_mesh(2), multichip="pod")
+    ep.set_topology(topo).build()
+    report = ep.run_until_rmse(1e-6, chunk=32, max_rounds=2048)
+    assert report["converged"] and report["rmse"] <= 1e-6
