@@ -32,6 +32,7 @@ from flow_updating_tpu.models.aggregates import (
     estimate_max,
     estimate_min,
     estimate_sum,
+    estimate_weighted_mean,
 )
 from flow_updating_tpu.models.actor import (
     TopoView,
@@ -56,5 +57,6 @@ __all__ = [
     "estimate_max",
     "estimate_min",
     "estimate_sum",
+    "estimate_weighted_mean",
     "__version__",
 ]

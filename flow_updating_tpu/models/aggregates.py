@@ -19,6 +19,8 @@ kernels take arbitrary per-node inputs:
   is detected on device and the loop stops there (``lax.while_loop``,
   bounded by N rounds).  This completes the classical gossip aggregate
   suite (Jesus/Baquero/Almeida survey: AVG / COUNT / SUM / MIN / MAX).
+* **weighted mean**: Σ(w·x)/Σw as the ratio of two mean runs (over w·x
+  and over w) — the survey's weighted-average construction.
 
 These are estimates with the same convergence behavior as the underlying
 mean (min/max excepted — exact at the fixed point); run enough rounds
@@ -74,6 +76,30 @@ def estimate_sum(topo, cfg: RoundConfig | None = None,
     cfg = cfg or RoundConfig.fast(variant="collectall", kernel="node")
     mean = _mean_estimates(topo, cfg, rounds)
     return mean * estimate_count(topo, cfg, rounds, root)
+
+
+def estimate_weighted_mean(topo, weights, cfg: RoundConfig | None = None,
+                           rounds: int = 1000) -> np.ndarray:
+    """Per-node estimates of Σ(w·x)/Σw — the classic two-aggregation
+    ratio (Jesus/Baquero/Almeida survey's weighted average): one mean run
+    over w·x and one over w, sharing the topology (any routed network
+    plan is a content-keyed cache hit).  Weights must be non-negative
+    with a positive sum."""
+    cfg = cfg or RoundConfig.fast(variant="collectall", kernel="node")
+    w = np.asarray(weights, np.float64)
+    if w.shape != (topo.num_nodes,):
+        raise ValueError(
+            f"weights must have shape ({topo.num_nodes},), got {w.shape}")
+    # (w >= 0).all() form: NaN fails the comparison, so non-finite
+    # weights raise instead of silently producing an all-NaN result
+    if not (w >= 0).all() or not np.isfinite(w).all() or not w.sum() > 0:
+        raise ValueError("weights must be non-negative, finite, and have "
+                         "a positive sum")
+    num = _mean_estimates(topo.with_values(topo.values * w), cfg, rounds)
+    den = _mean_estimates(topo.with_values(w), cfg, rounds)
+    # both denominators converge to mean(w) > 0; guard the not-yet-mixed
+    # zeros far from heavy nodes the same way estimate_count does
+    return np.where(den > 0, num / np.maximum(den, 1e-30), np.nan)
 
 
 @lru_cache(maxsize=None)

@@ -176,3 +176,24 @@ def test_count_aggregate_on_faithful_kernel():
                                 dtype="float64")
     n_est = estimate_count(topo, cfg=cfg, rounds=1500)
     np.testing.assert_allclose(n_est, 64.0, rtol=1e-4)
+
+
+def test_weighted_mean_aggregate():
+    """Σ(w·x)/Σw via the two-aggregation ratio, incl. zero weights."""
+    from flow_updating_tpu.models.aggregates import estimate_weighted_mean
+    from flow_updating_tpu.topology.generators import ring
+
+    rng = np.random.default_rng(3)
+    topo = ring(48, 2, seed=3)
+    w = rng.uniform(0.0, 2.0, 48)
+    w[:5] = 0.0  # some nodes contribute nothing
+    got = estimate_weighted_mean(topo, w, rounds=500)
+    expect = float((topo.values * w).sum() / w.sum())
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+    with pytest.raises(ValueError, match="non-negative"):
+        estimate_weighted_mean(topo, -w)
+    with pytest.raises(ValueError, match="non-negative"):
+        estimate_weighted_mean(topo, np.where(w == 0, np.nan, w))
+    with pytest.raises(ValueError, match="shape"):
+        estimate_weighted_mean(topo, w[:10])
