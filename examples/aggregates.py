@@ -5,7 +5,8 @@ The reference estimates only the average (``flowupdating-collectall.py``
 / ``flowupdating-pairwise.py``); the Flow-Updating literature derives
 the other classical aggregates from it, and this framework ships them
 all: AVG (the mean kernel), COUNT (root-indicator mean), SUM
-(mean x count), and exact MIN / MAX (extrema propagation).
+(mean x count), exact MIN / MAX (extrema propagation), and the
+degree-weighted mean (two-run ratio).
 
 Run:  python examples/aggregates.py [--generator erdos_renyi:1024] [--rounds 600]
 """
@@ -26,6 +27,7 @@ from flow_updating_tpu import (
     estimate_count,
     estimate_max,
     estimate_min,
+    estimate_weighted_mean,
 )
 from flow_updating_tpu.cli import _select_backend
 
@@ -57,6 +59,13 @@ def main() -> int:
     total = avg * count
     lo = float(estimate_min(topo)[0])
     hi = float(estimate_max(topo)[0])
+    # weighted mean: weight each node by its degree (any non-negative
+    # per-node weights work — Σ(w·x)/Σw via two mean runs); nanmedian:
+    # not-yet-mixed nodes read back as the NaN sentinel by contract
+    w = topo.out_deg.astype(float)
+    wavg = float(np.nanmedian(estimate_weighted_mean(topo, w,
+                                                     rounds=args.rounds)))
+    wtrue = float((topo.values * w).sum() / w.sum())
 
     print(f"nodes={topo.num_nodes} edges={topo.num_edges}")
     print(f"AVG   {avg:.6f}   (true {topo.true_mean:.6f})")
@@ -64,6 +73,7 @@ def main() -> int:
     print(f"SUM   {total:.4f}   (true {topo.values.sum():.4f})")
     print(f"MIN   {lo:.6f}   (true {topo.values.min():.6f})")
     print(f"MAX   {hi:.6f}   (true {topo.values.max():.6f})")
+    print(f"WAVG  {wavg:.6f}   (degree-weighted; true {wtrue:.6f})")
     return 0
 
 

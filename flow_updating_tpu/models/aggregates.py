@@ -1,4 +1,4 @@
-"""Derived aggregates on top of the mean kernel: COUNT, SUM, MIN, MAX.
+"""Derived aggregates on the mean kernel: COUNT, SUM, MIN, MAX, weighted mean.
 
 The reference estimates only the average.  The Flow-Updating literature
 (Jesus/Baquero/Almeida) derives the other classical gossip aggregates
