@@ -8,8 +8,9 @@ of the value/degree arrays).  This ladder measures gossip rounds/sec at
 §5 asks for (node count 6 -> 1M and beyond), far past what the edge-array
 paths can hold.
 
-Writes MEGASCALE_TPU_r4.json progressively (one row per scale, banked as
-soon as measured) so a mid-ladder tunnel wedge keeps earlier rows.  Each
+Writes its artifact (default MEGASCALE_TPU_r5.json, see --out)
+progressively (one row per scale, banked as soon as measured) so a
+mid-ladder tunnel wedge keeps earlier rows.  Each
 row: nodes, rounds/s via the R-vs-2R scan difference (bench.measure_tpu,
 launch-capped), fp32 state bytes, and a chunked convergence check
 (rmse after 3x diameter-ish rounds).
@@ -28,7 +29,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-OUT = os.path.join(REPO, "MEGASCALE_TPU_r4.json")
+DEFAULT_OUT = os.path.join(REPO, "MEGASCALE_TPU_r5.json")
 
 
 def measure_one(k: int) -> dict:
@@ -79,10 +80,13 @@ def measure_one(k: int) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ks", default="160,224,320,448,640")
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help="artifact path (progressively banked + merged)")
     ap.add_argument("--allow-cpu", action="store_true",
                     help="permit banking non-TPU rows (testing only; the "
                          "artifact is the round's TPU number of record)")
     args = ap.parse_args()
+    out_path = args.out
 
     import jax
 
@@ -95,9 +99,9 @@ def main() -> int:
 
     banked = {"what": "structured-stencil ladder on virtual fat-trees, "
                       "one chip", "rows": []}
-    if os.path.exists(OUT):
+    if os.path.exists(out_path):
         try:
-            with open(OUT) as f:
+            with open(out_path) as f:
                 prior = json.load(f)
             if isinstance(prior, dict) and prior.get("rows"):
                 banked = prior
@@ -116,14 +120,14 @@ def main() -> int:
             row = {"k": k, "error": f"{type(exc).__name__}: {exc}"[:400]}
             banked["rows"] = [r for r in banked["rows"] if r.get("k") != k]
             banked["rows"].append(row)
-            with open(OUT, "w") as f:
+            with open(out_path, "w") as f:
                 json.dump(banked, f, indent=1)
             print(json.dumps(row), flush=True)
             return 1
         banked["rows"] = [r for r in banked["rows"] if r.get("k") != k]
         banked["rows"].append(row)
         banked["rows"].sort(key=lambda r: r["k"])
-        with open(OUT, "w") as f:
+        with open(out_path, "w") as f:
             json.dump(banked, f, indent=1)
         print(json.dumps(row), flush=True)
     return 0

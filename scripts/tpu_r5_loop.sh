@@ -1,0 +1,20 @@
+#!/bin/sh
+# Round-5 TPU contact loop: probe (wedge-safe, 290 s budget inside the
+# session script) every 15 min until the tunnel answers, then run the
+# full banked session.  rc=3 means probe-failed (keep looping); rc=4
+# means the canary failed twice (likely transient wedge mid-recovery:
+# back off longer, retry); rc=0 means the session ran to completion.
+cd "$(dirname "$0")/.." || exit 1
+i=0
+while :; do
+    i=$((i + 1))
+    echo "== attempt $i: $(date -u +%FT%TZ)" >> _r5_session_loop.log
+    python scripts/tpu_r5_session.py >> _r5_session_loop.log 2>&1
+    rc=$?
+    echo "== attempt $i exited rc=$rc" >> _r5_session_loop.log
+    case "$rc" in
+        0) echo "session complete" >> _r5_session_loop.log; exit 0 ;;
+        3) sleep 900 ;;
+        *) sleep 1800 ;;
+    esac
+done
