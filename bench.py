@@ -50,6 +50,20 @@ def build_topology(k: int):
 MAX_LAUNCH_S = 20.0
 
 
+def _edge_runtime(topo, cfg):
+    """Shared edge-kernel setup — device arrays + initial state.  One
+    construction site for make_runner and the convergence metric, so the
+    (expensive, plan-bearing) device_arrays call can't drift between
+    them."""
+    from flow_updating_tpu.models.state import init_state
+
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring,
+                                segment_ell=cfg.use_segment_ell,
+                                segment_benes=cfg.segment_benes_mode,
+                                delivery_benes=cfg.delivery_benes_mode)
+    return arrays, init_state(topo, cfg)
+
+
 def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 segment: str = "auto", fire_policy: str = "fast",
                 variant: str = "collectall", delivery: str = "gather"):
@@ -105,7 +119,6 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
         read_est = k.estimates
     else:
         from flow_updating_tpu.models.rounds import node_estimates, run_rounds
-        from flow_updating_tpu.models.state import init_state
 
         if fire_policy == "reference":
             # the faithful asynchronous dynamics (1 msg/round drain, FIFO
@@ -117,11 +130,7 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
             cfg = RoundConfig.fast(variant=variant,
                                    segment_impl=segment,
                                    delivery=delivery)
-        arrays = topo.device_arrays(coloring=cfg.needs_coloring,
-                                    segment_ell=cfg.use_segment_ell,
-                                    segment_benes=cfg.segment_benes_mode,
-                                    delivery_benes=cfg.delivery_benes_mode)
-        state = init_state(topo, cfg)
+        arrays, state = _edge_runtime(topo, cfg)
 
         def run(r):
             out = run_rounds(state, arrays, cfg, r)
@@ -218,14 +227,9 @@ def measure_rounds_to_rmse(topo, threshold: float = 1e-6,
         state = k.init_state()
     else:
         from flow_updating_tpu.models.rounds import node_estimates, run_rounds
-        from flow_updating_tpu.models.state import init_state
 
         cfg = RoundConfig.fast(variant=variant)
-        arrays = topo.device_arrays(coloring=cfg.needs_coloring,
-                                    segment_ell=cfg.use_segment_ell,
-                                    segment_benes=cfg.segment_benes_mode,
-                                    delivery_benes=cfg.delivery_benes_mode)
-        state = init_state(topo, cfg)
+        arrays, state = _edge_runtime(topo, cfg)
 
         class _EdgeChunks:
             def run(self, st, r):

@@ -16,7 +16,7 @@ here it is a free by-product.
 
 from __future__ import annotations
 
-import flax.struct
+from flow_updating_tpu.utils import struct
 import jax
 import jax.numpy as jnp
 
@@ -24,7 +24,7 @@ from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.topology.graph import Topology
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class FlowUpdatingState:
     t: jnp.ndarray             # () int32 — round counter ("Engine.clock")
     value: jnp.ndarray         # (N,) — local input values

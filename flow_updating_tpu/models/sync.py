@@ -41,13 +41,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import flax.struct
+from flow_updating_tpu.utils import struct
 
 from flow_updating_tpu.models.config import COLLECTALL, RoundConfig
 from flow_updating_tpu.topology.graph import Topology
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class NodeSyncState:
     """Per-node state, stored in the ELL permutation's node order."""
 
@@ -58,7 +58,7 @@ class NodeSyncState:
     A_prev: jnp.ndarray    # (N,) neighbor sum of avg_{r-1}
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class NodeSyncArrays:
     """Device-side constants for the node-collapsed round."""
 
@@ -67,9 +67,9 @@ class NodeSyncArrays:
     deg: jnp.ndarray       # (N,) float degree
     mats: tuple            # per-bucket (rows, width) int32 neighbor matrices
     ns_masks: tuple = ()   # spmv='benes': permutation-network stage masks
-    ns_plan: object = flax.struct.field(pytree_node=False, default=None)
+    ns_plan: object = struct.field(pytree_node=False, default=None)
     #                        static NeighborSumPlan (identity-hashed)
-    ns_struct: object = flax.struct.field(pytree_node=False, default=None)
+    ns_struct: object = struct.field(pytree_node=False, default=None)
     #                        spmv='structured': closed-form adjacency
     #                        descriptor (ops/structured.py; frozen+hashable)
 

@@ -40,7 +40,7 @@ import dataclasses
 import functools
 import math
 
-import flax.struct
+from flow_updating_tpu.utils import struct
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,7 +55,7 @@ P = jax.sharding.PartitionSpec
 shard_map = jax.shard_map
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class PlanArrays:
     """Per-shard device arrays, stacked on a leading shard axis (S, ...)."""
 
@@ -72,7 +72,7 @@ class PlanArrays:
     #                           coloring=True — fast synchronous pairwise)
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class HaloTables:
     """Replicated plan-time routing tables for halo entries, in all_gather
     (shard-major) order.  Constant across rounds — kept out of the per-round
@@ -83,7 +83,7 @@ class HaloTables:
     delay: jnp.ndarray   # (S*H,) i32 — sending edge's delivery delay
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class PermTables:
     """Per-offset point-to-point halo routing (``halo='ppermute'``).
 

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import functools
 
-import flax.struct
+from flow_updating_tpu.utils import struct
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -50,7 +50,7 @@ shard_map = jax.shard_map
 _sharded_plan_cache: dict = {}
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class ShardedSpmvArrays:
     """Constants, stacked per shard on the leading axis."""
 
@@ -58,7 +58,7 @@ class ShardedSpmvArrays:
     inv_depp1: jnp.ndarray  # (S, M/S)
     deg: jnp.ndarray        # (S, M/S)
     mask_planes: tuple      # per pass: (S, rows, 128)
-    plan: object = flax.struct.field(pytree_node=False, default=None)
+    plan: object = struct.field(pytree_node=False, default=None)
     #                         static _ShardedPlan (identity-hashed)
 
 

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import flax.struct
+from flow_updating_tpu.utils import struct
 
 from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.ops.structured import FatTreeStruct
@@ -45,7 +45,7 @@ from flow_updating_tpu.parallel.mesh import NODE_AXIS
 from flow_updating_tpu.topology.graph import Topology
 
 
-@flax.struct.dataclass
+@struct.dataclass
 class PodState:
     """Sections: host (k, h, h), edge (k, h), agg (k, h), core (h, h),
     where h = k/2; host/edge/agg are pod-sharded on axis 0."""

@@ -31,6 +31,8 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
+from flow_updating_tpu.utils import struct
+
 logger = logging.getLogger("flow_updating_tpu")
 
 
@@ -395,10 +397,7 @@ class EllBuckets:
     #                         (CSR edge space, padded with E)
 
 
-import flax.struct  # noqa: E402  (kept close to its sole consumer)
-
-
-@flax.struct.dataclass
+@struct.dataclass
 class TopoArrays:
     """Pytree of device arrays the round kernel consumes."""
 
@@ -410,7 +409,7 @@ class TopoArrays:
     edge_rank: object
     delay: object
     edge_color: object = None
-    num_colors: int = flax.struct.field(pytree_node=False, default=0)
+    num_colors: int = struct.field(pytree_node=False, default=0)
     ell_edge_mats: object = None   # tuple of (rows, w) out-edge ELL buckets
     ell_inv_perm: object = None    # (N,) original node -> permuted row
     # link-level contention model (cfg.contention; platform topologies)
@@ -421,7 +420,7 @@ class TopoArrays:
     # gather-free message delivery (cfg.delivery='benes')
     rev_masks: tuple = ()            # Beneš stage masks for the rev perm
     delay_rev: object = None         # (E,) i32 = delay[rev] (static)
-    rev_plan: object = flax.struct.field(pytree_node=False, default=None)
+    rev_plan: object = struct.field(pytree_node=False, default=None)
     # gather/scatter-free segment reductions + broadcasts
     # (cfg.segment_impl='benes'; ops/seg_benes.py)
     deg_e: object = None             # (E,) i32 out_deg[src], baked at build
@@ -431,7 +430,7 @@ class TopoArrays:
     seg_dist: object = None          # (P,) i32 edge_rank padded (free masks)
     seg_extract_masks: tuple = ()    # row-end -> node Beneš masks
     seg_place_masks: tuple = ()      # node -> row-head Beneš masks
-    seg_plan: object = flax.struct.field(pytree_node=False, default=None)
+    seg_plan: object = struct.field(pytree_node=False, default=None)
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -1,26 +1,34 @@
-from flow_updating_tpu.utils.metrics import (
-    rmse,
-    mass_residual,
-    antisymmetry_residual,
-    convergence_report,
-)
-from flow_updating_tpu.utils.checkpoint import (
-    save_checkpoint,
-    load_checkpoint,
-    topology_fingerprint,
-)
-from flow_updating_tpu.utils.eventlog import EventLog
-from flow_updating_tpu.utils.trace import trace, annotate
+"""Utility subpackage: metrics, checkpointing, event log, tracing, struct.
 
-__all__ = [
-    "rmse",
-    "mass_residual",
-    "antisymmetry_residual",
-    "convergence_report",
-    "save_checkpoint",
-    "load_checkpoint",
-    "topology_fingerprint",
-    "EventLog",
-    "trace",
-    "annotate",
-]
+Re-exports are lazy (PEP 562): ``utils.checkpoint`` imports the model
+state (which imports the topology, which imports ``utils.struct``), so an
+eager re-export here would close an import cycle for any module that
+pulls a utility in at its own import time.  Lazy resolution also keeps
+light entry points (``utils.backend`` is imported before backend
+selection) from paying for jax-heavy siblings.
+"""
+
+_EXPORTS = {
+    "rmse": "metrics",
+    "mass_residual": "metrics",
+    "antisymmetry_residual": "metrics",
+    "convergence_report": "metrics",
+    "save_checkpoint": "checkpoint",
+    "load_checkpoint": "checkpoint",
+    "topology_fingerprint": "checkpoint",
+    "EventLog": "eventlog",
+    "trace": "trace",
+    "annotate": "trace",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"flow_updating_tpu.utils.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

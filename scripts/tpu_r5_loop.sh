@@ -2,8 +2,10 @@
 # Round-5 TPU contact loop: probe (wedge-safe, 290 s budget inside the
 # session script) every 15 min until the tunnel answers, then run the
 # full banked session.  rc=3 means probe-failed (keep looping); rc=4
-# means the canary failed twice (likely transient wedge mid-recovery:
+# means the canary found no live-TPU rows (likely mid-recovery wedge:
 # back off longer, retry); rc=0 means the session ran to completion.
+# Any OTHER rc is a permanent failure (crash, usage error, missing
+# interpreter) — exit and surface it instead of retrying for days.
 cd "$(dirname "$0")/.." || exit 1
 i=0
 while :; do
@@ -15,6 +17,8 @@ while :; do
     case "$rc" in
         0) echo "session complete" >> _r5_session_loop.log; exit 0 ;;
         3) sleep 900 ;;
-        *) sleep 1800 ;;
+        4) sleep 1800 ;;
+        *) echo "unexpected rc=$rc — stopping (see log)" \
+               >> _r5_session_loop.log; exit "$rc" ;;
     esac
 done

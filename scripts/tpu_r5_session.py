@@ -164,12 +164,16 @@ def main() -> int:
             rc, out = _run([PY, "scripts/tpu_microbench.py", "--spmv", "96"],
                            f"micro96_a{attempt}")
             rows = _json_lines(out)
-            if rc == 0 and rows:
+            if _tpu_rows(rc, rows):
                 break
         _keep("micro96", {"rc": rc, "rows": rows}, _tpu_rows(rc, rows))
-        if rc != 0 or not rows:
-            print("canary failed twice — banking what exists and stopping "
-                  "before a wedged tunnel eats the session", flush=True)
+        if not _tpu_rows(rc, rows):
+            # a clean rc with CPU rows is the silent-backend-fallback case:
+            # the TPU is gone, every later step would burn the contact on
+            # unbankable degraded runs — stop and let the loop back off
+            print("canary failed twice (no live-TPU rows) — banking what "
+                  "exists and stopping before a wedged tunnel eats the "
+                  "session", flush=True)
             return 4
 
     # -- 2. faithful asynchronous path, fused circuits (VERDICT item 1) --

