@@ -66,7 +66,8 @@ class Engine:
 
     def __init__(self, argv=None, config: RoundConfig | None = None,
                  mesh=None, multichip: str = "auto",
-                 halo: str = "ppermute", partition: str = "bfs"):
+                 halo: str = "ppermute", partition: str = "bfs",
+                 host_actors: bool = False):
         # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
         # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
         # accepts a ready RoundConfig here.  ``mesh`` (a jax.sharding.Mesh
@@ -89,6 +90,7 @@ class Engine:
             raise ValueError(f"unknown multichip mode {multichip!r}")
         self.argv = list(argv) if argv else []
         self.config = config or RoundConfig.fast()
+        self.config = self._apply_argv_cfg(self.config)
         self.mesh = mesh
         self.multichip = multichip
         self.halo = halo
@@ -104,6 +106,70 @@ class Engine:
         self._n_real: int | None = None   # real node count when mesh-padded
         self._halo_plan = None
         self.netzone_root = _NetzoneShim(self)
+        # host-fidelity mode: arbitrary Python actors on the s4u host DES
+        # (flow_updating_tpu.s4u) instead of array kernels — the explicit
+        # opt-in for the reference's register_actor(<any class>) surface
+        self.host_actors = bool(host_actors)
+        self._hostdes = None
+        if self.host_actors and mesh is not None:
+            raise ValueError(
+                "host_actors=True runs Python bytecode on the host; it "
+                "cannot shard over a device mesh — drop mesh=, or "
+                "express the protocol as a VectorActor")
+
+    def _apply_argv_cfg(self, cfg: RoundConfig) -> RoundConfig:
+        """Consume SimGrid-style ``--cfg=key:value`` flags from argv.
+
+        The reference passes ``sys.argv`` straight into the engine and
+        SimGrid interprets ``--cfg=`` entries as config overrides
+        (``flowupdating-collectall.py:152``).  Here every RoundConfig
+        field is addressable by its name (dashes accepted for
+        underscores); values are parsed to the field's type and the
+        result re-validated by RoundConfig itself.  SimGrid's own
+        ``category/name`` keys (always slash-form, e.g. ``network/model``)
+        have no equivalent on this runtime and are logged + skipped so a
+        reference command line keeps working verbatim; a mistyped *bare*
+        key raises, like SimGrid's xbt error — silently ignoring a config
+        override is worse than failing."""
+        import dataclasses as _dc
+        import typing as _t
+
+        # PEP 563: field.type is a string under `from __future__ import
+        # annotations`; resolve the declared types, not the live values'
+        hints = _t.get_type_hints(RoundConfig)
+        overrides = {}
+        for arg in self.argv:
+            if not (isinstance(arg, str) and arg.startswith("--cfg=")):
+                continue
+            key, sep, val = arg[len("--cfg="):].partition(":")
+            key = key.strip()
+            if "/" in key:
+                logger.warning(
+                    "--cfg=%s: SimGrid engine key has no equivalent on "
+                    "the TPU runtime; ignored", key)
+                continue
+            key = key.replace("-", "_")
+            if not sep or key not in hints:
+                raise ValueError(
+                    f"--cfg={key!r}: unknown config key (valid: "
+                    f"{', '.join(sorted(hints))}; format "
+                    "--cfg=key:value)")
+            ftype = hints[key]
+            if ftype is bool:
+                low = val.strip().lower()
+                if low in ("1", "true", "yes", "on"):
+                    overrides[key] = True
+                elif low in ("0", "false", "no", "off"):
+                    overrides[key] = False
+                else:
+                    raise ValueError(
+                        f"--cfg={key}:{val!r}: not a boolean "
+                        "(use true/false, yes/no, on/off, 1/0)")
+            elif ftype in (int, float):
+                overrides[key] = ftype(val)
+            else:
+                overrides[key] = val.strip()
+        return _dc.replace(cfg, **overrides) if overrides else cfg
 
     # ---- setup -----------------------------------------------------------
     @property
@@ -127,10 +193,20 @@ class Engine:
         under ``jit`` like the built-in kernels (see ``models/actor.py``
         for the contract and the per-actor-class rationale).
 
-        Anything else raises: per-actor Python bytecode (the reference's
-        ``Peer`` class, ``flowupdating-collectall.py:156``) cannot
-        execute on a TPU, and silently recording it would make users
-        think their callable runs."""
+        With ``Engine(host_actors=True)``, ``fn`` may be ANY Python
+        callable/class — the reference's ``register_actor("peer", Peer)``
+        surface (``flowupdating-collectall.py:156``) — executed on the
+        deterministic host-side DES (:mod:`flow_updating_tpu.s4u`) at
+        host speed.  Without that opt-in, anything else raises:
+        per-actor Python bytecode cannot execute on a TPU, and silently
+        recording it would make users think their callable runs."""
+        if self.host_actors:
+            if fn is not None and not callable(fn):
+                raise TypeError(
+                    f"register_actor({name!r}): {type(fn).__name__} is "
+                    "not callable")
+            self._registered[name] = fn
+            return self
         from flow_updating_tpu.models.actor import VectorActor
 
         if fn is not None and not isinstance(fn, VectorActor):
@@ -138,9 +214,13 @@ class Engine:
                 f"register_actor({name!r}): got {type(fn).__name__}; "
                 "per-actor Python callables cannot execute on TPU.  Pass "
                 "None to select the built-in protocols "
-                "(RoundConfig.variant), or express the protocol as a "
+                "(RoundConfig.variant), express the protocol as a "
                 "flow_updating_tpu.models.actor.VectorActor — pure "
-                "(N,)/(E,) array functions scanned under jit"
+                "(N,)/(E,) array functions scanned under jit — or opt "
+                "into the host-fidelity runtime with "
+                "Engine(host_actors=True) to run arbitrary Python "
+                "actors on the s4u host DES (reference semantics, host "
+                "speed, not TPU)"
             )
         self._registered[name] = fn
         return self
@@ -172,7 +252,37 @@ class Engine:
         if function is None and len(self._registered) == 1:
             function = next(iter(self._registered))
         self.deployment = load_deployment(path, function=function)
+        if self.host_actors:
+            # spawn reference-style now, so a driver-level Actor.create
+            # (e.g. the watcher, collectall.py:162) finds a live runtime
+            # between load_deployment and run_until
+            self._host_spawn_deployment()
         return self
+
+    def _host_des(self):
+        """The lazily created s4u host DES (host_actors mode)."""
+        from flow_updating_tpu import s4u
+
+        if self._hostdes is None:
+            self._hostdes = s4u.HostDes(platform=self.platform)
+            s4u._CURRENT_DES = self._hostdes
+        return self._hostdes
+
+    def _host_spawn_deployment(self) -> None:
+        """Instantiate each deployment actor SimGrid-style: the
+        registered class is constructed with the deployment's string
+        args *inside the actor context* and then called
+        (``flowupdating-collectall.py:156-157`` + ``actors.xml:4-7``)."""
+        des = self._host_des()
+        for spec in self.deployment.actors:
+            fn = self._registered.get(spec.function)
+            if fn is None:
+                raise RuntimeError(
+                    f"deployment binds function {spec.function!r} but no "
+                    "callable was registered for it (host_actors mode "
+                    "has no built-in protocol fallback)")
+            des.spawn(spec.host, des.host(spec.host),
+                      lambda _f=fn, _a=spec.args: _f(*_a)(), ())
 
     def set_topology(self, topo: Topology) -> "Engine":
         self.topology = topo
@@ -827,6 +937,14 @@ class Engine:
         self._clock += n * TICK_INTERVAL
         return self
 
+    def _host_run_until(self, t_end: float) -> "Engine":
+        """host_actors mode: drive the s4u DES (actors were spawned at
+        ``load_deployment``; any extras via ``s4u.Actor.create``)."""
+        des = self._host_des()
+        des.run_until(float(t_end))
+        self._clock = des.clock
+        return self
+
     def run_until(self, t_end: float) -> "Engine":
         """Advance simulated time to ``t_end``, honoring watchers: compiled
         chunks of rounds between sampling points, host callbacks at each
@@ -834,6 +952,8 @@ class Engine:
         (after which the clock still advances to ``t_end``, like the
         reference's dead time between kill_all at t=1000 and engine stop at
         t=10000, ``collectall.py:145,164``)."""
+        if self.host_actors:
+            return self._host_run_until(t_end)
         if self.state is None:
             self.build()
         events = sorted(

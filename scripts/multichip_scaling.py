@@ -269,6 +269,68 @@ def child(n_devices: int) -> None:
     print("RESULTS " + json.dumps(results))
 
 
+def child_mega(S: int, k: int) -> None:
+    """Mega-scale pod-stencil evidence (VERDICT r4 item 8): the 66M-node
+    design point's sharding, exercised at the largest scale a CPU mesh
+    can hold — a VIRTUAL fat-tree (no edge arrays; k=344 is 10.3M nodes)
+    at S shards.  Records rounds/s shape + HLO collective bytes and
+    asserts estimate parity against the single-device structured kernel.
+    CPU wall-clock is not a TPU prediction; the evidence is that the
+    sharded program compiles, executes, matches, and moves O(k) bytes
+    per round regardless of node count."""
+    import numpy as np
+
+    import jax
+
+    from flow_updating_tpu.models import sync
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.ops.structured import FatTreeStruct
+    from flow_updating_tpu.parallel.mesh import make_mesh
+    from flow_updating_tpu.parallel.structured_sharded import (
+        PodShardedFatTreeKernel,
+    )
+    from flow_updating_tpu.topology.generators import fat_tree
+
+    assert len(jax.devices()) >= S, f"{len(jax.devices())} devices < {S}"
+    mesh = make_mesh(S)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured")
+    topo = fat_tree(k, seed=0, materialize_edges=False)
+    assert isinstance(topo.structure, FatTreeStruct)
+    assert topo.structure.k % S == 0, (k, S)
+    tname = f"fat_tree_k{k}_virtual"
+    results = []
+
+    # single-device structured reference at the same scale
+    k1 = sync.NodeKernel(topo, cfg)
+    ref_est = k1.estimates(k1.run(k1.init_state(), 8))
+
+    runs = [("pod_structured",
+             PodShardedFatTreeKernel(topo, cfg, mesh)),
+            ("gspmd_structured",
+             sync.NodeKernel(topo, cfg, mesh=mesh))]
+    for path, kern in runs:
+        st = kern.init_state()
+        spr, noisy = _time_scan(kern.run, st, 8)
+        hlo = (jax.jit(lambda s, _k=kern: _k.run(s, 8))
+               .lower(st).compile().as_text())
+        est = kern.estimates(kern.run(st, 8))
+        # fp32 at 10M+ nodes: sharded stencil reductions accumulate in a
+        # different order than the single-device kernel; observed max
+        # deviation 1.5e-5 on values ~0.5 (0.27% of elements past 1e-5).
+        # 5e-5 still catches any semantic error by orders of magnitude.
+        np.testing.assert_allclose(est, ref_est, atol=5e-5)
+        results.append({
+            "path": path, "topology": tname, "shards": S,
+            "nodes": topo.num_nodes,
+            "rounds_per_sec": round(1.0 / spr, 2),
+            "hlo_collective_bytes": hlo_collective_bytes(hlo),
+            **({"noisy": True} if noisy else {}),
+        })
+
+    print("RESULTS " + json.dumps(results))
+
+
 def _merge_keep_best(out_path: str, fresh: list) -> list:
     """Merge fresh rows into a banked artifact, keeping the best
     measurement per (path, topology, shards).
@@ -304,23 +366,39 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", type=int, default=0)
     ap.add_argument("--shards", default="1,2,4,8")
+    ap.add_argument("--mega-k", type=int, default=0,
+                    help="also run the mega-scale virtual fat-tree "
+                         "section (pod/gspmd structured only) at this "
+                         "arity on the LARGEST shard count (e.g. 344 = "
+                         "10.3M nodes at S=8)")
+    ap.add_argument("--mega-only", action="store_true",
+                    help="skip the standard S-ladder; run only --mega-k")
     ap.add_argument("--out", default=os.path.join(
-        REPO, "MULTICHIP_SCALING_r4.json"))
+        REPO, "MULTICHIP_SCALING_r5.json"))
     args = ap.parse_args(argv)
 
     if args.child:
-        child(args.child)
+        if args.mega_k:
+            child_mega(args.child, args.mega_k)
+        else:
+            child(args.child)
         return 0
 
     sys.path.insert(0, REPO)
     from flow_updating_tpu.utils.backend import cpu_subprocess_env
 
+    shard_list = [int(s) for s in args.shards.split(",")]
+    jobs = [] if args.mega_only else [(S, []) for S in shard_list]
+    if args.mega_k:
+        jobs.append((max(shard_list), ["--mega-k", str(args.mega_k)]))
+
     all_results = []
-    for S in (int(s) for s in args.shards.split(",")):
+    for S, extra in jobs:
         env = cpu_subprocess_env(n_virtual_devices=max(S, 2),
                                  extra_path=REPO)
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", str(S)],
+            [sys.executable, os.path.abspath(__file__), "--child", str(S),
+             *extra],
             env=env, cwd=REPO, capture_output=True, text=True, timeout=3600)
         if proc.returncode != 0:
             print(proc.stdout[-2000:], file=sys.stderr)

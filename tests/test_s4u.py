@@ -1,0 +1,121 @@
+"""Host-actor runtime (s4u) — the reference's arbitrary-Python-actor
+surface, closed as an explicit host-fidelity mode (VERDICT r4 missing #2).
+
+The actor under test is the shipped example's ``Peer``
+(examples/host_actors.py) — a fresh Flow-Updating implementation written
+against :mod:`flow_updating_tpu.s4u` the way a reference user would port
+their own actor (verbs import-compatible with the reference's contact
+surface, SURVEY.md §1 L1; protocol per SURVEY.md A4/A6/A7, not a copy of
+the reference file).  Importing it here keeps example and test from
+drifting apart and proves the shipped example converges.  The fixture
+deployment is deliberately asymmetric, so runtime neighbor adoption (A7)
+is exercised too.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from flow_updating_tpu import s4u
+from flow_updating_tpu.engine import Engine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLATFORM = os.path.join(ROOT, "examples/platforms/small6.xml")
+ACTORS = os.path.join(ROOT, "examples/deployments/small6_actors.xml")
+
+_spec = importlib.util.spec_from_file_location(
+    "host_actors_example", os.path.join(ROOT, "examples/host_actors.py"))
+example = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(example)
+
+Peer = example.Peer
+watcher = example.watcher
+RESULTS = example.global_values
+
+
+@pytest.fixture()
+def host_engine():
+    RESULTS.clear()
+    eng = Engine(host_actors=True)
+    eng.load_platform(PLATFORM)
+    eng.register_actor("peer", Peer)
+    eng.load_deployment(ACTORS)
+    return eng
+
+
+def test_reference_style_peer_converges(host_engine):
+    eng = host_engine
+    s4u.Actor.create("watcher", s4u.Host.by_name("Lisboa"),
+                     watcher, 400.0, 10.0)
+    eng.run_until(500.0)
+    assert eng.clock == 500.0
+    last = RESULTS["last_avg"]
+    assert set(last) == {"Lisboa", "Porto", "Braga", "Coimbra", "Faro",
+                         "Aveiro"}
+    for name, avg in last.items():
+        assert avg == pytest.approx(30.0, abs=0.05), (name, avg)
+    # mass conservation (A6): values were never mutated, sum preserved
+    assert sum(RESULTS["value"].values()) / 6 == pytest.approx(30.0)
+
+
+def test_kill_all_stops_actors(host_engine):
+    eng = host_engine
+    s4u.Actor.create("watcher", s4u.Host.by_name("Lisboa"),
+                     watcher, 50.0, 10.0)
+    eng.run_until(200.0)
+    # after kill_all at t=50 nothing fires again: clock still reaches the
+    # horizon (the reference's dead-time semantics, collectall.py:145,164)
+    assert eng.clock == 200.0
+    alive = [c for c in eng._hostdes.actors if not c.done]
+    assert not alive, [c.name for c in alive]
+
+
+def test_register_arbitrary_callable_requires_opt_in():
+    eng = Engine()
+    with pytest.raises(TypeError, match="host_actors=True"):
+        eng.register_actor("peer", Peer)
+
+
+def test_mesh_and_host_actors_are_exclusive():
+    with pytest.raises(ValueError, match="host_actors"):
+        Engine(host_actors=True, mesh=object())
+
+
+def test_net_delay_uses_platform_routes(host_engine):
+    """A matched put completes after route latency + size/bandwidth —
+    the flow-model surface (SURVEY.md N3) at host-DES fidelity."""
+    eng = host_engine
+    des = eng._hostdes
+    lat = eng.platform.route_latency("Lisboa", "Porto", default=0.0)
+    bw = eng.platform.route_bandwidth("Lisboa", "Porto")
+    src = next(c for c in des.actors if c.name == "Lisboa")
+    mbox = des.mailbox("Porto")
+    delay = des._net_delay(src, mbox, 1000.0)
+    expected = lat + (1000.0 / bw if bw != float("inf") else 0.0)
+    assert delay == pytest.approx(expected)
+    assert delay > 0.0
+
+
+def test_cancelled_pending_put_is_never_delivered(host_engine):
+    """Comm.cancel on a queued put detaches it: a later get must not
+    receive the cancelled message (SimGrid detach semantics)."""
+    eng = host_engine
+    got = {}
+
+    def sender():
+        mbox = s4u.Mailbox.by_name("drop-here")
+        comm = mbox.put_async("lost", 10)
+        comm.cancel()
+        mbox.put_async("kept", 10)
+        s4u.this_actor.sleep_for(5.0)
+
+    def receiver():
+        s4u.this_actor.sleep_for(1.0)
+        got["payload"] = s4u.Mailbox.by_name("drop-here") \
+            .get_async().wait().get_payload()
+
+    s4u.Actor.create("canceller", s4u.Host.by_name("Lisboa"), sender)
+    s4u.Actor.create("drop-here", s4u.Host.by_name("Porto"), receiver)
+    eng.run_until(30.0)
+    assert got["payload"] == "kept"
