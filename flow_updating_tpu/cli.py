@@ -118,7 +118,8 @@ def _make_config(args):
               delivery=getattr(args, "delivery", "gather"),
               spmv=getattr(args, "spmv", "xla"),
               segment_impl=getattr(args, "segment", "auto"),
-              contention=getattr(args, "contention", False))
+              contention=getattr(args, "contention", False),
+              contention_iters=getattr(args, "contention_iters", 0))
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
@@ -276,15 +277,25 @@ def cmd_oracle(args) -> int:
     if not native.available():
         raise SystemExit("native runtime unavailable (g++ missing?)")
     topo = _build_topology(args)
-    est, last_avg, events = native.des_run(
-        topo, variant=args.variant,
-        timeout=args.timeout if args.timeout is not None else 50,
-        ticks=args.ticks,
-    )
+    timeout = args.timeout if args.timeout is not None else 50
+    network = "unit-delay"
+    if getattr(args, "lmm", False):
+        if not topo.has_link_model:
+            raise SystemExit("--lmm needs a platform topology with a link "
+                             "model (--platform + --latency-scale > 0)")
+        _rmse, est, last_avg, events = native.des_run_contend(
+            topo, variant=args.variant, timeout=timeout, ticks=args.ticks,
+            clamp_d=0, lmm=True)
+        network = "dynamic max-min LMM"
+    else:
+        est, last_avg, events = native.des_run(
+            topo, variant=args.variant, timeout=timeout, ticks=args.ticks,
+        )
     err = est - topo.true_mean
     print(json.dumps({
         "ticks": args.ticks,
         "events": events,
+        "network": network,
         "rmse": float(np.sqrt(np.mean(err * err))),
         "max_abs_err": float(np.max(np.abs(err))),
         "mass_residual": float(est.sum() - topo.values.sum()),
@@ -369,6 +380,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "--platform and --latency-scale > 0): concurrent "
                           "sends crossing a SHARED link split its capacity; "
                           "FATPIPE links never share")
+    run.add_argument("--contention-iters", type=int, default=0,
+                     help="with --contention: progressive-filling "
+                          "max-min iterations per round (0 = local "
+                          "bottleneck share; k>0 approximates SimGrid's "
+                          "LMM water-fill — see RoundConfig)")
     run.add_argument("--latency-scale", type=float, default=0.0,
                      help=">0: derive per-edge delays from platform "
                           "latencies x this scale")
@@ -422,6 +438,11 @@ def build_parser() -> argparse.ArgumentParser:
     orc.add_argument("--timeout", type=int, default=None)
     orc.add_argument("--ticks", type=int, default=1000)
     orc.add_argument("--latency-scale", type=float, default=0.0)
+    orc.add_argument("--msg-bytes", type=float, default=104.0)
+    orc.add_argument("--lmm", action="store_true",
+                     help="dynamic max-min LMM network (SimGrid flow-"
+                          "model fidelity; needs --platform and "
+                          "--latency-scale > 0)")
     orc.set_defaults(fn=cmd_oracle)
 
     return ap

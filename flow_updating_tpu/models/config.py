@@ -75,6 +75,21 @@ class RoundConfig:
     #                                    latency_scale > 0; delays are
     #                                    recomputed each round and clamped
     #                                    to delay_depth.
+    contention_iters: int = 0          # 0: each send pays its LOCAL
+    #                                    bottleneck share (the historical
+    #                                    quasi-static model).  k > 0: k
+    #                                    progressive-filling iterations of
+    #                                    the max-min water-fill per round —
+    #                                    flows bottlenecked elsewhere
+    #                                    release capacity to the rest,
+    #                                    converging (in the number of
+    #                                    distinct bottleneck levels) to the
+    #                                    true max-min allocation of
+    #                                    SimGrid's LMM for that round's
+    #                                    send set.  Validated against the
+    #                                    native dynamic-LMM oracle
+    #                                    (native.des_run_contend(lmm=True),
+    #                                    tests/test_lmm.py).
     dtype: str = "float32"             # ledger dtype
     kernel: str = "edge"               # 'edge' (general) | 'node' (collapsed
     #                                    SpMV recurrence; fast sync
@@ -164,6 +179,13 @@ class RoundConfig:
             raise ValueError(
                 "contention recomputes per-edge delays each round; only the "
                 "edge kernel carries the in-flight ring buffer (kernel='edge')"
+            )
+        if self.contention_iters < 0:
+            raise ValueError("contention_iters must be >= 0")
+        if self.contention_iters > 0 and not self.contention:
+            raise ValueError(
+                "contention_iters refines the shared-link bandwidth split; "
+                "it needs contention=True"
             )
         if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(

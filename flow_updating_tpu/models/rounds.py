@@ -431,10 +431,59 @@ def edge_delays(topo, cfg: RoundConfig, send_mask) -> jnp.ndarray:
     flows = jnp.zeros((Lp,), jnp.int32).at[topo.edge_links.reshape(-1)].add(
         jnp.repeat(send_mask.astype(jnp.int32), K)
     )
-    load = jnp.where(topo.link_shared, jnp.maximum(flows, 1), 1)
-    ser = load.astype(topo.link_ser_rounds.dtype) * topo.link_ser_rounds
-    worst = jnp.max(ser[topo.edge_links], axis=1)   # pad slot contributes 0
-    dyn = jnp.rint(topo.lat_rounds + worst).astype(jnp.int32)
+    if cfg.contention_iters == 0:
+        # historical quasi-static model: every send pays its LOCAL
+        # bottleneck share (equal split at its most-loaded link, no
+        # redistribution) — bit-matched by the C++ same-model oracle
+        load = jnp.where(topo.link_shared, jnp.maximum(flows, 1), 1)
+        ser = load.astype(topo.link_ser_rounds.dtype) * topo.link_ser_rounds
+        worst = jnp.max(ser[topo.edge_links], axis=1)  # pad slot adds 0
+        dyn = jnp.rint(topo.lat_rounds + worst).astype(jnp.int32)
+        return jnp.clip(dyn, 1, cfg.delay_depth)
+
+    # progressive-filling max-min (cfg.contention_iters unrolled rounds of
+    # water-fill): fix the flows crossing the currently most-contended
+    # link at its fair share, release the capacity they do NOT use on
+    # their other links, repeat — the per-round solve of SimGrid's LMM
+    # (exact when the send set has <= iters distinct bottleneck levels;
+    # leftovers fall back to their local fair share).  Validated against
+    # the dynamic native oracle in tests/test_lmm.py.
+    INF = jnp.float32(jnp.inf)
+    ser0 = topo.link_ser_rounds.astype(jnp.float32)
+    constraining = topo.link_shared & (ser0 > 0)
+    cap_rem = jnp.where(constraining, 1.0 / jnp.maximum(ser0, 1e-30), INF)
+    nflow = flows.astype(jnp.float32)
+    el = topo.edge_links                        # (E, K), pad slot = Lp-1
+    E = el.shape[0]
+    # per-flow full-rate bound from NON-shared ser>0 links: FATPIPE never
+    # shares, but each flow is still capped at the link bandwidth (the
+    # quasi-static model's 1x ser charge on those links)
+    own = jnp.where(~topo.link_shared & (ser0 > 0),
+                    1.0 / jnp.maximum(ser0, 1e-30), INF)
+    own_cap = jnp.min(own[el], axis=1)          # (E,)
+    rate = jnp.zeros((E,), jnp.float32)
+    fixed = ~send_mask                          # non-senders: irrelevant
+    for _ in range(cfg.contention_iters):
+        fair = jnp.where((nflow > 0.5) & constraining,
+                         cap_rem / jnp.maximum(nflow, 1.0), INF)
+        share = jnp.minimum(jnp.min(fair[el], axis=1), own_cap)
+        m = jnp.min(jnp.where(fixed, INF, share))
+        newly = (~fixed) & jnp.isfinite(share) & (share <= m * 1.000001)
+        rate = jnp.where(newly, share, rate)
+        newly_f = newly.astype(jnp.float32)
+        cap_rem = jnp.maximum(
+            cap_rem.at[el.reshape(-1)].add(
+                -jnp.repeat(jnp.where(newly, share, 0.0), K)), 0.0)
+        nflow = jnp.maximum(
+            nflow.at[el.reshape(-1)].add(-jnp.repeat(newly_f, K)), 0.0)
+        fixed = fixed | newly
+    fair = jnp.where((nflow > 0.5) & constraining,
+                     cap_rem / jnp.maximum(nflow, 1.0), INF)
+    share = jnp.minimum(jnp.min(fair[el], axis=1), own_cap)
+    rate = jnp.where(fixed, rate, share)
+    transfer = jnp.where(jnp.isfinite(rate) & (rate > 0),
+                         1.0 / jnp.maximum(rate, 1e-30), 0.0)
+    dyn = jnp.rint(topo.lat_rounds + transfer).astype(jnp.int32)
     return jnp.clip(dyn, 1, cfg.delay_depth)
 
 

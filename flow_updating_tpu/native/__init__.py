@@ -91,6 +91,8 @@ def get_lib():
         i64, ctypes.c_double, f64p,
         i64, i32p, i64, f64p, u8p, f64p, i64, i64,
     ]
+    lib.fu_des_run_lmm.restype = i64
+    lib.fu_des_run_lmm.argtypes = lib.fu_des_run_contend.argtypes
     _lib = lib
     return _lib
 
@@ -254,12 +256,20 @@ def des_run_traj(topo, variant: str = "collectall", timeout: int = 50,
 
 def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
                     ticks: int = 1000, obs_every: int = 10,
-                    clamp_d: int = 0, visit_seed: int = -1):
-    """DES with the shared-link contention model (same model as the
-    vectorized kernel's ``models.rounds.edge_delays`` — per-tick
-    bottleneck fair share over SHARED links, FATPIPE exempt; see
-    funative.cpp ``LinkModel``).  ``clamp_d`` mirrors the ring-buffer
-    clamp of a ``delay_depth``-bounded run (0 = unclamped).
+                    clamp_d: int = 0, visit_seed: int = -1,
+                    lmm: bool = False):
+    """DES with a link-level bandwidth model.
+
+    ``lmm=False``: the quasi-static per-tick bottleneck fair share over
+    SHARED links, FATPIPE exempt — the same model as the vectorized
+    kernel's ``models.rounds.edge_delays`` (cross-implementation
+    validation target).  ``lmm=True``: the dynamic max-min LMM — each
+    in-flight transfer is a continuous flow whose rate is re-solved by
+    progressive filling whenever a transfer starts or finishes, i.e.
+    SimGrid's flow-model semantics (SURVEY.md N3); this is the fidelity
+    oracle the quasi-static approximation is measured against
+    (``tests/test_lmm.py``).  ``clamp_d`` mirrors the ring-buffer clamp
+    of a ``delay_depth``-bounded run (0 = unclamped).
 
     ``visit_seed >= 0`` re-shuffles the within-tick node visit order
     every tick (mt19937 stream) — used to measure how much trajectory
@@ -289,7 +299,8 @@ def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
     est = np.empty(n, np.float64)
     last_avg = np.empty(n, np.float64)
     rmse = np.empty(max(ticks // obs_every, 1), np.float64)
-    events = lib.fu_des_run_contend(
+    entry = lib.fu_des_run_lmm if lmm else lib.fu_des_run_contend
+    events = entry(
         n, E, _ptr(src, ctypes.c_int32), _ptr(dst, ctypes.c_int32),
         _ptr(rev, ctypes.c_int32), _ptr(delay, ctypes.c_int32),
         _ptr(row_start, ctypes.c_int64), _ptr(values, ctypes.c_double),

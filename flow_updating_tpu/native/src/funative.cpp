@@ -23,6 +23,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <queue>
 #include <random>
 #include <vector>
@@ -328,8 +329,104 @@ struct LinkModel {
   const uint8_t* link_shared = nullptr;     // (L,)
   const double* lat_rounds = nullptr;       // (E,)
   int64_t clamp_d = 0;                // 0 = unclamped
+  // 0 = quasi-static per-tick bottleneck share (the vectorized kernel's
+  // model); 1 = dynamic max-min LMM: transfers are continuous flows whose
+  // rates are re-solved by progressive filling whenever a transfer starts
+  // or finishes — SimGrid's flow-model semantics (SURVEY.md N3), the
+  // fidelity oracle the quasi-static approximation is measured against.
+  int32_t lmm = 0;
   bool active() const { return edge_links != nullptr; }
 };
+
+// One in-flight transfer under the dynamic LMM: a unit message draining
+// at the max-min rate (msg/tick) the solver assigns it.
+struct Transfer {
+  double rem;     // message units remaining (starts at 1.0)
+  double rate;    // msg/tick, filled by lmm_solve
+  int32_t e;      // sending edge (delivery updates ledger rev[e])
+  int64_t t0;     // send tick (origin for the delay clamp)
+  double flow_v, est_v;
+};
+
+// Progressive-filling max-min: repeatedly find the most-contended
+// constraining link, fix its flows at the fair share, release capacity,
+// repeat.  Flows crossing no constraining link get +inf (latency-only).
+static void lmm_solve(std::vector<Transfer>& act, const LinkModel& lm) {
+  const double INF = std::numeric_limits<double>::infinity();
+  const size_t F = act.size();
+  if (F == 0) return;
+  std::vector<double> cap_rem((size_t)lm.L);
+  std::vector<int64_t> nflow((size_t)lm.L, 0);
+  for (int64_t l = 0; l < lm.L; ++l)
+    cap_rem[(size_t)l] = (lm.link_shared[l] && lm.link_ser_rounds[l] > 0.0)
+                             ? 1.0 / lm.link_ser_rounds[l]
+                             : INF;
+  for (size_t f = 0; f < F; ++f)
+    for (int64_t k = 0; k < lm.K; ++k) {
+      int32_t l = lm.edge_links[(int64_t)act[f].e * lm.K + k];
+      if (l < lm.L) nflow[(size_t)l]++;
+    }
+  auto fair_of = [&](size_t f) {
+    // fair share on SHARED links, capped by the flow's own full-rate
+    // bound on every ser>0 link it crosses: FATPIPE links never share,
+    // but each flow is still rate-capped at the link bandwidth
+    // (matches the quasi-static model's 1x ser charge on non-shared
+    // links; SURVEY.md N3 / small_platform.xml FATPIPE)
+    double mine = INF;
+    for (int64_t k = 0; k < lm.K; ++k) {
+      int32_t l = lm.edge_links[(int64_t)act[f].e * lm.K + k];
+      if (l >= lm.L) continue;
+      if (cap_rem[(size_t)l] < INF && nflow[(size_t)l] > 0)
+        mine = std::min(mine, cap_rem[(size_t)l] / (double)nflow[(size_t)l]);
+      if (!lm.link_shared[l] && lm.link_ser_rounds[l] > 0.0)
+        mine = std::min(mine, 1.0 / lm.link_ser_rounds[l]);
+    }
+    return mine;
+  };
+  auto fix = [&](size_t f, double rate) {
+    act[f].rate = rate;
+    for (int64_t k = 0; k < lm.K; ++k) {
+      int32_t l = lm.edge_links[(int64_t)act[f].e * lm.K + k];
+      if (l < lm.L) {
+        if (cap_rem[(size_t)l] < INF)
+          cap_rem[(size_t)l] = std::max(cap_rem[(size_t)l] - rate, 0.0);
+        nflow[(size_t)l]--;
+      }
+    }
+  };
+  std::vector<uint8_t> fixed(F, 0);
+  size_t nfixed = 0;
+  while (nfixed < F) {
+    double best = INF;
+    for (size_t f = 0; f < F; ++f)
+      if (!fixed[f]) best = std::min(best, fair_of(f));
+    if (best == INF) {  // rest cross no constraining link
+      for (size_t f = 0; f < F; ++f)
+        if (!fixed[f]) act[f].rate = INF;
+      break;
+    }
+    bool any = false;
+    for (size_t f = 0; f < F; ++f) {
+      if (fixed[f]) continue;
+      double mine = fair_of(f);
+      if (mine <= best * (1.0 + 1e-12)) {
+        fix(f, mine);
+        fixed[f] = 1;
+        ++nfixed;
+        any = true;
+      }
+    }
+    if (!any) {  // numerical guard — fix the single tightest flow
+      size_t argf = 0;
+      double mine = INF;
+      for (size_t f = 0; f < F; ++f)
+        if (!fixed[f] && fair_of(f) < mine) mine = fair_of(f), argf = f;
+      fix(argf, mine);
+      fixed[argf] = 1;
+      ++nfixed;
+    }
+  }
+}
 
 static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
                         const int32_t* dst, const int32_t* rev,
@@ -361,6 +458,59 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
   std::vector<PendSend> tick_sends;
   std::vector<int64_t> link_cnt(lm.active() ? (size_t)lm.L : 0, 0);
 
+  // dynamic-LMM state: in-flight transfers + the continuous clock they
+  // progress on (tick boundaries are integer points of the same axis)
+  std::vector<Transfer> act;
+  double now_c = 0.0;
+
+  auto lmm_advance = [&](double t_end_c) {
+    // progress continuous time to t_end_c, re-solving max-min rates at
+    // every completion event (the dynamic re-solve the quasi-static
+    // model lacks — transfers finishing mid-flight free capacity for
+    // the survivors immediately)
+    while (now_c < t_end_c - 1e-12 && !act.empty()) {
+      lmm_solve(act, lm);
+      double dt = t_end_c - now_c;
+      bool any_inf = false;
+      for (const auto& tr : act) {
+        if (tr.rate == std::numeric_limits<double>::infinity())
+          any_inf = true;
+        else if (tr.rate > 0.0)
+          dt = std::min(dt, tr.rem / tr.rate);
+      }
+      if (any_inf) dt = 0.0;
+      if (dt > 0.0) {
+        for (auto& tr : act)
+          if (tr.rate < std::numeric_limits<double>::infinity())
+            tr.rem -= tr.rate * dt;
+        now_c += dt;
+      }
+      bool completed = false;
+      for (size_t f = 0; f < act.size();) {
+        bool done = act[f].rem <= 1e-9 ||
+                    act[f].rate == std::numeric_limits<double>::infinity();
+        if (done) {
+          const auto& tr = act[f];
+          double arr_c = now_c + lm.lat_rounds[tr.e];
+          // ceil > t0 guarantees the one-round floor; clamp_d mirrors
+          // the ring-buffer delay bound of a delay_depth-bounded run
+          int64_t arr = (int64_t)std::ceil(arr_c - 1e-9);
+          arr = std::max(arr, tr.t0 + 1);
+          if (lm.clamp_d > 0) arr = std::min(arr, tr.t0 + lm.clamp_d);
+          mailbox[dst[tr.e]].push(
+              Msg{arr, seq++, rev[tr.e], tr.flow_v, tr.est_v});
+          act[f] = act.back();
+          act.pop_back();
+          completed = true;
+        } else {
+          ++f;
+        }
+      }
+      if (dt == 0.0 && !completed) break;  // safety: no progress possible
+    }
+    now_c = std::max(now_c, t_end_c);
+  };
+
   auto send = [&](int64_t t, int32_t e) {
     if (lm.active()) {
       tick_sends.push_back({e, flow[e], est[e]});
@@ -374,6 +524,16 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
 
   auto flush_tick_sends = [&](int64_t t) {
     if (!lm.active() || tick_sends.empty()) return;
+    if (lm.lmm) {
+      // dynamic mode: this tick's sends become in-flight transfers,
+      // transmitting from the tick boundary (continuous time t); the
+      // arrival ceil + one-round floor reproduce the quasi-static
+      // minimum of one tick
+      for (const auto& p : tick_sends)
+        act.push_back(Transfer{1.0, 0.0, p.e, t, p.flow_v, p.est_v});
+      tick_sends.clear();
+      return;
+    }
     std::fill(link_cnt.begin(), link_cnt.end(), 0);
     for (const auto& p : tick_sends)
       for (int64_t k = 0; k < lm.K; ++k) {
@@ -446,6 +606,8 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
   std::mt19937_64 vrng(visit_seed >= 0 ? (uint64_t)visit_seed : 0);
 
   for (int64_t t = 0; t < ticks; ++t) {
+    if (lm.active() && lm.lmm)
+      lmm_advance((double)t);  // completions up to this tick boundary
     if (visit_seed >= 0) std::shuffle(visit.begin(), visit.end(), vrng);
     for (int64_t vi = 0; vi < n; ++vi) {
       int64_t v = visit[(size_t)vi];
@@ -541,6 +703,33 @@ int64_t fu_des_run_contend(
   lm.link_shared = link_shared;
   lm.lat_rounds = lat_rounds;
   lm.clamp_d = clamp_d;
+  return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
+                  timeout, ticks, est_out, last_avg_out, obs_every, mean,
+                  rmse_out, lm, visit_seed);
+}
+
+// Dynamic max-min LMM variant: transfers are continuous flows; rates are
+// re-solved by progressive filling at every start/finish event — the
+// SimGrid-fidelity network oracle (closes SURVEY.md N3's remaining
+// semantic gap; the quasi-static model above is the TPU kernel's
+// approximation of THIS).
+int64_t fu_des_run_lmm(
+    int64_t n, int64_t E, const int32_t* src, const int32_t* dst,
+    const int32_t* rev, const int32_t* delay, const int64_t* row_start,
+    const double* values, int32_t variant, int64_t timeout, int64_t ticks,
+    double* est_out, double* last_avg_out, int64_t obs_every, double mean,
+    double* rmse_out, int64_t K, const int32_t* edge_links, int64_t L,
+    const double* link_ser_rounds, const uint8_t* link_shared,
+    const double* lat_rounds, int64_t clamp_d, int64_t visit_seed) {
+  LinkModel lm;
+  lm.K = K;
+  lm.edge_links = edge_links;
+  lm.L = L;
+  lm.link_ser_rounds = link_ser_rounds;
+  lm.link_shared = link_shared;
+  lm.lat_rounds = lat_rounds;
+  lm.clamp_d = clamp_d;
+  lm.lmm = 1;
   return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
                   timeout, ticks, est_out, last_avg_out, obs_every, mean,
                   rmse_out, lm, visit_seed);

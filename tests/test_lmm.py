@@ -1,0 +1,236 @@
+"""Dynamic max-min LMM — closing SURVEY.md N3's last semantic gap.
+
+SimGrid's flow model re-solves max-min bandwidth shares *as transfers
+start and finish mid-flight* (VERDICT r4 missing #1).  Round 4 validated
+the kernel's quasi-static approximation only against a same-model C++
+oracle; this round adds the TRUE dynamic model as a native oracle
+(``native.des_run_contend(lmm=True)`` — progressive-filling rates
+re-solved at every transfer event, continuous completion times) plus a
+per-round progressive-filling refinement in the kernel
+(``RoundConfig.contention_iters``), and MEASURES the residual against
+the true semantics:
+
+* collect-all: the per-round kernel lands within ~7% of the dynamic
+  oracle's rounds-to-threshold (pinned below);
+* pairwise: the kernel is ~1.7-2.3x optimistic — its per-round solve
+  cannot see in-flight transfers from earlier ticks, and pairwise's
+  message-per-receive dynamics keep several ticks of transfers in
+  flight at once (pinned below; the documented residual);
+* only the dynamic oracle reproduces congestive collapse when offered
+  load exceeds capacity — a flow-model behavior every per-round model
+  (including the round-4 quasi-static one) structurally hides.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu import native
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import edge_delays, run_rounds_observed
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.topology.graph import build_topology
+
+REF_PLATFORM = "/root/reference/platforms/small_platform.xml"
+REF_ACTORS = "/root/reference/actors.xml"
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native lib unavailable")
+needs_ref = pytest.mark.skipif(
+    not (os.path.exists(REF_PLATFORM) and os.path.exists(REF_ACTORS)),
+    reason="reference snapshot not available")
+
+
+def two_level_topology():
+    """Four flows, two links, two bottleneck levels — the minimal case
+    where max-min redistribution differs from local fair share.
+
+    A=(0,1) crosses L0 only; B=(2,3) crosses L0+L1; C=(4,5), D=(6,7)
+    cross L1 only.  cap(L0)=0.25 msg/round (ser 4), cap(L1)=0.75 (ser
+    4/3).  Local-share: C,D pay load(L1)=3 x 4/3 = 4 rounds.  Max-min:
+    A,B fix at 0.125 (L0 fair); L1's residual 0.625 splits over C,D =
+    0.3125 each -> 3.2 rounds."""
+    pairs = [(0, 1), (2, 3), (4, 5), (6, 7)]
+    caps = np.array([104.0 / 4.0, 104.0 / (4.0 / 3.0)])
+    route = {(0, 1): (0,), (2, 3): (0, 1), (4, 5): (1,), (6, 7): (1,)}
+    return build_topology(
+        8, np.array(pairs), values=np.arange(8, dtype=np.float64),
+        latency_s={p: 1.0 for p in pairs},
+        bandwidth={p: float(caps[min(route[p])]) for p in pairs},
+        latency_scale=1.0, msg_bytes=104.0,
+        route_links=route, link_caps=caps,
+        link_shared=np.array([True, True]),
+    )
+
+
+def test_waterfill_redistributes_released_capacity():
+    import jax.numpy as jnp
+
+    topo = two_level_topology()
+    arrays = topo.device_arrays()
+    # one direction of each pair: directed edges 0,2,4,6 (sorted by src)
+    mask = jnp.zeros(topo.num_edges, bool).at[jnp.array([0, 2, 4, 6])] \
+        .set(True)
+    local = RoundConfig.reference(delay_depth=16, contention=True)
+    fill = RoundConfig.reference(delay_depth=16, contention=True,
+                                 contention_iters=2)
+    d0 = np.asarray(edge_delays(arrays, local, mask))
+    d2 = np.asarray(edge_delays(arrays, fill, mask))
+    # A and B: bottlenecked at L0 either way -> 1 + 2*4 = 9
+    assert d0[0] == d0[2] == 9
+    assert d2[0] == d2[2] == 9
+    # C and D: local share 1 + 3*(4/3) = 5; max-min 1 + 1/0.3125 = 4.2 -> 4
+    assert d0[4] == d0[6] == 5
+    assert d2[4] == d2[6] == 4
+    # water-fill rates only ever redistribute RELEASED capacity: delays
+    # can never exceed the local-share model's
+    assert np.all(d2 <= d0)
+
+
+def test_contention_iters_requires_contention():
+    with pytest.raises(ValueError, match="contention_iters"):
+        RoundConfig.reference(contention_iters=2)
+    with pytest.raises(ValueError, match="contention_iters"):
+        RoundConfig.reference(contention=True, contention_iters=-1)
+
+
+def _rounds_to(curve, obs, th):
+    below = np.asarray(curve) < th
+    return int((np.argmax(below) + 1) * obs) if below.any() else None
+
+
+def _ref_topology(msg_bytes):
+    from flow_updating_tpu.topology.deployment import load_deployment
+    from flow_updating_tpu.topology.platform import load_platform
+
+    platform = load_platform(REF_PLATFORM)
+    deployment = load_deployment(REF_ACTORS)
+    return deployment.to_topology(platform, latency_scale=100.0,
+                                  msg_bytes=msg_bytes)
+
+
+@needs_native
+@needs_ref
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_dynamic_oracle_converges_at_stable_load(variant):
+    """At an offered load the links can sustain (100 kB payloads), the
+    dynamic-LMM DES converges and conserves mass exactly."""
+    topo = _ref_topology(1e5)
+    D = topo.contended_max_delay()
+    rmse, est, _last, events = native.des_run_contend(
+        topo, variant, timeout=50, ticks=3000, obs_every=10,
+        clamp_d=D, lmm=True)
+    assert events > 0
+    assert _rounds_to(rmse, 10, 1e-3) is not None, "never converged"
+    # antisymmetric flows conserve the estimate sum up to the mass held
+    # by messages still in flight at the horizon (collect-all keeps
+    # firing on timeouts forever, so a few transfers are always open)
+    assert np.mean(est) == pytest.approx(topo.true_mean, abs=1e-5)
+
+
+@needs_native
+@needs_ref
+def test_dynamic_oracle_shows_congestive_collapse():
+    """With 300 kB payloads pairwise's message-per-receive load exceeds
+    link capacity: in-flight transfers pile up across ticks and the
+    system cannot converge.  Only the dynamic model can represent this —
+    every per-round model structurally hides cross-tick queueing (the
+    reason the r4 quasi-static oracle 'converged' here)."""
+    topo = _ref_topology(3e5)
+    D = topo.contended_max_delay()
+    qs = native.des_run_contend(topo, "pairwise", timeout=50, ticks=3000,
+                                obs_every=10, clamp_d=D)[0]
+    lmm = native.des_run_contend(topo, "pairwise", timeout=50, ticks=3000,
+                                 obs_every=10, clamp_d=D, lmm=True)[0]
+    assert _rounds_to(qs, 10, 1e-2) is not None
+    assert _rounds_to(lmm, 10, 1e-2) is None, (
+        "dynamic LMM converged under overload — collapse semantics lost")
+
+
+@needs_native
+@needs_ref
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_kernel_residual_vs_dynamic_oracle(variant):
+    """The measured fidelity residual of the per-round kernel against the
+    TRUE LMM semantics, pinned so it cannot silently grow.
+
+    Measured at msg_bytes=1e5, latency_scale=100, x64 (2026-07):
+      collectall: vec 1220/1660 vs oracle 1300/1780 -> ratio 0.93-0.94
+      pairwise:   vec 250/300  vs oracle seed band [420-520]/[590-700]
+                  -> ratio 0.43-0.60 (per-round solving cannot see
+                  cross-tick in-flight load; documented residual)
+    """
+    topo = _ref_topology(1e5)
+    D = topo.contended_max_delay()
+    oracle = native.des_run_contend(
+        topo, variant, timeout=50, ticks=3000, obs_every=10,
+        clamp_d=D, lmm=True)[0]
+    cfg = RoundConfig.reference(variant=variant, delay_depth=D,
+                                contention=True, contention_iters=4,
+                                dtype="float64")
+    state = init_state(topo, cfg)
+    _, metrics = run_rounds_observed(state, topo.device_arrays(), cfg,
+                                     3000, 10, topo.true_mean)
+    vec = np.asarray(metrics["rmse"])
+    lo, hi = (0.85, 1.05) if variant == "collectall" else (0.35, 0.75)
+    for th in (1e-2, 1e-3):
+        r_vec = _rounds_to(vec, 10, th)
+        r_orc = _rounds_to(oracle, 10, th)
+        assert r_vec is not None and r_orc is not None
+        ratio = r_vec / r_orc
+        assert lo <= ratio <= hi, (
+            f"{variant} th={th}: vec {r_vec} vs dynamic oracle {r_orc} "
+            f"(ratio {ratio:.2f}) left the pinned band [{lo}, {hi}] — "
+            "the fidelity residual changed; re-measure and re-document")
+
+
+def fatpipe_topology(ser_rounds=4.0):
+    """One pair over a single FATPIPE link: never shares, but each flow
+    is still rate-capped at the link bandwidth."""
+    pairs = [(0, 1)]
+    caps = np.array([104.0 / ser_rounds])
+    return build_topology(
+        2, np.array(pairs), values=np.array([1.0, 5.0]),
+        latency_s={(0, 1): 1.0}, bandwidth={(0, 1): float(caps[0])},
+        latency_scale=1.0, msg_bytes=104.0,
+        route_links={(0, 1): (0,)}, link_caps=caps,
+        link_shared=np.array([False]),
+    )
+
+
+def test_fatpipe_still_serializes_under_waterfill():
+    """Regression (r5 review): FATPIPE links never SHARE, but a flow is
+    still capped at the link rate — the water-fill must charge 1x ser on
+    non-shared links exactly like the quasi-static model, not treat them
+    as infinitely fast."""
+    import jax.numpy as jnp
+
+    topo = fatpipe_topology(ser_rounds=4.0)
+    arrays = topo.device_arrays()
+    mask = jnp.ones(topo.num_edges, bool)
+    d0 = np.asarray(edge_delays(
+        arrays, RoundConfig.reference(delay_depth=16, contention=True),
+        mask))
+    d2 = np.asarray(edge_delays(
+        arrays, RoundConfig.reference(delay_depth=16, contention=True,
+                                      contention_iters=2), mask))
+    np.testing.assert_array_equal(d0, 5)   # rint(1 + 1*4)
+    np.testing.assert_array_equal(d2, d0)
+
+
+@needs_native
+def test_fatpipe_dynamic_oracle_matches_quasi_static():
+    """Same regression on the C++ dynamic oracle: a FATPIPE-only route
+    transfer takes lat + ser, not zero."""
+    topo = fatpipe_topology(ser_rounds=4.0)
+    qs = native.des_run_contend(topo, "pairwise", timeout=50, ticks=400,
+                                obs_every=10, clamp_d=16)[0]
+    lm = native.des_run_contend(topo, "pairwise", timeout=50, ticks=400,
+                                obs_every=10, clamp_d=16, lmm=True)[0]
+    r_qs = _rounds_to(qs, 10, 1e-6)
+    r_lm = _rounds_to(lm, 10, 1e-6)
+    assert r_qs is not None and r_lm is not None
+    # identical per-transfer cost (lat+ser, no sharing possible on one
+    # flow-pair) -> trajectories within one observation of each other
+    assert abs(r_qs - r_lm) <= 10, (r_qs, r_lm)
