@@ -119,7 +119,8 @@ def _make_config(args):
               spmv=getattr(args, "spmv", "xla"),
               segment_impl=getattr(args, "segment", "auto"),
               contention=getattr(args, "contention", False),
-              contention_iters=getattr(args, "contention_iters", 0))
+              contention_iters=getattr(args, "contention_iters", 0),
+              contention_backlog=getattr(args, "contention_backlog", False))
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
@@ -385,6 +386,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "max-min iterations per round (0 = local "
                           "bottleneck share; k>0 approximates SimGrid's "
                           "LMM water-fill — see RoundConfig)")
+    run.add_argument("--contention-backlog", action="store_true",
+                     help="with --contention: count still-in-flight "
+                          "messages as standing link load (cross-tick "
+                          "queueing; recommended for pairwise fidelity "
+                          "runs — see tests/test_lmm.py)")
     run.add_argument("--latency-scale", type=float, default=0.0,
                      help=">0: derive per-edge delays from platform "
                           "latencies x this scale")

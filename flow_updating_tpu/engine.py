@@ -407,8 +407,26 @@ class Engine:
             # the ring buffer must cover the WORST contended delay, or
             # edge_delays' clamp silently flattens contention back to the
             # static profile
-            depth = max(self.config.delay_depth,
-                        self.topology.contended_max_delay())
+            base = self.topology.contended_max_delay()
+            depth = max(self.config.delay_depth, base)
+            if self.config.contention_backlog:
+                # backlog makes the bound self-referential: up to D
+                # standing messages per edge add load, which grows D.
+                # Find the smallest self-consistent depth; under overload
+                # no finite fixed point exists (congestive collapse), so
+                # saturate at 4x the senders-only bound — beyond it the
+                # clamp IS the model's queue-capacity limit (delays
+                # saturate at delay_depth; the dynamic LMM oracle,
+                # native.des_run_contend(lmm=True), is the
+                # unbounded-queue tool)
+                cap = max(4 * base, depth)
+                for _ in range(16):
+                    nxt = min(cap, max(depth,
+                                       self.topology.contended_max_delay(
+                                           inflight_per_edge=depth)))
+                    if nxt == depth:
+                        break
+                    depth = nxt
             if depth != self.config.delay_depth:
                 import dataclasses
 

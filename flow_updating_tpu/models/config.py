@@ -75,6 +75,15 @@ class RoundConfig:
     #                                    latency_scale > 0; delays are
     #                                    recomputed each round and clamped
     #                                    to delay_depth.
+    contention_backlog: bool = False   # count STILL-IN-FLIGHT messages
+    #                                    (the ring buffer's valid slots)
+    #                                    as standing load on their route
+    #                                    links when splitting capacity —
+    #                                    the cross-tick queueing the
+    #                                    dynamic LMM oracle models and a
+    #                                    per-round-only solve misses (the
+    #                                    measured 1.7-2.3x pairwise
+    #                                    residual, tests/test_lmm.py).
     contention_iters: int = 0          # 0: each send pays its LOCAL
     #                                    bottleneck share (the historical
     #                                    quasi-static model).  k > 0: k
@@ -186,6 +195,11 @@ class RoundConfig:
             raise ValueError(
                 "contention_iters refines the shared-link bandwidth split; "
                 "it needs contention=True"
+            )
+        if self.contention_backlog and not self.contention:
+            raise ValueError(
+                "contention_backlog adds in-flight load to the shared-link "
+                "bandwidth split; it needs contention=True"
             )
         if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(

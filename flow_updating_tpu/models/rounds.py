@@ -403,8 +403,15 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     return state, msg_est, send_mask
 
 
-def edge_delays(topo, cfg: RoundConfig, send_mask) -> jnp.ndarray:
+def edge_delays(topo, cfg: RoundConfig, send_mask,
+                inflight=None) -> jnp.ndarray:
     """Per-edge delivery delay for this round's sends.
+
+    ``inflight`` ((E,) int — messages still in the ring buffer, i.e.
+    sent in earlier rounds and not yet delivered) is counted as standing
+    load on its route links when ``cfg.contention_backlog``: the
+    cross-tick queueing that the dynamic LMM oracle models and a
+    per-round-only solve misses.
 
     Static (``topo.delay``) unless ``cfg.contention``: then each SHARED
     link's capacity is split across this round's concurrent sends
@@ -428,8 +435,13 @@ def edge_delays(topo, cfg: RoundConfig, send_mask) -> jnp.ndarray:
         )
     Lp = topo.link_ser_rounds.shape[0]          # L + 1 (pad slot)
     K = topo.edge_links.shape[1]
-    flows = jnp.zeros((Lp,), jnp.int32).at[topo.edge_links.reshape(-1)].add(
-        jnp.repeat(send_mask.astype(jnp.int32), K)
+    counts = send_mask.astype(jnp.int32)
+    standing = jnp.zeros((Lp,), jnp.int32)
+    if cfg.contention_backlog and inflight is not None:
+        standing = standing.at[topo.edge_links.reshape(-1)].add(
+            jnp.repeat(inflight.astype(jnp.int32), K))
+    flows = standing.at[topo.edge_links.reshape(-1)].add(
+        jnp.repeat(counts, K)
     )
     if cfg.contention_iters == 0:
         # historical quasi-static model: every send pays its LOCAL
@@ -507,7 +519,11 @@ def send_messages(
     E = topo.src.shape[0]
     t = state.t
     D = cfg.delay_depth
-    delay = edge_delays(topo, cfg, send_mask)
+    # deliver_phase already cleared this round's arrival slots, so the
+    # ring's remaining valid slots are exactly the still-in-flight sends
+    inflight = (state.buf_valid.sum(0, dtype=jnp.int32)
+                if cfg.contention_backlog else None)
+    delay = edge_delays(topo, cfg, send_mask, inflight=inflight)
     if cfg.delivery in ("gather", "benes", "benes_fused"):
         if cfg.delivery != "gather":
             # same receiver-pull formulation, but the rev permutation runs

@@ -92,20 +92,26 @@ class Topology:
     def has_link_model(self) -> bool:
         return self.edge_links is not None
 
-    def contended_max_delay(self, max_flows: int | None = None) -> int:
+    def contended_max_delay(self, max_flows: int | None = None,
+                            inflight_per_edge: int = 0) -> int:
         """Upper bound on the dynamic delay under contention: every edge's
         latency plus its worst link serialization when every edge whose
         route CROSSES that link sends at once (``max_flows`` caps the
         per-link count) — the safe ``delay_depth`` for ``cfg.contention``
-        runs.  Uses exact per-link crossing counts: a link only ever sees
-        the routes that traverse it, so sizing by total edge count would
-        inflate the (D, E) ring buffers quadratically for nothing."""
+        runs.  ``inflight_per_edge`` > 0 additionally counts that many
+        standing in-flight messages per crossing edge
+        (``cfg.contention_backlog`` sizing: each edge can hold up to
+        ``delay_depth`` undelivered ring slots).  Uses exact per-link
+        crossing counts: a link only ever sees the routes that traverse
+        it, so sizing by total edge count would inflate the (D, E) ring
+        buffers quadratically for nothing."""
         if not self.has_link_model:
             return self.max_delay
         L = self.link_ser_rounds.shape[0]
         cross = np.bincount(
             self.edge_links.reshape(-1), minlength=L + 1
         )[:L]
+        cross = cross * (1 + max(int(inflight_per_edge), 0))
         if max_flows is not None:
             cross = np.minimum(cross, max_flows)
         ser = np.where(self.link_shared,

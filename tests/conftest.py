@@ -147,9 +147,10 @@ def pytest_collection_modifyitems(config, items):
                 f"exist (renamed without updating the registry?): "
                 f"{sorted(stale)}")
     # Default fast path: deselect the slow tail — but an explicit -m
-    # expression or explicit node ids always win (an addopts -m would
-    # wrongly deselect `pytest file::slow_test` too).
-    if config.option.markexpr or explicit_ids:
+    # expression, -k keyword filter, or explicit node ids always win (an
+    # addopts -m would wrongly deselect `pytest file::slow_test` or
+    # `pytest -k slow_test_name` too).
+    if config.option.markexpr or config.option.keyword or explicit_ids:
         return
     kept, dropped = [], []
     for item in items:
