@@ -90,6 +90,57 @@ class Peer:
         self.heard, self.ticks = set(), 0
 
 
+class PairwisePeer(Peer):
+    """Pairwise Flow-Updating on the same verb surface: every received
+    message immediately triggers a 2-party average with that sender
+    only, plus per-neighbor staleness re-initiation (SURVEY.md A5)."""
+
+    STALENESS = 20.0
+
+    def __call__(self):
+        self.name = s4u.this_actor.get_host().name
+        self.mailbox = s4u.Mailbox.by_name(self.name)
+        self.peers = {n: s4u.Mailbox.by_name(n) for n in self.neighbor_names}
+        self.flows = {n: 0.0 for n in self.neighbor_names}
+        self.estimates = {n: 0.0 for n in self.neighbor_names}
+        self.last_exchange = {n: 0.0 for n in self.neighbor_names}
+        self.pending = s4u.ActivitySet()
+        global_values.setdefault("value", {})[self.name] = self.value
+        comm = None
+        s4u.this_actor.info("pairwise peer up")
+        while True:
+            if comm is None:
+                comm = self.mailbox.get_async()
+            if comm.test():
+                msg = comm.wait().get_payload()
+                comm = None
+                self.on_receive(*msg)
+            for n in list(self.peers):
+                if self.last_exchange[n] < s4u.Engine.clock - self.STALENESS:
+                    self.avg_and_send(n)
+            s4u.this_actor.sleep_for(1.0)
+
+    def on_receive(self, sender, flow, estimate):
+        if sender not in self.peers:
+            s4u.this_actor.error(f"adopting unknown neighbor {sender}")
+            self.peers[sender] = s4u.Mailbox.by_name(sender)
+            self.flows[sender] = self.estimates[sender] = 0.0
+            self.last_exchange[sender] = 0.0
+        self.estimates[sender] = estimate
+        self.flows[sender] = -flow
+        self.avg_and_send(sender)
+
+    def avg_and_send(self, neighbor):
+        estimate = self.value - sum(self.flows.values())
+        avg = (self.estimates[neighbor] + estimate) / 2.0
+        global_values.setdefault("last_avg", {})[self.name] = avg
+        self.flows[neighbor] += avg - self.estimates[neighbor]
+        self.estimates[neighbor] = avg
+        self.last_exchange[neighbor] = s4u.Engine.clock
+        self.pending.push(self.peers[neighbor].put_async(
+            (self.name, self.flows[neighbor], avg), 104))
+
+
 def watcher(deadline, every):
     while s4u.Engine.clock < deadline:
         s4u.this_actor.sleep_for(min(every, deadline - s4u.Engine.clock))
@@ -103,12 +154,15 @@ def watcher(deadline, every):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--until", type=float, default=400.0)
+    ap.add_argument("--variant", default="collectall",
+                    choices=("collectall", "pairwise"))
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO, format="%(message)s")
 
-    eng = Engine(sys.argv, host_actors=True)
+    eng = Engine(host_actors=True)
     eng.load_platform(os.path.join(HERE, "platforms/small6.xml"))
-    eng.register_actor("peer", Peer)
+    eng.register_actor(
+        "peer", Peer if args.variant == "collectall" else PairwisePeer)
     eng.load_deployment(os.path.join(HERE, "deployments/small6_actors.xml"))
     eng.netzone_root.add_host("observer", 25e6)
     s4u.Actor.create("watcher", s4u.Host.by_name("observer"),

@@ -520,8 +520,13 @@ def send_messages(
     t = state.t
     D = cfg.delay_depth
     # deliver_phase already cleared this round's arrival slots, so the
-    # ring's remaining valid slots are exactly the still-in-flight sends
-    inflight = (state.buf_valid.sum(0, dtype=jnp.int32)
+    # ring's remaining valid slots are exactly the still-in-flight sends.
+    # Column r of the ring holds messages sent along edge rev[r] (the
+    # sender writes at the receiver's ledger edge), so the standing load
+    # of edge e's own transmissions — which occupy e's route links, not
+    # rev[e]'s (asymmetric platform routes differ) — is the rev-gathered
+    # occupancy.
+    inflight = (state.buf_valid.sum(0, dtype=jnp.int32)[topo.rev]
                 if cfg.contention_backlog else None)
     delay = edge_delays(topo, cfg, send_mask, inflight=inflight)
     if cfg.delivery in ("gather", "benes", "benes_fused"):

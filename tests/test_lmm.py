@@ -266,3 +266,52 @@ def test_engine_sizes_depth_for_backlog():
     backlog.set_topology(topo).build()
     assert backlog.config.delay_depth > base
     assert backlog.config.delay_depth <= 4 * base
+
+
+def test_backlog_charges_the_transmitting_edges_route():
+    """Regression (r5 review): ring column r holds messages sent along
+    edge rev[r], and asymmetric platform routes mean e's route differs
+    from rev[e]'s — standing load must land on the TRANSMITTING edge's
+    links, not the reverse direction's."""
+    import jax.numpy as jnp
+
+    from flow_updating_tpu.models.rounds import send_messages
+    from flow_updating_tpu.models.state import init_state
+
+    pairs = [(0, 1)]
+    caps = np.array([104.0 / 4.0, 104.0 / 4.0])
+    topo = build_topology(
+        2, np.array(pairs), values=np.array([1.0, 5.0]),
+        latency_s={(0, 1): 1.0}, bandwidth={(0, 1): float(caps[0])},
+        latency_scale=1.0, msg_bytes=104.0,
+        # asymmetric: 0->1 rides L0, 1->0 rides L1
+        route_links={(0, 1): (0,), (1, 0): (1,)},
+        link_caps=caps, link_shared=np.array([True, True]),
+    )
+    arrays = topo.device_arrays()
+    e01 = int(np.flatnonzero((np.asarray(arrays.src) == 0)
+                             & (np.asarray(arrays.dst) == 1))[0])
+    e10 = int(np.asarray(arrays.rev)[e01])
+    D = 16
+    cfg = RoundConfig.reference(delay_depth=D, contention=True,
+                                contention_backlog=True)
+    state = init_state(topo, cfg)
+    # one message already in flight ALONG e01: it sits in the receiver
+    # ledger's column (e10), parked at a far slot
+    state = state.replace(
+        buf_valid=state.buf_valid.at[D - 1, e10].set(True))
+
+    def sent_delay(send_edge):
+        mask = jnp.zeros(topo.num_edges, bool).at[send_edge].set(True)
+        out = send_messages(state, arrays, cfg,
+                            state.est, mask)
+        new = (np.asarray(out.buf_valid)
+               & ~np.asarray(state.buf_valid))
+        slots = np.flatnonzero(new[:, np.asarray(arrays.rev)[send_edge]])
+        assert len(slots) == 1
+        return int(slots[0])   # t=0: slot == delay
+
+    # a fresh send on e01 shares L0 with the standing message: 1 + 2*4
+    assert sent_delay(e01) == 9
+    # the reverse direction's L1 carries no standing load: 1 + 1*4
+    assert sent_delay(e10) == 5

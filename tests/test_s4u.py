@@ -119,3 +119,20 @@ def test_cancelled_pending_put_is_never_delivered(host_engine):
     s4u.Actor.create("drop-here", s4u.Host.by_name("Porto"), receiver)
     eng.run_until(30.0)
     assert got["payload"] == "kept"
+
+
+def test_pairwise_peer_converges():
+    """The pairwise variant on the same verb surface (SURVEY.md A5):
+    2-party averages per received message + staleness re-initiation."""
+    RESULTS.clear()
+    eng = Engine(host_actors=True)
+    eng.load_platform(PLATFORM)
+    eng.register_actor("peer", example.PairwisePeer)
+    eng.load_deployment(ACTORS)
+    s4u.Actor.create("watcher", s4u.Host.by_name("Lisboa"),
+                     watcher, 400.0, 10.0)
+    eng.run_until(450.0)
+    last = RESULTS["last_avg"]
+    assert len(last) == 6
+    for name, avg in last.items():
+        assert avg == pytest.approx(30.0, abs=0.1), (name, avg)
