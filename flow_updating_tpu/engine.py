@@ -503,6 +503,12 @@ class Engine:
         request to keep simulating — without this, ``--resume --until T``
         past an old deadline would silently freeze every peer.
         """
+        if self.host_actors:
+            raise NotImplementedError(
+                "host_actors mode runs watchers as ordinary s4u actors, "
+                "exactly like the reference: "
+                "s4u.Actor.create('watcher', host, fn, deadline, every) "
+                "(see examples/host_actors.py::watcher)")
         if self._killed and float(run_until) > self._clock:
             logger.info(
                 "[%0.1f] watcher: reviving peers (new deadline %.1f)",
@@ -523,6 +529,11 @@ class Engine:
     def global_values(self) -> dict:
         """The reference's ``global_values`` mirror: per-host value and
         last_avg keyed by host name (``collectall.py:47-63,131``)."""
+        if self.host_actors:
+            raise NotImplementedError(
+                "host_actors mode: state lives inside the user's Python "
+                "actors — keep your own global_values mirror like the "
+                "reference does (examples/host_actors.py)")
         if self.state is None:
             return {}
         names = self.topology.names or tuple(
@@ -547,6 +558,12 @@ class Engine:
         }
 
     def estimates(self) -> np.ndarray:
+        if self.host_actors:
+            raise NotImplementedError(
+                "host_actors mode: state lives inside the user's Python "
+                "actors (the reference keeps its own global_values mirror, "
+                "collectall.py:131) — expose it from the actor, as "
+                "examples/host_actors.py does")
         if self.state is None:
             raise RuntimeError("engine not built")
         if self._halo_mode:

@@ -269,6 +269,21 @@ class RoundConfig:
         return cls(variant=variant, **kw)
 
     @classmethod
+    def fidelity(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
+        """The measured-best network-fidelity preset: faithful dynamics +
+        shared-link contention with the per-round max-min water-fill, and
+        (pairwise only) in-flight backlog accounting.  These are the
+        configurations pinned against the dynamic LMM oracle in
+        tests/test_lmm.py — collect-all within ~7% of the true dynamic
+        semantics, pairwise inside the oracle's event-ordering band.
+        Needs a platform-loaded topology with a link model."""
+        kw.setdefault("contention", True)
+        if kw["contention"]:
+            kw.setdefault("contention_iters", 4)
+            kw.setdefault("contention_backlog", variant == PAIRWISE)
+        return cls.reference(variant=variant, **kw)
+
+    @classmethod
     def fast(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
         """The throughput mode: synchronous averaging every round."""
         kw.setdefault("fire_policy", "every_round")

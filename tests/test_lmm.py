@@ -315,3 +315,24 @@ def test_backlog_charges_the_transmitting_edges_route():
     assert sent_delay(e01) == 9
     # the reverse direction's L1 carries no standing load: 1 + 1*4
     assert sent_delay(e10) == 5
+
+
+def test_fidelity_preset():
+    """RoundConfig.fidelity is exactly the per-variant configuration the
+    residual bands are pinned for."""
+    ca = RoundConfig.fidelity()
+    assert (ca.fire_policy, ca.contention, ca.contention_iters,
+            ca.contention_backlog) == ("reference", True, 4, False)
+    pw = RoundConfig.fidelity("pairwise")
+    assert pw.contention_backlog is True
+    # overridable like the other presets
+    assert RoundConfig.fidelity(contention_backlog=True).contention_backlog
+
+
+def test_fidelity_preset_contention_opt_out():
+    """fidelity(contention=False) keeps the faithful dynamics without the
+    network model — and without a confusing validation error."""
+    cfg = RoundConfig.fidelity(contention=False)
+    assert cfg.fire_policy == "reference"
+    assert not cfg.contention and cfg.contention_iters == 0
+    assert not cfg.contention_backlog

@@ -136,3 +136,21 @@ def test_pairwise_peer_converges():
     assert len(last) == 6
     for name, avg in last.items():
         assert avg == pytest.approx(30.0, abs=0.1), (name, avg)
+
+
+def test_deterministic_replay():
+    """The sequential-maestro claim, enforced: two identical runs produce
+    bit-identical mirrors (virtual clock + heap order, no wall-clock or
+    thread-scheduling leakage)."""
+    snapshots = []
+    for _ in range(2):
+        RESULTS.clear()
+        eng = Engine(host_actors=True)
+        eng.load_platform(PLATFORM)
+        eng.register_actor("peer", Peer)
+        eng.load_deployment(ACTORS)
+        s4u.Actor.create("watcher", s4u.Host.by_name("Lisboa"),
+                         watcher, 150.0, 10.0)
+        eng.run_until(200.0)
+        snapshots.append({k: dict(v) for k, v in RESULTS.items()})
+    assert snapshots[0] == snapshots[1]
