@@ -297,10 +297,17 @@ def measure_des_baseline(topo, ticks: int, repeats: int = 3,
     }
 
 
-def recorded_baseline(k: int) -> float | None:
+def _baseline_key(k) -> str:
+    """Numeric configs key as k<N> (k160, k96_faithful); named configs
+    (er10k_collectall, ba100k_collectall) key as-is."""
+    s = str(k)
+    return s if s[:1].isalpha() else f"k{s}"
+
+
+def recorded_baseline(k) -> float | None:
     try:
         with open(MEASURED_PATH) as f:
-            return float(json.load(f)[f"k{k}"]["des_rounds_per_sec"])
+            return float(json.load(f)[_baseline_key(k)]["des_rounds_per_sec"])
     except Exception:
         return None
 
@@ -311,7 +318,14 @@ _BASELINE_READONLY_ENV = "FLOW_UPDATING_BASELINE_READONLY"
 SPREAD_VALIDITY_PCT = 100.0
 
 
-def record_baseline(k: int, entry: dict) -> None:
+def baseline_entry(topo, des: dict) -> dict:
+    """The recorded-baseline schema, built in one place (bench run_bench,
+    microbench configs, ad-hoc measurement scripts)."""
+    return {"des_rounds_per_sec": des["rounds_per_sec"],
+            "nodes": topo.num_nodes, "edges": topo.num_edges, "des": des}
+
+
+def record_baseline(k, entry: dict) -> None:
     """Persist a measured DES baseline under keep-the-fastest semantics.
 
     The DES is native CPU-bound code: between runs of the same build it
@@ -344,7 +358,7 @@ def record_baseline(k: int, entry: dict) -> None:
             data = json.load(f)
     except Exception:
         pass
-    old = data.get(f"k{k}", {}).get("des", {})
+    old = data.get(_baseline_key(k), {}).get("des", {})
     new = entry["des"]
     quality = lambda d: d.get("ticks", 0) * d.get("repeats", 1)
     if old:
@@ -356,7 +370,7 @@ def record_baseline(k: int, entry: dict) -> None:
         if old_valid and new["rounds_per_sec"] <= old.get(
                 "rounds_per_sec", 0.0):
             return
-    data[f"k{k}"] = entry
+    data[_baseline_key(k)] = entry
     try:
         with open(MEASURED_PATH, "w") as f:
             json.dump(data, f, indent=1)
@@ -492,11 +506,7 @@ def run_bench(args) -> dict:
     if faithful:
         base_key += "_faithful"
     if des is not None:
-        record_baseline(
-            base_key,
-            {"des_rounds_per_sec": des["rounds_per_sec"], "nodes": n,
-             "edges": e, "des": des},
-        )
+        record_baseline(base_key, baseline_entry(topo, des))
     # vs_baseline ALWAYS divides by the baseline of record — the
     # highest-quality entry in BASELINE_MEASURED.json (record_baseline
     # keeps the better of old/new) — never by a noisier in-run sample.

@@ -101,3 +101,16 @@ def test_record_baseline_readonly_env(bench, monkeypatch, tmp_path):
     monkeypatch.setenv(bench._BASELINE_READONLY_ENV, "1")
     bench.record_baseline(160, _entry(1.73))
     assert bench.recorded_baseline(160) is None
+
+
+def test_record_baseline_named_config_keys(bench, monkeypatch, tmp_path):
+    """Non-numeric configs (er10k_collectall, ba100k_collectall) keep
+    their names as keys; numeric ones keep the k-prefix."""
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(tmp_path / "m.json"))
+    bench.record_baseline("er10k_collectall", _entry(123.0))
+    bench.record_baseline(160, _entry(1.73))
+    assert bench.recorded_baseline("er10k_collectall") == 123.0
+    assert bench.recorded_baseline(160) == 1.73
+    import json
+    keys = set(json.load(open(tmp_path / "m.json")))
+    assert keys == {"er10k_collectall", "k160"}
