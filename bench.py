@@ -66,7 +66,8 @@ def _edge_runtime(topo, cfg):
 
 def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 segment: str = "auto", fire_policy: str = "fast",
-                variant: str = "collectall", delivery: str = "gather"):
+                variant: str = "collectall", delivery: str = "gather",
+                delay_depth: int | None = None):
     """Build the fast collect-all measurement closure for one topology.
 
     Returns ``(run, read_est)``: ``run(r)`` executes an r-round compiled
@@ -120,16 +121,25 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
     else:
         from flow_updating_tpu.models.rounds import node_estimates, run_rounds
 
+        depth_kw = {}
+        # latency-warped topologies need the ring to cover the worst
+        # route delay; an explicit depth is clamped up the same way
+        # engine.build sizes driver runs (bench configs never enable
+        # contention, so engine's contended_max_delay rule does not
+        # apply here)
+        depth = max(int(delay_depth or 1), int(topo.max_delay))
+        if depth > 1:
+            depth_kw["delay_depth"] = depth
         if fire_policy == "reference":
             # the faithful asynchronous dynamics (1 msg/round drain, FIFO
             # pending queue, 50-round timeouts) — the fidelity-path bench
             cfg = RoundConfig.reference(variant=variant,
                                         segment_impl=segment,
-                                        delivery=delivery)
+                                        delivery=delivery, **depth_kw)
         else:
             cfg = RoundConfig.fast(variant=variant,
                                    segment_impl=segment,
-                                   delivery=delivery)
+                                   delivery=delivery, **depth_kw)
         arrays, state = _edge_runtime(topo, cfg)
 
         def run(r):
@@ -145,7 +155,8 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
                 spmv: str = "xla", segment: str = "auto",
                 fire_policy: str = "fast",
                 variant: str = "collectall",
-                delivery: str = "gather") -> dict:
+                delivery: str = "gather",
+                delay_depth: int | None = None) -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: each executable launch carries a large fixed tunnel
@@ -162,7 +173,8 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
     t0 = time.perf_counter()
     run, read_est = make_runner(topo, kernel=kernel, spmv=spmv,
                                 segment=segment, fire_policy=fire_policy,
-                                variant=variant, delivery=delivery)
+                                variant=variant, delivery=delivery,
+                                delay_depth=delay_depth)
     plan_s = time.perf_counter() - t0  # host work: ELL build, Benes
     #                                    routing, fused-pass planning
 
