@@ -93,6 +93,9 @@ def get_lib():
     ]
     lib.fu_des_run_lmm.restype = i64
     lib.fu_des_run_lmm.argtypes = lib.fu_des_run_contend.argtypes
+    lib.fu_des_run_contend_backlog.restype = i64
+    lib.fu_des_run_contend_backlog.argtypes = \
+        lib.fu_des_run_contend.argtypes
     _lib = lib
     return _lib
 
@@ -257,7 +260,7 @@ def des_run_traj(topo, variant: str = "collectall", timeout: int = 50,
 def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
                     ticks: int = 1000, obs_every: int = 10,
                     clamp_d: int = 0, visit_seed: int = -1,
-                    lmm: bool = False):
+                    lmm: bool = False, backlog: bool = False):
     """DES with a link-level bandwidth model.
 
     ``lmm=False``: the quasi-static per-tick bottleneck fair share over
@@ -268,8 +271,12 @@ def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
     progressive filling whenever a transfer starts or finishes, i.e.
     SimGrid's flow-model semantics (SURVEY.md N3); this is the fidelity
     oracle the quasi-static approximation is measured against
-    (``tests/test_lmm.py``).  ``clamp_d`` mirrors the ring-buffer clamp
-    of a ``delay_depth``-bounded run (0 = unclamped).
+    (``tests/test_lmm.py``).  ``backlog=True`` (quasi-static only;
+    combining with ``lmm`` raises ValueError) additionally counts
+    messages whose arrival is still in the future as standing load on
+    their route links — the same-model C++ twin of the kernel's
+    ``cfg.contention_backlog``.  ``clamp_d`` mirrors the ring-buffer
+    clamp of a ``delay_depth``-bounded run (0 = unclamped).
 
     ``visit_seed >= 0`` re-shuffles the within-tick node visit order
     every tick (mt19937 stream) — used to measure how much trajectory
@@ -277,6 +284,9 @@ def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
     deterministic order.
 
     Returns (rmse trajectory, estimates, last_avg, events)."""
+    if lmm and backlog:
+        raise ValueError("backlog refines the quasi-static model; the "
+                         "dynamic LMM already carries in-flight load")
     lib = get_lib()
     if lib is None:
         raise RuntimeError("native DES unavailable (no compiler?)")
@@ -299,7 +309,9 @@ def des_run_contend(topo, variant: str = "collectall", timeout: int = 50,
     est = np.empty(n, np.float64)
     last_avg = np.empty(n, np.float64)
     rmse = np.empty(max(ticks // obs_every, 1), np.float64)
-    entry = lib.fu_des_run_lmm if lmm else lib.fu_des_run_contend
+    entry = (lib.fu_des_run_lmm if lmm
+             else lib.fu_des_run_contend_backlog if backlog
+             else lib.fu_des_run_contend)
     events = entry(
         n, E, _ptr(src, ctypes.c_int32), _ptr(dst, ctypes.c_int32),
         _ptr(rev, ctypes.c_int32), _ptr(delay, ctypes.c_int32),

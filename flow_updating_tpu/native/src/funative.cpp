@@ -23,9 +23,11 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <limits>
 #include <queue>
 #include <random>
+#include <utility>
 #include <vector>
 
 extern "C" {
@@ -335,6 +337,11 @@ struct LinkModel {
   // or finishes — SimGrid's flow-model semantics (SURVEY.md N3), the
   // fidelity oracle the quasi-static approximation is measured against.
   int32_t lmm = 0;
+  // quasi-static only: count messages still in flight (sent in earlier
+  // ticks, arrival > t) as standing load on their route links — the
+  // same-model C++ twin of the kernel's cfg.contention_backlog
+  // (models/rounds.py::edge_delays inflight accounting).
+  int32_t backlog = 0;
   bool active() const { return edge_links != nullptr; }
 };
 
@@ -463,6 +470,16 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
   std::vector<Transfer> act;
   double now_c = 0.0;
 
+  // quasi-static backlog state: per-LINK standing count of messages with
+  // arrival > t (the kernel's buf_valid ring occupancy scattered onto
+  // route links), maintained incrementally — O(K) per message instead of
+  // an O(E*K) rescan per tick; expiry pops as the clock passes arrivals
+  std::vector<int64_t> standing_link(
+      lm.backlog && lm.active() ? (size_t)lm.L : 0, 0);
+  std::priority_queue<std::pair<int64_t, int32_t>,
+                      std::vector<std::pair<int64_t, int32_t>>,
+                      std::greater<>> expiry;
+
   auto lmm_advance = [&](double t_end_c) {
     // progress continuous time to t_end_c, re-solving max-min rates at
     // every completion event (the dynamic re-solve the quasi-static
@@ -535,6 +552,20 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
       return;
     }
     std::fill(link_cnt.begin(), link_cnt.end(), 0);
+    if (lm.backlog) {
+      // standing load: messages sent in earlier ticks whose arrival is
+      // still in the future (kernel equivalent: ring occupancy counted
+      // AFTER deliver_phase cleared this tick's slot, BEFORE new sends)
+      while (!expiry.empty() && expiry.top().first <= t) {
+        int32_t e = expiry.top().second;
+        expiry.pop();
+        for (int64_t k = 0; k < lm.K; ++k) {
+          int32_t l = lm.edge_links[(int64_t)e * lm.K + k];
+          if (l < lm.L) standing_link[(size_t)l]--;
+        }
+      }
+      for (int64_t l = 0; l < lm.L; ++l) link_cnt[l] += standing_link[l];
+    }
     for (const auto& p : tick_sends)
       for (int64_t k = 0; k < lm.K; ++k) {
         int32_t l = lm.edge_links[(int64_t)p.e * lm.K + k];
@@ -560,6 +591,13 @@ static int64_t des_impl(int64_t n, int64_t E, const int32_t* src,
       if (lm.clamp_d > 0) d = std::min(d, lm.clamp_d);
       mailbox[dst[p.e]].push(
           Msg{t + d, seq++, rev[p.e], p.flow_v, p.est_v});
+      if (lm.backlog) {
+        for (int64_t k = 0; k < lm.K; ++k) {
+          int32_t l = lm.edge_links[(int64_t)p.e * lm.K + k];
+          if (l < lm.L) standing_link[(size_t)l]++;
+        }
+        expiry.push({t + d, p.e});
+      }
     }
     tick_sends.clear();
   };
@@ -703,6 +741,31 @@ int64_t fu_des_run_contend(
   lm.link_shared = link_shared;
   lm.lat_rounds = lat_rounds;
   lm.clamp_d = clamp_d;
+  return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
+                  timeout, ticks, est_out, last_avg_out, obs_every, mean,
+                  rmse_out, lm, visit_seed);
+}
+
+// Quasi-static + in-flight backlog: the same-model C++ twin of the
+// kernel's cfg.contention_backlog (standing load from messages whose
+// arrival is still in the future).
+int64_t fu_des_run_contend_backlog(
+    int64_t n, int64_t E, const int32_t* src, const int32_t* dst,
+    const int32_t* rev, const int32_t* delay, const int64_t* row_start,
+    const double* values, int32_t variant, int64_t timeout, int64_t ticks,
+    double* est_out, double* last_avg_out, int64_t obs_every, double mean,
+    double* rmse_out, int64_t K, const int32_t* edge_links, int64_t L,
+    const double* link_ser_rounds, const uint8_t* link_shared,
+    const double* lat_rounds, int64_t clamp_d, int64_t visit_seed) {
+  LinkModel lm;
+  lm.K = K;
+  lm.edge_links = edge_links;
+  lm.L = L;
+  lm.link_ser_rounds = link_ser_rounds;
+  lm.link_shared = link_shared;
+  lm.lat_rounds = lat_rounds;
+  lm.clamp_d = clamp_d;
+  lm.backlog = 1;
   return des_impl(n, E, src, dst, rev, delay, row_start, values, variant,
                   timeout, ticks, est_out, last_avg_out, obs_every, mean,
                   rmse_out, lm, visit_seed);

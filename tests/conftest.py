@@ -110,6 +110,7 @@ SLOW_TESTS = {
         "test_dynamic_oracle_shows_congestive_collapse",
         "test_kernel_residual_vs_dynamic_oracle",
         "test_waterfill_property_matches_exact_maxmin",
+        "test_backlog_kernel_matches_same_model_oracle",
     },
     "test_pairwise.py": {"test_segmented_affine_scan_matches_loop"},
     "test_faults.py": {
