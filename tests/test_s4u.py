@@ -201,3 +201,27 @@ def test_s4u_and_cpp_des_converge_in_the_same_class():
     des_rounds = int((np.argmax(below) + 1) * 10)
     ratio = s4u_rounds / des_rounds
     assert 0.4 <= ratio <= 2.5, (s4u_rounds, des_rounds, ratio)
+
+
+def test_actor_exception_does_not_kill_the_simulation(host_engine, caplog):
+    """A crashing actor dies alone (logged); the rest of the population
+    keeps running — SimGrid semantics, and what the engine's pure-array
+    paths get by construction."""
+    import logging
+
+    def crasher():
+        s4u.this_actor.sleep_for(5.0)
+        raise RuntimeError("boom")
+
+    eng = host_engine
+    s4u.Actor.create("crasher", s4u.Host.by_name("Lisboa"), crasher)
+    s4u.Actor.create("watcher", s4u.Host.by_name("Lisboa"),
+                     watcher, 150.0, 10.0)
+    with caplog.at_level(logging.ERROR, logger="flow_updating_tpu"):
+        eng.run_until(200.0)
+    assert any("crasher" in r.message for r in caplog.records)
+    # the peers still converged after the crash at t=5
+    last = RESULTS["last_avg"]
+    assert len(last) == 6
+    for avg in last.values():
+        assert avg == pytest.approx(30.0, abs=0.5)
