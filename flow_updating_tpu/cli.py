@@ -111,16 +111,35 @@ def _build_topology(args):
 def _make_config(args):
     from flow_updating_tpu.models.config import RoundConfig
 
-    maker = (RoundConfig.reference if args.fire_policy == "reference"
-             else RoundConfig.fast)
+    fidelity = getattr(args, "fidelity", False)
+    fire_policy = getattr(args, "fire_policy", None)
     kw = dict(variant=args.variant, drop_rate=args.drop_rate,
               kernel=getattr(args, "kernel", "edge"),
               delivery=getattr(args, "delivery", "gather"),
               spmv=getattr(args, "spmv", "xla"),
-              segment_impl=getattr(args, "segment", "auto"),
-              contention=getattr(args, "contention", False),
-              contention_iters=getattr(args, "contention_iters", 0),
-              contention_backlog=getattr(args, "contention_backlog", False))
+              segment_impl=getattr(args, "segment", "auto"))
+    iters = getattr(args, "contention_iters", None)
+    if fidelity:
+        # the RoundConfig.fidelity preset is the single source of the
+        # preset values; only knobs the user explicitly set are passed,
+        # so they win over the preset's setdefaults
+        if fire_policy not in (None, "reference"):
+            raise SystemExit(
+                "--fidelity runs the faithful dynamics; it cannot "
+                "combine with --fire-policy every_round")
+        maker = RoundConfig.fidelity
+        if iters is not None:
+            kw["contention_iters"] = iters
+        if getattr(args, "contention_backlog", False):
+            kw["contention_backlog"] = True
+    else:
+        maker = (RoundConfig.reference
+                 if (fire_policy or "reference") == "reference"
+                 else RoundConfig.fast)
+        kw["contention"] = getattr(args, "contention", False)
+        kw["contention_iters"] = iters if iters is not None else 0
+        kw["contention_backlog"] = getattr(args, "contention_backlog",
+                                           False)
     if args.drain is not None:
         kw["drain"] = args.drain
     if args.timeout is not None:
@@ -175,7 +194,9 @@ def cmd_run(args) -> int:
     else:
         try:
             engine.build(latency_scale=args.latency_scale, seed=args.seed)
-        except ValueError as err:
+        except (ValueError, NotImplementedError) as err:
+            # NotImplementedError covers explicit unsupported-mode guards
+            # (e.g. halo + contention) — a clean exit, not a traceback
             raise SystemExit(f"invalid flag combination: {err}")
 
     from flow_updating_tpu.utils.eventlog import EventLog
@@ -317,7 +338,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(run)
     run.add_argument("--variant", default="collectall",
                      choices=("collectall", "pairwise"))
-    run.add_argument("--fire-policy", default="reference",
+    run.add_argument("--fire-policy", default=None,
                      choices=("reference", "every_round"),
                      help="'reference' = faithful async dynamics; "
                           "'every_round' = fast synchronous mode")
@@ -376,12 +397,19 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--pending-depth", type=int, default=None,
                      help="per-edge mailbox FIFO depth (default: mode "
                           "default — 2 in reference mode, 1 in fast mode)")
+    run.add_argument("--fidelity", action="store_true",
+                     help="the measured-best network-fidelity preset for "
+                          "the chosen --variant (faithful dynamics + "
+                          "max-min water-fill contention; backlog for "
+                          "pairwise — RoundConfig.fidelity, residuals "
+                          "pinned vs the dynamic LMM oracle).  Needs "
+                          "--platform and --latency-scale > 0")
     run.add_argument("--contention", action="store_true",
                      help="shared-link bandwidth contention (needs "
                           "--platform and --latency-scale > 0): concurrent "
                           "sends crossing a SHARED link split its capacity; "
                           "FATPIPE links never share")
-    run.add_argument("--contention-iters", type=int, default=0,
+    run.add_argument("--contention-iters", type=int, default=None,
                      help="with --contention: progressive-filling "
                           "max-min iterations per round (0 = local "
                           "bottleneck share; k>0 approximates SimGrid's "
