@@ -111,3 +111,26 @@ def test_run_save_and_resume_checkpoint(tmp_path, capsys):
     assert rc == 0
     assert rep2["t"] == 100
     assert rep2["rmse"] <= rep1["rmse"]
+
+
+def test_fidelity_preset_flag():
+    """--fidelity resolves through RoundConfig.fidelity (single source of
+    preset values); explicit knobs win; conflicts exit cleanly."""
+    from flow_updating_tpu.cli import _make_config, build_parser
+    from flow_updating_tpu.models.config import RoundConfig
+
+    ap = build_parser()
+    base = ["run", "--generator", "ring:8:1", "--variant", "pairwise"]
+    assert _make_config(ap.parse_args(base + ["--fidelity"])) == \
+        RoundConfig.fidelity("pairwise")
+    # explicit opt-out of the water-fill is honored
+    cfg = _make_config(ap.parse_args(base + ["--fidelity",
+                                             "--contention-iters", "0"]))
+    assert cfg.contention_iters == 0 and cfg.contention
+    # fast mode conflicts cleanly
+    with pytest.raises(SystemExit, match="faithful"):
+        _make_config(ap.parse_args(base + ["--fidelity", "--fire-policy",
+                                           "every_round"]))
+    # without --fidelity nothing changes: reference default, no contention
+    cfg = _make_config(ap.parse_args(base))
+    assert cfg == RoundConfig.reference("pairwise")
