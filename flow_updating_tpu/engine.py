@@ -149,7 +149,13 @@ class Engine:
                     "the TPU runtime; ignored", key)
                 continue
             key = key.replace("-", "_")
-            if not sep or key not in hints:
+            if not sep and key in hints:
+                # a valid knob missing its value is a syntax slip, not an
+                # unknown key — diagnose the actual mistake (ADVICE r5 #2)
+                raise ValueError(
+                    f"--cfg={key}: missing ':' separator "
+                    f"(format --cfg={key}:value)")
+            if key not in hints:
                 raise ValueError(
                     f"--cfg={key!r}: unknown config key (valid: "
                     f"{', '.join(sorted(hints))}; format "
@@ -166,7 +172,14 @@ class Engine:
                         f"--cfg={key}:{val!r}: not a boolean "
                         "(use true/false, yes/no, on/off, 1/0)")
             elif ftype in (int, float):
-                overrides[key] = ftype(val)
+                try:
+                    overrides[key] = ftype(val)
+                except ValueError:
+                    # name the offending flag, not just int()'s bare
+                    # "invalid literal" (ADVICE r5 #2)
+                    raise ValueError(
+                        f"--cfg={key}:{val}: not a valid "
+                        f"{ftype.__name__} value")
             else:
                 overrides[key] = val.strip()
         return _dc.replace(cfg, **overrides) if overrides else cfg
@@ -395,8 +408,9 @@ class Engine:
             if not self.topology.has_link_model:
                 raise ValueError(
                     "contention=True needs a platform-loaded topology with "
-                    "a link model and latency_scale > 0 (generators have "
-                    "no links)"
+                    "a link model and a positive latency scale — pass "
+                    "--platform with --latency-scale > 0 on the CLI "
+                    "(generators have no links)"
                 )
             if self.mesh is not None:
                 raise NotImplementedError(

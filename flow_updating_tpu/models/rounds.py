@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from flow_updating_tpu.models.config import COLLECTALL, PAIRWISE, RoundConfig
-from flow_updating_tpu.models.state import FlowUpdatingState
+from flow_updating_tpu.models.state import FlowUpdatingState, _ex, _feat
 from flow_updating_tpu.ops.segment import (
     ell_segment_all,
     ell_segment_max,
@@ -147,9 +147,11 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
     hit = arr_valid[None, :] & (
         jnp.arange(Q, dtype=put.dtype)[:, None] == put[None, :]
     )
-    pending_flow = jnp.where(hit, state.buf_flow[slot][None, :],
+    pending_flow = jnp.where(_ex(hit, state.pending_flow),
+                             state.buf_flow[slot][None],
                              state.pending_flow)
-    pending_est = jnp.where(hit, state.buf_est[slot][None, :],
+    pending_est = jnp.where(_ex(hit, state.pending_est),
+                            state.buf_est[slot][None],
                             state.pending_est)
     pending_stamp = jnp.where(hit, state.t, state.pending_stamp)
     pending_valid = state.pending_valid | hit
@@ -185,19 +187,21 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
             process = process | pick
             remaining = remaining & ~pick
 
-    flow = jnp.where(process, -pending_flow[0], state.flow)
-    est = jnp.where(process, pending_est[0], state.est)
+    flow = jnp.where(_ex(process, state.flow), -pending_flow[0], state.flow)
+    est = jnp.where(_ex(process, state.est), pending_est[0], state.est)
     recv = state.recv | process
 
     # pop the head of each processed queue: shift slots down by one
     if Q > 1:
         shift = lambda a, fill: jnp.concatenate([a[1:], fill], axis=0)
         pending_flow = jnp.where(
-            process[None, :], shift(pending_flow, pending_flow[-1:]),
+            _ex(process[None], pending_flow),
+            shift(pending_flow, pending_flow[-1:]),
             pending_flow,
         )
         pending_est = jnp.where(
-            process[None, :], shift(pending_est, pending_est[-1:]),
+            _ex(process[None], pending_est),
+            shift(pending_est, pending_est[-1:]),
             pending_est,
         )
         pending_stamp = jnp.where(
@@ -253,8 +257,12 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     # current state (flow sum, est sum, all-heard); with the planned
     # segment networks they share one batched extraction application
     # (ops/seg_benes.seg_reduce_multi) instead of paying it three times
+    # vector payloads skip the batched-lane multi helpers (they assume
+    # (E,) lanes); each payload reduction/broadcast instead rides the
+    # generalized per-op path with its own trailing feature axis
+    vec = state.flow.ndim > 1
     all_heard = None
-    if topo.seg_plan is not None and cfg.variant == COLLECTALL:
+    if topo.seg_plan is not None and cfg.variant == COLLECTALL and not vec:
         from flow_updating_tpu.ops.seg_benes import seg_reduce_multi
 
         xs = [(state.flow, "sum"), (state.est, "sum")]
@@ -282,8 +290,9 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
         # avg over self + ALL neighbors' last-known estimates (unheard
         # neighbors contribute their defaultdict 0.0, as in the reference,
         # ``collectall.py:109-113``).
-        avg = (estimate + est_sum) / (topo.out_deg + 1).astype(dt)
-        if topo.seg_plan is not None:
+        avg = (estimate + est_sum) / _ex((topo.out_deg + 1).astype(dt),
+                                         estimate)
+        if topo.seg_plan is not None and not vec:
             from flow_updating_tpu.ops.seg_benes import broadcast_multi
 
             fire_e, avg_e = broadcast_multi(
@@ -292,13 +301,15 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
         else:
             fire_e = _bcast(fire_n, topo)
             avg_e = _bcast(avg, topo)
-        new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
-        new_est = jnp.where(fire_e, avg_e, state.est)
+        fire_ex = _ex(fire_e, state.flow)
+        new_flow = jnp.where(fire_ex, state.flow + avg_e - state.est,
+                             state.flow)
+        new_est = jnp.where(fire_ex, avg_e, state.est)
         msg_est = avg_e
         send_mask = fire_e
         ticks = jnp.where(fire_n, 0, ticks)
         recv = recv & ~fire_e
-        last_avg = jnp.where(fire_n, avg, last_avg)
+        last_avg = jnp.where(_ex(fire_n, avg), avg, last_avg)
         fired_ctr = fired_ctr + fire_n.astype(jnp.int32)
     else:  # PAIRWISE
         if cfg.fire_policy == "every_round":
@@ -330,18 +341,19 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             x_u = estimate[src]
             x_v = estimate[topo.dst]
             avg_e = (x_u + x_v) * half
+            m_ex = _ex(matched, state.flow)
             new_flow = jnp.where(
-                matched, state.flow + (x_u - x_v) * half, state.flow
+                m_ex, state.flow + (x_u - x_v) * half, state.flow
             )
-            new_est = jnp.where(matched, avg_e, state.est)
+            new_est = jnp.where(m_ex, avg_e, state.est)
             msg_est = avg_e
             send_mask = jnp.zeros_like(matched)  # direct exchange, no messages
             stamp = jnp.where(matched, t, stamp)
             fire_any = _seg_max(matched.astype(jnp.int32), topo, N, 0) > 0
             node_avg = _seg_sum(
-                jnp.where(matched, avg_e, jnp.asarray(0, dt)), topo, N
+                jnp.where(m_ex, avg_e, jnp.asarray(0, dt)), topo, N
             )
-            last_avg = jnp.where(fire_any, node_avg, last_avg)
+            last_avg = jnp.where(_ex(fire_any, node_avg), node_avg, last_avg)
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
         else:
             # Faithful message-based dynamics.
@@ -353,14 +365,17 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             # ``pairwise.py:86-91,102-109``) — as one segmented affine scan.
             a = jnp.where(fire_e, jnp.asarray(0.5, dt), jnp.asarray(1.0, dt))
             b = jnp.where(
-                fire_e, state.est * jnp.asarray(0.5, dt), jnp.asarray(0.0, dt)
+                _ex(fire_e, state.est), state.est * jnp.asarray(0.5, dt),
+                jnp.asarray(0.0, dt)
             )
             seg_start = topo.edge_rank == 0
             A, B = segmented_affine_scan(a, b, seg_start)
-            run_est = A * _bcast(estimate, topo) + B  # est after edge e
+            run_est = _ex(A, B) * _bcast(estimate, topo) + B  # est after edge e
             avg_e = run_est                  # == the 2-party average at firing e
-            new_flow = jnp.where(fire_e, state.flow + avg_e - state.est, state.flow)
-            new_est = jnp.where(fire_e, avg_e, state.est)
+            f_ex = _ex(fire_e, state.flow)
+            new_flow = jnp.where(f_ex, state.flow + avg_e - state.est,
+                                 state.flow)
+            new_est = jnp.where(f_ex, avg_e, state.est)
             msg_est = avg_e
             send_mask = fire_e
             stamp = jnp.where(fire_e, t, stamp)
@@ -377,7 +392,8 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             else:
                 seg_end = jnp.maximum(topo.row_start[1:] - 1, 0)
                 final_est = run_est[seg_end]
-            last_avg = jnp.where(fire_any, final_est, last_avg)
+            last_avg = jnp.where(_ex(fire_any, final_est), final_est,
+                                 last_avg)
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
 
     # link-failure mask: a dead link loses every message put on it; the
@@ -550,18 +566,26 @@ def send_messages(
             # payload values f32 -> bf16 afterwards is value-preserving)
             lane_dt = jnp.promote_types(dt, jnp.float32) \
                 if cfg.contention else dt
-            lanes = [state.flow.astype(lane_dt), msg_est.astype(lane_dt),
-                     send_mask.astype(lane_dt)]
+            # a vector payload's features ride the SAME network as extra
+            # lanes: (E, F) transposes to F lanes of (E,), so the batched
+            # application stays one pass regardless of F
+            nf = _feat(state.flow)
+            as_lanes = (lambda x: x.T.astype(lane_dt) if x.ndim > 1
+                        else x.astype(lane_dt)[None])
+            lanes = [as_lanes(state.flow), as_lanes(msg_est),
+                     send_mask.astype(lane_dt)[None]]
             if cfg.contention:
-                lanes.append(delay.astype(lane_dt))
+                lanes.append(delay.astype(lane_dt)[None])
             moved = apply_padded_perm(
-                jnp.stack(lanes), topo.rev_plan, topo.rev_masks
+                jnp.concatenate(lanes), topo.rev_plan, topo.rev_masks
             )
-            pay_flow = moved[0].astype(dt)
-            pay_est = moved[1].astype(dt)
-            sending = moved[2] > 0.5
-            delay_r = (moved[3].astype(topo.delay.dtype) if cfg.contention
-                       else topo.delay_rev)
+            un_lanes = (lambda m: m.T.astype(dt) if state.flow.ndim > 1
+                        else m[0].astype(dt))
+            pay_flow = un_lanes(moved[:nf])
+            pay_est = un_lanes(moved[nf:2 * nf])
+            sending = moved[2 * nf] > 0.5
+            delay_r = (moved[2 * nf + 1].astype(topo.delay.dtype)
+                       if cfg.contention else topo.delay_rev)
             slot_r = (t + delay_r) % D
         else:
             rf = topo.rev
@@ -572,8 +596,9 @@ def send_messages(
         hit = sending[None, :] & (
             slot_r[None, :] == jnp.arange(D, dtype=slot_r.dtype)[:, None]
         )
-        buf_flow = jnp.where(hit, pay_flow[None, :], state.buf_flow)
-        buf_est = jnp.where(hit, pay_est[None, :], state.buf_est)
+        hit_p = _ex(hit, state.buf_flow)
+        buf_flow = jnp.where(hit_p, pay_flow[None], state.buf_flow)
+        buf_est = jnp.where(hit_p, pay_est[None], state.buf_est)
         buf_valid = state.buf_valid | hit
     else:
         slot_idx = (t + delay) % D
@@ -669,8 +694,11 @@ def _observe_chunk(s, topo, cfg, observe_every: int, mean):
     )
     est = node_estimates(s, topo)
     alive = s.alive
-    cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
-    err = jnp.where(alive, est - mean, 0)
+    # vector payloads: rmse/max-err pool over features, mass sums over
+    # them (per-feature mass is asserted where it matters —
+    # workloads/gossip_sgd.py churn runs and tests/test_vector_payload.py)
+    cnt = (jnp.maximum(jnp.sum(alive), 1) * _feat(est)).astype(est.dtype)
+    err = jnp.where(_ex(alive, est), est - mean, 0)
     # Summing (N,) int32 fire counters keeps int32 in JAX and would wrap
     # once N*rounds exceeds ~2.1e9 — i.e. at the advertised ~1M-node bench
     # scale.  Accumulate in int64 when x64 is on; otherwise float32 (never
@@ -681,7 +709,7 @@ def _observe_chunk(s, topo, cfg, observe_every: int, mean):
         s.t,
         jnp.sqrt(jnp.sum(err * err) / cnt),
         jnp.max(jnp.abs(err)),
-        jnp.sum(jnp.where(alive, est, 0)),
+        jnp.sum(jnp.where(_ex(alive, est), est, 0)),
         jnp.sum(s.fired, dtype=fired_acc),
     )
     return s, sample

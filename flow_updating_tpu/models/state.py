@@ -12,6 +12,18 @@ and sending is one masked scatter through ``rev``.
 Being a single pytree makes checkpoint/resume, vmapping over replicas and
 sharding trivial — the reference has no checkpointing at all (SURVEY.md §5);
 here it is a free by-product.
+
+**Vector payloads.**  Every *payload* array (``value``, ``flow``, ``est``,
+``last_avg`` and the pending/ring payload planes) may carry a trailing
+feature axis: pass ``values`` of shape ``(N, D)`` to :func:`init_state` and
+the aggregate becomes a D-vector averaged per-feature in one run — the
+substrate of the decentralized-learning workloads
+(:mod:`flow_updating_tpu.workloads`), where each node's payload is a model
+parameter vector.  Control/mask arrays (``recv``, ``ticks``, ``alive``,
+validity planes, …) never grow a feature axis: the protocol's firing and
+delivery decisions are payload-independent, so a ``(N, D)`` run is exactly
+D independent scalar protocol instances sharing one set of messages
+(asserted in tests/test_vector_payload.py).
 """
 
 from __future__ import annotations
@@ -47,11 +59,56 @@ class FlowUpdatingState:
     key: jnp.ndarray           # PRNG key (fault injection)
 
 
+def feature_shape(values) -> tuple:
+    """Trailing feature axes of a payload array: ``()`` for the scalar
+    protocol, ``(D,)`` for D-feature vector payloads."""
+    return tuple(values.shape[1:])
+
+
+def _ex(m, ref):
+    """Broadcast a control-plane array (a mask or per-node/per-edge
+    scalar) over a payload's trailing feature axes.
+
+    The protocol's decisions (who fires, what is delivered, what is
+    dropped) are computed on feature-free ``(N,)``/``(E,)`` arrays; the
+    payloads they select between may carry a trailing ``(D,)`` feature
+    axis.  ``_ex`` appends singleton axes so ``jnp.where(_ex(mask, x),
+    a, x)`` broadcasts the mask across features instead of mis-aligning
+    it against them.  Shared by every kernel (rounds, sync, sharded)."""
+    extra = ref.ndim - m.ndim
+    return m.reshape(m.shape + (1,) * extra) if extra > 0 else m
+
+
+def _feat(x) -> int:
+    """Number of feature lanes of a payload array (1 for scalar)."""
+    return int(x.size // x.shape[0]) if x.ndim > 1 else 1
+
+
+def check_payload_values(values, num_nodes: int) -> None:
+    """Shared payload-shape contract for every state entry point
+    (init_state, sync.NodeKernel, parallel.sharded.init_plan_state):
+    ``(N,)`` scalar or ``(N, D)`` — ONE feature axis, because the lane
+    packings (benes delivery, halo exchange) address features as
+    ``x[:, d]``."""
+    if values.shape[0] != num_nodes:
+        raise ValueError(
+            f"values must have leading dimension {num_nodes} "
+            f"(got {values.shape})")
+    if values.ndim > 2:
+        raise ValueError(
+            f"values must be (N,) or (N, D) — got shape {values.shape}; "
+            "flatten extra feature axes to one")
+
+
 def init_state(
     topo: Topology, cfg: RoundConfig, seed: int = 0, values=None
 ) -> FlowUpdatingState:
     """Fresh state: zero flows/estimates (the reference's ``defaultdict(float)``
-    semantics, ``flowupdating-collectall.py:33-34``), empty buffers."""
+    semantics, ``flowupdating-collectall.py:33-34``), empty buffers.
+
+    ``values`` may be ``(N,)`` (the scalar protocol, default
+    ``topo.values``) or ``(N, D)`` — then every payload array carries the
+    trailing feature axis (see module docstring)."""
     N, E, D = topo.num_nodes, topo.num_edges, cfg.delay_depth
     if D < topo.max_delay:
         raise ValueError(
@@ -61,24 +118,27 @@ def init_state(
     dt = cfg.jnp_dtype
     if values is None:
         values = topo.values
+    values = jnp.asarray(values, dt)
+    check_payload_values(values, N)
+    F = feature_shape(values)
     return FlowUpdatingState(
         t=jnp.zeros((), jnp.int32),
-        value=jnp.asarray(values, dt),
-        flow=jnp.zeros((E,), dt),
-        est=jnp.zeros((E,), dt),
+        value=values,
+        flow=jnp.zeros((E,) + F, dt),
+        est=jnp.zeros((E,) + F, dt),
         recv=jnp.zeros((E,), bool),
         ticks=jnp.zeros((N,), jnp.int32),
         stamp=jnp.zeros((E,), jnp.int32),
-        last_avg=jnp.zeros((N,), dt),
+        last_avg=jnp.zeros((N,) + F, dt),
         fired=jnp.zeros((N,), jnp.int32),
         alive=jnp.ones((N,), bool),
         edge_ok=jnp.ones((E,), bool),
-        pending_flow=jnp.zeros((cfg.pending_depth, E), dt),
-        pending_est=jnp.zeros((cfg.pending_depth, E), dt),
+        pending_flow=jnp.zeros((cfg.pending_depth, E) + F, dt),
+        pending_est=jnp.zeros((cfg.pending_depth, E) + F, dt),
         pending_valid=jnp.zeros((cfg.pending_depth, E), bool),
         pending_stamp=jnp.zeros((cfg.pending_depth, E), jnp.int32),
-        buf_flow=jnp.zeros((D, E), dt),
-        buf_est=jnp.zeros((D, E), dt),
+        buf_flow=jnp.zeros((D, E) + F, dt),
+        buf_est=jnp.zeros((D, E) + F, dt),
         buf_valid=jnp.zeros((D, E), bool),
         key=jax.random.PRNGKey(seed),
     )

@@ -44,6 +44,7 @@ import numpy as np
 from flow_updating_tpu.utils import struct
 
 from flow_updating_tpu.models.config import COLLECTALL, RoundConfig
+from flow_updating_tpu.models.state import _ex, _feat, check_payload_values
 from flow_updating_tpu.topology.graph import Topology
 
 
@@ -100,10 +101,27 @@ class NodeKernel:
     """
 
     def __init__(self, topo: Topology, cfg: RoundConfig,
-                 row_multiple: int = 1, mesh=None):
+                 row_multiple: int = 1, mesh=None, values=None):
+        """``values`` overrides ``topo.values`` and may be ``(N, D)`` —
+        the node-collapsed recurrence is linear in the payload, so a
+        vector run is exactly D independent scalar recurrences sharing
+        one neighbor-sum schedule (the workloads substrate,
+        :mod:`flow_updating_tpu.workloads`).  Vector payloads run the
+        'xla' neighbor sum: the pallas/benes/structured layouts reshape
+        the node axis into circuit/stencil geometry and stay scalar."""
         _check_cfg(cfg)
         self.topo = topo
         self.cfg = cfg
+        self._values = np.asarray(
+            topo.values if values is None else values, np.float64)
+        check_payload_values(self._values, topo.num_nodes)
+        self.feature_shape = tuple(self._values.shape[1:])
+        if self.feature_shape and cfg.spmv != "xla":
+            raise ValueError(
+                f"vector payloads run the node kernel with spmv='xla' "
+                f"(spmv={cfg.spmv!r} reshapes the node axis into "
+                "circuit/stencil geometry; use the edge kernel for "
+                "vector runs on those paths)")
         import math
 
         if cfg.spmv in ("pallas", "benes", "benes_fused"):
@@ -148,9 +166,9 @@ class NodeKernel:
         self._pos_of_real = pos          # (N,) permuted-real -> padded slot
         self._perm = ell.perm            # (N,) permuted-real -> original id
 
-        value = np.zeros(M, np.float64)
+        value = np.zeros((M,) + self.feature_shape, np.float64)
         deg = np.zeros(M, np.float64)
-        value[pos] = topo.values[ell.perm]
+        value[pos] = self._values[ell.perm]
         deg[pos] = topo.out_deg[ell.perm]
 
         mats = []
@@ -207,7 +225,7 @@ class NodeKernel:
         self._perm = np.arange(n, dtype=np.int64)
         value = np.zeros(M, np.float64)
         deg = np.zeros(M, np.float64)
-        value[:n] = topo.values
+        value[:n] = self._values
         deg[:n] = topo.out_deg
         self.arrays = NodeSyncArrays(
             value=jnp.asarray(value, dt),
@@ -238,7 +256,8 @@ class NodeKernel:
         self.arrays = jax.device_put(self.arrays, arrs_sh)
 
     def init_state(self) -> NodeSyncState:
-        z = jnp.zeros((self.padded_size,), self.cfg.jnp_dtype)
+        z = jnp.zeros((self.padded_size,) + self.feature_shape,
+                      self.cfg.jnp_dtype)
         state = NodeSyncState(t=jnp.zeros((), jnp.int32), S=z, G=z,
                               avg_prev=z, A_prev=z)
         if self.mesh is not None:
@@ -266,7 +285,8 @@ class NodeKernel:
         )
 
     def _unpermute(self, padded: np.ndarray) -> np.ndarray:
-        out = np.empty(self.topo.num_nodes, padded.dtype)
+        out = np.empty((self.topo.num_nodes,) + padded.shape[1:],
+                       padded.dtype)
         out[self._perm] = padded[self._pos_of_real]
         return out
 
@@ -280,12 +300,15 @@ class NodeKernel:
 
 
 def neighbor_sum(x: jnp.ndarray, mats: tuple) -> jnp.ndarray:
-    """A(x)[u] = sum of x over u's neighbors — bucketed gather + row sums."""
-    xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
+    """A(x)[u] = sum of x over u's neighbors — bucketed gather + row sums.
+    ``x`` is (M,) or (M, D); the gather and row reduction broadcast over
+    the trailing feature axes."""
+    feat = x.shape[1:]
+    xp = jnp.concatenate([x, jnp.zeros((1,) + feat, x.dtype)])
     parts = []
     for m in mats:
         if m.shape[1] == 0:
-            parts.append(jnp.zeros((m.shape[0],), x.dtype))
+            parts.append(jnp.zeros((m.shape[0],) + feat, x.dtype))
         else:
             parts.append(jnp.sum(xp[m], axis=1))
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
@@ -294,7 +317,8 @@ def neighbor_sum(x: jnp.ndarray, mats: tuple) -> jnp.ndarray:
 def node_round_step(
     state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig
 ) -> NodeSyncState:
-    avg = (arrs.value - state.S + state.A_prev) * arrs.inv_depp1
+    avg = ((arrs.value - state.S + state.A_prev)
+           * _ex(arrs.inv_depp1, arrs.value))
     if cfg.spmv == "pallas":
         from flow_updating_tpu.ops.pallas_spmv import neighbor_sum_pallas
 
@@ -309,8 +333,9 @@ def node_round_step(
         A_cur = structured_neighbor_sum(avg, arrs.ns_struct)
     else:
         A_cur = neighbor_sum(avg, arrs.mats)
-    S_next = -state.G - A_cur + arrs.deg * state.avg_prev
-    G_next = -state.S - arrs.deg * avg + state.A_prev
+    deg = _ex(arrs.deg, arrs.value)
+    S_next = -state.G - A_cur + deg * state.avg_prev
+    G_next = -state.S - deg * avg + state.A_prev
     return NodeSyncState(
         t=state.t + 1, S=S_next, G=G_next, avg_prev=avg, A_prev=A_cur
     )
@@ -334,13 +359,13 @@ def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):
     (deg > 0 — padding has degree 0)."""
     real = arrs.inv_depp1 < 1.0  # deg > 0 <=> 1/(deg+1) < 1
     est = arrs.value + s.G
-    cnt = jnp.maximum(jnp.sum(real), 1).astype(est.dtype)
-    err = jnp.where(real, est - mean, 0)
+    cnt = (jnp.maximum(jnp.sum(real), 1) * _feat(est)).astype(est.dtype)
+    err = jnp.where(_ex(real, est), est - mean, 0)
     return (
         s.t,
         jnp.sqrt(jnp.sum(err * err) / cnt),
         jnp.max(jnp.abs(err)),
-        jnp.sum(jnp.where(real, est, 0)),
+        jnp.sum(jnp.where(_ex(real, est), est, 0)),
         # communicating-node count; the host multiplies by t (in Python
         # ints — t * N overflows int32 at ~1M nodes x ~2k rounds)
         jnp.sum(real),

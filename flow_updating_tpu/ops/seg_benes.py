@@ -169,13 +169,37 @@ def _combine(op: str):
             "all": jnp.logical_and}[op]
 
 
+def _to_lanes(x, plan_len: int, fill, E_or_N: int):
+    """Embed an ``(L, F...)`` edge/node array into the circuit width as
+    ``(F, P)`` feature lanes (just ``(P,)`` for the scalar ``(L,)``
+    case) — every network stage operates over the LAST axis, so feature
+    lanes of a vector payload ride one batched application, exactly like
+    the multi-lane helpers below.  Returns ``(z, F)``."""
+    import jax.numpy as jnp
+
+    F = x.shape[1:]
+    if not F:
+        z = jnp.full((plan_len,), fill, x.dtype)
+        return z.at[:E_or_N].set(x), F
+    lanes = x.reshape(x.shape[0], -1).T          # (prod(F), L)
+    z = jnp.full((lanes.shape[0], plan_len), fill, x.dtype)
+    return z.at[:, :E_or_N].set(lanes), F
+
+
+def _from_lanes(z, F, out_len: int):
+    """Inverse of :func:`_to_lanes`: ``(F, P)`` lanes -> ``(out_len,
+    F...)`` (the scalar case degenerates to ``z[:out_len]``)."""
+    return z[..., :out_len].T.reshape((out_len,) + F)
+
+
 def seg_reduce(x, op: str, plan: SegmentedPlan, dist, extract_masks):
-    """Per-node reduction of the (E,) edge array ``x`` -> (N,)."""
+    """Per-node reduction of the ``(E,)`` (or ``(E, F)`` vector-payload)
+    edge array ``x`` -> ``(N,)`` (or ``(N, F)``)."""
     import jax.numpy as jnp
 
     ident = _identity_for(op, x.dtype)
     comb = _combine(op)
-    z = jnp.full((plan.P,), ident, x.dtype).at[: plan.E].set(x)
+    z, F = _to_lanes(x, plan.P, ident, plan.E)
     if plan.geom is not None and plan.scan_bits:
         from flow_updating_tpu.ops.pallas_fused import segscan_pass
 
@@ -189,20 +213,20 @@ def seg_reduce(x, op: str, plan: SegmentedPlan, dist, extract_masks):
     else:
         for k in range(plan.scan_bits):
             d = 1 << k
-            taken = jnp.where(dist >= d, jnp.roll(z, d), ident)
+            taken = jnp.where(dist >= d, jnp.roll(z, d, axis=-1), ident)
             z = comb(z, taken)
     out = _apply(z, plan.extract, plan.extract_fused, extract_masks)
-    return out[: plan.N]
+    return _from_lanes(out, F, plan.N)
 
 
 def extract_row_ends(x, plan: SegmentedPlan, extract_masks):
-    """(E,) edge array -> (N,) values at each node's LAST out-edge (the
-    ``x[row_start[1:] - 1]`` gather; deg-0 nodes read 0)."""
-    import jax.numpy as jnp
-
-    z = jnp.zeros((plan.P,), x.dtype).at[: plan.E].set(x)
-    return _apply(z, plan.extract, plan.extract_fused,
-                  extract_masks)[: plan.N]
+    """(E,) (or (E, F)) edge array -> (N,) (or (N, F)) values at each
+    node's LAST out-edge (the ``x[row_start[1:] - 1]`` gather; deg-0
+    nodes read 0)."""
+    z, F = _to_lanes(x, plan.P, 0, plan.E)
+    return _from_lanes(
+        _apply(z, plan.extract, plan.extract_fused, extract_masks),
+        F, plan.N)
 
 
 def seg_reduce_multi(xs_ops, plan: SegmentedPlan, dist, extract_masks):
@@ -305,11 +329,11 @@ def broadcast_multi(vs, plan: SegmentedPlan, dist, place_masks):
 
 
 def broadcast(v, plan: SegmentedPlan, dist, place_masks):
-    """Node array (N,) -> per-out-edge array (E,) (the ``v[src]``
-    gather, gather-free)."""
+    """Node array (N,) (or (N, F) vector payload) -> per-out-edge array
+    (E,) (or (E, F)) (the ``v[src]`` gather, gather-free)."""
     import jax.numpy as jnp
 
-    z = jnp.zeros((plan.P,), v.dtype).at[: plan.N].set(v)
+    z, F = _to_lanes(v, plan.P, 0, plan.N)
     z = _apply(z, plan.place, plan.place_fused, place_masks)
     if plan.geom is not None and plan.fill_bits:
         from flow_updating_tpu.ops.pallas_fused import fill_pass
@@ -319,5 +343,5 @@ def broadcast(v, plan: SegmentedPlan, dist, place_masks):
     else:
         for k in range(plan.fill_bits):
             d = 1 << k
-            z = jnp.where((dist >> k) & 1 != 0, jnp.roll(z, d), z)
-    return z[: plan.E]
+            z = jnp.where((dist >> k) & 1 != 0, jnp.roll(z, d, axis=-1), z)
+    return _from_lanes(z, F, plan.E)

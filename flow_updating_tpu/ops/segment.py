@@ -55,13 +55,17 @@ def segment_all(pred, segment_ids, num_segments: int):
 
 
 def _ell_reduce(values, pad_value, topo, reducer, out_dtype=None):
+    """``values`` is ``(E,)`` or ``(E, D)`` (vector payloads) — the pad
+    slot, gathers and the axis-1 row reduction all broadcast over the
+    trailing feature axes unchanged."""
+    feat = values.shape[1:]
     xp = jnp.concatenate(
-        [values, jnp.asarray([pad_value], dtype=values.dtype)]
+        [values, jnp.full((1,) + feat, pad_value, dtype=values.dtype)]
     )
     parts = []
     for m in topo.ell_edge_mats:
         if m.shape[1] == 0:
-            parts.append(jnp.full((m.shape[0],), pad_value, xp.dtype))
+            parts.append(jnp.full((m.shape[0],) + feat, pad_value, xp.dtype))
         else:
             parts.append(reducer(xp[m]))
     cat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]

@@ -27,8 +27,14 @@ def segmented_affine_scan(a, b, seg_start):
     Element i carries the map ``x -> a[i] * x + b[i]``; ``seg_start[i]`` is
     True where a new segment begins.  Returns ``(A, B)`` such that the
     composition of maps ``seg_first..i`` is ``x -> A[i] * x + B[i]``.
+
+    ``b`` may carry trailing feature axes (vector payloads: the same
+    scale ``a`` applies one affine map per feature); ``a`` and
+    ``seg_start`` stay 1-D over the scanned axis.
     """
     seg_start = seg_start.astype(bool)
+    ext = b.ndim - a.ndim
+    up = (lambda m: m.reshape(m.shape + (1,) * ext)) if ext else (lambda m: m)
 
     def combine(left, right):
         a1, b1, f1 = left
@@ -36,7 +42,7 @@ def segmented_affine_scan(a, b, seg_start):
         # right-after-left: x -> a2*(a1 x + b1) + b2, unless right starts a
         # new segment, in which case left is discarded.
         a_out = jnp.where(f2, a2, a2 * a1)
-        b_out = jnp.where(f2, b2, a2 * b1 + b2)
+        b_out = jnp.where(up(f2), b2, up(a2) * b1 + b2)
         return a_out, b_out, f1 | f2
 
     A, B, _ = jax.lax.associative_scan(combine, (a, b, seg_start))

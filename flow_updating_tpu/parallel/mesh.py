@@ -14,6 +14,22 @@ import numpy as np
 NODE_AXIS = "nodes"
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``.
+
+    jax >= 0.6 exposes ``jax.shard_map`` (replication check flag
+    ``check_vma``); earlier releases ship it as
+    ``jax.experimental.shard_map.shard_map`` with the flag spelled
+    ``check_rep``.  Same semantics either way."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> jax.sharding.Mesh:
     devices = jax.devices()
     if n_devices is not None:

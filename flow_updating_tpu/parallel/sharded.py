@@ -46,13 +46,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from flow_updating_tpu.models.config import RoundConfig
-from flow_updating_tpu.models.state import FlowUpdatingState
+from flow_updating_tpu.models.state import (
+    FlowUpdatingState,
+    _ex,
+    check_payload_values,
+)
 from flow_updating_tpu.models.rounds import deliver_phase, fire_core
-from flow_updating_tpu.parallel.mesh import NODE_AXIS
+from flow_updating_tpu.parallel.mesh import NODE_AXIS, shard_map
 from flow_updating_tpu.topology.graph import Topology, TopoArrays
 
 P = jax.sharding.PartitionSpec
-shard_map = jax.shard_map
 
 
 @struct.dataclass
@@ -329,10 +332,17 @@ def _sharding_tree(tree, mesh):
 
 
 def init_plan_state(
-    plan: ShardPlan, cfg: RoundConfig, mesh: jax.sharding.Mesh, seed: int = 0
+    plan: ShardPlan, cfg: RoundConfig, mesh: jax.sharding.Mesh,
+    seed: int = 0, values=None,
 ) -> FlowUpdatingState:
     """Fresh sharded state: every leaf carries a leading (S,) shard axis and
-    is placed with its block on its device."""
+    is placed with its block on its device.
+
+    ``values`` overrides the plan's node values and may be ``(N, D)`` in
+    the caller's ORIGINAL node order (vector payloads): payload arrays
+    then carry the trailing feature axis, co-sharded with their node/edge
+    blocks (the feature axis itself is never split — it travels with its
+    node)."""
     if cfg.needs_coloring and plan.num_colors == 0:
         raise ValueError(
             "fast synchronous pairwise needs the edge coloring in the "
@@ -340,27 +350,42 @@ def init_plan_state(
         )
     S, Nb, Eb, D = plan.num_shards, plan.Nb, plan.Eb, cfg.delay_depth
     dt = cfg.jnp_dtype
+    if values is None:
+        vals = plan.values
+        F = ()
+    else:
+        values = np.asarray(values, np.float64)
+        N = plan.topo.num_nodes
+        check_payload_values(values, N)
+        F = tuple(values.shape[1:])
+        # original order -> partition order -> (S, Nb) blocks (same
+        # layout rule as plan_sharding's scalar values)
+        ordered = values[plan.order] if plan.order is not None else values
+        flat = np.zeros((S * plan.cap,) + F, np.float64)
+        flat[:N] = ordered
+        vals = np.zeros((S, Nb) + F, np.float64)
+        vals[:, : plan.cap] = flat.reshape((S, plan.cap) + F)
     keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(seed), i))(
         jnp.arange(S)
     )
     state = FlowUpdatingState(
         t=jnp.zeros((S,), jnp.int32),
-        value=jnp.asarray(plan.values, dt),
-        flow=jnp.zeros((S, Eb), dt),
-        est=jnp.zeros((S, Eb), dt),
+        value=jnp.asarray(vals, dt),
+        flow=jnp.zeros((S, Eb) + F, dt),
+        est=jnp.zeros((S, Eb) + F, dt),
         recv=jnp.zeros((S, Eb), bool),
         ticks=jnp.zeros((S, Nb), jnp.int32),
         stamp=jnp.zeros((S, Eb), jnp.int32),
-        last_avg=jnp.zeros((S, Nb), dt),
+        last_avg=jnp.zeros((S, Nb) + F, dt),
         fired=jnp.zeros((S, Nb), jnp.int32),
         alive=jnp.asarray(plan.alive0),
         edge_ok=jnp.ones((S, Eb), bool),
-        pending_flow=jnp.zeros((S, cfg.pending_depth, Eb), dt),
-        pending_est=jnp.zeros((S, cfg.pending_depth, Eb), dt),
+        pending_flow=jnp.zeros((S, cfg.pending_depth, Eb) + F, dt),
+        pending_est=jnp.zeros((S, cfg.pending_depth, Eb) + F, dt),
         pending_valid=jnp.zeros((S, cfg.pending_depth, Eb), bool),
         pending_stamp=jnp.zeros((S, cfg.pending_depth, Eb), jnp.int32),
-        buf_flow=jnp.zeros((S, D, Eb), dt),
-        buf_est=jnp.zeros((S, D, Eb), dt),
+        buf_flow=jnp.zeros((S, D, Eb) + F, dt),
+        buf_est=jnp.zeros((S, D, Eb) + F, dt),
         buf_valid=jnp.zeros((S, D, Eb), bool),
         key=keys,
     )
@@ -379,6 +404,18 @@ def plan_device_arrays(
     perm = jax.tree.map(jnp.asarray, plan.perm_tables)
     perm = jax.device_put(perm, _sharding_tree(perm, mesh))
     return arrays, halo, perm
+
+
+def _lanes(x):
+    """Payload -> lane-major for collectives: (H,) -> (1, H); a vector
+    payload's (H, F) -> (F, H), so features ride the SAME ppermute /
+    all_gather as extra lanes of one message block."""
+    return x.T if x.ndim > 1 else x[None]
+
+
+def _unlanes(m, ref):
+    """Inverse of :func:`_lanes`, shaped like payload ``ref``."""
+    return m.T if ref.ndim > 1 else m[0]
 
 
 def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
@@ -414,21 +451,26 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
         # per-round traffic is each shard's own (padded, per-pair) cut-edge
         # payloads, O(cut edges), vs the all_gather broadcast's O(S * cut).
         # Routing tables are plan-time constants sharded with their rows.
+        # Vector payloads ride as extra feature lanes of the same block.
         dt = st.flow.dtype
+        nf = st.flow.shape[1] if st.flow.ndim > 1 else 1
         for di in range(len(offsets)):
             sidx = perm.send_idx[di]
             in_r = sidx < Eb
             slc = jnp.minimum(sidx, Eb - 1)
             v = (send_mask[slc] & in_r).astype(dt)
-            payload = jnp.stack([st.flow[slc], msg_est[slc], v])
+            payload = jnp.concatenate(
+                [_lanes(st.flow[slc]), _lanes(msg_est[slc]), v[None]])
             pairs = [(s, (s + offsets[di]) % S) for s in range(S)]
             got = jax.lax.ppermute(payload, NODE_AXIS, pairs)
-            rv = got[2] > 0.5
+            rv = got[2 * nf] > 0.5
             rt = perm.recv_tlocal[di]
             slot_r = (t + perm.recv_delay[di]) % D
             tgt2 = jnp.where(rv & (rt < Eb), rt, Eb)
-            buf_flow = buf_flow.at[slot_r, tgt2].set(got[0], mode="drop")
-            buf_est = buf_est.at[slot_r, tgt2].set(got[1], mode="drop")
+            buf_flow = buf_flow.at[slot_r, tgt2].set(
+                _unlanes(got[:nf], st.flow), mode="drop")
+            buf_est = buf_est.at[slot_r, tgt2].set(
+                _unlanes(got[nf:2 * nf], st.flow), mode="drop")
             buf_valid = buf_valid.at[slot_r, tgt2].set(True, mode="drop")
     else:
         # broadcast halo: all_gather every shard's cut-edge payloads;
@@ -440,7 +482,8 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
         h_flow = st.flow[hidx]
         h_est = msg_est[hidx]
 
-        g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(-1)
+        g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(
+            (-1,) + x.shape[1:])
         a_valid = g(h_valid)
         a_flow = g(h_flow)
         a_est = g(h_est)
@@ -481,59 +524,64 @@ def _local_round_fastpair(st: FlowUpdatingState, pl: PlanArrays,
 
     est_n = st.value - jax.ops.segment_sum(
         st.flow, pl.src_local, num_segments=Nb)
-    x_u = est_n[pl.src_local]                       # (Eb,)
+    F = st.flow.shape[1:]                           # () | (D,) features
+    x_u = est_n[pl.src_local]                       # (Eb,) + F
     valid_u = st.alive[pl.src_local] & st.edge_ok   # sender-side half of
     #                                                 the matched predicate
 
     # partner state: local reverse slot, then overwrite cut slots from halo
     is_local = (pl.tshard == me) & (pl.tlocal < Eb)
     lr = jnp.minimum(pl.tlocal, Eb - 1)
-    x_v = jnp.where(is_local, x_u[lr], jnp.asarray(0, dt))
+    x_v = jnp.where(_ex(is_local, x_u), x_u[lr], jnp.asarray(0, dt))
     valid_v = is_local & valid_u[lr]
+    nf = x_u.shape[1] if x_u.ndim > 1 else 1
 
     if halo_mode == "ppermute":
         for di in range(len(offsets)):
             sidx = perm.send_idx[di]
             in_r = sidx < Eb
             slc = jnp.minimum(sidx, Eb - 1)
-            payload = jnp.stack([
-                x_u[slc], (valid_u[slc] & in_r).astype(dt)])
+            payload = jnp.concatenate([
+                _lanes(x_u[slc]), (valid_u[slc] & in_r).astype(dt)[None]])
             pairs = [(s, (s + offsets[di]) % S) for s in range(S)]
             got = jax.lax.ppermute(payload, NODE_AXIS, pairs)
             rt = perm.recv_tlocal[di]
-            tgt = jnp.where(got[1] > 0.5, jnp.minimum(rt, Eb), Eb)
+            tgt = jnp.where(got[nf] > 0.5, jnp.minimum(rt, Eb), Eb)
             arrived = jnp.zeros((Eb + 1,), bool).at[tgt].set(
                 True, mode="drop")[:Eb]
-            xin = jnp.zeros((Eb + 1,), dt).at[tgt].set(
-                got[0], mode="drop")[:Eb]
-            x_v = jnp.where(arrived, xin, x_v)
+            xin = jnp.zeros((Eb + 1,) + F, dt).at[tgt].set(
+                _unlanes(got[:nf], x_u), mode="drop")[:Eb]
+            x_v = jnp.where(_ex(arrived, x_v), xin, x_v)
             valid_v = valid_v | arrived
     else:
         hidx = jnp.minimum(pl.halo_idx, Eb - 1)
         in_range = pl.halo_idx < Eb
-        g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(-1)
+        g = lambda x: jax.lax.all_gather(x, NODE_AXIS).reshape(
+            (-1,) + x.shape[1:])
         a_x = g(x_u[hidx])
         a_ok = g(valid_u[hidx] & in_range)
         mine = a_ok & (halo.tshard == me)
         tgt = jnp.where(mine, halo.tlocal, Eb)
         arrived = jnp.zeros((Eb + 1,), bool).at[tgt].set(
             True, mode="drop")[:Eb]
-        xin = jnp.zeros((Eb + 1,), dt).at[tgt].set(a_x, mode="drop")[:Eb]
-        x_v = jnp.where(arrived, xin, x_v)
+        xin = jnp.zeros((Eb + 1,) + F, dt).at[tgt].set(
+            a_x, mode="drop")[:Eb]
+        x_v = jnp.where(_ex(arrived, x_v), xin, x_v)
         valid_v = valid_v | arrived
 
     matched = ((pl.edge_color == t % num_colors)
                & valid_u & valid_v)
+    m_ex = _ex(matched, x_u)
     avg_e = (x_u + x_v) * half
-    flow = jnp.where(matched, st.flow + (x_u - x_v) * half, st.flow)
-    est_e = jnp.where(matched, avg_e, st.est)
+    flow = jnp.where(m_ex, st.flow + (x_u - x_v) * half, st.flow)
+    est_e = jnp.where(m_ex, avg_e, st.est)
     stamp = jnp.where(matched, t, st.stamp)
     fire_any = jax.ops.segment_max(
         matched.astype(jnp.int32), pl.src_local, num_segments=Nb) > 0
     node_avg = jax.ops.segment_sum(
-        jnp.where(matched, avg_e, jnp.asarray(0, dt)), pl.src_local,
+        jnp.where(m_ex, avg_e, jnp.asarray(0, dt)), pl.src_local,
         num_segments=Nb)
-    last_avg = jnp.where(fire_any, node_avg, st.last_avg)
+    last_avg = jnp.where(_ex(fire_any, node_avg), node_avg, st.last_avg)
     return st.replace(
         t=t + 1, flow=flow, est=est_e, stamp=stamp, last_avg=last_avg,
         fired=st.fired + fire_any.astype(jnp.int32),
@@ -624,18 +672,21 @@ def gather_estimates(state: FlowUpdatingState, plan: ShardPlan) -> np.ndarray:
     flow = np.asarray(state.flow)
     value = np.asarray(state.value)
     src = np.asarray(plan.arrays.src_local)
-    sums = np.zeros((S, Nb), flow.dtype)
+    F = flow.shape[2:]                 # trailing feature axes (vector)
+    sums = np.zeros((S, Nb) + F, flow.dtype)
     for s in range(S):
         np.add.at(sums[s], src[s], flow[s])
     est = value - sums
-    return _unpermute(est[:, : plan.cap].reshape(-1)[:N], plan)
+    return _unpermute(est[:, : plan.cap].reshape((-1,) + F)[:N], plan)
 
 
 def gather_node_array(x, plan: ShardPlan) -> np.ndarray:
-    """Unpad a (S, Nb)-stacked per-node array back to the original global
-    node order."""
+    """Unpad a (S, Nb, ...)-stacked per-node array back to the original
+    global node order (trailing feature axes pass through)."""
     N = plan.topo.num_nodes
-    return _unpermute(np.asarray(x)[:, : plan.cap].reshape(-1)[:N], plan)
+    x = np.asarray(x)
+    return _unpermute(
+        x[:, : plan.cap].reshape((-1,) + x.shape[2:])[:N], plan)
 
 
 def _unpermute(x: np.ndarray, plan: ShardPlan) -> np.ndarray:

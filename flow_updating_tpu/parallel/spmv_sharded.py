@@ -41,11 +41,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from flow_updating_tpu.models.config import RoundConfig
-from flow_updating_tpu.parallel.mesh import NODE_AXIS
+from flow_updating_tpu.parallel.mesh import NODE_AXIS, shard_map
 from flow_updating_tpu.topology.graph import Topology
 
 P = jax.sharding.PartitionSpec
-shard_map = jax.shard_map
 
 _sharded_plan_cache: dict = {}
 

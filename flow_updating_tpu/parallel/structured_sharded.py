@@ -41,7 +41,7 @@ from flow_updating_tpu.utils import struct
 
 from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.ops.structured import FatTreeStruct
-from flow_updating_tpu.parallel.mesh import NODE_AXIS
+from flow_updating_tpu.parallel.mesh import NODE_AXIS, shard_map
 from flow_updating_tpu.topology.graph import Topology
 
 
@@ -107,7 +107,7 @@ class PodShardedFatTreeKernel:
             jax.jit, static_argnames=("num_rounds",))
         def _run(state: PodState, value, inv_depp1, deg,
                  num_rounds: int) -> PodState:
-            shmap = jax.shard_map(
+            shmap = shard_map(
                 functools.partial(_scan_rounds, num_rounds=num_rounds),
                 mesh=mesh,
                 in_specs=(PodState(t=rep, S=self._specs, G=self._specs,

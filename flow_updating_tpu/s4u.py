@@ -54,6 +54,13 @@ class ActorKilled(BaseException):
     the termination (mirrors SimGrid force-kill semantics)."""
 
 
+class CancelException(Exception):
+    """Raised by ``Comm.wait()`` on a comm that was cancelled while still
+    pending (SimGrid's ``CancelException``).  A cancel of an
+    already-completed comm stays a no-op and ``wait()`` returns normally
+    — the reference's quirk at ``collectall.py:78``."""
+
+
 def _des() -> "HostDes":
     if _CURRENT_DES is None:
         raise RuntimeError(
@@ -149,6 +156,12 @@ class Comm:
             self._waiter = ctx
             ctx.yield_to_maestro()
         self._waiter = None
+        if self.cancelled and not self.finished:
+            # SimGrid raises on waiting a cancelled activity; returning
+            # payload None here would read as a successful zero-message
+            # (ADVICE r5 #1)
+            raise CancelException(
+                f"{self.kind} comm was cancelled while pending")
         return self
 
     def get_payload(self):
@@ -161,9 +174,14 @@ class Comm:
         ``collectall.py:78``) — that stays a no-op.  A genuinely pending
         cancel detaches the comm: queued mailbox entries are skipped at
         match time and an in-flight delivery is dropped (both sides stay
-        incomplete; Flow-Updating is loss-tolerant by design, A6)."""
+        incomplete; Flow-Updating is loss-tolerant by design, A6).  An
+        actor blocked in ``wait()`` on this comm is woken and observes
+        :class:`CancelException` — without the wake it would stay parked
+        until ``kill_all`` (ADVICE r5 #1)."""
         if not self.finished:
             self.cancelled = True
+            if self._waiter is not None:
+                self.des.make_ready(self._waiter)
 
     def _complete(self, payload=None) -> None:
         self.finished = True

@@ -1,0 +1,31 @@
+"""Workloads built ON TOP of the aggregation substrate.
+
+The protocol layer (``models/``) computes mass-conserving averages of
+whatever payload the nodes carry; with vector payloads
+(``models/state.py``: ``(N, D)`` values) that payload can be a *model
+parameter vector*, which turns the simulator into a decentralized-learning
+engine: local compute mutates each node's payload, Flow-Updating rounds
+are the communication-efficient model-averaging step.
+
+First workload: **gossip-SGD / decentralized FedAvg**
+(:mod:`flow_updating_tpu.workloads.gossip_sgd`) — each node holds a
+parameter vector and a private synthetic dataset
+(:mod:`flow_updating_tpu.workloads.data`), runs local gradient steps, and
+averages over the gossip graph, optionally with periodic exact global
+averaging (the Gossip-PGA schedule of arXiv:2105.09080; graph-structured
+communication efficiency per arXiv:2506.10607).
+
+Entry points: the ``flow-updating-tpu train`` CLI subcommand,
+``examples/gossip_sgd.py``, and the classes re-exported here.
+"""
+
+from flow_updating_tpu.workloads.data import (  # noqa: F401
+    NodeDataset,
+    centralized_solution,
+    make_dataset,
+)
+from flow_updating_tpu.workloads.gossip_sgd import (  # noqa: F401
+    GossipSGDConfig,
+    GossipSGDTrainer,
+    per_feature_mass_residual,
+)

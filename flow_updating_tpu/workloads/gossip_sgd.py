@@ -1,0 +1,244 @@
+"""Gossip-SGD / decentralized FedAvg on the Flow-Updating substrate.
+
+Each node ``i`` holds a parameter vector ``w_i`` (the node's *payload*,
+``models/state.py`` vector mode) and a private dataset shard
+(:mod:`flow_updating_tpu.workloads.data`).  One outer step is
+
+1. **local compute** — ``local_steps`` full-batch gradient steps on the
+   node's own loss, applied to the node's *input value*: the working
+   model is the node's current Flow-Updating estimate
+   ``w_i = value_i - sum(out flows_i)``, so shifting ``value_i`` by
+   ``-lr * grad_i`` shifts the model by exactly that step while the
+   ledgers keep conserving per-feature mass (Flow-Updating tracks
+   dynamic inputs natively — no state reset on data change);
+2. **communication** — ``comm_rounds`` Flow-Updating rounds: the
+   gossip-averaging step, D features riding one message schedule;
+3. optionally, every ``global_avg_every`` outer steps, **periodic global
+   averaging** (Gossip-PGA, arXiv:2105.09080): every alive node's
+   estimate is set to the exact alive-mean.  Implemented as the
+   mass-preserving rebase ``value <- value - est + mean(est)`` — the sum
+   of alive values is unchanged, so the knob composes with churn and the
+   ledger invariants.
+
+Node churn composes with training: killed nodes freeze (no local steps,
+no firing), survivors keep averaging, and revived nodes re-join with
+their ledgers intact — per-feature mass conservation is asserted by
+:func:`per_feature_mass_residual` in the tests and the example.
+
+The whole outer step is one jitted function of device state; the Python
+loop only orchestrates churn and metrics sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, round_step
+from flow_updating_tpu.models.state import FlowUpdatingState, init_state
+from flow_updating_tpu.workloads.data import NodeDataset, pooled_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipSGDConfig:
+    """Static trainer configuration (jit-static, like RoundConfig)."""
+
+    lr: float = 0.2            # local gradient step size
+    local_steps: int = 1       # gradient steps per outer step
+    comm_rounds: int = 2       # Flow-Updating rounds per outer step
+    outer_steps: int = 200
+    global_avg_every: int = 0  # H of arXiv:2105.09080; 0 = pure gossip
+
+    def __post_init__(self):
+        if self.local_steps < 1:
+            raise ValueError("local_steps must be >= 1")
+        if self.comm_rounds < 0:
+            raise ValueError("comm_rounds must be >= 0")
+        if self.global_avg_every < 0:
+            raise ValueError("global_avg_every must be >= 0 (0 = never)")
+
+
+def per_feature_mass_residual(state: FlowUpdatingState, arrays) -> np.ndarray:
+    """(D,) per-feature ``sum(est) - sum(value)`` — the vector-payload
+    mass-conservation invariant (~0 at quiescence; transiently nonzero
+    while messages are in flight or nodes are down)."""
+    est = node_estimates(state, arrays)
+    return np.asarray(jnp.sum(est, axis=0) - jnp.sum(state.value, axis=0))
+
+
+def _grad(w, X, y, task: str):
+    """Per-node full-batch gradient at per-node parameters ``w`` (N, D)."""
+    z = jnp.einsum("nmd,nd->nm", X, w)
+    if task == "linear":
+        r = z - y
+    else:
+        r = jax.nn.sigmoid(z) - y
+    return jnp.einsum("nmd,nm->nd", X, r) / X.shape[1]
+
+
+def _global_average(state: FlowUpdatingState, arrays) -> FlowUpdatingState:
+    """Exact global averaging over alive nodes (the PGA step): rebases
+    every alive node's value so its estimate equals the alive-mean.
+    ``sum_alive(value)`` is unchanged (the rebase swaps ``est`` terms for
+    their mean, which sums to the same total), so mass conservation — and
+    therefore the aggregate the ledgers track — survives the sync."""
+    est = node_estimates(state, arrays)
+    alive = state.alive
+    a = alive[:, None]
+    cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
+    mean = jnp.sum(jnp.where(a, est, 0), axis=0) / cnt        # (D,)
+    value = jnp.where(a, state.value - est + mean, state.value)
+    return state.replace(value=value)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rcfg", "gcfg", "task", "do_global"))
+def _outer_step(state, arrays, X, y, rcfg: RoundConfig,
+                gcfg: GossipSGDConfig, task: str, do_global: bool):
+    for _ in range(gcfg.local_steps):
+        w = node_estimates(state, arrays)
+        g = _grad(w, X, y, task)
+        g = jnp.where(state.alive[:, None], g, 0)   # dead nodes freeze
+        state = state.replace(
+            value=state.value - jnp.asarray(gcfg.lr, w.dtype) * g)
+    state = jax.lax.fori_loop(
+        0, gcfg.comm_rounds, lambda _, s: round_step(s, arrays, rcfg), state)
+    if do_global:
+        state = _global_average(state, arrays)
+    return state
+
+
+class GossipSGDTrainer:
+    """Decentralized gossip-SGD over one topology + dataset.
+
+    ``round_cfg`` defaults to the fast synchronous collect-all dynamics
+    in float64 (every node averages with all neighbors each comm round);
+    any edge-kernel :class:`RoundConfig` works — e.g.
+    ``RoundConfig.reference()`` trains over the faithful asynchronous
+    message dynamics, and ``RoundConfig.fast('pairwise')`` over
+    edge-colored matching gossip.
+    """
+
+    def __init__(self, topo, data: NodeDataset,
+                 cfg: GossipSGDConfig = GossipSGDConfig(),
+                 round_cfg: RoundConfig | None = None,
+                 w0: np.ndarray | None = None):
+        if data.num_nodes != topo.num_nodes:
+            raise ValueError(
+                f"dataset covers {data.num_nodes} nodes, topology has "
+                f"{topo.num_nodes}")
+        if round_cfg is None:
+            round_cfg = RoundConfig.fast(dtype="float64")
+        if round_cfg.kernel != "edge":
+            raise ValueError(
+                "gossip-SGD mutates per-node values between comm rounds; "
+                "it drives the edge kernel (kernel='edge')")
+        self.topo = topo
+        self.data = data
+        self.cfg = cfg
+        self.round_cfg = round_cfg
+        self.arrays = topo.device_arrays(
+            coloring=round_cfg.needs_coloring,
+            segment_ell=round_cfg.use_segment_ell,
+            segment_benes=round_cfg.segment_benes_mode,
+            delivery_benes=round_cfg.delivery_benes_mode,
+        )
+        dt = round_cfg.jnp_dtype
+        if w0 is None:
+            w0 = np.zeros((topo.num_nodes, data.features))
+        self.state = init_state(topo, round_cfg, values=w0)
+        self._X = jnp.asarray(data.X, dt)
+        self._y = jnp.asarray(data.y, dt)
+        self.outer_done = 0
+
+    # -- payload views ---------------------------------------------------
+    def params(self) -> np.ndarray:
+        """(N, D) current per-node models (the Flow-Updating estimates)."""
+        return np.asarray(node_estimates(self.state, self.arrays))
+
+    def consensus_dispersion(self) -> float:
+        """max_i ||w_i - mean(w)||_inf over alive nodes."""
+        w = self.params()
+        alive = np.asarray(self.state.alive)
+        wa = w[alive]
+        return float(np.abs(wa - wa.mean(axis=0)).max()) if len(wa) else 0.0
+
+    def distance_to_centralized(self, w_opt) -> float:
+        """Max over ALIVE nodes of the relative L2 distance to the
+        centralized solution ``w_opt`` — THE definition of the workload's
+        acceptance metric, owned here so every driver (CLI, example,
+        tests) reports the same thing.  Dead nodes are excluded: their
+        params froze at death and don't represent the survivors."""
+        w_opt = np.asarray(w_opt)
+        alive = np.asarray(self.state.alive)
+        w = self.params()
+        if alive.any():
+            w = w[alive]
+        denom = max(float(np.linalg.norm(w_opt)), 1e-12)
+        return float(np.linalg.norm(w - w_opt, axis=1).max() / denom)
+
+    def mass_residual(self) -> np.ndarray:
+        return per_feature_mass_residual(self.state, self.arrays)
+
+    # -- fault injection -------------------------------------------------
+    def kill_nodes(self, nodes) -> None:
+        ids = jnp.asarray(np.asarray(nodes, np.int32))
+        self.state = self.state.replace(
+            alive=self.state.alive.at[ids].set(False))
+
+    def revive_nodes(self, nodes) -> None:
+        ids = jnp.asarray(np.asarray(nodes, np.int32))
+        self.state = self.state.replace(
+            alive=self.state.alive.at[ids].set(True))
+
+    # -- training --------------------------------------------------------
+    def step(self) -> None:
+        """One outer step (local compute + gossip + optional PGA sync)."""
+        H = self.cfg.global_avg_every
+        do_global = bool(H) and (self.outer_done + 1) % H == 0
+        self.state = _outer_step(
+            self.state, self.arrays, self._X, self._y, self.round_cfg,
+            self.cfg, self.data.task, do_global)
+        self.outer_done += 1
+
+    def train(self, churn: dict | None = None, sample_every: int = 0,
+              callback=None) -> dict:
+        """Run ``cfg.outer_steps`` outer steps.
+
+        ``churn`` maps an outer-step index to ``("kill", ids)`` /
+        ``("revive", ids)``, applied before that step — mid-training node
+        churn.  ``sample_every`` > 0 invokes ``callback(step, trainer)``
+        on that cadence.  Returns the final report (see
+        :meth:`report`)."""
+        churn = churn or {}
+        for k in range(self.cfg.outer_steps):
+            if k in churn:
+                verb, ids = churn[k]
+                {"kill": self.kill_nodes, "revive": self.revive_nodes}[verb](
+                    ids)
+            self.step()
+            if sample_every and callback and (k + 1) % sample_every == 0:
+                callback(k + 1, self)
+        return self.report()
+
+    def report(self) -> dict:
+        w = self.params()
+        alive = np.asarray(self.state.alive)
+        w_mean = w[alive].mean(axis=0) if alive.any() else w.mean(axis=0)
+        res = self.mass_residual()
+        return {
+            "outer_steps": self.outer_done,
+            "comm_rounds_total": self.outer_done * self.cfg.comm_rounds,
+            "task": self.data.task,
+            "features": self.data.features,
+            "nodes": self.topo.num_nodes,
+            "alive": int(alive.sum()),
+            "pooled_loss": pooled_loss(self.data, w_mean),
+            "consensus_dispersion": self.consensus_dispersion(),
+            "max_mass_residual": float(np.abs(res).max()),
+        }

@@ -152,12 +152,21 @@ def pytest_collection_modifyitems(config, items):
     # Default fast path: deselect the slow tail — but an explicit -m
     # expression, -k keyword filter, or explicit node ids always win (an
     # addopts -m would wrongly deselect `pytest file::slow_test` or
-    # `pytest -k slow_test_name` too).
+    # `pytest -k slow_test_name` too).  Naming a test FILE on the
+    # command line (`pytest tests/test_lmm.py`) is also explicit
+    # selection for THAT file: the user asked for it in full, so its
+    # slow tests run — even mixed with directory args (ADVICE r5 #3).
+    # Directory args (`pytest tests/`) keep the fast path for their
+    # tests; `-m 'slow or not slow'` is the run-everything escape hatch.
     if config.option.markexpr or config.option.keyword or explicit_ids:
         return
+    named_files = {os.path.basename(str(a)) for a in config.args
+                   if str(a).endswith(".py")}
     kept, dropped = [], []
     for item in items:
-        (dropped if item.get_closest_marker("slow") else kept).append(item)
+        slow = item.get_closest_marker("slow")
+        named = os.path.basename(str(item.fspath)) in named_files
+        (dropped if slow and not named else kept).append(item)
     if dropped:
         config.hook.pytest_deselected(items=dropped)
         items[:] = kept

@@ -198,3 +198,18 @@ def test_argv_cfg_passthrough():
     # a value the config itself rejects still fails loudly
     with pytest.raises(ValueError):
         Engine(["prog", "--cfg=variant:bogus"])
+
+
+def test_argv_cfg_diagnostics():
+    """ADVICE r5 #2: a valid key missing its ':' gets a missing-separator
+    message (not 'unknown config key'), and a type-parse failure names
+    the offending --cfg flag instead of a bare int() ValueError."""
+    with pytest.raises(ValueError, match="missing ':' separator"):
+        Engine(["prog", "--cfg=timeout"])
+    with pytest.raises(ValueError, match=r"--cfg=timeout:abc"):
+        Engine(["prog", "--cfg=timeout:abc"])
+    with pytest.raises(ValueError, match="not a valid float"):
+        Engine(["prog", "--cfg=drop-rate:lots"])
+    # a bare unknown key (no separator) still reads as unknown
+    with pytest.raises(ValueError, match="unknown config key"):
+        Engine(["prog", "--cfg=not_a_knob"])

@@ -1,0 +1,40 @@
+"""The conftest fast/slow split's selection rules (ADVICE r5 #3).
+
+Naming a test FILE on the command line is explicit selection: the file's
+slow tests must run (previously only `::` node ids counted, so
+`pytest tests/test_lmm.py` silently dropped that file's slow tail).
+Directory invocations keep the default fast path.  Checked by running
+pytest's collection in a subprocess — the deselection hook only fires in
+a real session.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _collect(*args):
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-p", "no:cacheprovider", *args],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=ROOT)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    return p.stdout
+
+
+def test_named_file_runs_its_slow_tests():
+    out = _collect("tests/test_delivery.py")
+    # test_gather_equals_scatter is in the SLOW_TESTS registry; naming
+    # the file keeps it selected
+    assert "test_gather_equals_scatter" in out
+    assert "deselected" not in out
+
+
+def test_directory_arg_keeps_fast_path():
+    out = _collect("tests/test_delivery.py", "tests/test_collectall.py")
+    assert "deselected" not in out   # all named files -> explicit
+    out = _collect("tests")
+    assert "deselected" in out       # directory -> fast path applies

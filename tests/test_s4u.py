@@ -225,3 +225,55 @@ def test_actor_exception_does_not_kill_the_simulation(host_engine, caplog):
     assert len(last) == 6
     for avg in last.values():
         assert avg == pytest.approx(30.0, abs=0.5)
+
+
+def test_cancel_wakes_cross_actor_waiter(host_engine):
+    """ADVICE r5 #1: cancelling a comm another actor is blocked in wait()
+    must wake that actor (not park it until kill_all), and the woken
+    wait() raises CancelException instead of returning payload None."""
+    eng = host_engine
+    seen = {}
+
+    def receiver():
+        comm = s4u.Mailbox.by_name("never-served").get_async()
+        seen["comm"] = comm
+        try:
+            comm.wait()
+            seen["outcome"] = "returned"
+        except s4u.CancelException:
+            seen["outcome"] = "cancelled"
+        seen["clock"] = s4u.Engine.clock
+
+    def canceller():
+        s4u.this_actor.sleep_for(3.0)
+        seen["comm"].cancel()
+
+    s4u.Actor.create("waiter", s4u.Host.by_name("Lisboa"), receiver)
+    s4u.Actor.create("canceller", s4u.Host.by_name("Porto"), canceller)
+    eng.run_until(30.0)
+    # the waiter observed the cancel AT the cancel time — it did not hang
+    # to the horizon and was not force-killed
+    assert seen["outcome"] == "cancelled"
+    assert seen["clock"] == pytest.approx(3.0)
+
+
+def test_wait_after_cancel_of_completed_comm_returns(host_engine):
+    """The reference's quirk (collectall.py:78): cancel on an
+    already-completed comm is a no-op and wait() returns its payload."""
+    eng = host_engine
+    got = {}
+
+    def sender():
+        s4u.Mailbox.by_name("done-box").put_async("payload", 1)
+
+    def receiver():
+        s4u.this_actor.sleep_for(1.0)
+        comm = s4u.Mailbox.by_name("done-box").get_async()
+        comm.wait()
+        comm.cancel()              # already finished: no-op
+        got["payload"] = comm.wait().get_payload()
+
+    s4u.Actor.create("done-sender", s4u.Host.by_name("Lisboa"), sender)
+    s4u.Actor.create("done-box", s4u.Host.by_name("Porto"), receiver)
+    eng.run_until(30.0)
+    assert got["payload"] == "payload"

@@ -1,0 +1,153 @@
+"""Vector-payload generalization: (N, D)/(E, D) payloads through every layer.
+
+The protocol's control plane (firing, delivery, drain, faults) is
+payload-independent, so a D-feature run must be EXACTLY D independent
+scalar protocol instances sharing one message schedule — asserted here
+bit-for-bit against per-feature scalar runs for both kernels, every
+dynamics mode, the scatter-free layouts (ELL / Beneš segment / Beneš
+delivery) and the shard_map halo kernel.  D=1 in particular reproduces
+the scalar trajectories on the small6 fixture, so the generalization
+provably changes nothing for the paper's protocol.
+"""
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models import sync
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.topology.generators import erdos_renyi
+
+
+def _edge_est(topo, cfg, values, rounds, **arr_kw):
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring, **arr_kw)
+    state = init_state(topo, cfg, values=values)
+    out = run_rounds(state, arrays, cfg, rounds)
+    return np.asarray(node_estimates(out, arrays)), out
+
+
+@pytest.fixture(scope="module")
+def topo():
+    return erdos_renyi(40, avg_degree=6.0, seed=1)
+
+
+@pytest.fixture(scope="module")
+def vals(topo):
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(topo.num_nodes, 3))
+
+
+CFGS = [
+    RoundConfig.fast(),
+    RoundConfig.fast("pairwise"),
+    RoundConfig.reference(),
+    RoundConfig.reference("pairwise"),
+    RoundConfig.reference(drop_rate=0.2),
+]
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=lambda c: (
+    f"{c.variant}-{c.fire_policy}" + ("-drop" if c.drop_rate else "")))
+def test_vector_equals_stacked_scalar_runs(topo, vals, cfg):
+    """A (N, 3) run == 3 scalar runs stacked, bit-for-bit: same masks,
+    same message schedule, same drop pattern (one PRNG stream)."""
+    est_v, out = _edge_est(topo, cfg, vals, 30)
+    cols = [
+        _edge_est(topo, cfg, vals[:, d], 30)[0] for d in range(3)
+    ]
+    np.testing.assert_array_equal(est_v, np.stack(cols, axis=1))
+    assert est_v.shape == (topo.num_nodes, 3)
+    # ledger arrays carry the feature axis; control arrays must not
+    assert out.flow.shape[1:] == (3,)
+    assert out.recv.ndim == 1 and out.pending_valid.ndim == 2
+
+
+def test_d1_reproduces_scalar_trajectory_small6(small6):
+    """Acceptance: D=1 vector payload reproduces the existing scalar
+    trajectories on the small6 fixture for the paper's faithful dynamics
+    (both variants)."""
+    platform, deployment = small6
+    topo = deployment.to_topology(platform=platform)
+    for cfg in (RoundConfig.reference(), RoundConfig.reference("pairwise")):
+        scalar, s_out = _edge_est(topo, cfg, topo.values, 60)
+        vec, v_out = _edge_est(topo, cfg, topo.values[:, None], 60)
+        np.testing.assert_array_equal(vec[:, 0], scalar)
+        np.testing.assert_array_equal(
+            np.asarray(v_out.flow)[:, 0], np.asarray(s_out.flow))
+        np.testing.assert_array_equal(
+            np.asarray(v_out.last_avg)[:, 0], np.asarray(s_out.last_avg))
+
+
+@pytest.mark.parametrize("arr_kw,cfg_kw", [
+    (dict(segment_ell=True), dict(segment_impl="ell")),
+    (dict(segment_benes=True), dict(segment_impl="benes")),
+    (dict(delivery_benes=True), dict(delivery="benes")),
+])
+def test_scatter_free_layouts_match_on_vectors(topo, vals, arr_kw, cfg_kw):
+    """The Beneš permutation / segment networks and the ELL reductions
+    broadcast over the trailing feature axis: same trajectories as the
+    jax.ops segment + gather formulation, still scatter/gather-free."""
+    base, _ = _edge_est(topo, RoundConfig.reference(), vals, 25)
+    got, _ = _edge_est(topo, RoundConfig.reference(**cfg_kw), vals, 25,
+                       **arr_kw)
+    np.testing.assert_array_equal(got, base)
+
+
+def test_node_kernel_vector_matches_scalar_columns(topo, vals):
+    cfg = RoundConfig.fast(kernel="node", dtype="float64")
+    k = sync.NodeKernel(topo, cfg, values=vals)
+    est = k.estimates(k.run(k.init_state(), 400))
+    cols = []
+    for d in range(3):
+        kd = sync.NodeKernel(topo, cfg, values=vals[:, d])
+        cols.append(kd.estimates(kd.run(kd.init_state(), 400)))
+    np.testing.assert_array_equal(est, np.stack(cols, axis=1))
+    # and it converges to the per-feature means
+    np.testing.assert_allclose(
+        est, np.broadcast_to(vals.mean(axis=0), est.shape), atol=1e-6)
+
+
+def test_node_kernel_vector_rejects_scalar_only_spmv(topo, vals):
+    with pytest.raises(ValueError, match="spmv='xla'"):
+        sync.NodeKernel(topo, RoundConfig.fast(kernel="node", spmv="benes"),
+                        values=vals)
+
+
+def test_vector_churn_preserves_per_feature_mass(topo, vals):
+    """Crash-stop churn mid-run: after revive + quiescence the vector
+    mass residual is ~0 in EVERY feature (the per-feature generalization
+    of the paper's conservation invariant)."""
+    cfg = RoundConfig.reference(dtype="float64", delay_depth=2)
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg, values=vals)
+    state = run_rounds(state, arrays, cfg, 100)
+    state = state.replace(alive=state.alive.at[:4].set(False))
+    state = run_rounds(state, arrays, cfg, 150)
+    state = state.replace(alive=state.alive.at[:4].set(True))
+    state = run_rounds(state, arrays, cfg, 2000)
+    est = np.asarray(node_estimates(state, arrays))
+    residual = est.sum(axis=0) - np.asarray(state.value).sum(axis=0)
+    assert residual.shape == (3,)
+    np.testing.assert_allclose(residual, 0, atol=1e-9)
+    # and the protocol reconverged toward the per-feature means (the
+    # faithful dynamics converge slowly; exact-mean agreement is the fast
+    # kernels' test above — here the invariant under churn is the point)
+    np.testing.assert_allclose(
+        est, np.broadcast_to(vals.mean(axis=0), est.shape), atol=1e-3)
+
+
+def test_sharded_halo_vector_matches_single_device(topo, vals):
+    """Vector payloads through the shard_map halo kernel: feature lanes
+    ride the cut-edge collectives; trajectories match one device."""
+    from flow_updating_tpu.parallel import sharded
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    cfg = RoundConfig.reference(dtype="float64")
+    ref, _ = _edge_est(topo, cfg, vals, 30)
+    mesh = make_mesh(4)
+    plan = sharded.plan_sharding(topo, 4, partition="bfs")
+    state = sharded.init_plan_state(plan, cfg, mesh, values=vals)
+    out = sharded.run_rounds_sharded(state, plan, cfg, mesh, 30)
+    est = sharded.gather_estimates(out, plan)
+    np.testing.assert_allclose(est, ref, atol=1e-12)

@@ -1,0 +1,183 @@
+"""Gossip-SGD / decentralized FedAvg workload (flow_updating_tpu.workloads).
+
+The acceptance bar: decentralized training over Flow-Updating rounds
+agrees with the CENTRALIZED full-data solution within a documented
+tolerance, the periodic-global-averaging knob (Gossip-PGA,
+arXiv:2105.09080) drives consensus exactly, and mid-training node churn
+preserves per-feature mass conservation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.topology.generators import erdos_renyi
+from flow_updating_tpu.workloads import (
+    GossipSGDConfig,
+    GossipSGDTrainer,
+    centralized_solution,
+    make_dataset,
+)
+from flow_updating_tpu.workloads.data import pooled_loss
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# documented tolerance of the gossip-SGD acceptance criterion: max over
+# nodes of the relative L2 distance to the centralized solution
+REL_TOL = 0.01
+
+
+@pytest.fixture(scope="module")
+def problem():
+    topo = erdos_renyi(32, avg_degree=6.0, seed=3)
+    ds = make_dataset(32, 8, samples_per_node=20, task="linear",
+                      noise=0.05, seed=0)
+    return topo, ds, centralized_solution(ds)
+
+
+def _rel_dist(trainer, w_opt):
+    w = trainer.params()
+    return float(np.linalg.norm(w - w_opt, axis=1).max()
+                 / max(np.linalg.norm(w_opt), 1e-12))
+
+
+def test_linear_converges_to_centralized(problem):
+    topo, ds, w_opt = problem
+    tr = GossipSGDTrainer(
+        topo, ds, GossipSGDConfig(lr=0.2, comm_rounds=3, outer_steps=400))
+    rep = tr.train()
+    assert _rel_dist(tr, w_opt) < REL_TOL
+    # every node individually reached consensus near the optimum
+    assert rep["consensus_dispersion"] < 1e-2
+    assert rep["pooled_loss"] < pooled_loss(ds, np.zeros(ds.features))
+
+
+def test_churn_mid_training_preserves_mass_and_converges(problem):
+    """Acceptance: a run with mid-training node churn still converges,
+    and per-feature mass conservation holds once the protocol quiesces."""
+    topo, ds, w_opt = problem
+    tr = GossipSGDTrainer(
+        topo, ds, GossipSGDConfig(lr=0.2, comm_rounds=3, outer_steps=500))
+    tr.train(churn={100: ("kill", [0, 1, 2]), 200: ("revive", [0, 1, 2])})
+    assert _rel_dist(tr, w_opt) < REL_TOL
+    # freeze inputs, drain messages: the per-feature invariant is exact
+    tr.state = run_rounds(tr.state, tr.arrays, tr.round_cfg, 200)
+    residual = tr.mass_residual()
+    assert residual.shape == (ds.features,)
+    np.testing.assert_allclose(residual, 0, atol=1e-10)
+
+
+def test_periodic_global_averaging_knob(problem):
+    """The PGA step (arXiv:2105.09080) is an exact, mass-preserving sync:
+    right after it every alive node's model equals the alive-mean, and it
+    tightens the final distance to the centralized solution vs pure
+    gossip at the same budget."""
+    topo, ds, w_opt = problem
+    cfg = GossipSGDConfig(lr=0.2, comm_rounds=1, outer_steps=50,
+                          global_avg_every=50)
+    tr = GossipSGDTrainer(topo, ds, cfg)
+    tr.train()   # step 50 ends with the global average
+    w = tr.params()
+    np.testing.assert_allclose(                        # exact consensus
+        w, np.broadcast_to(w[0], w.shape), atol=1e-12)
+    # the sync itself is mass-preserving: re-applying it to the settled
+    # state leaves the per-feature sum of values unchanged
+    from flow_updating_tpu.workloads.gossip_sgd import _global_average
+
+    before = np.asarray(tr.state.value).sum(axis=0)
+    after = np.asarray(
+        _global_average(tr.state, tr.arrays).value).sum(axis=0)
+    np.testing.assert_allclose(after, before, atol=1e-10)
+
+    pure = GossipSGDTrainer(
+        topo, ds, GossipSGDConfig(lr=0.2, comm_rounds=3, outer_steps=400))
+    pure.train()
+    pga = GossipSGDTrainer(
+        topo, ds, GossipSGDConfig(lr=0.2, comm_rounds=3, outer_steps=400,
+                                  global_avg_every=10))
+    pga.train()
+    assert _rel_dist(pga, w_opt) <= _rel_dist(pure, w_opt)
+
+
+def test_logistic_task_trains(problem):
+    topo, _, _ = problem
+    ds = make_dataset(32, 4, samples_per_node=30, task="logistic",
+                      noise=0.5, seed=1)
+    w_opt = centralized_solution(ds)
+    tr = GossipSGDTrainer(
+        topo, ds, GossipSGDConfig(lr=0.5, comm_rounds=3, outer_steps=400))
+    tr.train()
+    w = tr.params()
+    # logistic has no closed form; the decentralized consensus must sit
+    # near the pooled-GD optimum (looser documented tolerance)
+    assert np.linalg.norm(w - w_opt, axis=1).max() < 0.05
+    assert pooled_loss(ds, w.mean(axis=0)) < pooled_loss(
+        ds, np.zeros(ds.features))
+
+
+def test_trainer_over_faithful_dynamics(problem):
+    """The workload composes with the faithful asynchronous message
+    dynamics (drain limits, timeouts, FIFO mailboxes), not just the fast
+    synchronous mode."""
+    topo, ds, w_opt = problem
+    tr = GossipSGDTrainer(
+        topo, ds,
+        GossipSGDConfig(lr=0.1, comm_rounds=8, outer_steps=300),
+        round_cfg=RoundConfig.reference(dtype="float64"))
+    tr.train()
+    assert _rel_dist(tr, w_opt) < 0.05
+
+
+def test_trainer_validation(problem):
+    topo, ds, _ = problem
+    with pytest.raises(ValueError, match="kernel='edge'"):
+        GossipSGDTrainer(topo, ds,
+                         round_cfg=RoundConfig.fast(kernel="node"))
+    bad = make_dataset(7, 4)
+    with pytest.raises(ValueError, match="7 nodes"):
+        GossipSGDTrainer(topo, bad)
+
+
+def test_train_cli_smoke(tmp_path):
+    """`flow-updating-tpu train` end-to-end: JSON report with the
+    documented fields, churn schedule applied, event log written."""
+    log = tmp_path / "train.jsonl"
+    p = subprocess.run(
+        [sys.executable, "-m", "flow_updating_tpu", "train",
+         "--generator", "erdos_renyi:24", "--features", "6",
+         "--samples-per-node", "12", "--outer-steps", "80",
+         "--comm-rounds", "3", "--churn-kill", "20:0,1",
+         "--churn-revive", "40:0,1", "--event-log", str(log)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    rep = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rep["features"] == 6 and rep["nodes"] == 24
+    assert rep["alive"] == 24
+    assert rep["distance_to_centralized"] < 0.05
+    assert rep["churn"] == {"20": ["kill", [0, 1]],
+                            "40": ["revive", [0, 1]]}
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    assert any(e.get("kind") == "train_sample" for e in events)
+    assert any(e.get("kind") == "train_end" for e in events)
+
+
+def test_gossip_sgd_example(tmp_path):
+    """The shipped example (fault-free + churn runs) passes its own
+    assertions at a reduced size."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", "gossip_sgd.py"),
+         "--nodes", "32", "--features", "8", "--outer-steps", "200"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(l) for l in p.stdout.strip().splitlines()]
+    assert {r["run"] for r in lines} == {"fault_free", "churn"}
+    churn = next(r for r in lines if r["run"] == "churn")
+    assert churn["quiesced_mass_residual"] < 1e-8
