@@ -468,6 +468,11 @@ def parse_args(argv=None):
     ap.add_argument("--backend", default="auto", choices=("auto", "tpu", "cpu"),
                     help="auto: probe the TPU tunnel first and fall back to "
                          "a CPU-pinned run if it is wedged/unavailable")
+    ap.add_argument("--report", metavar="PATH",
+                    help="also write a self-describing JSON run manifest "
+                         "(argv, topology fingerprint, backend/device "
+                         "info, the bench result) to PATH — the same "
+                         "schema as the CLI's --report")
     args = ap.parse_args(argv)
     # reject impossible combinations HERE: in auto-backend mode a child-
     # side ValueError would first burn the ~290s TPU probe and surface as
@@ -802,6 +807,18 @@ def main():
             result = run_bench(args)
         except ValueError as err:
             raise SystemExit(f"invalid flag combination: {err}")
+        if args.report:
+            from flow_updating_tpu.obs.report import (
+                build_manifest,
+                write_report,
+            )
+
+            # no topo= here: rebuilding the k160 fat-tree just for a
+            # fingerprint would double the host-side planning cost; the
+            # result already carries nodes/edges/config
+            write_report(args.report, build_manifest(
+                argv=sys.argv[1:], report=result,
+            ))
         print(json.dumps(result))
         return
 

@@ -176,6 +176,8 @@ def _resolve_latency_scale(args) -> None:
 
 
 def cmd_run(args) -> int:
+    import time as _time
+
     _select_backend(args.backend,
                     n_virtual_devices=getattr(args, "shards", None) or None)
     _resolve_latency_scale(args)
@@ -183,6 +185,35 @@ def cmd_run(args) -> int:
     from flow_updating_tpu.engine import Engine
 
     cfg = _make_config(args)
+    telemetry_spec = None
+    if args.telemetry is not None:
+        from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+        try:
+            telemetry_spec = TelemetrySpec.parse(args.telemetry)
+        except ValueError as err:
+            raise SystemExit(f"--telemetry: {err}")
+        if not telemetry_spec.enabled:
+            # '--telemetry off' means exactly that: the plain run paths
+            # (watcher, --stream, --until-rmse) all stay available
+            telemetry_spec = None
+    if telemetry_spec is not None:
+        if args.stream or args.until_rmse is not None:
+            raise SystemExit(
+                "--telemetry accumulates the series inside one fixed-"
+                "length compiled scan; it cannot combine with --stream "
+                "or --until-rmse")
+        if args.event_log:
+            # watch-record emission needs these four; fail before the
+            # run, not after the compute
+            need = [m for m in ("rmse", "max_abs_err", "mass",
+                                "fired_total")
+                    if not telemetry_spec.has(m)]
+            if need:
+                raise SystemExit(
+                    f"--telemetry with --event-log needs metric(s) "
+                    f"{','.join(need)} for the watch records — add them "
+                    "to the list or use '--telemetry default'")
     if getattr(args, "multichip", "auto") in ("halo", "pod") \
             and not args.shards:
         raise SystemExit(
@@ -193,11 +224,17 @@ def cmd_run(args) -> int:
         from flow_updating_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(args.shards)
+
+    from flow_updating_tpu.utils.eventlog import EventLog
+
+    event_log = EventLog(args.event_log) if args.event_log else None
     engine = Engine(config=cfg, mesh=mesh,
                     multichip=getattr(args, "multichip", "auto"),
                     halo=getattr(args, "halo", "ppermute"),
-                    partition=getattr(args, "partition", "bfs"))
+                    partition=getattr(args, "partition", "bfs"),
+                    event_log=event_log)
     engine.set_topology(_build_topology(args))
+    t_build0 = _time.perf_counter()
     if args.resume:
         # restore allocates no fresh state; the checkpoint's config governs
         # the run (it is part of the run's identity — e.g. delay_depth
@@ -220,11 +257,10 @@ def cmd_run(args) -> int:
             # NotImplementedError covers explicit unsupported-mode guards
             # (e.g. halo + contention) — a clean exit, not a traceback
             raise SystemExit(f"invalid flag combination: {err}")
+    build_s = _time.perf_counter() - t_build0
 
-    from flow_updating_tpu.utils.eventlog import EventLog
     from flow_updating_tpu.utils.trace import trace
 
-    event_log = EventLog(args.event_log) if args.event_log else None
     if event_log:
         event_log.emit(
             "run_start", nodes=engine.topology.num_nodes,
@@ -235,8 +271,24 @@ def cmd_run(args) -> int:
     import jax
 
     until_rmse_result = None
+    telemetry_series = None
+    t_run0 = _time.perf_counter()
     with trace(args.profile):
-        if args.until_rmse is not None:
+        if telemetry_spec is not None:
+            # device-resident series: one compiled scan, bulk readback
+            every = max(1, int(args.observe_every))
+            n = (args.rounds if args.rounds is not None
+                 else max(0, int(round(args.until - engine.clock))))
+            try:
+                telemetry_series = engine.run_telemetry(n, telemetry_spec)
+            except (ValueError, NotImplementedError) as err:
+                raise SystemExit(f"--telemetry: {err}")
+            if event_log and telemetry_series:
+                # the one obs emit path — same record shape as the
+                # streamed observers (contract-tested)
+                for rec in telemetry_series.watch_records(every):
+                    event_log.emit("watch", **rec)
+        elif args.until_rmse is not None:
             until_rmse_result = engine.run_until_rmse(
                 args.until_rmse, max_rounds=args.max_rounds)
             if event_log:
@@ -276,6 +328,7 @@ def cmd_run(args) -> int:
         if engine.state is not None:
             jax.block_until_ready(engine.state)
         jax.effects_barrier()
+    run_s = _time.perf_counter() - t_run0
 
     report = engine.convergence_report()
     if until_rmse_result is not None:
@@ -285,9 +338,23 @@ def cmd_run(args) -> int:
     report["edges"] = engine.topology.num_edges
     report["variant"] = engine.config.variant
     report["fire_policy"] = engine.config.fire_policy
+    if telemetry_series is not None:
+        # summary on stdout; the full series belongs in --report/--event-log
+        report["telemetry"] = telemetry_series.summary()
     if args.save_checkpoint:
         engine.save_checkpoint(args.save_checkpoint)
         report["checkpoint"] = args.save_checkpoint
+    if args.report:
+        from flow_updating_tpu.obs.report import build_manifest, write_report
+
+        timings = {"build_s": round(build_s, 6), "run_s": round(run_s, 6)}
+        timings.update(engine.telemetry_timings or {})
+        write_report(args.report, build_manifest(
+            argv=getattr(args, "_argv", None), config=engine.config,
+            topo=engine.topology, report=report, timings=timings,
+            telemetry=telemetry_series,
+        ))
+        report["report_path"] = args.report
     if event_log:
         event_log.emit("run_end", **report)
         event_log.close()
@@ -393,13 +460,26 @@ def cmd_train(args) -> int:
             consensus_dispersion=tr.consensus_dispersion(),
             max_mass_residual=float(np.abs(tr.mass_residual()).max()),
         )
+    import time as _time
+
+    t_run0 = _time.perf_counter()
     report = trainer.train(churn=churn,
                            sample_every=args.sample_every if cb else 0,
                            callback=cb)
+    run_s = _time.perf_counter() - t_run0
     report["distance_to_centralized"] = trainer.distance_to_centralized(
         centralized_solution(ds))
     report["churn"] = {str(k): [v[0], list(map(int, v[1]))]
                        for k, v in churn.items()}
+    if args.report:
+        from flow_updating_tpu.obs.report import build_manifest, write_report
+
+        write_report(args.report, build_manifest(
+            argv=getattr(args, "_argv", None),
+            config={"round": rcfg, "train": gcfg}, topo=topo,
+            report=report, timings={"run_s": round(run_s, 6)},
+        ))
+        report["report_path"] = args.report
     if event_log:
         event_log.emit("train_end", **{
             k: v for k, v in report.items() if not isinstance(v, dict)})
@@ -458,6 +538,36 @@ def cmd_oracle(args) -> int:
         "mass_residual": float(est.sum() - topo.values.sum()),
         "true_mean": topo.true_mean,
     }))
+    return 0
+
+
+def cmd_obs_export_trace(args) -> int:
+    """``obs export-trace``: EventLog JSONL -> Chrome trace-event /
+    Perfetto JSON (open in chrome://tracing or ui.perfetto.dev)."""
+    from flow_updating_tpu.obs.trace import (
+        eventlog_to_chrome_trace,
+        read_eventlog,
+    )
+
+    if not os.path.exists(args.eventlog):
+        raise SystemExit(f"no such event log: {args.eventlog}")
+    records = read_eventlog(args.eventlog)
+    if not records:
+        raise SystemExit(
+            f"{args.eventlog}: no parseable JSONL records (is this an "
+            "event log written with --event-log?)")
+    doc = eventlog_to_chrome_trace(records)
+    out = args.output or (args.eventlog + ".trace.json")
+    if out == "-":
+        json.dump(doc, sys.stdout)
+        sys.stdout.write("\n")
+    else:
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        print(json.dumps({
+            "trace": out, "records": len(records),
+            "trace_events": len(doc["traceEvents"]),
+        }))
     return 0
 
 
@@ -587,6 +697,22 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--stream", action="store_true",
                      help="one compiled run with metrics streamed mid-run "
                           "via jax.debug.callback (vs host-chunked watcher)")
+    run.add_argument("--telemetry", nargs="?", const="default",
+                     metavar="METRICS",
+                     help="device-resident per-round metric series: run "
+                          "--rounds/--until as ONE compiled scan that "
+                          "accumulates metrics on device (no debug "
+                          "callbacks, one bulk readback).  METRICS is "
+                          "'default', 'full', or a comma list from: "
+                          "rmse, max_abs_err, mass, mass_residual, "
+                          "antisymmetry, sent, delivered, fired_total, "
+                          "active.  Summary lands in the printed report; "
+                          "full series in --report / --event-log")
+    run.add_argument("--report", metavar="PATH",
+                     help="write a self-describing JSON run manifest "
+                          "(argv, config, topology fingerprint, backend, "
+                          "compile/execute timings, convergence report, "
+                          "telemetry series) to PATH")
     run.add_argument("--event-log", metavar="PATH",
                      help="append structured JSONL events (watch samples, "
                           "run start/end) to PATH")
@@ -645,6 +771,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="event-log sampling cadence in outer steps")
     tr.add_argument("--event-log", metavar="PATH",
                     help="append structured JSONL train samples to PATH")
+    tr.add_argument("--report", metavar="PATH",
+                    help="write a self-describing JSON run manifest to "
+                         "PATH (as in `run --report`)")
     tr.set_defaults(fn=cmd_train)
 
     gen = sub.add_parser("generate", help="topology summary")
@@ -666,11 +795,27 @@ def build_parser() -> argparse.ArgumentParser:
                           "--latency-scale > 0)")
     orc.set_defaults(fn=cmd_oracle)
 
+    obs = sub.add_parser(
+        "obs", help="observability tools (event-log trace export)")
+    obs_sub = obs.add_subparsers(dest="obs_cmd", required=True)
+    exp = obs_sub.add_parser(
+        "export-trace",
+        help="convert an --event-log JSONL into Chrome trace-event / "
+             "Perfetto JSON: actor lanes, message-flow arrows, watcher "
+             "metrics as counter tracks")
+    exp.add_argument("eventlog", help="JSONL event log path")
+    exp.add_argument("-o", "--output", default=None,
+                     help="output path (default: <eventlog>.trace.json; "
+                          "'-' = stdout)")
+    exp.set_defaults(fn=cmd_obs_export_trace)
+
     return ap
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # the run manifest records the exact invocation
+    args._argv = list(argv) if argv is not None else list(sys.argv[1:])
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(levelname)s %(name)s: %(message)s",

@@ -38,6 +38,30 @@ logger = logging.getLogger("flow_updating_tpu.engine")
 TICK_INTERVAL = 1.0  # simulated seconds per round
 
 
+def _aot_timed(runner, state, arrays, *, cfg, num_rounds, spec, true_mean):
+    """Run a jitted telemetry runner with the compile wall time measured
+    separately via AOT lowering (``.lower().compile()``); falls back to a
+    plain call (compile time folded into execution) when the runner or
+    backend does not support AOT.  Returns ``(state, series, compile_s)``.
+    """
+    import time as _time
+
+    try:
+        lowered = runner.lower(state, arrays, cfg, num_rounds, spec,
+                               true_mean)
+        t0 = _time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = _time.perf_counter() - t0
+    except (AttributeError, TypeError, NotImplementedError):
+        out_state, series = runner(state, arrays, cfg, num_rounds, spec,
+                                   true_mean)
+        return out_state, series, None
+    # the compiled call stays OUTSIDE the fallback: an execution-time
+    # error must surface, not silently re-run the whole scan
+    out_state, series = compiled(state, arrays, true_mean)
+    return out_state, series, compile_s
+
+
 def _log_stream_sample(m: dict) -> None:
     logger.info(
         "[%d] rmse=%.3e max_err=%.3e mass=%.6g fired=%d",
@@ -67,7 +91,7 @@ class Engine:
     def __init__(self, argv=None, config: RoundConfig | None = None,
                  mesh=None, multichip: str = "auto",
                  halo: str = "ppermute", partition: str = "bfs",
-                 host_actors: bool = False):
+                 host_actors: bool = False, event_log=None):
         # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
         # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
         # accepts a ready RoundConfig here.  ``mesh`` (a jax.sharding.Mesh
@@ -106,6 +130,13 @@ class Engine:
         self._n_real: int | None = None   # real node count when mesh-padded
         self._halo_plan = None
         self.netzone_root = _NetzoneShim(self)
+        # optional EventLog sink for engine lifecycle records ("advance"
+        # compiled-chunk dispatches, "kill_all") — together with the s4u
+        # actor/comm events the raw material of `obs export-trace`
+        self.event_log = event_log
+        # compile/execute wall-time split of the last run_telemetry call
+        # (run manifests record it); None entries = not measured
+        self.telemetry_timings: dict = {}
         # host-fidelity mode: arbitrary Python actors on the s4u host DES
         # (flow_updating_tpu.s4u) instead of array kernels — the explicit
         # opt-in for the reference's register_actor(<any class>) surface
@@ -277,7 +308,8 @@ class Engine:
         from flow_updating_tpu import s4u
 
         if self._hostdes is None:
-            self._hostdes = s4u.HostDes(platform=self.platform)
+            self._hostdes = s4u.HostDes(platform=self.platform,
+                                        event_log=self.event_log)
             s4u._CURRENT_DES = self._hostdes
         return self._hostdes
 
@@ -866,7 +898,23 @@ class Engine:
 
     # ---- execution -------------------------------------------------------
     def _advance(self, n: int) -> None:
-        """Dispatch ``n`` compiled rounds to the configured kernel."""
+        """Dispatch ``n`` compiled rounds to the configured kernel.
+
+        With an event log attached, each dispatch leaves an ``advance``
+        record (simulated start time + round count + host-side dispatch
+        wall time; execution is asynchronous, so ``wall_s`` measures
+        dispatch — the first call of a scan length also includes its
+        compile)."""
+        import time as _time
+
+        t0 = _time.perf_counter() if self.event_log is not None else 0.0
+        self._advance_inner(n)
+        if self.event_log is not None:
+            self.event_log.emit(
+                "advance", t=self._clock, rounds=n,
+                wall_s=round(_time.perf_counter() - t0, 6))
+
+    def _advance_inner(self, n: int) -> None:
         if self._halo_mode:
             from flow_updating_tpu.parallel import sharded
 
@@ -887,6 +935,94 @@ class Engine:
             self._advance(n)
         self._clock += n * TICK_INTERVAL
         return self
+
+    def run_telemetry(self, n: int, spec=None):
+        """Run ``n`` rounds as ONE compiled scan that accumulates the
+        ``spec``-selected per-round metric series on device (zero
+        ``debug.callback``s; one bulk host transfer at the end).  Returns
+        a :class:`~flow_updating_tpu.obs.telemetry.TelemetrySeries`.
+
+        Dispatches to the kernel's telemetry runner (edge, node-collapsed,
+        halo shard_map, pod-sharded stencil); a disabled spec runs the
+        PLAIN kernel — bit-identical program to :meth:`run_rounds` — and
+        returns an empty series, so telemetry-off costs nothing
+        (scripts/telemetry_overhead.py holds this to < 5%).
+
+        ``self.telemetry_timings`` afterwards holds the compile/execute
+        wall-time split (compile via AOT lowering where the runner
+        supports it; None otherwise) for the run manifest.
+        """
+        import time as _time
+
+        from flow_updating_tpu.obs.telemetry import (
+            TelemetrySeries,
+            TelemetrySpec,
+        )
+
+        spec = TelemetrySpec.default() if spec is None else spec
+        self.telemetry_timings = {}
+        if self.state is None:
+            self.build()
+        if not spec.enabled or self._killed or n <= 0:
+            self.run_rounds(n)
+            return TelemetrySeries.empty()
+        if self._custom_actor is not None:
+            raise NotImplementedError(
+                "telemetry series cover the built-in kernels; a custom "
+                "VectorActor defines its own carry — sample it from the "
+                "actor's scan instead")
+        kind = ("halo" if self._halo_mode else
+                "pod" if self._pod_mode else
+                "node" if self._node_like else "edge")
+        spec = spec.for_kernel(kind)
+        import jax
+        import jax.numpy as jnp
+
+        # a ready device scalar (not a Python float) so the AOT-compiled
+        # runner sees the exact aval it was lowered with
+        mean = jnp.asarray(self.topology.true_mean, self.config.jnp_dtype)
+
+        compile_s = None
+        t0 = _time.perf_counter()
+        if kind == "halo":
+            from flow_updating_tpu.parallel import sharded
+
+            state, series = sharded.run_rounds_sharded_telemetry(
+                self.state, self._halo_plan, self.config, self.mesh, n,
+                spec, mean, arrays=self._halo_arrays, halo=self.halo)
+        elif kind == "pod":
+            state, series = self._node_kernel.run_telemetry(
+                self.state, n, spec)
+        elif kind == "node":
+            from flow_updating_tpu.models import sync
+
+            if not isinstance(self._node_kernel, sync.NodeKernel):
+                raise NotImplementedError(
+                    f"telemetry is not wired into "
+                    f"{type(self._node_kernel).__name__} yet — use the "
+                    "plain NodeKernel (spmv='xla'|'pallas'|'benes'|"
+                    "'structured'), the pod kernel, or the edge kernel")
+            state, series, compile_s = _aot_timed(
+                sync.run_rounds_node_telemetry, self.state,
+                self._node_kernel.arrays,
+                cfg=self.config, num_rounds=n, spec=spec, true_mean=mean)
+        else:
+            from flow_updating_tpu.models.rounds import run_rounds_telemetry
+
+            state, series, compile_s = _aot_timed(
+                run_rounds_telemetry, self.state, self._topo_arrays,
+                cfg=self.config, num_rounds=n, spec=spec, true_mean=mean)
+        series = jax.block_until_ready(series)
+        wall = _time.perf_counter() - t0
+        self.telemetry_timings = {
+            "compile_s": (round(compile_s, 6)
+                          if compile_s is not None else None),
+            "execute_s": round(wall - (compile_s or 0.0), 6),
+        }
+        self.state = state
+        self._clock += n * TICK_INTERVAL
+        return TelemetrySeries({k: np.asarray(v) for k, v in
+                                series.items()})
 
     def run_until_rmse(
         self, threshold: float, max_rounds: int = 100_000,
@@ -1039,5 +1175,7 @@ class Engine:
                         "[%0.1f] watcher: stopping every peer.", self._clock
                     )
                     self._killed = True
+                    if self.event_log is not None:
+                        self.event_log.emit("kill_all", t=self._clock)
         self._clock = float(t_end)
         return self

@@ -619,12 +619,22 @@ def fire_phase(
     return send_messages(state, topo, cfg, msg_est, send_mask)
 
 
+def round_step_aux(state: FlowUpdatingState, topo, cfg: RoundConfig):
+    """One full round, also surfacing the per-edge ``processed`` (messages
+    drained this round) and ``send_mask`` (messages fired) masks — the
+    telemetry counters.  :func:`round_step` discards them; XLA dead-code
+    eliminates the unused outputs, so the plain path is unchanged."""
+    state, processed = deliver_phase(state, topo, cfg)
+    state, msg_est, send_mask = fire_core(state, topo, cfg, processed)
+    state = send_messages(state, topo, cfg, msg_est, send_mask)
+    return state, processed, send_mask
+
+
 def round_step(
     state: FlowUpdatingState, topo, cfg: RoundConfig
 ) -> FlowUpdatingState:
     """One full gossip round (= one simulated second of the reference)."""
-    state, processed = deliver_phase(state, topo, cfg)
-    return fire_phase(state, topo, cfg, processed)
+    return round_step_aux(state, topo, cfg)[0]
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
@@ -638,6 +648,90 @@ def run_rounds(
 
     state, _ = jax.lax.scan(body, state, None, length=num_rounds)
     return state
+
+
+def _fired_acc():
+    """Accumulator dtype for summed int32 fire counters: int64 when x64 is
+    on, else float32 (never wraps; approximate beyond 2^24 events — fine
+    for an observability counter)."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def telemetry_sample(state, topo, spec, mean, processed, send_mask) -> dict:
+    """One round's metric row for the edge kernel (device-side, inside the
+    scan body — no callbacks).  ``spec`` is a static
+    :class:`~flow_updating_tpu.obs.telemetry.TelemetrySpec`; only the
+    selected metrics are computed, so a narrow spec pays only for what it
+    asks.  Metrics mask to alive nodes (excludes mesh-padding dummies and
+    crash-stopped nodes), like :func:`_observe_chunk`."""
+    out = {"t": state.t}
+    alive = state.alive
+    need_est = any(spec.has(m) for m in
+                   ("rmse", "max_abs_err", "mass", "mass_residual"))
+    if need_est:
+        est = node_estimates(state, topo)
+        a_ex = _ex(alive, est)
+        if spec.has("rmse") or spec.has("max_abs_err"):
+            err = jnp.where(a_ex, est - mean, 0)
+            if spec.has("rmse"):
+                cnt = (jnp.maximum(jnp.sum(alive), 1)
+                       * _feat(est)).astype(est.dtype)
+                out["rmse"] = jnp.sqrt(jnp.sum(err * err) / cnt)
+            if spec.has("max_abs_err"):
+                out["max_abs_err"] = jnp.max(jnp.abs(err))
+        if spec.has("mass") or spec.has("mass_residual"):
+            mass = jnp.sum(jnp.where(a_ex, est, 0), axis=0)  # per-feature
+            if spec.has("mass"):
+                out["mass"] = mass
+            if spec.has("mass_residual"):
+                out["mass_residual"] = mass - jnp.sum(
+                    jnp.where(_ex(alive, state.value), state.value, 0),
+                    axis=0)
+    if spec.has("antisymmetry"):
+        out["antisymmetry"] = jnp.max(
+            jnp.abs(state.flow + state.flow[topo.rev]))
+    if spec.has("sent"):
+        out["sent"] = jnp.sum(send_mask.astype(jnp.int32))
+    if spec.has("delivered"):
+        out["delivered"] = jnp.sum(processed.astype(jnp.int32))
+    if spec.has("fired_total"):
+        out["fired_total"] = jnp.sum(state.fired, dtype=_fired_acc())
+    if spec.has("active"):
+        out["active"] = jnp.sum(alive.astype(jnp.int32))
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_rounds", "spec")
+)
+def run_rounds_telemetry(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int,
+    spec, true_mean,
+):
+    """Run ``num_rounds`` rounds as one compiled scan, accumulating the
+    ``spec``-selected per-round metric series ON DEVICE (scan ``ys``) —
+    one bulk host transfer at the end, zero ``debug.callback``s in the
+    body.  Returns ``(state, {metric: (R,) or (R, D) array})``.
+
+    The device-resident replacement for the streamed observer: the full
+    per-round curve of a run (the resolution Gossip-PGA-style convergence
+    judgments need) at the cost of one extra set of reductions per round,
+    only when enabled.  A disabled spec is rejected — callers dispatch to
+    :func:`run_rounds` instead so telemetry-off compiles to the exact
+    current program (``Engine.run_telemetry`` does this)."""
+    if not spec.enabled:
+        raise ValueError(
+            "telemetry spec is disabled; run run_rounds() instead (the "
+            "Engine.run_telemetry dispatcher handles this)")
+    mean = jnp.asarray(true_mean, state.value.dtype)
+
+    def body(s, _):
+        s, processed, send_mask = round_step_aux(s, topo, cfg)
+        return s, telemetry_sample(s, topo, spec, mean, processed,
+                                   send_mask)
+
+    state, series = jax.lax.scan(body, state, None, length=num_rounds)
+    return state, series
 
 
 @functools.partial(
@@ -720,13 +814,9 @@ def _observe_chunk(s, topo, cfg, observe_every: int, mean):
 )
 def _run_streamed(state, topo, cfg, chunks, observe_every, mean, emit):
     def host_emit(t, rmse_v, max_err, mass, fired):
-        emit({
-            "t": int(t),
-            "rmse": float(rmse_v),
-            "max_abs_err": float(max_err),
-            "mass": float(mass),
-            "fired_total": int(fired),
-        })
+        from flow_updating_tpu.utils.metrics import observer_sample
+
+        emit(observer_sample(t, rmse_v, max_err, mass, fired))
 
     def chunk_body(s, _):
         s, sample = _observe_chunk(s, topo, cfg, observe_every, mean)

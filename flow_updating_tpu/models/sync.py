@@ -284,6 +284,14 @@ class NodeKernel:
             self.topo.true_mean, emit,
         )
 
+    def run_telemetry(self, state: NodeSyncState, num_rounds: int, spec):
+        """Device-resident per-round series (see
+        :func:`run_rounds_node_telemetry`); returns ``(state, series)``."""
+        return run_rounds_node_telemetry(
+            state, self.arrays, self.cfg, num_rounds, spec,
+            self.topo.true_mean,
+        )
+
     def _unpermute(self, padded: np.ndarray) -> np.ndarray:
         out = np.empty((self.topo.num_nodes,) + padded.shape[1:],
                        padded.dtype)
@@ -351,6 +359,68 @@ def run_rounds_node(
 
     state, _ = jax.lax.scan(body, state, None, length=num_rounds)
     return state
+
+
+def node_telemetry_sample(s: NodeSyncState, arrs: NodeSyncArrays, spec,
+                          mean) -> dict:
+    """One round's metric row for the node-collapsed kernel (device-side).
+    Same masking as :func:`_node_sample`: communicating rows only (deg > 0
+    — padding has degree 0).  In fast sync mode every communicating node
+    fires every round, so ``fired_total = t * active`` (accumulated in the
+    wide dtype — see models.rounds._fired_acc)."""
+    from flow_updating_tpu.models.rounds import _fired_acc
+
+    real = arrs.inv_depp1 < 1.0
+    out = {"t": s.t}
+    need_est = any(spec.has(m) for m in
+                   ("rmse", "max_abs_err", "mass", "mass_residual"))
+    if need_est:
+        est = arrs.value + s.G
+        r_ex = _ex(real, est)
+        if spec.has("rmse") or spec.has("max_abs_err"):
+            err = jnp.where(r_ex, est - mean, 0)
+            if spec.has("rmse"):
+                cnt = (jnp.maximum(jnp.sum(real), 1)
+                       * _feat(est)).astype(est.dtype)
+                out["rmse"] = jnp.sqrt(jnp.sum(err * err) / cnt)
+            if spec.has("max_abs_err"):
+                out["max_abs_err"] = jnp.max(jnp.abs(err))
+        if spec.has("mass") or spec.has("mass_residual"):
+            mass = jnp.sum(jnp.where(r_ex, est, 0), axis=0)
+            if spec.has("mass"):
+                out["mass"] = mass
+            if spec.has("mass_residual"):
+                out["mass_residual"] = mass - jnp.sum(
+                    jnp.where(_ex(real, arrs.value), arrs.value, 0),
+                    axis=0)
+    active = jnp.sum(real.astype(jnp.int32))
+    if spec.has("fired_total"):
+        acc = _fired_acc()
+        out["fired_total"] = s.t.astype(acc) * active.astype(acc)
+    if spec.has("active"):
+        out["active"] = active
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds", "spec"))
+def run_rounds_node_telemetry(
+    state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig,
+    num_rounds: int, spec, true_mean,
+):
+    """Node-kernel twin of
+    :func:`flow_updating_tpu.models.rounds.run_rounds_telemetry`: one
+    compiled scan, per-round series as scan ``ys``, one bulk transfer."""
+    if not spec.enabled:
+        raise ValueError(
+            "telemetry spec is disabled; run run_rounds_node() instead")
+    mean = jnp.asarray(true_mean, state.S.dtype)
+
+    def body(s, _):
+        s = node_round_step(s, arrs, cfg)
+        return s, node_telemetry_sample(s, arrs, spec, mean)
+
+    state, series = jax.lax.scan(body, state, None, length=num_rounds)
+    return state, series
 
 
 def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):

@@ -1,0 +1,44 @@
+"""Observability subsystem: device-resident telemetry, run manifests,
+and the DES trace exporter.
+
+Three pillars (docs/OBSERVABILITY.md):
+
+* :mod:`~flow_updating_tpu.obs.telemetry` — the metric spec/series
+  contract for per-round series accumulated *inside* the compiled round
+  scan (no ``jax.debug.callback`` in the hot path; one bulk host
+  transfer at the end).  The per-kernel runners live with their kernels.
+* :mod:`~flow_updating_tpu.obs.report` — the self-describing JSON run
+  manifest every CLI entry point can emit (``--report``).
+* :mod:`~flow_updating_tpu.obs.trace` — EventLog JSONL -> Chrome
+  trace-event / Perfetto converter (``obs export-trace``), the TPU-native
+  answer to SimGrid's Paje traces.
+
+``observer_sample`` is re-exported here as the ONE watch-record shape:
+every streamed-observer emit site and :meth:`TelemetrySeries.
+watch_records` produce it, so the watcher contract cannot drift between
+execution modes (contract-tested in tests/test_obs_tools.py).
+"""
+
+from flow_updating_tpu.obs.telemetry import (
+    ALL_METRICS,
+    DEFAULT_METRICS,
+    SUPPORTED_METRICS,
+    TelemetrySeries,
+    TelemetrySpec,
+)
+from flow_updating_tpu.obs.report import build_manifest, write_report
+from flow_updating_tpu.obs.trace import eventlog_to_chrome_trace, read_eventlog
+from flow_updating_tpu.utils.metrics import observer_sample
+
+__all__ = [
+    "ALL_METRICS",
+    "DEFAULT_METRICS",
+    "SUPPORTED_METRICS",
+    "TelemetrySeries",
+    "TelemetrySpec",
+    "build_manifest",
+    "write_report",
+    "eventlog_to_chrome_trace",
+    "read_eventlog",
+    "observer_sample",
+]

@@ -1,0 +1,111 @@
+"""Unified run manifests: one self-describing JSON artifact per run.
+
+The reference leaves no machine-readable record of a run at all — its
+output is the watcher's eye-ball dump plus whatever scrolled past on
+stderr.  A manifest binds everything needed to *audit* a run into one
+document: the exact invocation (argv + resolved config), the topology
+(size + content fingerprint, the same digest that binds checkpoints to
+their graph), the execution substrate (backend/devices/versions),
+compile-vs-execute wall times, the final convergence report, and — when
+telemetry was enabled — the full per-round metric series.
+
+``run --report PATH``, ``train --report PATH`` and ``bench.py --report
+PATH`` all write this schema (``flow-updating-run-report/v1``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+SCHEMA = "flow-updating-run-report/v1"
+
+
+def environment_info() -> dict:
+    """Backend/device/version facts (imports jax lazily; safe pre-pin)."""
+    info: dict = {"python": sys.version.split()[0]}
+    try:
+        import jax
+
+        devs = jax.devices()
+        info.update({
+            "jax": jax.__version__,
+            "backend": devs[0].platform,
+            "device_kind": getattr(devs[0], "device_kind", str(devs[0])),
+            "device_count": len(devs),
+            "process_count": jax.process_count(),
+            "x64": bool(jax.config.jax_enable_x64),
+        })
+    except Exception as exc:  # backend init can fail; the manifest must not
+        info["backend_error"] = f"{type(exc).__name__}: {exc}"
+    try:
+        import numpy as np
+
+        info["numpy"] = np.__version__
+    except Exception:
+        pass
+    return info
+
+
+def topology_summary(topo) -> dict:
+    """Size + degree stats + the checkpoint-grade content fingerprint."""
+    import numpy as np
+
+    from flow_updating_tpu.utils.checkpoint import topology_fingerprint
+
+    deg = np.asarray(topo.out_deg)
+    out = topology_fingerprint(topo)
+    out.update({
+        "degree_min": int(deg.min()) if deg.size else 0,
+        "degree_mean": round(float(deg.mean()), 3) if deg.size else 0.0,
+        "degree_max": int(deg.max()) if deg.size else 0,
+        "true_mean": float(topo.true_mean),
+    })
+    return out
+
+
+def _config_dict(config) -> dict:
+    if config is None:
+        return {}
+    if dataclasses.is_dataclass(config) and not isinstance(config, type):
+        return dataclasses.asdict(config)
+    if isinstance(config, dict):
+        return dict(config)
+    return {"repr": repr(config)}
+
+
+def build_manifest(*, argv=None, config=None, topo=None, report=None,
+                   timings=None, telemetry=None, extra=None) -> dict:
+    """Assemble the v1 manifest.  ``telemetry`` is a
+    :class:`~flow_updating_tpu.obs.telemetry.TelemetrySeries` (or None);
+    ``config`` may be a dataclass, a dict, or a dict of dataclasses."""
+    manifest = {
+        "schema": SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "topology": topology_summary(topo) if topo is not None else None,
+        "environment": environment_info(),
+        "timings": dict(timings) if timings else None,
+        "report": report,
+    }
+    if telemetry is not None and len(telemetry):
+        manifest["telemetry"] = {
+            "metrics": list(telemetry.metrics),
+            "rounds": len(telemetry),
+            "series": telemetry.to_jsonable(),
+        }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_report(path: str, manifest: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, default=str)
+        f.write("\n")

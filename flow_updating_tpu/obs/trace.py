@@ -1,0 +1,181 @@
+"""EventLog JSONL -> Chrome trace-event / Perfetto JSON.
+
+SimGrid ships Paje tracing (``--cfg=tracing:yes``) that the reference
+never turns on; the TPU-native equivalent is this converter: it takes the
+framework's structured event log (watch samples, engine lifecycle, and —
+in host-actors mode — the s4u runtime's actor/comm lifecycle events) and
+emits the Chrome trace-event JSON format, which both ``chrome://tracing``
+and https://ui.perfetto.dev open directly.
+
+Mapping:
+
+* each s4u actor gets its own *thread lane* (pid 1 "simulation"); its
+  lifetime ``actor_spawn -> actor_exit`` renders as one complete ("X")
+  slice on that lane;
+* message flows render as flow arrows: ``comm_put`` starts a flow ("s")
+  on the sender's lane, ``comm_deliver`` finishes it ("f") on the
+  receiving mailbox's lane (mailbox name == actor name, the reference's
+  convention) — arrows point from put to delivery across lanes;
+* ``watch`` / ``train_sample`` records become counter ("C") tracks
+  (pid 2 "metrics"): rmse, max_abs_err, mass, fired_total, ... — the
+  watcher's convergence curves, scrubbable against the actor timeline;
+* engine ``advance`` records render as compiled-chunk slices on an
+  "engine" lane; ``run_start``/``run_end``/``kill_all`` as instants.
+
+Timestamps are *simulated* seconds (the records' ``t``), scaled to the
+trace format's microseconds; records without ``t`` fall back to wall
+time so pure-host logs still order sensibly.
+"""
+
+from __future__ import annotations
+
+import json
+
+_US = 1_000_000.0  # simulated seconds -> trace microseconds
+
+PID_SIM = 1
+PID_METRICS = 2
+
+#: record fields that never become counters
+_NON_COUNTER_FIELDS = {"t", "kind", "wall_s", "step"}
+
+
+def read_eventlog(path: str) -> list:
+    """Parse a JSONL event log, skipping non-JSON lines (a truncated tail
+    from a killed run must not void the rest of the trace)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _ts(rec: dict) -> float:
+    t = rec.get("t")
+    if t is None:
+        t = rec.get("wall_s", 0.0)
+    try:
+        return float(t) * _US
+    except (TypeError, ValueError):
+        return 0.0
+
+
+class _Lanes:
+    """Stable actor -> tid assignment with thread_name metadata."""
+
+    def __init__(self, events: list):
+        self._events = events
+        self._tids: dict = {}
+
+    def tid(self, name: str) -> int:
+        if name not in self._tids:
+            tid = len(self._tids) + 1
+            self._tids[name] = tid
+            self._events.append({
+                "ph": "M", "name": "thread_name", "pid": PID_SIM,
+                "tid": tid, "args": {"name": name},
+            })
+        return self._tids[name]
+
+
+def eventlog_to_chrome_trace(records) -> dict:
+    """Convert event-log records to a Chrome trace-event document."""
+    events: list = [
+        {"ph": "M", "name": "process_name", "pid": PID_SIM,
+         "args": {"name": "simulation"}},
+        {"ph": "M", "name": "process_name", "pid": PID_METRICS,
+         "args": {"name": "metrics"}},
+        {"ph": "M", "name": "thread_name", "pid": PID_METRICS, "tid": 0,
+         "args": {"name": "watcher"}},
+    ]
+    lanes = _Lanes(events)
+    spawn_ts: dict = {}          # actor -> spawn timestamp (us)
+    comm_src: dict = {}          # cid -> source actor
+    last_ts = 0.0
+
+    for rec in records:
+        kind = rec.get("kind")
+        ts = _ts(rec)
+        last_ts = max(last_ts, ts)
+        if kind == "actor_spawn":
+            actor = str(rec.get("actor", "?"))
+            lanes.tid(actor)
+            spawn_ts[actor] = ts
+            events.append({
+                "ph": "i", "name": f"spawn {actor}", "cat": "actor",
+                "pid": PID_SIM, "tid": lanes.tid(actor), "ts": ts, "s": "t",
+            })
+        elif kind == "actor_exit":
+            actor = str(rec.get("actor", "?"))
+            start = spawn_ts.pop(actor, ts)
+            events.append({
+                "ph": "X", "name": actor, "cat": "actor",
+                "pid": PID_SIM, "tid": lanes.tid(actor),
+                "ts": start, "dur": max(ts - start, 0.0),
+                "args": {"killed": bool(rec.get("killed", False))},
+            })
+        elif kind == "comm_put":
+            src = str(rec.get("src", "?"))
+            cid = rec.get("cid", len(comm_src))
+            comm_src[cid] = src
+            common = {"cat": "comm", "id": int(cid), "pid": PID_SIM,
+                      "tid": lanes.tid(src), "ts": ts,
+                      "name": f"msg:{rec.get('mailbox', '?')}"}
+            events.append({"ph": "s", **common})
+        elif kind == "comm_deliver":
+            dst = str(rec.get("mailbox", "?"))
+            cid = rec.get("cid", -1)
+            events.append({
+                "ph": "f", "bp": "e", "cat": "comm", "id": int(cid),
+                "pid": PID_SIM, "tid": lanes.tid(dst), "ts": ts,
+                "name": f"msg:{dst}",
+                "args": {"src": comm_src.get(cid)},
+            })
+        elif kind in ("comm_cancel", "comm_drop"):
+            events.append({
+                "ph": "i", "name": kind, "cat": "comm", "pid": PID_SIM,
+                "tid": 0, "ts": ts, "s": "p",
+            })
+        elif kind == "advance":
+            rounds = float(rec.get("rounds", 0))
+            events.append({
+                "ph": "X", "name": f"advance x{int(rounds)}",
+                "cat": "engine", "pid": PID_SIM, "tid": lanes.tid("engine"),
+                "ts": ts, "dur": rounds * _US,
+                "args": {"wall_s": rec.get("wall_s")},
+            })
+            last_ts = max(last_ts, ts + rounds * _US)
+        elif kind in ("watch", "train_sample", "until_rmse"):
+            for field, value in rec.items():
+                if field in _NON_COUNTER_FIELDS or not isinstance(
+                        value, (int, float)) or isinstance(value, bool):
+                    continue
+                events.append({
+                    "ph": "C", "name": field, "pid": PID_METRICS, "tid": 0,
+                    "ts": ts, "args": {field: value},
+                })
+        elif kind is not None:
+            # run_start / run_end / kill_all / train_end / anything new:
+            # an instant marker keeps unknown kinds visible, never dropped
+            events.append({
+                "ph": "i", "name": str(kind), "cat": "lifecycle",
+                "pid": PID_SIM, "tid": 0, "ts": ts, "s": "g",
+            })
+
+    # actors that never exited (log truncated / still running): close
+    # their slices at the last seen timestamp so lanes stay visible
+    for actor, start in spawn_ts.items():
+        events.append({
+            "ph": "X", "name": actor, "cat": "actor", "pid": PID_SIM,
+            "tid": lanes.tid(actor), "ts": start,
+            "dur": max(last_ts - start, 1.0), "args": {"open": True},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -421,7 +421,9 @@ def _unlanes(m, ref):
 def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
                  perm: PermTables, cfg: RoundConfig, Eb: int, S: int,
                  offsets: tuple, halo_mode: str):
-    """One round on one shard's block (runs inside shard_map)."""
+    """One round on one shard's block (runs inside shard_map).  Returns
+    ``(state, processed, send_mask)`` — the masks feed the telemetry
+    sampler; plain runs drop them (dead-code eliminated)."""
     me = jax.lax.axis_index(NODE_AXIS)
     D = cfg.delay_depth
     ltopo = TopoArrays(
@@ -495,9 +497,10 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
         buf_est = buf_est.at[a_slot, tgt2].set(a_est, mode="drop")
         buf_valid = buf_valid.at[a_slot, tgt2].set(True, mode="drop")
 
-    return st.replace(
+    st = st.replace(
         t=t + 1, buf_flow=buf_flow, buf_est=buf_est, buf_valid=buf_valid
     )
+    return st, processed, send_mask
 
 
 def _local_round_fastpair(st: FlowUpdatingState, pl: PlanArrays,
@@ -582,10 +585,15 @@ def _local_round_fastpair(st: FlowUpdatingState, pl: PlanArrays,
         jnp.where(m_ex, avg_e, jnp.asarray(0, dt)), pl.src_local,
         num_segments=Nb)
     last_avg = jnp.where(_ex(fire_any, node_avg), node_avg, st.last_avg)
-    return st.replace(
+    st = st.replace(
         t=t + 1, flow=flow, est=est_e, stamp=stamp, last_avg=last_avg,
         fired=st.fired + fire_any.astype(jnp.int32),
     )
+    # direct exchange: no messages drained or put on the wire — the zero
+    # masks keep the telemetry counters consistent with the single-device
+    # fast-pairwise branch (send_mask there is all-False too)
+    none = jnp.zeros((Eb,), bool)
+    return st, none, none
 
 
 @functools.partial(
@@ -608,13 +616,15 @@ def _run_sharded(state, arrays, halo, perm, cfg, mesh, num_rounds, Eb,
 
         def step(s, _):
             if cfg.needs_coloring:
-                return _local_round_fastpair(
+                s2, _, _ = _local_round_fastpair(
                     s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
                     num_colors,
-                ), None
-            return _local_round(
-                s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
-            ), None
+                )
+            else:
+                s2, _, _ = _local_round(
+                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
+                )
+            return s2, None
 
         st, _ = jax.lax.scan(step, st, None, length=num_rounds)
         return jax.tree.map(lambda x: x[None], st)
@@ -663,6 +673,143 @@ def run_rounds_sharded(
         state, plan_arrays, halo_tables, perm, cfg, mesh, num_rounds,
         plan.Eb, plan.perm_offsets, halo, plan.num_colors,
     )
+
+
+def _halo_telemetry_sample(st: FlowUpdatingState, pl: PlanArrays, spec,
+                           mean, processed, send_mask, Nb: int) -> dict:
+    """One round's metric row on one shard, ``psum``-reduced over the mesh
+    axis so every shard holds the GLOBAL value — the series then matches
+    the single-device edge kernel's bit-for-bit up to reduction order
+    (asserted in tests/test_telemetry.py).  Padding rows are dead dummies
+    (alive=False, value 0), so the alive mask excludes them exactly like
+    mesh padding on the GSPMD path."""
+    from flow_updating_tpu.models.rounds import _fired_acc
+
+    psum = lambda x: jax.lax.psum(x, NODE_AXIS)
+    out = {"t": st.t}
+    alive = st.alive
+    need_est = any(spec.has(m) for m in
+                   ("rmse", "max_abs_err", "mass", "mass_residual"))
+    if need_est:
+        est = st.value - jax.ops.segment_sum(
+            st.flow, pl.src_local, num_segments=Nb)
+        a_ex = _ex(alive, est)
+        if spec.has("rmse") or spec.has("max_abs_err"):
+            err = jnp.where(a_ex, est - mean, 0)
+            if spec.has("rmse"):
+                feat = int(est.size // est.shape[0]) if est.ndim > 1 else 1
+                cnt = (jnp.maximum(
+                    psum(jnp.sum(alive.astype(jnp.int32))), 1)
+                    * feat).astype(est.dtype)
+                out["rmse"] = jnp.sqrt(psum(jnp.sum(err * err)) / cnt)
+            if spec.has("max_abs_err"):
+                out["max_abs_err"] = jax.lax.pmax(
+                    jnp.max(jnp.abs(err)), NODE_AXIS)
+        if spec.has("mass") or spec.has("mass_residual"):
+            mass = psum(jnp.sum(jnp.where(a_ex, est, 0), axis=0))
+            if spec.has("mass"):
+                out["mass"] = mass
+            if spec.has("mass_residual"):
+                out["mass_residual"] = mass - psum(jnp.sum(
+                    jnp.where(_ex(alive, st.value), st.value, 0), axis=0))
+    if spec.has("sent"):
+        out["sent"] = psum(jnp.sum(send_mask.astype(jnp.int32)))
+    if spec.has("delivered"):
+        out["delivered"] = psum(jnp.sum(processed.astype(jnp.int32)))
+    if spec.has("fired_total"):
+        out["fired_total"] = psum(jnp.sum(st.fired, dtype=_fired_acc()))
+    if spec.has("active"):
+        out["active"] = psum(jnp.sum(alive.astype(jnp.int32)))
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh", "num_rounds", "Eb", "Nb", "offsets",
+                     "halo_mode", "num_colors", "spec"),
+)
+def _run_sharded_telemetry(state, arrays, halo, perm, mean, cfg, mesh,
+                           num_rounds, Eb, Nb, offsets, halo_mode,
+                           num_colors, spec):
+    state_specs = jax.tree.map(_spec, state)
+    plan_specs = jax.tree.map(_spec, arrays)
+    halo_specs = jax.tree.map(lambda x: P(), halo)
+    perm_specs = jax.tree.map(_spec, perm)
+    S = mesh.devices.size
+
+    def body(st_s, pl_s, halo_t, pm_s, mean_r):
+        st = jax.tree.map(lambda x: x[0], st_s)
+        pl = jax.tree.map(lambda x: x[0], pl_s)
+        pm = jax.tree.map(lambda x: x[0], pm_s)
+
+        def step(s, _):
+            if cfg.needs_coloring:
+                s2, pr, sm = _local_round_fastpair(
+                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
+                    num_colors,
+                )
+            else:
+                s2, pr, sm = _local_round(
+                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
+                )
+            m = _halo_telemetry_sample(s2, pl, spec, mean_r, pr, sm, Nb)
+            return s2, m
+
+        st, series = jax.lax.scan(step, st, None, length=num_rounds)
+        # series values are post-psum identical on every shard; stack a
+        # unit shard axis so the out_spec can shard it like everything
+        # else (the host reads block 0)
+        return (jax.tree.map(lambda x: x[None], st),
+                jax.tree.map(lambda x: x[None], series))
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, plan_specs, halo_specs, perm_specs, P()),
+        out_specs=(state_specs, P(NODE_AXIS)),
+        check_vma=False,
+    )
+    return fn(state, arrays, halo, perm, mean)
+
+
+def run_rounds_sharded_telemetry(
+    state: FlowUpdatingState,
+    plan: ShardPlan,
+    cfg: RoundConfig,
+    mesh: jax.sharding.Mesh,
+    num_rounds: int,
+    spec,
+    true_mean,
+    arrays: tuple[PlanArrays, HaloTables, PermTables] | None = None,
+    halo: str = "ppermute",
+):
+    """Telemetry twin of :func:`run_rounds_sharded`: one compiled
+    shard_map'd scan whose ys are the psum-reduced global metric series.
+    Returns ``(state, {metric: (R, ...) device array})``."""
+    if not spec.enabled:
+        raise ValueError(
+            "telemetry spec is disabled; run run_rounds_sharded() instead")
+    if cfg.needs_coloring and plan.num_colors == 0:
+        raise ValueError(
+            "fast synchronous pairwise needs the edge coloring in the "
+            "plan: build it with plan_sharding(..., coloring=True)"
+        )
+    if halo not in ("ppermute", "allgather"):
+        raise ValueError(f"unknown halo mode {halo!r}")
+    if cfg.contention:
+        raise NotImplementedError(
+            "contention is single-device (per-round link flow counts are a "
+            "global reduction; fidelity runs are platform-scale)"
+        )
+    if arrays is None:
+        arrays = plan_device_arrays(plan, mesh)
+    plan_arrays, halo_tables, perm = arrays
+    mean = jnp.asarray(true_mean, state.value.dtype)
+    state, series = _run_sharded_telemetry(
+        state, plan_arrays, halo_tables, perm, mean, cfg, mesh, num_rounds,
+        plan.Eb, plan.Nb, plan.perm_offsets, halo, plan.num_colors, spec,
+    )
+    return state, {k: v[0] for k, v in series.items()}
 
 
 def gather_estimates(state: FlowUpdatingState, plan: ShardPlan) -> np.ndarray:

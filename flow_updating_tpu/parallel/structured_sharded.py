@@ -122,6 +122,28 @@ class PodShardedFatTreeKernel:
 
         self._run_jit = _run
 
+        n_nodes = topo.num_nodes
+
+        @functools.partial(
+            jax.jit, static_argnames=("num_rounds", "spec"))
+        def _run_tel(state: PodState, value, inv_depp1, deg, mean,
+                     num_rounds: int, spec):
+            st_specs = PodState(t=rep, S=self._specs, G=self._specs,
+                                avg_prev=self._specs, A_prev=self._specs)
+            shmap = shard_map(
+                functools.partial(_scan_rounds_telemetry,
+                                  num_rounds=num_rounds, spec=spec,
+                                  n=n_nodes),
+                mesh=mesh,
+                in_specs=(st_specs, self._specs, self._specs, self._specs,
+                          rep),
+                out_specs=(st_specs,
+                           jax.sharding.PartitionSpec(NODE_AXIS)),
+            )
+            return shmap(state, value, inv_depp1, deg, mean)
+
+        self._run_tel_jit = _run_tel
+
     @property
     def padded_size(self) -> int:
         """Node-slot count: no padding — sections tile exactly."""
@@ -156,6 +178,17 @@ class PodShardedFatTreeKernel:
             emit(observer_sample(state.t, np.sqrt(float(sq) / n), mx,
                                  mass, int(state.t) * n))
         return state
+
+    def run_telemetry(self, state: PodState, num_rounds: int, spec):
+        """Device-resident per-round series, psum-reduced over the pod
+        axis (each round adds a handful of scalar psums to the existing
+        (k/2,)-element one).  Returns ``(state, series)`` with the same
+        field contract as the node kernel's sampler."""
+        mean = jnp.asarray(self.topo.true_mean, self.value[0].dtype)
+        state, series = self._run_tel_jit(
+            state, self.value, self.inv_depp1, self.deg, mean,
+            num_rounds=num_rounds, spec=spec)
+        return state, {k: v[0] for k, v in series.items()}
 
     def estimates(self, state: PodState) -> np.ndarray:
         """value + G per node, original (generator) node order."""
@@ -239,3 +272,63 @@ def _scan_rounds(state: PodState, value, inv_depp1, deg,
 
     out, _ = jax.lax.scan(body, state, None, length=num_rounds)
     return out
+
+
+def _pod_telemetry_sample(s: PodState, value, spec, mean, n: int,
+                          axis_name: str) -> dict:
+    """One round's metric row across the pod-sharded sections.  The core
+    section is REPLICATED (every shard holds the same copy), so its sums
+    enter the psum on shard 0 only; max is idempotent and needs no mask.
+    In fast sync mode every node fires every round: fired_total = t * n."""
+    from flow_updating_tpu.models.rounds import _fired_acc
+
+    first = jax.lax.axis_index(axis_name) == 0
+    dt = value[0].dtype
+    zero = jnp.zeros((), dt)
+    sq = mass = vsum = mx = zero
+    last = len(value) - 1
+    for i, (v, g) in enumerate(zip(value, s.G)):
+        est = v + g
+        err = est - mean
+        lsq = jnp.sum(err * err)
+        lmass = jnp.sum(est)
+        lv = jnp.sum(v)
+        if i == last:  # core: replicated — count once
+            lsq = jnp.where(first, lsq, zero)
+            lmass = jnp.where(first, lmass, zero)
+            lv = jnp.where(first, lv, zero)
+        sq = sq + lsq
+        mass = mass + lmass
+        vsum = vsum + lv
+        mx = jnp.maximum(mx, jnp.max(jnp.abs(err)))
+    psum = lambda x: jax.lax.psum(x, axis_name)
+    out = {"t": s.t}
+    if spec.has("rmse"):
+        out["rmse"] = jnp.sqrt(psum(sq) / jnp.asarray(n, dt))
+    if spec.has("max_abs_err"):
+        out["max_abs_err"] = jax.lax.pmax(mx, axis_name)
+    if spec.has("mass") or spec.has("mass_residual"):
+        total = psum(mass)
+        if spec.has("mass"):
+            out["mass"] = total
+        if spec.has("mass_residual"):
+            out["mass_residual"] = total - psum(vsum)
+    if spec.has("fired_total"):
+        acc = _fired_acc()
+        out["fired_total"] = s.t.astype(acc) * jnp.asarray(n, acc)
+    if spec.has("active"):
+        out["active"] = jnp.asarray(n, jnp.int32)
+    return out
+
+
+def _scan_rounds_telemetry(state: PodState, value, inv_depp1, deg, mean,
+                           num_rounds: int, spec, n: int):
+    def body(s, _):
+        s2 = _round(s, value, inv_depp1, deg, NODE_AXIS)
+        return s2, _pod_telemetry_sample(s2, value, spec, mean, n,
+                                         NODE_AXIS)
+
+    out, series = jax.lax.scan(body, state, None, length=num_rounds)
+    # psum-reduced series are identical on every shard; stack a unit
+    # shard axis so the P(NODE_AXIS) out_spec shards it (host reads [0])
+    return out, jax.tree.map(lambda x: x[None], series)

@@ -105,6 +105,7 @@ class _ActorCtx:
             logger.exception("actor %r died with an exception", self.name)
         finally:
             self.done = True
+            self.des._emit("actor_exit", actor=self.name, killed=self.killed)
             self.des.maestro_evt.set()
 
     def yield_to_maestro(self):
@@ -309,20 +310,32 @@ class Engine(metaclass=_EngineMeta):
 
 
 class HostDes:
-    """Deterministic sequential-maestro DES over actor threads."""
+    """Deterministic sequential-maestro DES over actor threads.
 
-    def __init__(self, platform=None):
+    ``event_log`` (an :class:`~flow_updating_tpu.utils.eventlog.EventLog`)
+    turns on actor/comm lifecycle records — ``actor_spawn``/``actor_exit``,
+    ``comm_put``/``comm_deliver``/``comm_drop`` — the raw material of the
+    Perfetto trace exporter (:mod:`flow_updating_tpu.obs.trace`), the
+    runtime's answer to SimGrid's Paje tracing."""
+
+    def __init__(self, platform=None, event_log=None):
         self.clock = 0.0
         self.platform = platform
+        self.event_log = event_log
         self.hosts: dict = {}
         self.mailboxes: dict = {}
         self.actors: list = []
         self.heap: list = []           # (time, seq, callback)
         self.seq = itertools.count()
+        self.comm_seq = itertools.count()
         self.maestro_evt = threading.Event()
         if platform is not None:
             for name, speed in getattr(platform, "hosts", {}).items():
                 self.hosts[name] = Host(name, speed)
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.event_log is not None:
+            self.event_log.emit(kind, t=round(self.clock, 9), **fields)
 
     # -- registry -------------------------------------------------------
     def host(self, name: str) -> Host:
@@ -343,6 +356,7 @@ class HostDes:
     def spawn(self, name: str, host: Host, fn, args) -> _ActorCtx:
         ctx = _ActorCtx(self, name, host, fn, args)
         self.actors.append(ctx)
+        self._emit("actor_spawn", actor=name, host=host.name)
         ctx.thread.start()
         self._push(0.0, lambda: self._resume(ctx))
         return ctx
@@ -356,10 +370,16 @@ class HostDes:
     def schedule_delivery(self, mbox: Mailbox, send: Comm, recv: Comm,
                           payload, size: float, src: _ActorCtx) -> None:
         delay = self._net_delay(src, mbox, size)
+        cid = next(self.comm_seq)
+        self._emit("comm_put", cid=cid, mailbox=mbox.name, src=src.name,
+                   size=float(size))
 
         def deliver():
             if send.cancelled or recv.cancelled:
+                self._emit("comm_drop", cid=cid, mailbox=mbox.name)
                 return          # detached mid-flight: message dropped
+            self._emit("comm_deliver", cid=cid, mailbox=mbox.name,
+                       src=src.name)
             send._complete()
             recv._complete(payload)
 

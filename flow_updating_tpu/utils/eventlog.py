@@ -16,6 +16,40 @@ import threading
 import time
 from typing import IO
 
+#: Arrays up to this many elements are inlined as JSON lists; larger ones
+#: are summarized (shape + dtype) — an event line is a log record, not a
+#: tensor store.
+MAX_INLINE_ARRAY = 64
+
+
+def _jsonable(v):
+    """JSON-safe coercion of one emitted field.
+
+    Only 0-d / size-1 array-likes collapse to a Python scalar (``.item()``
+    on anything bigger raises); small arrays become lists, large ones a
+    shape/dtype stub.  Containers recurse so a dict-valued field (e.g. a
+    nested report) with array leaves still serializes."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    shape = getattr(v, "shape", None)
+    if shape is not None:  # numpy / jax array-like
+        size = 1
+        for d in shape:
+            size *= int(d)
+        if size <= 1:
+            return v.item() if size == 1 else []
+        if size <= MAX_INLINE_ARRAY:
+            return _jsonable(v.tolist())
+        return {"__array__": True, "shape": [int(d) for d in shape],
+                "dtype": str(getattr(v, "dtype", "?"))}
+    if hasattr(v, "item"):  # shapeless scalar wrappers
+        return v.item()
+    return v
+
 
 class EventLog:
     """Append-only JSONL sink.  Thread-safe (debug callbacks may fire from
@@ -34,11 +68,9 @@ class EventLog:
     def emit(self, kind: str, **fields) -> None:
         record = {"kind": kind, "wall_s": round(time.monotonic() - self._t0, 6)}
         for k, v in fields.items():
-            if hasattr(v, "item"):  # 0-d numpy / jax scalars
-                v = v.item()
-            record[k] = v
+            record[k] = _jsonable(v)
         with self._lock:
-            self._fh.write(json.dumps(record) + "\n")
+            self._fh.write(json.dumps(record, default=str) + "\n")
 
     def close(self) -> None:
         if self._owns:

@@ -16,6 +16,7 @@ are first-class metrics, plus the protocol invariants the paper guarantees:
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from flow_updating_tpu.models.rounds import node_estimates
 
@@ -27,9 +28,28 @@ def rmse(estimates, true_mean) -> jnp.ndarray:
 
 def mass_residual(state, topo) -> jnp.ndarray:
     """sum(current estimates) - sum(inputs); ~0 in quiescent/synchronous
-    states, transiently nonzero while messages are in flight."""
+    states, transiently nonzero while messages are in flight.
+
+    Vector payloads get the PER-FEATURE residual (shape ``(D,)``): summing
+    across features first would let a +x error in one feature hide a -x
+    error in another.  Scalar states keep the 0-d result."""
     est = node_estimates(state, topo)
-    return jnp.sum(est) - jnp.sum(state.value)
+    return jnp.sum(est, axis=0) - jnp.sum(state.value, axis=0)
+
+
+def summarize_mass_residual(res):
+    """Report form of a mass residual: a plain float for scalar payloads,
+    ``{"max": max|r_d|, "mean": mean(r_d), "per_feature": [...]}`` for a
+    ``(D,)`` per-feature residual (per-feature list included up to 64
+    features)."""
+    r = np.asarray(res)
+    if r.ndim == 0:
+        return float(r)
+    out = {"max": float(np.max(np.abs(r))) if r.size else 0.0,
+           "mean": float(np.mean(r)) if r.size else 0.0}
+    if r.size <= 64:
+        out["per_feature"] = [float(x) for x in r]
+    return out
 
 
 def antisymmetry_residual(state, topo) -> jnp.ndarray:
@@ -58,7 +78,10 @@ def convergence_report(state, topo, true_mean) -> dict:
         "t": int(state.t),
         "rmse": float(jnp.sqrt(jnp.mean(err * err))),
         "max_abs_err": float(jnp.max(jnp.abs(err))),
-        "mass_residual": float(jnp.sum(est) - jnp.sum(state.value)),
+        # per-feature for vector payloads (summary dict), float for scalar
+        "mass_residual": summarize_mass_residual(
+            jnp.sum(est, axis=0) - jnp.sum(state.value, axis=0)
+        ),
         "antisymmetry_residual": float(
             jnp.max(jnp.abs(state.flow + state.flow[topo.rev]))
         ),

@@ -1,0 +1,276 @@
+"""The obs subsystem's host-side tools: the one-watch-record contract
+across every streamed-observer emit site, vector-aware metrics, run
+manifests, and the Perfetto/Chrome trace exporter.
+
+Satellite contracts from the observability PR:
+
+* the three historical ``observer_sample`` emit sites (node-kernel
+  streamed sampler, the halo engine branch, the pod-sharded sampler) and
+  their ``obs`` replacement (``TelemetrySeries.watch_records``) produce
+  identical records on the same run — same ``t`` grid, metrics within
+  tolerance;
+* ``EventLog.emit`` no longer crashes on size>1 arrays (regression);
+* ``metrics.mass_residual`` / ``convergence_report`` report per-feature
+  mass so compensating cross-feature errors cannot hide;
+* ``obs export-trace`` turns an event log into Chrome trace JSON with
+  actor lanes and counter events.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from flow_updating_tpu.cli import main as cli_main
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.obs.telemetry import TelemetrySpec
+from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.topology.generators import fat_tree, ring
+from flow_updating_tpu.utils.eventlog import EventLog
+
+
+# ---- the one-watch-record contract (observer_sample unification) --------
+
+def _small6_topo(small6):
+    platform, deployment = small6
+    return deployment.to_topology(platform=platform, tick_interval=1.0)
+
+
+def _streamed(topo, cfg, rounds, every, **engine_kw):
+    seen = []
+    e = Engine(config=cfg, **engine_kw).set_topology(topo).build()
+    e.run_streamed(rounds, observe_every=every, emit=seen.append)
+    jax.block_until_ready(e.state)
+    jax.effects_barrier()
+    return seen
+
+
+def _assert_records_agree(a, b, atol=1e-9, what=""):
+    assert [r["t"] for r in a] == [r["t"] for r in b], what
+    for ra, rb in zip(a, b):
+        for key in ("rmse", "max_abs_err", "mass"):
+            assert ra[key] == pytest.approx(rb[key], abs=atol), \
+                f"{what}: {key} @t={ra['t']}"
+        assert ra["fired_total"] == rb["fired_total"], what
+
+
+def test_observer_sites_agree_on_small6(small6):
+    """Node-kernel streamed sampler (models/sync.py), halo engine branch
+    (engine.py), edge-kernel streamed observer, and the obs replacement
+    (telemetry watch records) all emit the same records on the same
+    small6 fast-sync run."""
+    topo = _small6_topo(small6)
+    ecfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    ncfg = RoundConfig.fast(variant="collectall", kernel="node",
+                            dtype="float64")
+
+    edge = _streamed(topo, ecfg, 40, 10)
+    node = _streamed(topo, ncfg, 40, 10)
+    halo = _streamed(topo, ecfg, 40, 10, mesh=make_mesh(2),
+                     multichip="halo")
+
+    e = Engine(config=ecfg).set_topology(topo).build()
+    series = e.run_telemetry(40, TelemetrySpec.default())
+    obs = series.watch_records(10)
+
+    # every record is the observer_sample shape
+    keys = {"t", "rmse", "max_abs_err", "mass", "fired_total"}
+    for recs in (edge, node, halo, obs):
+        assert all(set(r) == keys for r in recs)
+    _assert_records_agree(edge, obs, what="edge vs obs")
+    _assert_records_agree(node, obs, what="node vs obs")
+    _assert_records_agree(halo, obs, what="halo vs obs")
+
+
+def test_pod_observer_site_matches_node():
+    """The pod-sharded sampler (parallel/structured_sharded.py) emits the
+    same records as the node kernel's — small6 has no fat-tree structure,
+    so this site runs its own fat-tree (same contract, same grid)."""
+    topo = fat_tree(4, seed=0)
+    ncfg = RoundConfig.fast(variant="collectall", kernel="node",
+                            dtype="float64")
+    pcfg = RoundConfig.fast(variant="collectall", kernel="node",
+                            spmv="structured", dtype="float64")
+    node = _streamed(topo, ncfg, 30, 10)
+    pod = _streamed(topo, pcfg, 30, 10, mesh=make_mesh(2), multichip="pod")
+    _assert_records_agree(node, pod, atol=1e-9, what="node vs pod")
+
+
+# ---- EventLog.emit coercion (satellite regression) ----------------------
+
+def test_eventlog_size_gt1_array_does_not_crash(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with EventLog(path) as log:
+        log.emit("watch", vec=np.arange(3), mat=np.ones((2, 2)))
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["vec"] == [0, 1, 2]
+    assert rec["mat"] == [[1.0, 1.0], [1.0, 1.0]]
+
+
+def test_eventlog_scalar_and_large_array_coercion(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with EventLog(path) as log:
+        log.emit("watch",
+                 zero_d=np.float32(0.5),
+                 one_elem=np.array([7]),
+                 big=np.zeros(1000),
+                 nested={"inner": np.arange(2), "x": 1},
+                 jax_scalar=jax.numpy.asarray(3))
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["zero_d"] == 0.5 and isinstance(rec["zero_d"], float)
+    assert rec["one_elem"] == 7          # size-1 coerces to the scalar
+    assert rec["big"] == {"__array__": True, "shape": [1000],
+                          "dtype": "float64"}
+    assert rec["nested"] == {"inner": [0, 1], "x": 1}
+    assert rec["jax_scalar"] == 3
+
+
+# ---- vector-aware mass residual (satellite) -----------------------------
+
+def test_mass_residual_is_per_feature():
+    from flow_updating_tpu.models.state import init_state
+    from flow_updating_tpu.utils.metrics import (
+        convergence_report,
+        mass_residual,
+        summarize_mass_residual,
+    )
+
+    topo = ring(8, k=1, seed=0)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    values = np.zeros((topo.num_nodes, 2))
+    state = init_state(topo, cfg, values=values)
+    # craft flows whose per-feature sums are +1 and -1: summed across
+    # features the residual cancels to 0 — exactly the hiding failure
+    flow = np.zeros((topo.num_edges, 2))
+    flow[0, 0] = -1.0
+    flow[0, 1] = 1.0
+    state = state.replace(flow=jax.numpy.asarray(flow))
+    arrays = topo.device_arrays()
+
+    res = np.asarray(mass_residual(state, arrays))
+    np.testing.assert_allclose(res, [1.0, -1.0], atol=1e-12)
+    summ = summarize_mass_residual(res)
+    assert summ["max"] == pytest.approx(1.0)
+    assert summ["mean"] == pytest.approx(0.0)
+
+    rep = convergence_report(state, arrays, 0.0)
+    assert rep["mass_residual"]["max"] == pytest.approx(1.0)
+
+    # scalar payloads keep the plain float report
+    sstate = init_state(topo, cfg)
+    srep = convergence_report(sstate, arrays, topo.true_mean)
+    assert isinstance(srep["mass_residual"], float)
+
+
+# ---- run manifest + trace exporter (CLI end to end) ---------------------
+
+def _run_cli(capsys, argv):
+    rc = cli_main(argv)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def test_run_manifest_and_trace_export(tmp_path, capsys):
+    ev = str(tmp_path / "ev.jsonl")
+    rep_path = str(tmp_path / "report.json")
+    rc, rep = _run_cli(capsys, [
+        "run", "--backend", "auto", "--generator", "ring:32:2",
+        "--fire-policy", "every_round", "--rounds", "30",
+        "--telemetry", "full", "--observe-every", "10",
+        "--event-log", ev, "--report", rep_path,
+    ])
+    assert rc == 0
+    assert rep["telemetry"]["rounds"] == 30
+    assert rep["telemetry"]["final"]["t"] == 30
+
+    manifest = json.load(open(rep_path))
+    assert manifest["schema"] == "flow-updating-run-report/v1"
+    assert manifest["topology"]["num_nodes"] == 32
+    assert len(manifest["topology"]["digest"]) == 64
+    assert manifest["config"]["variant"] == "collectall"
+    assert manifest["environment"]["backend"]
+    assert manifest["timings"]["run_s"] >= 0
+    series = manifest["telemetry"]["series"]
+    assert len(series["t"]) == 30 and len(series["rmse"]) == 30
+    assert "--telemetry" in manifest["argv"]
+
+    # the event log now holds watch records from the obs path; export it
+    trace_path = str(tmp_path / "trace.json")
+    rc2, info = _run_cli(capsys, ["obs", "export-trace", ev,
+                                  "-o", trace_path])
+    assert rc2 == 0 and info["trace"] == trace_path
+    doc = json.load(open(trace_path))
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    assert {c["name"] for c in counters} >= {"rmse", "mass", "fired_total"}
+
+
+def test_trace_export_actor_lanes(tmp_path, capsys):
+    """A host-DES event log exports with one lane per actor and flow
+    arrows for the comm put->deliver pairs."""
+    from flow_updating_tpu import s4u
+
+    ev = str(tmp_path / "host.jsonl")
+    log = EventLog(ev)
+    des = s4u.HostDes(event_log=log)
+    prev = s4u._CURRENT_DES
+    s4u._CURRENT_DES = des
+    try:
+        def sender():
+            mb = s4u.Mailbox.by_name("bob")
+            for _ in range(2):
+                s4u.this_actor.sleep_for(1.0)
+                mb.put_async("ping", size=10.0)
+
+        def receiver():
+            mb = s4u.Mailbox.by_name("bob")
+            for _ in range(2):
+                mb.get_async().wait()
+
+        des.spawn("alice", des.host("h1"), sender, ())
+        des.spawn("bob", des.host("h2"), receiver, ())
+        des.run_until(5.0)
+    finally:
+        s4u._CURRENT_DES = prev
+    log.close()
+
+    rc, _ = _run_cli(capsys, ["obs", "export-trace", ev, "-o",
+                              str(tmp_path / "t.json")])
+    assert rc == 0
+    doc = json.load(open(str(tmp_path / "t.json")))
+    ev_list = doc["traceEvents"]
+    lanes = {e["args"]["name"] for e in ev_list
+             if e.get("name") == "thread_name"}
+    assert {"alice", "bob"} <= lanes
+    slices = [e for e in ev_list if e.get("ph") == "X"
+              and e.get("cat") == "actor"]
+    assert {s["name"] for s in slices} == {"alice", "bob"}
+    starts = [e for e in ev_list if e.get("ph") == "s"]
+    finishes = [e for e in ev_list if e.get("ph") == "f"]
+    assert len(starts) == 2 and len(finishes) == 2
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+
+
+def test_telemetry_cli_flag_validation(tmp_path, capsys):
+    # a metric subset without the watch-record fields fails BEFORE the
+    # run when --event-log is requested
+    with pytest.raises(SystemExit, match="needs metric"):
+        cli_main(["run", "--generator", "ring:16:2", "--fire-policy",
+                  "every_round", "--rounds", "5", "--telemetry", "active",
+                  "--event-log", str(tmp_path / "el.jsonl")])
+    # '--telemetry off' is a no-op: the --stream path stays available
+    rc, rep = _run_cli(capsys, [
+        "run", "--generator", "ring:16:2", "--fire-policy", "every_round",
+        "--telemetry", "off", "--stream", "--rounds", "20",
+        "--observe-every", "10"])
+    assert rc == 0 and "telemetry" not in rep
+
+
+def test_export_trace_missing_and_garbage_input(tmp_path, capsys):
+    with pytest.raises(SystemExit, match="no such event log"):
+        cli_main(["obs", "export-trace", str(tmp_path / "nope.jsonl")])
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n{broken\n")
+    with pytest.raises(SystemExit, match="no parseable"):
+        cli_main(["obs", "export-trace", str(bad)])

@@ -1,0 +1,178 @@
+"""Device-resident telemetry: series parity across kernels and the
+telemetry-off = plain-program guarantee.
+
+The telemetry carry rides the round ``lax.scan`` as stacked ys — per-round
+metric series computed on device, one bulk host transfer, zero
+``jax.debug.callback``s in the scan body.  These tests pin the contract:
+
+* the series agrees with the host watcher's streamed samples (same
+  formulas, same masking) on both protocol variants;
+* halo (shard_map + psum) and GSPMD runs reproduce the single-device
+  series; the pod-sharded stencil reproduces the node kernel's;
+* a disabled spec advances state bit-identically to the plain kernel;
+* vector payloads report PER-FEATURE mass series.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models import sync
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds, run_rounds_telemetry
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs.telemetry import TelemetrySeries, TelemetrySpec
+from flow_updating_tpu.parallel import sharded
+from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.topology.generators import erdos_renyi, ring
+
+
+def _series(topo, cfg, rounds, spec, values=None):
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+    state = init_state(topo, cfg, values=values)
+    out, raw = run_rounds_telemetry(state, arrays, cfg, rounds, spec,
+                                    topo.true_mean)
+    return out, TelemetrySeries({k: np.asarray(v) for k, v in raw.items()})
+
+
+@pytest.mark.parametrize("variant", ["collectall", "pairwise"])
+def test_series_matches_streamed_watcher(small6, variant):
+    """The device series re-sampled at the watcher grid equals the
+    streamed observer's host records (same t grid, same metrics) — on the
+    small6 reference platform, both protocol variants, faithful
+    dynamics."""
+    platform, deployment = small6
+    topo = deployment.to_topology(platform=platform, tick_interval=1.0)
+    cfg = RoundConfig.reference(variant=variant, dtype="float64")
+
+    streamed = []
+    e = Engine(config=cfg).set_topology(topo).build()
+    e.run_streamed(60, observe_every=10, emit=streamed.append)
+    jax.block_until_ready(e.state)
+    jax.effects_barrier()
+
+    e2 = Engine(config=cfg).set_topology(topo).build()
+    series = e2.run_telemetry(60, TelemetrySpec.default())
+    recs = series.watch_records(10)
+
+    assert [r["t"] for r in recs] == [m["t"] for m in streamed]
+    for r, m in zip(recs, streamed):
+        for key in ("rmse", "max_abs_err", "mass"):
+            assert r[key] == pytest.approx(m[key], abs=1e-9), key
+        assert r["fired_total"] == m["fired_total"]
+    # and the state advanced identically
+    np.testing.assert_array_equal(np.asarray(e.state.flow),
+                                  np.asarray(e2.state.flow))
+
+
+def test_halo_series_matches_single_device():
+    topo = erdos_renyi(48, avg_degree=4.0, seed=3)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    spec = TelemetrySpec.full()
+
+    _, single = _series(topo, cfg, 24, spec.for_kernel("edge"))
+
+    mesh = make_mesh(2)
+    plan = sharded.plan_sharding(topo, 2)
+    state = sharded.init_plan_state(plan, cfg, mesh)
+    _, halo_raw = sharded.run_rounds_sharded_telemetry(
+        state, plan, cfg, mesh, 24, spec.for_kernel("halo"), topo.true_mean)
+    halo = TelemetrySeries({k: np.asarray(v) for k, v in halo_raw.items()})
+
+    np.testing.assert_array_equal(halo.t, single.t)
+    for m in ("rmse", "max_abs_err", "mass", "mass_residual", "sent",
+              "delivered", "fired_total", "active"):
+        np.testing.assert_allclose(halo[m], single[m], atol=1e-12,
+                                   err_msg=m)
+
+
+def test_node_series_matches_edge_fast_sync():
+    """The node-collapsed recurrence reports the same convergence series
+    as the edge kernel in the mode it collapses (fast sync collect-all)."""
+    topo = erdos_renyi(64, avg_degree=5.0, seed=5)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    _, edge = _series(topo, cfg, 20, TelemetrySpec.default())
+
+    k = sync.NodeKernel(
+        topo, RoundConfig.fast(variant="collectall", kernel="node",
+                               dtype="float64"))
+    _, raw = k.run_telemetry(k.init_state(), 20,
+                             TelemetrySpec.default().for_kernel("node"))
+    node = TelemetrySeries({k2: np.asarray(v) for k2, v in raw.items()})
+    np.testing.assert_array_equal(node.t, edge.t)
+    for m in ("rmse", "max_abs_err", "mass", "mass_residual",
+              "fired_total", "active"):
+        np.testing.assert_allclose(node[m], edge[m], atol=1e-9, err_msg=m)
+
+
+def test_telemetry_off_is_the_plain_program():
+    """A disabled spec dispatches to the untouched kernel: states are
+    bit-identical and the series is empty."""
+    topo = ring(40, k=2, seed=1)
+    cfg = RoundConfig.fast(variant="collectall")
+    e1 = Engine(config=cfg).set_topology(topo).build()
+    series = e1.run_telemetry(30, TelemetrySpec.off())
+    assert len(series) == 0 and not series
+
+    arrays = topo.device_arrays()
+    plain = run_rounds(init_state(topo, cfg), arrays, cfg, 30)
+    np.testing.assert_array_equal(np.asarray(e1.state.flow),
+                                  np.asarray(plain.flow))
+    np.testing.assert_array_equal(np.asarray(e1.state.buf_valid),
+                                  np.asarray(plain.buf_valid))
+
+
+def test_no_callbacks_in_telemetry_scan():
+    """Telemetry-on stays a pure device program: no debug callbacks (or
+    any host callbacks) anywhere in the jaxpr."""
+    topo = ring(16, k=2, seed=0)
+    cfg = RoundConfig.fast(variant="collectall")
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg)
+    spec = TelemetrySpec.full()
+    jaxpr = str(jax.make_jaxpr(
+        lambda s: run_rounds_telemetry(s, arrays, cfg, 8, spec,
+                                       topo.true_mean))(state))
+    assert "callback" not in jaxpr
+
+
+def test_vector_payload_per_feature_mass_series():
+    topo = erdos_renyi(32, avg_degree=4.0, seed=9)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    rng = np.random.default_rng(0)
+    values = rng.normal(size=(topo.num_nodes, 3))
+    spec = TelemetrySpec.parse("rmse,mass,mass_residual")
+    _, series = _series(topo, cfg, 120, spec, values=values)
+    assert series["mass"].shape == (120, 3)
+    assert series["mass_residual"].shape == (120, 3)
+    # in-flight messages perturb mass transiently; as the run quiesces the
+    # PER-FEATURE residuals (not just their sum) go to zero
+    first = np.abs(series["mass_residual"][0]).max()
+    last = np.abs(series["mass_residual"][-1]).max()
+    assert last < 1e-6 < first
+    np.testing.assert_allclose(series["mass"][-1], values.sum(axis=0),
+                               atol=1e-6)
+
+
+def test_spec_parse_and_kernel_validation():
+    assert not TelemetrySpec.parse("off").enabled
+    assert TelemetrySpec.parse("default").metrics == \
+        TelemetrySpec.default().metrics
+    with pytest.raises(ValueError, match="unknown telemetry metric"):
+        TelemetrySpec.parse("rmse,bogus")
+    # explicit request for an unsupported metric raises ...
+    with pytest.raises(ValueError, match="not measurable"):
+        TelemetrySpec.parse("antisymmetry").for_kernel("node")
+    # ... while the 'full' preset silently narrows
+    full_node = TelemetrySpec.full().for_kernel("node")
+    assert "antisymmetry" not in full_node.metrics
+    assert "rmse" in full_node.metrics
+
+
+def test_engine_rejects_unsupported_kernels():
+    topo = erdos_renyi(32, avg_degree=4.0, seed=2)
+    cfg = RoundConfig.fast(variant="collectall")
+    e = Engine(config=cfg).set_topology(topo).build()
+    with pytest.raises(ValueError, match="not measurable"):
+        e.run_telemetry(4, TelemetrySpec(metrics=("bananas",)))
