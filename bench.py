@@ -414,10 +414,197 @@ def record_baseline(k, entry: dict) -> None:
         pass
 
 
+def measure_sweep(topo, batch: int, rounds: int,
+                  variant: str = "collectall",
+                  fire_policy: str = "fast") -> dict:
+    """Batched-sweep row: aggregate instance-rounds/s of ONE vmapped
+    bucket of ``batch`` same-topology instances vs running the same
+    instances sequentially through today's single-instance kernel.
+
+    Both sides use the edge kernel and get exactly one compile (the
+    bucket program, and one scan reused across the sequential runs); the
+    sequential loop's per-launch dispatch is deliberately inside the
+    timed region — amortizing it is the thing batching buys.  Per-lane
+    parity (batched lane estimates bit-equal to the sequential run's) is
+    checked on a short prefix run and reported alongside the rates.
+    """
+    import jax
+    import numpy as np
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+    from flow_updating_tpu.sweep import (
+        SweepInstance,
+        pack_instances,
+        run_bucket,
+    )
+
+    cfg = (RoundConfig.reference(variant=variant)
+           if fire_policy == "reference"
+           else RoundConfig.fast(variant=variant))
+    insts = [SweepInstance(topo=topo, seed=i) for i in range(batch)]
+    t0 = time.perf_counter()
+    buckets = pack_instances(insts, cfg)
+    pack_s = time.perf_counter() - t0
+    assert len(buckets) == 1, "same-topology instances must share a bucket"
+    bucket = buckets[0]
+
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+    seq_states = [init_state(topo, cfg, seed=i) for i in range(batch)]
+
+    def run_batched(r):
+        out = run_bucket(bucket, cfg, r)
+        jax.block_until_ready(out.flow)
+        np.asarray(out.flow[:1, :1])  # force completion through the tunnel
+        return out
+
+    def run_seq(r):
+        outs = []
+        for s in seq_states:
+            outs.append(run_rounds(s, arrays, cfg, r))
+        jax.block_until_ready(outs[-1].flow)
+        np.asarray(outs[-1].flow[:1])
+        return outs
+
+    # first calls really compile: the timing runs BEFORE any other use
+    # of these programs (a warm cache here would report ~1ms "compiles")
+    t0 = time.perf_counter()
+    run_batched(rounds)
+    compile_batched_s = time.perf_counter() - t0  # includes first compile
+    t0 = time.perf_counter()
+    run_seq(rounds)
+    compile_seq_s = time.perf_counter() - t0
+
+    # per-lane parity on a short prefix (bit-exact acceptance evidence)
+    pr = min(64, max(rounds, 1))
+    b_out = run_batched(pr)
+    s_outs = run_seq(pr)
+    parity = True
+    for lane in range(batch):
+        lane_state = jax.tree.map(lambda x: x[lane], b_out)
+        be = np.asarray(node_estimates(lane_state, jax.tree.map(
+            lambda x: x[lane], bucket.arrays)))[: topo.num_nodes]
+        se = np.asarray(node_estimates(s_outs[lane], arrays))
+        if not np.array_equal(be, se):
+            parity = False
+            break
+
+    while True:
+        run_batched(rounds)   # warm this scan length (jit keys on it)
+        run_seq(rounds)
+        t0 = time.perf_counter()
+        run_batched(rounds)
+        t_b = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run_seq(rounds)
+        t_s = time.perf_counter() - t0
+        if t_b > 0.2 or rounds >= 65536 or t_b * 4 > MAX_LAUNCH_S:
+            break
+        rounds *= 4
+    # settled scan length: 3 independent measurements each (mean +
+    # spread, as the DES baseline does — a single sample moved headline
+    # ratios between rounds before, ADVICE r2)
+    tb, ts = [t_b], [t_s]
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run_batched(rounds)
+        tb.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_seq(rounds)
+        ts.append(time.perf_counter() - t0)
+    rate_b = [batch * rounds / t for t in tb]
+    rate_s = [batch * rounds / t for t in ts]
+    agg_batched = sum(rate_b) / len(rate_b)
+    agg_seq = sum(rate_s) / len(rate_s)
+    return {
+        "batch": batch,
+        "rounds": rounds,
+        "repeats": len(tb),
+        "aggregate_instance_rounds_per_sec": agg_batched,
+        "per_instance_rounds_per_sec": agg_batched / batch,
+        "batched_spread_pct": round(
+            100 * (max(rate_b) - min(rate_b)) / agg_batched, 1),
+        "sequential_aggregate_rounds_per_sec": agg_seq,
+        "sequential_spread_pct": round(
+            100 * (max(rate_s) - min(rate_s)) / agg_seq, 1),
+        "speedup_vs_sequential": agg_batched / agg_seq,
+        "lane_parity_bitexact": parity,
+        "padded_shape": list(map(int, bucket.shape)),
+        "pack_s": pack_s,
+        "compile_batched_s": compile_batched_s,
+        "compile_seq_s": compile_seq_s,
+        "variant": variant,
+        "fire_policy": fire_policy,
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def run_sweep_bench(args) -> dict:
+    """The ``--sweep`` measurement body (child-side, settled backend)."""
+    topo = build_topology(args.fat_tree_k)
+    n, e = topo.num_nodes, topo.num_edges
+    sw = measure_sweep(topo, args.batch_size, args.rounds,
+                       variant=args.variant,
+                       fire_policy=args.fire_policy)
+
+    # the sequential comparator is this row's baseline of record.  The
+    # key ALWAYS carries the batch size: a B=32 sweep row must never
+    # displace (or be displaced by) the recorded single-instance k96/k160
+    # DES baselines, which live under the bare k keys.
+    base_key = f"{args.fat_tree_k}_sweep_b{args.batch_size}"
+    if args.variant != "collectall":
+        base_key += f"_{args.variant}"
+    if args.fire_policy == "reference":
+        base_key += "_faithful"
+    seq = {
+        "rounds_per_sec": sw["sequential_aggregate_rounds_per_sec"],
+        "ticks": sw["rounds"],
+        "repeats": sw["repeats"],
+        "spread_pct": sw["sequential_spread_pct"],
+        "note": ("sequential single-instance jax comparator "
+                 "(aggregate instance-rounds/s; not a DES measurement)"),
+    }
+    record_baseline(base_key, baseline_entry(topo, seq))
+    base_rps = recorded_baseline(base_key)
+    if base_rps is not None:
+        base_src = "recorded"
+    else:
+        base_rps, base_src = seq["rounds_per_sec"], "measured"
+
+    return {
+        "metric": (f"aggregate instance-rounds/sec, B={args.batch_size} "
+                   f"batched sweep (fat-tree k={args.fat_tree_k}, "
+                   f"{n} nodes/instance, "
+                   + ("faithful asynchronous)"
+                      if args.fire_policy == "reference"
+                      else "fast synchronous)")),
+        "value": round(sw["aggregate_instance_rounds_per_sec"], 2),
+        "unit": "instance-rounds/sec",
+        "backend": {"axon": "tpu"}.get(sw["platform"], sw["platform"]),
+        "vs_baseline": (round(sw["aggregate_instance_rounds_per_sec"]
+                              / base_rps, 2) if base_rps else None),
+        "extra": {
+            "nodes": n,
+            "directed_edges": e,
+            "sweep": {k: (round(v, 4) if isinstance(v, float) else v)
+                      for k, v in sw.items()},
+            "baseline_rounds_per_sec": (round(base_rps, 4)
+                                        if base_rps else None),
+            "baseline_source": base_src,
+            "baseline_key": _baseline_key(base_key),
+        },
+    }
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--fat-tree-k", type=int, default=160,
-                    help="fat-tree arity (160 -> ~1.056M vertices)")
+    ap.add_argument("--fat-tree-k", type=int, default=None,
+                    help="fat-tree arity (default 160 -> ~1.056M "
+                         "vertices; with --sweep, default 16 — a "
+                         "B-sized bucket of small instances is the "
+                         "batching win)")
     ap.add_argument("--rounds", type=int, default=64,
                     help="starting timed scan length (grows adaptively while "
                          "each launch stays under the tunnel execution cap; "
@@ -456,6 +643,16 @@ def parse_args(argv=None):
                          "substrate; config key gains a _vector_dD suffix "
                          "and the scalar DES baseline is divided by D, "
                          "since the reference DES would need D runs)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="batched-sweep row: pack --batch-size same-"
+                         "topology instances into ONE vmapped bucket "
+                         "(edge kernel; --kernel/--spmv/--segment are "
+                         "ignored) and report aggregate instance-"
+                         "rounds/s vs running them sequentially")
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="with --sweep: instances per bucket (the "
+                         "baseline key carries this, so sweep rows "
+                         "never shadow single-instance records)")
     ap.add_argument("--des-ticks", type=int, default=10,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--des-repeats", type=int, default=3,
@@ -474,12 +671,20 @@ def parse_args(argv=None):
                          "info, the bench result) to PATH — the same "
                          "schema as the CLI's --report")
     args = ap.parse_args(argv)
+    if args.fat_tree_k is None:
+        args.fat_tree_k = 16 if args.sweep else 160
     # reject impossible combinations HERE: in auto-backend mode a child-
     # side ValueError would first burn the ~290s TPU probe and surface as
     # a degraded-bench diagnostic instead of a usage error
-    if args.variant != "collectall" and args.kernel != "edge":
+    if args.variant != "collectall" and args.kernel != "edge" \
+            and not args.sweep:
         ap.error(f"--variant {args.variant} requires --kernel edge "
                  "(the node-collapsed kernel is collect-all only)")
+    if args.sweep and args.batch_size < 1:
+        ap.error("--batch-size must be >= 1")
+    if args.sweep and args.features:
+        ap.error("--sweep rows measure the scalar payload; combine "
+                 "--features with the single-instance bench")
     if args.features < 0:
         ap.error("--features must be >= 0 (0 = scalar payload)")
     if args.features and args.kernel == "node" and args.spmv not in (
@@ -491,6 +696,8 @@ def parse_args(argv=None):
 
 def run_bench(args) -> dict:
     """The measurement body (runs in a child with a settled backend)."""
+    if args.sweep:
+        return run_sweep_bench(args)
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 

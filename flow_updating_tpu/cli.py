@@ -488,6 +488,110 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _csv_list(text, cast, flag: str):
+    if text is None:
+        return (None,)
+    try:
+        vals = tuple(cast(v) for v in text.split(",") if v.strip())
+    except ValueError:
+        raise SystemExit(f"{flag} {text!r}: expected a comma list of "
+                         f"{cast.__name__} values")
+    if not vals:
+        raise SystemExit(f"{flag} {text!r}: no values")
+    return vals
+
+
+def cmd_sweep(args) -> int:
+    """``sweep``: batched multi-instance execution — pack a grid of
+    (topology, seed, params) instances into shape buckets, one compiled
+    vmapped program per bucket (flow_updating_tpu.sweep)."""
+    import time as _time
+
+    _select_backend(args.backend)
+    import numpy as np
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.obs.telemetry import TelemetrySpec
+    from flow_updating_tpu.sweep import grid_instances, run_sweep
+    from flow_updating_tpu.topology.generators import GENERATORS
+
+    topos = []
+    for spec in args.generator:
+        parts = spec.split(":")
+        name = parts[0]
+        if name not in GENERATORS:
+            raise SystemExit(
+                f"unknown generator {name!r}; have {sorted(GENERATORS)}")
+        try:
+            gparams = [int(p) if p.lstrip("-").isdigit() else float(p)
+                       for p in parts[1:]]
+        except ValueError:
+            raise SystemExit(f"bad generator parameters in {spec!r}")
+        topos.append((spec, GENERATORS[name](*gparams, seed=args.seed)))
+
+    drop_rates = _csv_list(args.drop_rates, float, "--drop-rates")
+    timeouts = _csv_list(args.timeouts, int, "--timeouts")
+    latency_scales = _csv_list(args.latency_scales, float,
+                               "--latency-scales")
+    maker = (RoundConfig.reference if args.fire_policy == "reference"
+             else RoundConfig.fast)
+    try:
+        cfg = maker(variant=args.variant, dtype=args.dtype)
+        ls_max = max((ls for ls in latency_scales if ls is not None),
+                     default=0.0)
+        if ls_max > 0:
+            # the ring buffer must cover the worst traced-scaled delay,
+            # or the params path's clamp flattens the latency sweep
+            import dataclasses as _dc
+
+            max_d = max(t.max_delay for _, t in topos)
+            need = int(np.ceil(max_d * ls_max))
+            cfg = _dc.replace(cfg, delay_depth=max(cfg.delay_depth, need))
+    except ValueError as err:
+        raise SystemExit(f"invalid flag combination: {err}")
+
+    seeds = [args.seed + i for i in range(max(1, args.seeds))]
+    instances = grid_instances(topos, seeds=seeds, drop_rates=drop_rates,
+                               timeouts=timeouts,
+                               latency_scales=latency_scales)
+    spec = TelemetrySpec.default()
+    if args.telemetry is not None:
+        try:
+            spec = TelemetrySpec.parse(args.telemetry)
+        except ValueError as err:
+            raise SystemExit(f"--telemetry: {err}")
+    t0 = _time.perf_counter()
+    try:
+        records, summary = run_sweep(
+            instances, cfg, args.rounds, spec=spec,
+            rmse_threshold=args.rmse_threshold,
+            max_batch=args.max_batch or None,
+            include_series=args.include_series)
+    except ValueError as err:
+        raise SystemExit(f"invalid sweep configuration: {err}")
+    wall_s = _time.perf_counter() - t0
+
+    out = dict(summary)
+    out["wall_s"] = round(wall_s, 6)
+    exits = [r["convergence"]["converged_round"] for r in records
+             if r["convergence"]["converged"]]
+    out["median_exit_round"] = (
+        int(np.median(exits)) if exits else None)
+    if args.report:
+        from flow_updating_tpu.obs.report import (
+            build_sweep_manifest,
+            write_report,
+        )
+
+        write_report(args.report, build_sweep_manifest(
+            argv=getattr(args, "_argv", None), config=cfg,
+            instances=records, summary=summary,
+            timings={"wall_s": round(wall_s, 6)}))
+        out["report_path"] = args.report
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_generate(args) -> int:
     import numpy as np
 
@@ -775,6 +879,66 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write a self-describing JSON run manifest to "
                          "PATH (as in `run --report`)")
     tr.set_defaults(fn=cmd_train)
+
+    sw = sub.add_parser(
+        "sweep",
+        help="batched multi-instance parameter sweep: pack a grid of "
+             "(topology, seed, params) instances into shape buckets and "
+             "run each bucket as ONE vmapped compiled program "
+             "(docs/SWEEP.md)")
+    sw.add_argument("--backend", default="auto",
+                    choices=("auto", "cpu", "jax_tpu"),
+                    help="execution backend (as in `run`)")
+    sw.add_argument("--generator", action="append", required=True,
+                    metavar="SPEC",
+                    help="synthetic topology, e.g. 'ring:64:2' or "
+                         "'erdos_renyi:1000' — repeat the flag to sweep "
+                         "several topologies")
+    sw.add_argument("--seed", type=int, default=0,
+                    help="base seed (topology values + first instance "
+                         "seed)")
+    sw.add_argument("--seeds", type=int, default=1,
+                    help="instance seeds per grid point: seed, seed+1, "
+                         "... (independent message-loss realizations)")
+    sw.add_argument("--drop-rates", metavar="CSV",
+                    help="comma list of per-message loss probabilities "
+                         "(traced per-instance — the whole list shares "
+                         "one compile)")
+    sw.add_argument("--timeouts", metavar="CSV",
+                    help="comma list of timeout values (traced "
+                         "per-instance)")
+    sw.add_argument("--latency-scales", metavar="CSV",
+                    help="comma list of traced delay multipliers "
+                         "(scales each topology's static per-edge "
+                         "delays; delay_depth is sized to cover the "
+                         "largest)")
+    sw.add_argument("--variant", default="collectall",
+                    choices=("collectall", "pairwise"))
+    sw.add_argument("--fire-policy", default="reference",
+                    choices=("reference", "every_round"))
+    sw.add_argument("--dtype", default="float32",
+                    choices=("float32", "float64"))
+    sw.add_argument("--rounds", type=int, default=200,
+                    help="rounds per instance (every lane runs the full "
+                         "count; converged lanes record their effective "
+                         "early-exit round)")
+    sw.add_argument("--rmse-threshold", type=float, default=1e-6,
+                    help="per-instance convergence threshold for the "
+                         "early-exit round")
+    sw.add_argument("--max-batch", type=int, default=0,
+                    help="cap lanes per bucket (0 = unbounded; same-"
+                         "shape chunks still share one compile)")
+    sw.add_argument("--telemetry", nargs="?", const="default",
+                    metavar="METRICS",
+                    help="per-instance metric selection (as in `run`; "
+                         "must include rmse)")
+    sw.add_argument("--include-series", action="store_true",
+                    help="embed each instance's full per-round series "
+                         "in the manifest records (large)")
+    sw.add_argument("--report", metavar="PATH",
+                    help="write the flow-updating-sweep-report/v1 "
+                         "manifest (one record per instance) to PATH")
+    sw.set_defaults(fn=cmd_sweep)
 
     gen = sub.add_parser("generate", help="topology summary")
     _add_common(gen)

@@ -5,6 +5,13 @@ hashable config is passed as a jit-static argument and selects the mode at
 trace time (all branches resolve statically — no data-dependent Python
 control flow reaches XLA).
 
+The purely *numeric* knobs (``drop_rate``, ``timeout``, plus latency /
+contention scaling) also exist in traced form as :class:`RoundParams`:
+passing a params pytree to the round kernel moves them out of the jit
+cache key, so one compile serves a whole parameter grid (the batched
+sweep engine, :mod:`flow_updating_tpu.sweep`).  Without params the
+static fields govern, program-identically to before the split.
+
 Mapping to the reference's knobs:
 
 * ``variant``           — which script: ``flowupdating-collectall.py`` vs
@@ -39,8 +46,87 @@ import dataclasses
 
 import jax.numpy as jnp
 
+from flow_updating_tpu.utils import struct
+
 COLLECTALL = "collectall"
 PAIRWISE = "pairwise"
+
+
+@struct.dataclass
+class RoundParams:
+    """The *traced* half of the static/traced config split.
+
+    :class:`RoundConfig` stays the jit-static program selector (every
+    field there resolves Python control flow at trace time), but four of
+    its knobs are purely numeric — they parameterize arithmetic, not
+    program structure.  Factoring them into this pytree lets ONE compiled
+    program serve a whole ``drop_rate``/``timeout`` grid (the sweep
+    engine's one-compile parameter grids, and the per-instance lanes of a
+    vmapped bucket).  Passing ``params=None`` (the default everywhere)
+    keeps the historical static path: the compiled program is unchanged,
+    and a drop-rate grid recompiles per point exactly as before.
+
+    Semantics under ``params``:
+
+    * ``drop_rate``  — per-message loss probability.  The traced path
+      always draws the Bernoulli keep mask (it cannot branch on a traced
+      probability), so the PRNG key advances even at 0.0; ledger values
+      are bit-identical to the static path at drop 0 (a keep-all mask
+      masks nothing).
+    * ``timeout``    — collect-all tick timeout / pairwise staleness
+      rounds (int32).
+    * ``latency_scale`` — multiplies the topology's static per-edge delay
+      and rounds to whole rounds, clamped to ``[1, delay_depth]`` (the
+      traced analogue of rebuilding the topology with a different
+      ``latency_scale``; 1.0 = the topology's own delays, untouched).
+    * ``contention_scale`` — under ``cfg.contention``, scales every
+      link's per-message serialization cost (a traced load/capacity
+      knob for contention sweeps; 1.0 = the platform's own capacities).
+    """
+
+    drop_rate: jnp.ndarray | None  # () float32, or None = statically no
+    #                                drop (skips the per-round Bernoulli
+    #                                draw entirely — None is pytree
+    #                                STRUCTURE, so it is a compile-time
+    #                                fact shared by a whole bucket)
+    timeout: jnp.ndarray           # () int32
+    latency_scale: jnp.ndarray     # () float32
+    contention_scale: jnp.ndarray  # () float32
+
+    @classmethod
+    def from_config(cls, cfg: "RoundConfig", drop_rate=None, timeout=None,
+                    latency_scale=None,
+                    contention_scale=None) -> "RoundParams":
+        """Params mirroring ``cfg``'s numeric knobs; any keyword
+        overrides its field (the grid fan-out's per-point constructor)."""
+        return cls(
+            drop_rate=jnp.asarray(
+                cfg.drop_rate if drop_rate is None else drop_rate,
+                jnp.float32),
+            timeout=jnp.asarray(
+                cfg.timeout if timeout is None else timeout, jnp.int32),
+            latency_scale=jnp.asarray(
+                1.0 if latency_scale is None else latency_scale,
+                jnp.float32),
+            contention_scale=jnp.asarray(
+                1.0 if contention_scale is None else contention_scale,
+                jnp.float32),
+        )
+
+    def without_drop(self) -> "RoundParams":
+        """Drop-free variant: the Bernoulli mask is omitted from the
+        compiled program (valid only when the drop rate is 0)."""
+        return self.replace(drop_rate=None)
+
+    def describe(self) -> dict:
+        """Host-side JSON form (sweep manifests record one per instance)."""
+        return {
+            "drop_rate": (0.0 if self.drop_rate is None
+                          else float(self.drop_rate)),
+            "timeout": int(self.timeout),
+            "latency_scale": float(self.latency_scale),
+            "contention_scale": float(self.contention_scale),
+        }
 
 
 @dataclasses.dataclass(frozen=True)

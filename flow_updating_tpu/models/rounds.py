@@ -34,13 +34,21 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from flow_updating_tpu.models.config import COLLECTALL, PAIRWISE, RoundConfig
+from flow_updating_tpu.models.config import (
+    COLLECTALL,
+    RoundConfig,
+    RoundParams,
+)
 from flow_updating_tpu.models.state import FlowUpdatingState, _ex, _feat
 from flow_updating_tpu.ops.segment import (
     ell_segment_all,
     ell_segment_max,
     ell_segment_min,
     ell_segment_sum,
+    rows_segment_all,
+    rows_segment_max,
+    rows_segment_min,
+    rows_segment_sum,
     segment_all,
     segment_max,
     segment_min,
@@ -52,6 +60,9 @@ _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
 # Per-node reductions over out-edges dispatch on the topology arrays:
+# * topo.sweep_edge_rows (the batched sweep layout) unrolls a uniform-
+#   width out-edge row matrix in edge order — scatter-free AND bit-exact
+#   with the sorted scatter-add (ops/segment.rows_segment_*);
 # * cfg.segment_impl='benes' (device_arrays(segment_benes=True)) routes
 #   every reduction through the permutation-network segmented scan
 #   (ops/seg_benes.py) — no gather, no scatter, the TPU path;
@@ -61,6 +72,8 @@ _I32_MAX = jnp.iinfo(jnp.int32).max
 # Node->edge broadcasts (`x[src]`) follow the same dispatch via _bcast.
 
 def _seg_sum(x, topo, N):
+    if topo.sweep_edge_rows is not None:
+        return rows_segment_sum(x, topo.sweep_edge_rows)
     if topo.seg_plan is not None:
         from flow_updating_tpu.ops.seg_benes import seg_reduce
 
@@ -72,6 +85,8 @@ def _seg_sum(x, topo, N):
 
 
 def _seg_min(x, topo, N, identity):
+    if topo.sweep_edge_rows is not None:
+        return rows_segment_min(x, topo.sweep_edge_rows, identity)
     if topo.seg_plan is not None:
         from flow_updating_tpu.ops.seg_benes import seg_reduce
 
@@ -83,6 +98,8 @@ def _seg_min(x, topo, N, identity):
 
 
 def _seg_max(x, topo, N, identity):
+    if topo.sweep_edge_rows is not None:
+        return rows_segment_max(x, topo.sweep_edge_rows, identity)
     if topo.seg_plan is not None:
         from flow_updating_tpu.ops.seg_benes import seg_reduce
 
@@ -94,6 +111,8 @@ def _seg_max(x, topo, N, identity):
 
 
 def _seg_all(pred, topo, N):
+    if topo.sweep_edge_rows is not None:
+        return rows_segment_all(pred, topo.sweep_edge_rows, topo.out_deg)
     if topo.seg_plan is not None:
         from flow_updating_tpu.ops.seg_benes import seg_reduce
 
@@ -229,9 +248,15 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
     return state, process
 
 
-def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
+def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
+              params: RoundParams | None = None):
     """Tick + averaging + ledger update; outgoing messages are *computed*
     but not yet delivered.
+
+    ``params`` (optional) supplies the TRACED numeric knobs — timeout and
+    drop rate — in place of ``cfg``'s static fields, so one compiled
+    program serves a parameter grid (see :class:`RoundParams`).  ``None``
+    keeps the exact historical static program.
 
     Returns ``(state, msg_est, send_mask)`` where the message payload for
     edge ``e`` is ``(state.flow[e], msg_est[e])`` — the sender's ledger after
@@ -247,6 +272,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     t = state.t
     src = topo.src
 
+    timeout = cfg.timeout if params is None else params.timeout
     ticks = state.ticks
     stamp = state.stamp
     recv = state.recv
@@ -286,7 +312,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
         else:
             if all_heard is None:
                 all_heard = _seg_all(recv, topo, N)
-            fire_n = (all_heard | (ticks >= cfg.timeout)) & state.alive
+            fire_n = (all_heard | (ticks >= timeout)) & state.alive
         # avg over self + ALL neighbors' last-known estimates (unheard
         # neighbors contribute their defaultdict 0.0, as in the reference,
         # ``collectall.py:109-113``).
@@ -328,8 +354,12 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
                     "topology arrays with device_arrays(coloring=True)"
                 )
             half = jnp.asarray(0.5, dt)
+            # batched sweep arrays carry the color count as a traced
+            # scalar (static num_colors would split the vmap treedef)
+            n_colors = (topo.num_colors if topo.num_colors_arr is None
+                        else topo.num_colors_arr)
             matched = (
-                (topo.edge_color == t % topo.num_colors)
+                (topo.edge_color == t % n_colors)
                 & state.alive[src]
                 & state.alive[topo.dst]
                 # direct (message-free) exchange: a failed link in either
@@ -357,7 +387,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
         else:
             # Faithful message-based dynamics.
-            stale = stamp < (t - cfg.timeout)
+            stale = stamp < (t - timeout)
             fire_e = (trigger | stale) & _bcast(state.alive, topo)
             # Sequential-within-tick semantics: each firing out-edge applies
             # x -> (x + est)/2 to the node's running estimate, in edge order
@@ -401,7 +431,16 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
     send_mask = send_mask & state.edge_ok
 
     key = state.key
-    if cfg.drop_rate > 0.0:
+    if params is not None and params.drop_rate is not None:
+        # traced drop probability: the keep mask is always drawn (no
+        # branching on traced values), so the key advances even at 0.0 —
+        # where the mask keeps everything and ledgers stay bit-identical
+        # to the static path.  params.drop_rate=None omits the draw
+        # statically (None is pytree structure, not a traced value).
+        key, sub = jax.random.split(key)
+        keep = jax.random.bernoulli(sub, 1.0 - params.drop_rate, (E,))
+        send_mask = send_mask & keep
+    elif params is None and cfg.drop_rate > 0.0:
         key, sub = jax.random.split(key)
         keep = jax.random.bernoulli(sub, 1.0 - cfg.drop_rate, (E,))
         send_mask = send_mask & keep
@@ -420,7 +459,8 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger):
 
 
 def edge_delays(topo, cfg: RoundConfig, send_mask,
-                inflight=None) -> jnp.ndarray:
+                inflight=None,
+                params: RoundParams | None = None) -> jnp.ndarray:
     """Per-edge delivery delay for this round's sends.
 
     ``inflight`` ((E,) int — messages still in the ring buffer, i.e.
@@ -443,7 +483,15 @@ def edge_delays(topo, cfg: RoundConfig, send_mask,
     SHARED links, 1 on FATPIPE.
     """
     if not cfg.contention:
-        return topo.delay
+        if params is None:
+            return topo.delay
+        # traced latency scaling: the per-edge static delay stretched by
+        # params.latency_scale and re-quantized to whole rounds (1.0
+        # reproduces topo.delay exactly: rint(d * 1.0) == d)
+        scaled = jnp.rint(
+            topo.delay.astype(jnp.float32) * params.latency_scale
+        ).astype(jnp.int32)
+        return jnp.clip(scaled, 1, cfg.delay_depth)
     if topo.edge_links is None:
         raise ValueError(
             "cfg.contention needs a topology with a link model (platform-"
@@ -459,14 +507,22 @@ def edge_delays(topo, cfg: RoundConfig, send_mask,
     flows = standing.at[topo.edge_links.reshape(-1)].add(
         jnp.repeat(counts, K)
     )
+    # traced scaling knobs (RoundParams): latency_scale stretches route
+    # latencies, contention_scale every link's per-message serialization
+    # cost; both 1.0 by construction when params is None
+    lat_rounds = topo.lat_rounds
+    link_ser = topo.link_ser_rounds
+    if params is not None:
+        lat_rounds = lat_rounds * params.latency_scale
+        link_ser = link_ser * params.contention_scale
     if cfg.contention_iters == 0:
         # historical quasi-static model: every send pays its LOCAL
         # bottleneck share (equal split at its most-loaded link, no
         # redistribution) — bit-matched by the C++ same-model oracle
         load = jnp.where(topo.link_shared, jnp.maximum(flows, 1), 1)
-        ser = load.astype(topo.link_ser_rounds.dtype) * topo.link_ser_rounds
+        ser = load.astype(link_ser.dtype) * link_ser
         worst = jnp.max(ser[topo.edge_links], axis=1)  # pad slot adds 0
-        dyn = jnp.rint(topo.lat_rounds + worst).astype(jnp.int32)
+        dyn = jnp.rint(lat_rounds + worst).astype(jnp.int32)
         return jnp.clip(dyn, 1, cfg.delay_depth)
 
     # progressive-filling max-min (cfg.contention_iters unrolled rounds of
@@ -477,7 +533,7 @@ def edge_delays(topo, cfg: RoundConfig, send_mask,
     # leftovers fall back to their local fair share).  Validated against
     # the dynamic native oracle in tests/test_lmm.py.
     INF = jnp.float32(jnp.inf)
-    ser0 = topo.link_ser_rounds.astype(jnp.float32)
+    ser0 = link_ser.astype(jnp.float32)
     constraining = topo.link_shared & (ser0 > 0)
     cap_rem = jnp.where(constraining, 1.0 / jnp.maximum(ser0, 1e-30), INF)
     nflow = flows.astype(jnp.float32)
@@ -511,12 +567,13 @@ def edge_delays(topo, cfg: RoundConfig, send_mask,
     rate = jnp.where(fixed, rate, share)
     transfer = jnp.where(jnp.isfinite(rate) & (rate > 0),
                          1.0 / jnp.maximum(rate, 1e-30), 0.0)
-    dyn = jnp.rint(topo.lat_rounds + transfer).astype(jnp.int32)
+    dyn = jnp.rint(lat_rounds + transfer).astype(jnp.int32)
     return jnp.clip(dyn, 1, cfg.delay_depth)
 
 
 def send_messages(
-    state: FlowUpdatingState, topo, cfg: RoundConfig, msg_est, send_mask
+    state: FlowUpdatingState, topo, cfg: RoundConfig, msg_est, send_mask,
+    params: RoundParams | None = None,
 ) -> FlowUpdatingState:
     """Single-device delivery into the receiver edge's ring-buffer slot at
     ``(t + delay) % D``.
@@ -535,6 +592,13 @@ def send_messages(
     E = topo.src.shape[0]
     t = state.t
     D = cfg.delay_depth
+    if params is not None and cfg.delivery not in ("gather", "scatter"):
+        # the benes delivery bakes delay[rev] in as a static lane; a
+        # traced latency_scale would silently not apply to it
+        raise ValueError(
+            "traced RoundParams support delivery='gather'|'scatter'; "
+            f"delivery={cfg.delivery!r} bakes static delays into the "
+            "permutation network")
     # deliver_phase already cleared this round's arrival slots, so the
     # ring's remaining valid slots are exactly the still-in-flight sends.
     # Column r of the ring holds messages sent along edge rev[r] (the
@@ -544,7 +608,8 @@ def send_messages(
     # occupancy.
     inflight = (state.buf_valid.sum(0, dtype=jnp.int32)[topo.rev]
                 if cfg.contention_backlog else None)
-    delay = edge_delays(topo, cfg, send_mask, inflight=inflight)
+    delay = edge_delays(topo, cfg, send_mask, inflight=inflight,
+                        params=params)
     if cfg.delivery in ("gather", "benes", "benes_fused"):
         if cfg.delivery != "gather":
             # same receiver-pull formulation, but the rev permutation runs
@@ -612,39 +677,52 @@ def send_messages(
 
 
 def fire_phase(
-    state: FlowUpdatingState, topo, cfg: RoundConfig, trigger
+    state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
+    params: RoundParams | None = None,
 ) -> FlowUpdatingState:
     """Tick, averaging, ledger update and message send (one device)."""
-    state, msg_est, send_mask = fire_core(state, topo, cfg, trigger)
-    return send_messages(state, topo, cfg, msg_est, send_mask)
+    state, msg_est, send_mask = fire_core(state, topo, cfg, trigger,
+                                          params=params)
+    return send_messages(state, topo, cfg, msg_est, send_mask,
+                         params=params)
 
 
-def round_step_aux(state: FlowUpdatingState, topo, cfg: RoundConfig):
+def round_step_aux(state: FlowUpdatingState, topo, cfg: RoundConfig,
+                   params: RoundParams | None = None):
     """One full round, also surfacing the per-edge ``processed`` (messages
     drained this round) and ``send_mask`` (messages fired) masks — the
     telemetry counters.  :func:`round_step` discards them; XLA dead-code
     eliminates the unused outputs, so the plain path is unchanged."""
     state, processed = deliver_phase(state, topo, cfg)
-    state, msg_est, send_mask = fire_core(state, topo, cfg, processed)
-    state = send_messages(state, topo, cfg, msg_est, send_mask)
+    state, msg_est, send_mask = fire_core(state, topo, cfg, processed,
+                                          params=params)
+    state = send_messages(state, topo, cfg, msg_est, send_mask,
+                          params=params)
     return state, processed, send_mask
 
 
 def round_step(
-    state: FlowUpdatingState, topo, cfg: RoundConfig
+    state: FlowUpdatingState, topo, cfg: RoundConfig,
+    params: RoundParams | None = None,
 ) -> FlowUpdatingState:
     """One full gossip round (= one simulated second of the reference)."""
-    return round_step_aux(state, topo, cfg)[0]
+    return round_step_aux(state, topo, cfg, params=params)[0]
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
 def run_rounds(
-    state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int
+    state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int,
+    params: RoundParams | None = None,
 ) -> FlowUpdatingState:
-    """Run ``num_rounds`` rounds as one compiled ``lax.scan``."""
+    """Run ``num_rounds`` rounds as one compiled ``lax.scan``.
+
+    ``params`` moves the numeric knobs (drop rate, timeout, latency /
+    contention scaling) into traced inputs: calls differing only in
+    params VALUES hit one jit cache entry.  ``None`` (default) is the
+    historical static path — program-identical to before the split."""
 
     def body(s, _):
-        return round_step(s, topo, cfg), None
+        return round_step(s, topo, cfg, params=params), None
 
     state, _ = jax.lax.scan(body, state, None, length=num_rounds)
     return state
@@ -706,7 +784,7 @@ def telemetry_sample(state, topo, spec, mean, processed, send_mask) -> dict:
 )
 def run_rounds_telemetry(
     state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int,
-    spec, true_mean,
+    spec, true_mean, params: RoundParams | None = None,
 ):
     """Run ``num_rounds`` rounds as one compiled scan, accumulating the
     ``spec``-selected per-round metric series ON DEVICE (scan ``ys``) —
@@ -726,7 +804,8 @@ def run_rounds_telemetry(
     mean = jnp.asarray(true_mean, state.value.dtype)
 
     def body(s, _):
-        s, processed, send_mask = round_step_aux(s, topo, cfg)
+        s, processed, send_mask = round_step_aux(s, topo, cfg,
+                                                 params=params)
         return s, telemetry_sample(s, topo, spec, mean, processed,
                                    send_mask)
 

@@ -43,7 +43,7 @@ import numpy as np
 
 from flow_updating_tpu.utils import struct
 
-from flow_updating_tpu.models.config import COLLECTALL, RoundConfig
+from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.state import _ex, _feat, check_payload_values
 from flow_updating_tpu.topology.graph import Topology
 

@@ -11,6 +11,12 @@ telemetry was enabled — the full per-round metric series.
 
 ``run --report PATH``, ``train --report PATH`` and ``bench.py --report
 PATH`` all write this schema (``flow-updating-run-report/v1``).
+
+Batched sweeps (``sweep --report PATH``) write the sibling
+``flow-updating-sweep-report/v1``: same environment/config/argv binding,
+but ``instances`` replaces the single run report — one record per packed
+instance (topology fingerprint, seed, resolved per-instance params,
+convergence with the effective early-exit round), in grid fan-out order.
 """
 
 from __future__ import annotations
@@ -21,6 +27,7 @@ import sys
 import time
 
 SCHEMA = "flow-updating-run-report/v1"
+SWEEP_SCHEMA = "flow-updating-sweep-report/v1"
 
 
 def environment_info() -> dict:
@@ -100,6 +107,32 @@ def build_manifest(*, argv=None, config=None, topo=None, report=None,
             "rounds": len(telemetry),
             "series": telemetry.to_jsonable(),
         }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_sweep_manifest(*, argv=None, config=None, instances=None,
+                         summary=None, timings=None,
+                         extra=None) -> dict:
+    """Assemble the sweep-shaped v1 manifest: the run manifest's
+    environment/config/argv binding with one record per packed instance
+    (``instances``: each carrying its own topology fingerprint, params
+    and convergence) plus the sweep-level ``summary`` (bucket shapes,
+    compile count, aggregate timings)."""
+    manifest = {
+        "schema": SWEEP_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "environment": environment_info(),
+        "summary": dict(summary) if summary else None,
+        "timings": dict(timings) if timings else None,
+        "instances": list(instances) if instances is not None else [],
+    }
     if extra:
         manifest.update(extra)
     return manifest

@@ -6,33 +6,77 @@ vector: summing a node's incoming flow ledger, checking whether all
 neighbors have reported, picking which pending message a node drains this
 round.  Edges are sorted by ``src`` at topology build time so every wrapper
 passes ``indices_are_sorted=True``.
+
+**Batching rule.**  ``jax.vmap`` of a segment reduction lowers to a
+*batched* scatter, which XLA:CPU executes as a serialized per-element
+update loop — measured ~100x slower than one lane run B times, which
+would sink the sweep engine's whole premise.  Each wrapper therefore
+carries a ``jax.custom_batching.custom_vmap`` rule that flattens the
+batch instead: lane ``b``'s segment ids are offset by ``b *
+num_segments`` and the reduction runs ONCE over the flattened ``(B*E,)``
+axis with ``B*num_segments`` segments.  Lane-major offsets keep the ids
+globally sorted (each lane's ids are sorted by construction), so the
+flattened form takes the same fast sorted-segment lowering as the
+single-instance path — bit-identical results, one scatter for the whole
+bucket.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 
 
+def _flat_segment_rule(op):
+    """custom_vmap rule factory: run ``op`` once over the lane-flattened
+    axis with per-lane segment-id offsets (see module docstring)."""
+
+    def rule(num_segments, axis_size, in_batched, data, segment_ids):
+        data_b, ids_b = in_batched
+        B = axis_size
+        if not ids_b:
+            segment_ids = jnp.broadcast_to(
+                segment_ids, (B,) + segment_ids.shape)
+        if not data_b:
+            data = jnp.broadcast_to(data, (B,) + data.shape)
+        offs = jnp.arange(B, dtype=segment_ids.dtype) * num_segments
+        flat_ids = (segment_ids + offs[:, None]).reshape(-1)
+        flat = data.reshape((-1,) + data.shape[2:])
+        out = op(flat, flat_ids, num_segments=B * num_segments,
+                 indices_are_sorted=True)
+        return out.reshape((B, num_segments) + out.shape[1:]), True
+
+    return rule
+
+
+@functools.lru_cache(maxsize=None)
+def _segment_op(name: str, num_segments: int):
+    """One custom_vmap-wrapped reduction per (op, num_segments) — the
+    segment count must stay a Python int (static shape), so it is bound
+    by closure rather than passed through the vmapped call."""
+    op = getattr(jax.ops, f"segment_{name}")
+
+    @jax.custom_batching.custom_vmap
+    def f(data, segment_ids):
+        return op(data, segment_ids, num_segments=num_segments,
+                  indices_are_sorted=True)
+
+    f.def_vmap(functools.partial(_flat_segment_rule(op), num_segments))
+    return f
+
+
 def segment_sum(data, segment_ids, num_segments: int):
-    return jax.ops.segment_sum(
-        data, segment_ids, num_segments=num_segments,
-        indices_are_sorted=True,
-    )
+    return _segment_op("sum", num_segments)(data, segment_ids)
 
 
 def segment_max(data, segment_ids, num_segments: int):
-    return jax.ops.segment_max(
-        data, segment_ids, num_segments=num_segments,
-        indices_are_sorted=True,
-    )
+    return _segment_op("max", num_segments)(data, segment_ids)
 
 
 def segment_min(data, segment_ids, num_segments: int):
-    return jax.ops.segment_min(
-        data, segment_ids, num_segments=num_segments,
-        indices_are_sorted=True,
-    )
+    return _segment_op("min", num_segments)(data, segment_ids)
 
 
 def segment_all(pred, segment_ids, num_segments: int):
@@ -43,6 +87,49 @@ def segment_all(pred, segment_ids, num_segments: int):
     mins = segment_min(pred.astype(jnp.int32), segment_ids, num_segments)
     counts = segment_sum(jnp.ones_like(pred, jnp.int32), segment_ids, num_segments)
     return (mins == 1) & (counts > 0)
+
+
+# ---- scatter-free uniform-width row reductions (the sweep layout) --------
+#
+# The batched sweep cannot afford scatters at all (XLA:CPU executes them
+# as serial per-element loops — the flat custom_vmap rule above bounds
+# the damage but the loop remains).  Its packed topologies instead carry
+# ONE dense (N, W) out-edge index matrix per lane (W = the bucket's max
+# degree, pad slot = E), and reductions unroll the W columns
+# *sequentially*: the accumulator starts at the op's initial value and
+# folds edge values in CSR edge order — the exact addition order of the
+# sorted scatter-add, so float sums stay BIT-IDENTICAL to the
+# single-instance segment path while lowering to W gathers + W
+# elementwise ops (vector-friendly, batches cleanly under vmap).
+
+
+def _rows_fold(values, rows, init, combine):
+    feat = values.shape[1:]
+    xp = jnp.concatenate(
+        [values, jnp.full((1,) + feat, init, dtype=values.dtype)])
+    acc = jnp.full((rows.shape[0],) + feat, init, dtype=values.dtype)
+    for w in range(rows.shape[1]):
+        acc = combine(acc, xp[rows[:, w]])
+    return acc
+
+
+def rows_segment_sum(values, rows):
+    return _rows_fold(values, rows, 0, jnp.add)
+
+
+def rows_segment_min(values, rows, identity):
+    return _rows_fold(values, rows, identity, jnp.minimum)
+
+
+def rows_segment_max(values, rows, identity):
+    return _rows_fold(values, rows, identity, jnp.maximum)
+
+
+def rows_segment_all(pred, rows, out_deg):
+    """AND over each row's valid slots; empty rows (isolated nodes and
+    ghost-free pad rows) are False — matching :func:`segment_all`."""
+    mins = rows_segment_min(pred.astype(jnp.int32), rows, 1)
+    return (mins == 1) & (out_deg > 0)
 
 
 # ---- scatter-free variants over the degree-bucketed out-edge ELL layout ---

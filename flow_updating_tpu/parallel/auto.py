@@ -19,10 +19,8 @@ metrics are unaffected.  Metrics must slice ``[:n_real]``.
 from __future__ import annotations
 
 import dataclasses
-import math
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flow_updating_tpu.models.config import RoundConfig

@@ -1,0 +1,46 @@
+"""Batched sweep engine: many (topology, seed, params) instances per XLA
+program.
+
+The reference paper's evaluation is a grid — topologies x loss rates x
+timeouts — but one instance per program leaves dense hardware idle on
+small graphs and recompiles per grid point.  This subsystem packs B
+instances into ONE compiled computation:
+
+* :mod:`flow_updating_tpu.sweep.pack` — shape-bucketed padding: instances
+  are padded to a shared ``(N_pad, E_pad)`` with mass-neutral ghost nodes
+  and masked self-loop edges, then stacked into batched device arrays;
+* :mod:`flow_updating_tpu.sweep.batch` — vmapped execution: the edge
+  kernel and its telemetry sampler run under ``jax.vmap`` over the batch
+  axis, with traced per-instance :class:`~flow_updating_tpu.models.config.
+  RoundParams` so one compile serves a whole parameter grid, plus
+  per-instance convergence tracking (converged lanes keep ticking but
+  report their effective early-exit round);
+* :mod:`flow_updating_tpu.sweep.runner` — grid fan-out, bucket
+  orchestration and the ``flow-updating-sweep-report/v1`` manifest (one
+  record per instance).
+
+See docs/SWEEP.md for packing rules, the static-vs-traced config table
+and CLI examples (``flow-updating-tpu sweep ...``).
+"""
+
+from flow_updating_tpu.sweep.pack import (
+    SweepBucket,
+    SweepInstance,
+    bucket_shape,
+    pack_instances,
+    pad_topology_to,
+)
+from flow_updating_tpu.sweep.batch import run_bucket, run_bucket_telemetry
+from flow_updating_tpu.sweep.runner import grid_instances, run_sweep
+
+__all__ = [
+    "SweepBucket",
+    "SweepInstance",
+    "bucket_shape",
+    "pack_instances",
+    "pad_topology_to",
+    "run_bucket",
+    "run_bucket_telemetry",
+    "grid_instances",
+    "run_sweep",
+]

@@ -1,0 +1,104 @@
+"""Vmapped bucket execution: one compile, B instances per round.
+
+The edge kernel (:func:`~flow_updating_tpu.models.rounds.round_step_aux`)
+and its telemetry sampler run unchanged under ``jax.vmap`` over the
+bucket's leading batch axis — state, topology arrays AND the traced
+:class:`~flow_updating_tpu.models.config.RoundParams` all carry one lane
+per instance, so a single XLA program serves every (topology, seed,
+drop_rate, timeout, ...) combination in the bucket.  ``cfg`` stays the
+jit-static program selector shared by the whole bucket.
+
+Convergence is tracked per lane *inside* the scan: a lane whose
+alive-masked RMSE first drops to ``rmse_threshold`` records that round as
+its effective early-exit round and keeps ticking (lock-step lanes cannot
+exit individually — but the sweep report and the bench's effective-rounds
+accounting use the recorded exit, so a converged lane stops counting).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flow_updating_tpu.models.rounds import (
+    round_step_aux,
+    telemetry_sample,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds"))
+def _run_bucket(states, arrays, params, cfg, num_rounds):
+    step = jax.vmap(
+        lambda s, a, p: round_step_aux(s, a, cfg, params=p)[0])
+
+    def body(ss, _):
+        return step(ss, arrays, params), None
+
+    states, _ = jax.lax.scan(body, states, None, length=num_rounds)
+    return states
+
+
+def run_bucket(bucket, cfg, num_rounds: int):
+    """Advance every lane of ``bucket`` by ``num_rounds`` rounds as ONE
+    compiled vmapped scan; returns the stacked final states."""
+    return _run_bucket(bucket.states, bucket.arrays, bucket.params, cfg,
+                       num_rounds)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_rounds", "spec"))
+def _run_bucket_telemetry(states, arrays, params, means, threshold, cfg,
+                          num_rounds, spec):
+    sample_one = lambda s, a, m, pr, sn: telemetry_sample(
+        s, a, spec, m, pr, sn)
+    step = jax.vmap(lambda s, a, p: round_step_aux(s, a, cfg, params=p))
+    vsample = jax.vmap(sample_one)
+
+    def body(carry, _):
+        ss, conv = carry
+        ss, processed, send_mask = step(ss, arrays, params)
+        sample = vsample(ss, arrays, means, processed, send_mask)
+        newly = (conv < 0) & (sample["rmse"] <= threshold)
+        conv = jnp.where(newly, ss.t, conv)
+        return (ss, conv), sample
+
+    conv0 = jnp.full(means.shape[:1], -1, jnp.int32)
+    (states, conv), series = jax.lax.scan(
+        body, (states, conv0), None, length=num_rounds)
+    return states, conv, series
+
+
+def run_bucket_telemetry(bucket, cfg, num_rounds: int, spec,
+                         rmse_threshold: float = 0.0):
+    """One compiled vmapped scan with per-round, per-lane telemetry.
+
+    Returns ``(states, converged_round, series)``:
+
+    * ``states`` — stacked final states (every lane ran the full
+      ``num_rounds``; converged lanes keep ticking);
+    * ``converged_round`` — ``(B,)`` int32, the round at which each
+      lane's alive-masked RMSE first reached ``rmse_threshold`` (its
+      effective early-exit round), or -1 if it never did;
+    * ``series`` — ``{metric: (B, R, ...) numpy}`` per-instance series
+      (the scan's ``(R, B)`` ys transposed lane-major for reporting).
+
+    ``spec`` must include ``rmse`` — convergence tracking reads it from
+    the sampled row (the sampler computes each reduction once).
+    """
+    if not spec.enabled or not spec.has("rmse"):
+        raise ValueError(
+            "run_bucket_telemetry needs a TelemetrySpec that includes "
+            "'rmse' (convergence tracking reads the sampled rmse row)")
+    mean_dt = cfg.jnp_dtype
+    thr = jnp.asarray(rmse_threshold, mean_dt)
+    states, conv, series = _run_bucket_telemetry(
+        bucket.states, bucket.arrays, bucket.params,
+        jnp.asarray(bucket.means, mean_dt), thr, cfg, num_rounds, spec)
+    host = {}
+    for k, v in series.items():
+        arr = np.asarray(v)           # (R, B, ...) scan-major
+        host[k] = np.swapaxes(arr, 0, 1) if arr.ndim > 1 else arr
+    return states, np.asarray(conv), host

@@ -1,0 +1,366 @@
+"""Packing: shape-bucketed padding of sweep instances into batched arrays.
+
+``jax.vmap`` needs every lane to share one shape, but a sweep's
+topologies differ in N and E.  Instances are therefore padded to a
+bucket shape ``(N_pad, E_pad)`` chosen by rounding each axis up to the
+next power of two — topologies of similar size share one compile, wildly
+different sizes never share a bucket (padding a ring-16 to a 100k-node
+lane would waste the batch).
+
+Padding must not perturb the protocol.  The rules (asserted by
+tests/test_sweep.py):
+
+* **ghost nodes** are appended after the real nodes with value 0 and are
+  *born dead* (``alive=False`` in the packed state): they never fire,
+  never drain, and every alive-masked metric (rmse, mass, active)
+  excludes them — so the instance's true mean and per-feature mass are
+  untouched;
+* **pad edges** are self-loops on the LAST ghost node with
+  ``edge_ok=False`` (a failed link loses every message put on it) and
+  ``rev`` mapped to themselves, appended after the real edges.  Because
+  edges sort by ``(src, dst)`` and every ghost id exceeds every real id,
+  the real edge arrays stay a bit-identical *prefix* of the padded
+  arrays — per-node reductions over ``src``, gathers through ``rev`` and
+  the ring-buffer update all compute exactly the unpadded values on the
+  real slice (the per-lane bit-exactness guarantee);
+* the **edge coloring** of a padded topology extends the real coloring
+  with color ``-1`` on pad self-loops (``src == dst`` never enters the
+  matching), which no round ever fires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig, RoundParams
+from flow_updating_tpu.models.state import (
+    check_payload_values,
+    init_state,
+)
+from flow_updating_tpu.topology.graph import Topology
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def _bucket_ceil(x: int) -> int:
+    """Round up to an eighth-power-of-two boundary: at most 12.5% pad
+    waste per axis, at most 8 bucket sizes per octave (the
+    compile-count/pad-waste trade)."""
+    g = max(_pow2_ceil(x) // 8, 1)
+    return ((int(x) + g - 1) // g) * g
+
+
+def bucket_shape(topo: Topology, n_min: int = 8,
+                 e_min: int = 16) -> tuple[int, int]:
+    """The padded ``(N_pad, E_pad)`` bucket an instance lands in:
+    eighth-pow2 rounding of ``N + 1`` / ``E + 1`` (always at least one
+    ghost node and one pad edge, so the padding invariants are exercised
+    uniformly), floored so tiny instances coalesce."""
+    n_pad = max(_bucket_ceil(topo.num_nodes + 1), n_min)
+    e_pad = max(_bucket_ceil(topo.num_edges + 1), e_min)
+    return n_pad, e_pad
+
+
+def pad_topology_to(topo: Topology, n_pad: int, e_pad: int) -> Topology:
+    """Pad ``topo`` to exactly ``(n_pad, e_pad)`` with ghost nodes and
+    self-loop pad edges spread evenly across the ghosts (even spreading
+    caps every row's degree, which bounds the uniform row width W of the
+    batched reduction layout).  The real arrays remain a prefix; ghost
+    values are 0."""
+    topo._require_edges("pad_topology_to (sweep packing)")
+    N, E = topo.num_nodes, topo.num_edges
+    if n_pad <= N:
+        raise ValueError(
+            f"n_pad={n_pad} must exceed the real node count {N} (at "
+            "least one ghost node carries the pad edges)")
+    if e_pad < E:
+        raise ValueError(f"e_pad={e_pad} < real edge count {E}")
+    pad_n = n_pad - N
+    pad_e = e_pad - E
+    # ghost i in [N, n_pad) takes an even contiguous share of the pad
+    # self-loops; (g, g) pairs sort ascending by g, so the edge list
+    # stays (src, dst)-sorted with the real edges as a prefix
+    ghost_of = (N + (np.arange(pad_e, dtype=np.int64) * pad_n)
+                // max(pad_e, 1) % pad_n) if pad_e else \
+        np.empty(0, np.int64)
+    ghost_of = np.sort(ghost_of).astype(np.int32)
+
+    src = np.concatenate([topo.src, ghost_of])
+    dst = np.concatenate([topo.dst, ghost_of])
+    # self-loops reverse to themselves: rev stays an involution and the
+    # antisymmetry permutation is the identity on the pad slice
+    rev = np.concatenate([topo.rev, np.arange(E, e_pad, dtype=np.int32)])
+    ghost_deg = np.bincount(ghost_of - N, minlength=pad_n) \
+        if pad_e else np.zeros(pad_n, np.int64)
+    pad_rank = (np.arange(pad_e, dtype=np.int64)
+                - np.concatenate([[0], np.cumsum(ghost_deg)])[
+                    ghost_of - N]) if pad_e else np.empty(0, np.int64)
+    edge_rank = np.concatenate(
+        [topo.edge_rank, pad_rank.astype(np.int32)])
+    delay = np.concatenate([topo.delay, np.ones(pad_e, np.int32)])
+    out_deg = np.concatenate(
+        [topo.out_deg, ghost_deg.astype(np.int32)])
+    values = np.concatenate([topo.values, np.zeros(pad_n)])
+    counts = np.bincount(src, minlength=n_pad)
+    row_start = np.zeros(n_pad + 1, np.int64)
+    np.cumsum(counts, out=row_start[1:])
+
+    padded = dataclasses.replace(
+        topo,
+        num_nodes=n_pad,
+        src=src,
+        dst=dst,
+        rev=rev,
+        out_deg=out_deg,
+        row_start=row_start,
+        edge_rank=edge_rank,
+        delay=delay,
+        values=values,
+        names=None,
+        speeds=None,
+        bandwidth=None,
+        latency_s=None,
+        adopted=None,
+        # the link-contention model is rejected by pack_instances (link
+        # route tables don't batch); drop the arrays for consistency
+        edge_links=None,
+        link_ser_rounds=None,
+        link_shared=None,
+        lat_rounds=None,
+        # a structure descriptor indexes the UNpadded node layout
+        structure=None,
+    )
+    # carry a computed coloring through (extended with -1 on pad
+    # self-loops) so the padded instance runs the SAME matching sequence;
+    # an uncached coloring recomputes identically (src==dst edges never
+    # enter the matching)
+    cached = getattr(topo, "_edge_coloring", None)
+    if cached is not None:
+        col, c = cached
+        col = np.concatenate([col, np.full(pad_e, -1, np.int32)])
+        object.__setattr__(padded, "_edge_coloring", (col, c))
+    return padded
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepInstance:
+    """One (topology, seed, params) point of a sweep grid.
+
+    ``drop_rate`` / ``timeout`` / ``latency_scale`` / ``contention_scale``
+    override the shared :class:`RoundConfig`'s numeric knobs for this
+    instance only (they become the lane's traced :class:`RoundParams`);
+    ``None`` inherits the config value.  ``values`` optionally replaces
+    the topology's node values (``(N,)`` or ``(N, D)``); ``tag`` is
+    free-form grid metadata echoed into the sweep manifest record."""
+
+    topo: Topology
+    seed: int = 0
+    drop_rate: float | None = None
+    timeout: int | None = None
+    latency_scale: float | None = None
+    contention_scale: float | None = None
+    values: object | None = None
+    tag: dict = dataclasses.field(default_factory=dict)
+
+    def params(self, cfg: RoundConfig) -> RoundParams:
+        return RoundParams.from_config(
+            cfg, drop_rate=self.drop_rate, timeout=self.timeout,
+            latency_scale=self.latency_scale,
+            contention_scale=self.contention_scale)
+
+    def true_mean(self):
+        """Per-instance convergence target: mean over REAL nodes of the
+        values this lane actually aggregates (scalar, or ``(D,)`` for
+        vector payloads)."""
+        if self.values is None:
+            return self.topo.true_mean
+        vals = np.asarray(self.values)
+        return vals.mean(axis=0)
+
+
+@dataclasses.dataclass
+class SweepBucket:
+    """One packed batch: stacked state/arrays/params with leading axis B
+    plus the host-side per-instance bookkeeping."""
+
+    shape: tuple          # (N_pad, E_pad) + feature shape
+    states: object        # FlowUpdatingState, every leaf (B, ...)
+    arrays: object        # TopoArrays, every array leaf (B, ...)
+    params: RoundParams   # every leaf (B,)
+    means: object         # (B,) or (B, D) convergence targets
+    n_real: np.ndarray    # (B,) real node counts
+    e_real: np.ndarray    # (B,) real directed-edge counts
+    meta: list            # per-instance manifest records (dicts)
+
+    @property
+    def size(self) -> int:
+        return len(self.meta)
+
+
+def _validate_cfg(cfg: RoundConfig) -> None:
+    if cfg.kernel != "edge":
+        raise ValueError(
+            "the sweep engine batches the edge kernel (per-edge state "
+            "vmaps over lanes); kernel='node' collapses state per "
+            "topology structure — use kernel='edge'")
+    if cfg.delivery not in ("gather", "scatter"):
+        raise ValueError(
+            f"sweep buckets run delivery='gather'|'scatter'; "
+            f"{cfg.delivery!r} plans a per-topology permutation network "
+            "(static masks cannot batch across instances)")
+    if cfg.segment_impl not in ("auto", "segment"):
+        raise ValueError(
+            f"sweep buckets run segment_impl='auto'|'segment'; "
+            f"{cfg.segment_impl!r} builds per-topology layouts that do "
+            "not batch")
+    if cfg.contention:
+        raise ValueError(
+            "contention needs per-topology link route tables, which do "
+            "not batch; sweep latency effects go through "
+            "RoundParams.latency_scale instead")
+
+
+def _edge_rows(padded: Topology, width: int, e_pad: int) -> np.ndarray:
+    """The (N_pad, W) out-edge index matrix of the scatter-free row
+    reduction layout (pad slot = e_pad; see ops/segment.rows_segment_*)."""
+    lo = padded.row_start[:-1]
+    deg = padded.out_deg.astype(np.int64)
+    ar = np.arange(width, dtype=np.int64)
+    valid = ar[None, :] < deg[:, None]
+    return np.where(valid, lo[:, None] + ar[None, :], e_pad).astype(
+        np.int32)
+
+
+def row_width(topo: Topology, n_pad: int, e_pad: int) -> int:
+    """Uniform row width this instance needs in an ``(n_pad, e_pad)``
+    bucket: its real max degree, or the evenly-spread ghost degree if
+    that is larger."""
+    pad_n = n_pad - topo.num_nodes
+    pad_e = e_pad - topo.num_edges
+    ghost_deg = -(-pad_e // pad_n) if pad_n and pad_e else 0
+    real = int(topo.out_deg.max()) if topo.num_nodes else 0
+    return max(real, ghost_deg, 1)
+
+
+def pack_instance(inst: SweepInstance, cfg: RoundConfig,
+                  n_pad: int, e_pad: int, width: int | None = None,
+                  static_no_drop: bool = False):
+    """Pad + build one lane: returns ``(state, arrays, params)`` device
+    trees (unstacked) for the given bucket shape.  ``width`` is the
+    bucket-wide uniform row width (defaults to this instance's own);
+    ``static_no_drop`` omits the Bernoulli drop draw from the program
+    (set when NO lane of the bucket drops messages)."""
+    import jax.numpy as jnp
+
+    padded = pad_topology_to(inst.topo, n_pad, e_pad)
+    arrays = padded.device_arrays(coloring=cfg.needs_coloring)
+    width = row_width(inst.topo, n_pad, e_pad) if width is None else width
+    arrays = arrays.replace(
+        sweep_edge_rows=jnp.asarray(_edge_rows(padded, width, e_pad)))
+    if cfg.needs_coloring:
+        # the color count moves into a traced scalar so lanes with
+        # different counts share one treedef (and one compile)
+        arrays = arrays.replace(
+            num_colors=0,
+            num_colors_arr=jnp.asarray(arrays.num_colors, jnp.int32))
+    values = None
+    if inst.values is not None:
+        vals = np.asarray(inst.values, np.float64)
+        check_payload_values(vals, inst.topo.num_nodes)
+        pad_rows = np.zeros((n_pad - vals.shape[0],) + vals.shape[1:])
+        values = np.concatenate([vals, pad_rows], axis=0)
+    state = init_state(padded, cfg, seed=inst.seed, values=values)
+    N, E = inst.topo.num_nodes, inst.topo.num_edges
+    state = state.replace(
+        alive=state.alive.at[N:].set(False),
+        edge_ok=state.edge_ok.at[E:].set(False),
+    )
+    params = inst.params(cfg)
+    if static_no_drop:
+        params = params.without_drop()
+    return state, arrays, params
+
+
+def pack_instances(instances, cfg: RoundConfig,
+                   max_batch: int | None = None,
+                   n_min: int = 8, e_min: int = 16) -> list[SweepBucket]:
+    """Bucket + pad + stack ``instances`` into :class:`SweepBucket`\\ s.
+
+    Instances are grouped by ``(bucket_shape, feature_shape)``; each
+    group is split into chunks of at most ``max_batch`` lanes.  Bucket
+    order and lane order within a bucket follow the input order, so the
+    manifest's instance records stay aligned with the grid fan-out.
+    """
+    import jax
+
+    from flow_updating_tpu.utils.checkpoint import topology_fingerprint
+
+    if max_batch is not None and max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1 (got {max_batch}); "
+                         "pass None for unbounded buckets")
+    _validate_cfg(cfg)
+    groups: dict = {}
+    order: list = []
+    for idx, inst in enumerate(instances):
+        feat = (() if inst.values is None
+                else np.asarray(inst.values).shape[1:])
+        key = bucket_shape(inst.topo, n_min=n_min, e_min=e_min) + feat
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append((idx, inst))
+
+    buckets = []
+    for key in order:
+        members = groups[key]
+        n_pad, e_pad = key[0], key[1]
+        step = max_batch or len(members)
+        for lo in range(0, len(members), step):
+            chunk = members[lo: lo + step]
+            width = max(row_width(inst.topo, n_pad, e_pad)
+                        for _, inst in chunk)
+            # a bucket where NO lane drops messages omits the Bernoulli
+            # draw from its compiled program (pytree structure, so the
+            # whole bucket must agree)
+            no_drop = all(
+                (inst.drop_rate if inst.drop_rate is not None
+                 else cfg.drop_rate) == 0.0 for _, inst in chunk)
+            lanes = [pack_instance(inst, cfg, n_pad, e_pad, width=width,
+                                   static_no_drop=no_drop)
+                     for _, inst in chunk]
+            states = jax.tree.map(lambda *xs: jax.numpy.stack(xs),
+                                  *[ln[0] for ln in lanes])
+            arrays = jax.tree.map(lambda *xs: jax.numpy.stack(xs),
+                                  *[ln[1] for ln in lanes])
+            params = jax.tree.map(lambda *xs: jax.numpy.stack(xs),
+                                  *[ln[2] for ln in lanes])
+            means = jax.numpy.stack(
+                [jax.numpy.asarray(inst.true_mean(), cfg.jnp_dtype)
+                 for _, inst in chunk])
+            meta = []
+            for (idx, inst), (_, _, p) in zip(chunk, lanes):
+                rec = {
+                    "instance": idx,
+                    "seed": int(inst.seed),
+                    "topology": topology_fingerprint(inst.topo),
+                    "params": inst.params(cfg).describe(),
+                    "padded_shape": [int(n_pad), int(e_pad)],
+                }
+                if inst.tag:
+                    rec["tag"] = dict(inst.tag)
+                meta.append(rec)
+            buckets.append(SweepBucket(
+                shape=key,
+                states=states,
+                arrays=arrays,
+                params=params,
+                means=means,
+                n_real=np.asarray([i.topo.num_nodes for _, i in chunk]),
+                e_real=np.asarray([i.topo.num_edges for _, i in chunk]),
+                meta=meta,
+            ))
+    return buckets

@@ -1,0 +1,117 @@
+"""Sweep driving: grid fan-out, bucket orchestration, manifest assembly.
+
+A sweep is a cross product — topologies x seeds x parameter values — run
+through the packing (:mod:`flow_updating_tpu.sweep.pack`) and batched
+execution (:mod:`flow_updating_tpu.sweep.batch`) layers, reduced to one
+record per instance and bound into a single self-describing
+``flow-updating-sweep-report/v1`` manifest (the sweep-shaped sibling of
+the run manifest, same :mod:`flow_updating_tpu.obs.report` plumbing).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.sweep.batch import run_bucket_telemetry
+from flow_updating_tpu.sweep.pack import SweepInstance, pack_instances
+
+
+def grid_instances(topos, seeds=(0,), drop_rates=(None,),
+                   timeouts=(None,), latency_scales=(None,)) -> list:
+    """Fan a parameter grid out to :class:`SweepInstance`\\ s.
+
+    ``topos`` is a list of ``(name, Topology)`` pairs (the name lands in
+    each instance's tag); the remaining axes cross-multiply.  ``None``
+    grid values inherit the shared config's knob."""
+    instances = []
+    for name, topo in topos:
+        for seed in seeds:
+            for dr in drop_rates:
+                for to in timeouts:
+                    for ls in latency_scales:
+                        tag = {"topology": str(name), "seed": int(seed)}
+                        if dr is not None:
+                            tag["drop_rate"] = float(dr)
+                        if to is not None:
+                            tag["timeout"] = int(to)
+                        if ls is not None:
+                            tag["latency_scale"] = float(ls)
+                        instances.append(SweepInstance(
+                            topo=topo, seed=int(seed), drop_rate=dr,
+                            timeout=to, latency_scale=ls, tag=tag))
+    return instances
+
+
+def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
+              rmse_threshold: float = 1e-6, max_batch: int | None = None,
+              include_series: bool = False):
+    """Pack ``instances``, run every bucket, reduce to per-instance
+    records.
+
+    Returns ``(records, summary)``: ``records`` is one dict per instance
+    (input order) — topology fingerprint, seed, params, convergence
+    (effective early-exit round, final/min rmse) and, when
+    ``include_series``, the per-round metric series; ``summary`` carries
+    sweep-level aggregates (bucket shapes = compile count, wall time,
+    converged count).
+    """
+    from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+    instances = list(instances)
+    spec = TelemetrySpec.default() if spec is None else spec
+    spec = spec.for_kernel("edge")
+    if not spec.has("rmse"):
+        raise ValueError(
+            "sweep telemetry needs 'rmse' for convergence tracking "
+            "(the 'default' spec includes it)")
+    t0 = time.perf_counter()
+    buckets = pack_instances(instances, cfg, max_batch=max_batch)
+    pack_s = time.perf_counter() - t0
+
+    records: list = [None] * len(instances)
+    converged = 0
+    t0 = time.perf_counter()
+    for bucket in buckets:
+        _states, conv, series = run_bucket_telemetry(
+            bucket, cfg, rounds, spec, rmse_threshold=rmse_threshold)
+        for lane, meta in enumerate(bucket.meta):
+            rmse_series = series["rmse"][lane]
+            rec = dict(meta)
+            rec["convergence"] = {
+                "rounds": int(rounds),
+                "converged_round": int(conv[lane]),
+                "converged": bool(conv[lane] >= 0),
+                "rmse_threshold": float(rmse_threshold),
+                "final_rmse": float(rmse_series[-1]) if rounds else None,
+                "min_rmse": float(rmse_series.min()) if rounds else None,
+            }
+            if conv[lane] >= 0:
+                converged += 1
+            if include_series:
+                rec["series"] = {k: np.asarray(v[lane]).tolist()
+                                 for k, v in series.items()}
+            records[meta["instance"]] = rec
+    run_s = time.perf_counter() - t0
+
+    # a compile is keyed by the full traced structure, not just the
+    # bucket shape: lane count and row width (both visible in
+    # sweep_edge_rows' (B, N_pad, W) shape), payload feature shape
+    # (means), and the statically-absent drop leaf all split the cache
+    compile_keys = {
+        (np.shape(np.asarray(b.arrays.sweep_edge_rows)),
+         np.shape(np.asarray(b.means)),
+         b.params.drop_rate is None)
+        for b in buckets}
+    summary = {
+        "instances": len(records),
+        "buckets": [{"shape": list(map(int, b.shape)), "size": b.size}
+                    for b in buckets],
+        "compiled_programs": len(compile_keys),
+        "rounds": int(rounds),
+        "converged": converged,
+        "timings": {"pack_s": round(pack_s, 6), "run_s": round(run_s, 6)},
+    }
+    return records, summary

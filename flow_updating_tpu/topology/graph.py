@@ -416,6 +416,18 @@ class TopoArrays:
     delay: object
     edge_color: object = None
     num_colors: int = struct.field(pytree_node=False, default=0)
+    sweep_edge_rows: object = None  # (N, W) i32 out-edge indices, pad = E —
+    #                                the sweep engine's uniform-width row
+    #                                layout: per-node reductions unroll the
+    #                                W columns in edge order (bit-exact with
+    #                                the sorted scatter-add, no scatter at
+    #                                all; ops/segment.rows_segment_*)
+    num_colors_arr: object = None  # () i32 traced color count — the sweep
+    #                                engine's batched arrays carry it so one
+    #                                vmapped program serves instances with
+    #                                different color counts (num_colors is
+    #                                static metadata and would split the
+    #                                treedef); None = use num_colors
     ell_edge_mats: object = None   # tuple of (rows, w) out-edge ELL buckets
     ell_inv_perm: object = None    # (N,) original node -> permuted row
     # link-level contention model (cfg.contention; platform topologies)

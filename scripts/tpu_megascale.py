@@ -33,8 +33,6 @@ DEFAULT_OUT = os.path.join(REPO, "MEGASCALE_TPU_r5.json")
 
 
 def measure_one(k: int) -> dict:
-    import numpy as np
-
     import jax
 
     from bench import measure_tpu

@@ -13,7 +13,6 @@ import pytest
 
 from flow_updating_tpu.engine import Engine
 from flow_updating_tpu.models.actor import (
-    TopoView,
     VectorActor,
     push_sum_actor,
 )

@@ -6,8 +6,6 @@ running R more — bit-for-bit on every state leaf.  (The reference has no
 checkpointing at all, SURVEY.md §5.)
 """
 
-import dataclasses
-
 import numpy as np
 import pytest
 

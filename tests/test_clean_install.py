@@ -19,7 +19,6 @@ import ast
 import os
 import subprocess
 import sys
-import sysconfig
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(ROOT, "flow_updating_tpu")

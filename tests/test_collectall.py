@@ -1,5 +1,4 @@
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from flow_updating_tpu.models.config import RoundConfig

@@ -125,8 +125,6 @@ def test_mesh_run_with_link_model_topology():
     """Regression: a platform-style topology carrying the link model must
     still run on the GSPMD mesh path (contention off — pad_topology drops
     the link arrays; contention+mesh is rejected by the Engine)."""
-    import jax
-
     from flow_updating_tpu.models.rounds import node_estimates, run_rounds
     from flow_updating_tpu.parallel import auto
     from flow_updating_tpu.parallel.mesh import make_mesh

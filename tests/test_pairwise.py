@@ -1,6 +1,5 @@
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from flow_updating_tpu.models.config import RoundConfig
 from flow_updating_tpu.models.rounds import run_rounds

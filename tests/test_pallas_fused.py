@@ -13,7 +13,6 @@ import jax.numpy as jnp
 
 from flow_updating_tpu.ops import permute
 from flow_updating_tpu.ops.pallas_fused import (
-    DEFAULT_BLOCK_ROWS,
     LANE,
     MAX_STAGES_PER_PASS,
     apply_fused,

@@ -1,0 +1,388 @@
+"""Batched sweep engine: vmapped multi-instance execution.
+
+Contracts pinned here (docs/SWEEP.md):
+
+* **per-lane bit-exactness** — a batch of B copies of small6 matches the
+  single-instance kernel per lane, in every mode combination
+  (collectall/pairwise x reference/every_round).  The comparator is the
+  plain single-instance kernel on the UNPADDED topology: the packed
+  arrays keep the real edges as a bit-identical prefix and the row-fold
+  reductions reproduce the sorted scatter-add's exact addition order;
+* **padding invariants** — ghost nodes and self-loop pad edges stay
+  exactly zero/dead through churn and drop_rate > 0, so the true mean
+  and per-feature mass of each instance are untouched;
+* **compile counts** — one jit cache entry serves a drop_rate x timeout
+  grid after the static->traced RoundParams split, the plain static path
+  still compiles drop-free programs at drop 0 (the traced machinery does
+  not leak), and same-shape buckets share one compiled program;
+* **sweep manifest** — ``flow-updating-sweep-report/v1``, one record per
+  instance with argv / topology fingerprint / params / convergence
+  (the observer_sample-style contract test from test_obs_tools.py);
+* **bench isolation** — sweep baseline keys carry the batch size, so a
+  B=32 row can never displace the recorded single-instance baselines.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from flow_updating_tpu.cli import main as cli_main
+from flow_updating_tpu.models.config import RoundConfig, RoundParams
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs.telemetry import TelemetrySpec
+from flow_updating_tpu.sweep import (
+    SweepInstance,
+    pack_instances,
+    pad_topology_to,
+    run_bucket,
+    run_bucket_telemetry,
+)
+from flow_updating_tpu.sweep.batch import _run_bucket
+from flow_updating_tpu.topology.generators import grid2d, ring
+
+
+def _small6_topo(small6):
+    platform, deployment = small6
+    return deployment.to_topology(platform=platform, tick_interval=1.0)
+
+
+def _lane(tree, i):
+    return jax.tree.map(lambda x: np.asarray(x)[i], tree)
+
+
+# ---- per-lane bit-exact parity (all modes) -------------------------------
+
+@pytest.mark.parametrize("variant,fire_policy", [
+    ("collectall", "reference"),
+    ("collectall", "every_round"),
+    ("pairwise", "reference"),
+    ("pairwise", "every_round"),
+])
+def test_batch_of_small6_matches_single_instance(small6, variant,
+                                                 fire_policy):
+    topo = _small6_topo(small6)
+    maker = (RoundConfig.reference if fire_policy == "reference"
+             else RoundConfig.fast)
+    cfg = maker(variant=variant, dtype="float64")
+    B, R = 3, 40
+    insts = [SweepInstance(topo=topo, seed=s, tag={"lane": s})
+             for s in range(B)]
+    buckets = pack_instances(insts, cfg)
+    assert len(buckets) == 1 and buckets[0].size == B
+    states = run_bucket(buckets[0], cfg, R)
+
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+    E = topo.num_edges
+    for lane, inst in enumerate(insts):
+        single = run_rounds(init_state(topo, cfg, seed=inst.seed),
+                            arrays, cfg, R, params=inst.params(cfg))
+        got = _lane(states, lane)
+        np.testing.assert_array_equal(np.asarray(single.flow),
+                                      got.flow[:E])
+        np.testing.assert_array_equal(np.asarray(single.est),
+                                      got.est[:E])
+        np.testing.assert_array_equal(
+            np.asarray(node_estimates(single, arrays)),
+            np.asarray(node_estimates(
+                got, _lane(buckets[0].arrays, lane)))[: topo.num_nodes])
+
+
+def test_mixed_topologies_share_bucket_and_stay_exact():
+    """Different graphs (and different edge-color counts) in ONE bucket:
+    per-lane results still match single-instance runs bit-exactly."""
+    cfg = RoundConfig.fast(variant="pairwise", dtype="float64")
+    insts = [SweepInstance(topo=ring(12, k=2, seed=0), seed=0),
+             SweepInstance(topo=grid2d(4, 4, seed=1), seed=1)]
+    buckets = pack_instances(insts, cfg, n_min=32, e_min=64)
+    assert len(buckets) == 1, "instances under the floors must coalesce"
+    states = run_bucket(buckets[0], cfg, 30)
+    for lane, inst in enumerate(insts):
+        arrays = inst.topo.device_arrays(coloring=True)
+        single = run_rounds(init_state(inst.topo, cfg, seed=inst.seed),
+                            arrays, cfg, 30, params=inst.params(cfg))
+        got = _lane(states, lane)
+        np.testing.assert_array_equal(np.asarray(single.flow),
+                                      got.flow[: inst.topo.num_edges])
+
+
+# ---- padding invariants under churn + drop -------------------------------
+
+def test_padding_neutral_under_churn_and_drop():
+    """Ghost nodes / pad self-loops carry exactly zero state through a
+    churned, lossy run — the padded lane equals the unpadded run on the
+    real prefix (bit-exact: the counter-based PRNG draws a prefix-stable
+    keep mask), so true mean and per-feature mass are untouched."""
+    topo = ring(16, k=2, seed=3)
+    cfg = RoundConfig.reference(variant="collectall", dtype="float64")
+    rng = np.random.default_rng(0)
+    values = rng.normal(size=(topo.num_nodes, 2))  # per-feature mass
+    inst = SweepInstance(topo=topo, seed=5, drop_rate=0.3, values=values)
+    bucket = pack_instances([inst], cfg)[0]
+    N, E = topo.num_nodes, topo.num_edges
+
+    arrays = topo.device_arrays()
+    vals = np.asarray(values, np.float64)
+    single = init_state(topo, cfg, seed=5, values=vals)
+    params = inst.params(cfg)
+
+    batched = bucket.states
+    kill = 3  # churn schedule: kill node 3, later revive it
+    # one scan length -> each program compiles once across the 3 phases
+    for phase, rounds in (("pre", 12), ("killed", 12), ("revived", 12)):
+        if phase == "killed":
+            single = single.replace(alive=single.alive.at[kill].set(False))
+            batched = batched.replace(
+                alive=batched.alive.at[0, kill].set(False))
+        if phase == "revived":
+            single = single.replace(alive=single.alive.at[kill].set(True))
+            batched = batched.replace(
+                alive=batched.alive.at[0, kill].set(True))
+        single = run_rounds(single, arrays, cfg, rounds, params=params)
+        batched = _run_bucket(batched, bucket.arrays, bucket.params,
+                              cfg, rounds)
+        got = _lane(batched, 0)
+        # real prefix bit-equal -> mean/mass of the instance untouched
+        np.testing.assert_array_equal(np.asarray(single.flow),
+                                      got.flow[:E])
+        np.testing.assert_array_equal(np.asarray(single.est),
+                                      got.est[:E])
+        # ghosts: dead, valueless, flowless — exactly
+        assert not got.alive[N:].any()
+        assert not got.flow[E:].any() and not got.est[E:].any()
+        assert not got.value[N:].any()
+        assert not got.buf_valid[:, E:].any()
+        # per-feature mass over alive real nodes matches the unpadded run
+        lane_est = np.asarray(node_estimates(
+            got, _lane(bucket.arrays, 0)))[:N]
+        ref_est = np.asarray(node_estimates(single, arrays))
+        alive = np.asarray(single.alive)
+        np.testing.assert_array_equal(lane_est[alive].sum(axis=0),
+                                      ref_est[alive].sum(axis=0))
+
+
+# ---- compile-count regression (static -> traced split) -------------------
+
+def test_one_compile_serves_drop_timeout_grid():
+    topo = ring(10, k=2, seed=0)
+    arrays = topo.device_arrays()
+    cfg = RoundConfig.reference(variant="collectall")
+    state = init_state(topo, cfg, seed=0)
+
+    n0 = run_rounds._cache_size()
+    for dr in (0.0, 0.1, 0.25):
+        for to in (10, 30, 50):
+            run_rounds(state, arrays, cfg, 5,
+                       params=RoundParams.from_config(
+                           cfg, drop_rate=dr, timeout=to))
+    assert run_rounds._cache_size() == n0 + 1, \
+        "a 3x3 params grid must compile exactly once"
+
+    # the plain static path still recompiles per value — and stays the
+    # drop-free program at drop 0 (no PRNG machinery leaked in)
+    import dataclasses
+
+    n1 = run_rounds._cache_size()
+    run_rounds(state, arrays, cfg, 5)
+    run_rounds(state, arrays, dataclasses.replace(cfg, timeout=10), 5)
+    assert run_rounds._cache_size() == n1 + 2
+    plain_hlo = run_rounds.lower(state, arrays, cfg, 5).as_text()
+    assert "rng" not in plain_hlo and "threefry" not in plain_hlo
+    traced_hlo = run_rounds.lower(
+        state, arrays, cfg, 5,
+        params=RoundParams.from_config(cfg)).as_text()
+    assert "rng" in traced_hlo or "threefry" in traced_hlo
+
+
+def test_same_shape_buckets_share_one_compiled_program():
+    cfg = RoundConfig.fast(variant="collectall")
+    b1 = pack_instances(
+        [SweepInstance(topo=ring(12, k=2, seed=0), seed=0),
+         SweepInstance(topo=ring(12, k=2, seed=0), seed=1)], cfg)[0]
+    b2 = pack_instances(
+        [SweepInstance(topo=ring(13, k=2, seed=4), seed=2,
+                       timeout=10),
+         SweepInstance(topo=ring(12, k=2, seed=7), seed=3,
+                       latency_scale=1.0)], cfg)[0]
+    assert b1.shape == b2.shape
+    n0 = _run_bucket._cache_size()
+    run_bucket(b1, cfg, 7)
+    run_bucket(b2, cfg, 7)
+    assert _run_bucket._cache_size() == n0 + 1, \
+        "same-shape buckets (different topologies AND params) must " \
+        "share one compile"
+
+
+# ---- convergence flags ---------------------------------------------------
+
+def test_effective_early_exit_round():
+    """Converged lanes keep ticking but record the round their RMSE first
+    reached the threshold."""
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    insts = [SweepInstance(topo=ring(8, k=2, seed=0), seed=0),
+             SweepInstance(topo=ring(8, k=1, seed=1), seed=1)]
+    bucket = pack_instances(insts, cfg, n_min=16, e_min=64)[0]
+    assert bucket.size == 2
+    R = 300
+    states, conv, series = run_bucket_telemetry(
+        bucket, cfg, R, TelemetrySpec.default(), rmse_threshold=1e-9)
+    assert (conv >= 0).all(), "fast sync collect-all converges well"
+    assert conv[0] != conv[1]  # per-lane, not bucket-global
+    for lane in range(2):
+        t = series["t"][lane]
+        i = int(np.searchsorted(t, conv[lane]))
+        assert series["rmse"][lane][i] <= 1e-9
+        if i:
+            assert series["rmse"][lane][i - 1] > 1e-9
+    # lanes kept ticking to the full horizon
+    assert (np.asarray(states.t) == R).all()
+
+
+# ---- validation ----------------------------------------------------------
+
+def test_pack_rejects_unbatchable_configs():
+    insts = [SweepInstance(topo=ring(8, k=2, seed=0))]
+    with pytest.raises(ValueError, match="kernel='edge'"):
+        pack_instances(insts, RoundConfig.fast(
+            variant="collectall", kernel="node"))
+    with pytest.raises(ValueError, match="delivery"):
+        pack_instances(insts, RoundConfig.fast(
+            variant="collectall", delivery="benes"))
+    with pytest.raises(ValueError, match="segment_impl"):
+        pack_instances(insts, RoundConfig.fast(
+            variant="collectall", segment_impl="ell"))
+    with pytest.raises(ValueError, match="n_pad"):
+        pad_topology_to(ring(8, k=2, seed=0), 8, 40)
+    with pytest.raises(ValueError, match="max_batch"):
+        pack_instances(insts, RoundConfig.fast(variant="collectall"),
+                       max_batch=-1)
+
+
+def test_rows_reductions_match_segment_ops():
+    """The scatter-free row-fold reductions are bit-identical to the
+    jax.ops segment primitives on sorted ids (scalar + vector payloads)."""
+    from flow_updating_tpu.ops.segment import (
+        rows_segment_all,
+        rows_segment_max,
+        rows_segment_min,
+        rows_segment_sum,
+        segment_all,
+        segment_max,
+        segment_min,
+        segment_sum,
+    )
+
+    topo = grid2d(5, 5, seed=0)
+    padded = pad_topology_to(topo, 28, 112)
+    from flow_updating_tpu.sweep.pack import _edge_rows, row_width
+
+    rows = jax.numpy.asarray(_edge_rows(
+        padded, row_width(topo, 28, 112), 112))
+    N, E = padded.num_nodes, padded.num_edges
+    src = jax.numpy.asarray(padded.src)
+    rng = np.random.default_rng(1)
+    for shape in ((E,), (E, 3)):
+        x = jax.numpy.asarray(rng.normal(size=shape).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(rows_segment_sum(x, rows)),
+            np.asarray(segment_sum(x, src, N)))
+    xi = jax.numpy.asarray(rng.integers(-9, 9, (E,)).astype(np.int32))
+    imax = np.iinfo(np.int32).max
+    got_min = np.asarray(rows_segment_min(xi, rows, imax))
+    ref_min = np.asarray(segment_min(xi, src, N))
+    deg = np.asarray(padded.out_deg)
+    np.testing.assert_array_equal(got_min[deg > 0], ref_min[deg > 0])
+    got_max = np.asarray(rows_segment_max(xi, rows, -imax - 1))
+    ref_max = np.asarray(segment_max(xi, src, N))
+    np.testing.assert_array_equal(got_max[deg > 0], ref_max[deg > 0])
+    pred = jax.numpy.asarray(rng.integers(0, 2, (E,)).astype(bool))
+    np.testing.assert_array_equal(
+        np.asarray(rows_segment_all(pred, rows,
+                                    jax.numpy.asarray(padded.out_deg))),
+        np.asarray(segment_all(pred, src, N)))
+
+
+# ---- sweep manifest contract (CLI end to end) ----------------------------
+
+def _run_cli(capsys, argv):
+    rc = cli_main(argv)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def test_sweep_manifest_contract(tmp_path, capsys):
+    rep_path = str(tmp_path / "sweep.json")
+    rc, out = _run_cli(capsys, [
+        "sweep", "--generator", "ring:12:2", "--generator", "grid2d:4:4",
+        "--seeds", "2", "--drop-rates", "0,0.1", "--timeouts", "20",
+        "--rounds", "30", "--rmse-threshold", "1e-3",
+        "--report", rep_path,
+    ])
+    assert rc == 0
+    assert out["instances"] == 8  # 2 topos x 2 seeds x 2 drops x 1 timeout
+    shapes = {tuple(b["shape"]) for b in out["buckets"]}
+    assert out["compiled_programs"] == len(shapes)
+    assert out["report_path"] == rep_path
+
+    m = json.load(open(rep_path))
+    assert m["schema"] == "flow-updating-sweep-report/v1"
+    assert "--drop-rates" in m["argv"]
+    assert m["config"]["variant"] == "collectall"
+    assert m["environment"]["backend"]
+    assert len(m["instances"]) == 8
+    drops = set()
+    for i, rec in enumerate(m["instances"]):
+        assert rec["instance"] == i  # grid fan-out order preserved
+        assert len(rec["topology"]["digest"]) == 64
+        assert set(rec["params"]) == {"drop_rate", "timeout",
+                                      "latency_scale", "contention_scale"}
+        assert rec["params"]["timeout"] == 20
+        conv = rec["convergence"]
+        assert conv["rounds"] == 30
+        assert isinstance(conv["converged"], bool)
+        assert conv["final_rmse"] >= 0.0
+        assert rec["tag"]["topology"] in ("ring:12:2", "grid2d:4:4")
+        drops.add(rec["params"]["drop_rate"])
+    # params are recorded as the kernel sees them (float32)
+    assert sorted(drops) == pytest.approx([0.0, 0.1])
+
+
+def test_sweep_cli_validation(tmp_path):
+    with pytest.raises(SystemExit, match="unknown generator"):
+        cli_main(["sweep", "--generator", "nope:4"])
+    with pytest.raises(SystemExit, match="comma list"):
+        cli_main(["sweep", "--generator", "ring:8:2",
+                  "--drop-rates", "a,b"])
+    with pytest.raises(SystemExit, match="rmse"):
+        cli_main(["sweep", "--generator", "ring:8:2", "--rounds", "5",
+                  "--telemetry", "mass,fired_total"])
+
+
+# ---- bench baseline-key isolation ----------------------------------------
+
+def test_sweep_baseline_key_never_shadows_single_instance(tmp_path,
+                                                          monkeypatch):
+    import bench
+
+    path = str(tmp_path / "baseline.json")
+    monkeypatch.setattr(bench, "MEASURED_PATH", path)
+    k96 = {"des_rounds_per_sec": 3.21, "nodes": 232704, "edges": 1327104,
+           "des": {"rounds_per_sec": 3.21, "ticks": 10, "repeats": 3,
+                   "spread_pct": 5.0}}
+    bench.record_baseline("96", k96)
+    # a (much faster) B=32 sweep row records under its OWN key
+    sweep_entry = {
+        "des_rounds_per_sec": 5000.0, "nodes": 232704, "edges": 1327104,
+        "des": {"rounds_per_sec": 5000.0, "ticks": 4096, "repeats": 3,
+                "spread_pct": 2.0}}
+    bench.record_baseline("96_sweep_b32", sweep_entry)
+    data = json.load(open(path))
+    assert set(data) == {"k96", "k96_sweep_b32"}
+    assert data["k96"]["des_rounds_per_sec"] == 3.21  # untouched
+    assert bench.recorded_baseline("96") == 3.21
+    assert bench.recorded_baseline("96_sweep_b32") == 5000.0
+    # distinct batch sizes are distinct configs
+    assert bench._baseline_key("96_sweep_b8") != \
+        bench._baseline_key("96_sweep_b32")

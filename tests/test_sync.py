@@ -128,8 +128,6 @@ def test_cli_node_kernel(capsys, tmp_path):
 
 def test_node_kernel_sharded_matches(monkeypatch):
     """GSPMD: padded NodeKernel on an 8-device mesh == single device."""
-    import jax
-
     from flow_updating_tpu.parallel.mesh import make_mesh
 
     topo = barabasi_albert(301, m=3, seed=2)  # odd N, uneven buckets
