@@ -83,6 +83,75 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--seed", type=int, default=0)
 
 
+def _add_kernel_flags(ap: argparse.ArgumentParser) -> None:
+    """Kernel/dispatch selection shared by ``run``, ``profile`` and the
+    live ``doctor`` (one flag vocabulary — a config profiled or doctored
+    is a config that can run)."""
+    ap.add_argument("--variant", default="collectall",
+                    choices=("collectall", "pairwise"))
+    ap.add_argument("--fire-policy", default=None,
+                    choices=("reference", "every_round"),
+                    help="'reference' = faithful async dynamics; "
+                         "'every_round' = fast synchronous mode")
+    ap.add_argument("--delivery", default="gather",
+                    choices=("gather", "scatter", "benes", "benes_fused"),
+                    help="message-delivery formulation (identical "
+                         "semantics; gather avoids TPU scatters, benes "
+                         "avoids TPU gathers too, benes_fused runs the "
+                         "benes network as fused Pallas passes — the "
+                         "fastest TPU form)")
+    ap.add_argument("--spmv", default="xla",
+                    choices=("xla", "pallas", "benes", "benes_fused",
+                             "structured"),
+                    help="node-kernel neighbor-sum implementation "
+                         "(benes_fused batches the permutation-network "
+                         "stages into Pallas HBM passes; structured uses "
+                         "the generator's closed-form stencil — regular "
+                         "topologies only)")
+    ap.add_argument("--segment", default="auto",
+                    choices=("auto", "segment", "ell", "benes",
+                             "benes_fused"),
+                    help="edge-kernel per-node reduction layout: jax.ops "
+                         "segment primitives vs scatter-free degree-"
+                         "bucketed ELL gather+row-reduce")
+    ap.add_argument("--multichip", default="auto",
+                    choices=("auto", "halo", "pod"),
+                    help="distribution strategy under --shards: 'auto' "
+                         "= GSPMD (XLA places collectives), 'halo' = "
+                         "explicitly scheduled shard_map halo-exchange "
+                         "kernel (edge kernel only), 'pod' = pod-sharded "
+                         "fat-tree stencil (node kernel, "
+                         "--spmv structured, fat_tree generator with "
+                         "shards dividing k; one (k/2,)-element psum "
+                         "per round)")
+    ap.add_argument("--halo", default="ppermute",
+                    choices=("ppermute", "allgather"),
+                    help="halo kernel's cut-edge exchange collective")
+    ap.add_argument("--partition", default="bfs",
+                    choices=("bfs", "contiguous"),
+                    help="halo kernel's node partition order")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="shard the node axis over N devices (GSPMD over a "
+                         "jax Mesh; 0 = single device)")
+    ap.add_argument("--kernel", default="edge", choices=("edge", "node"),
+                    help="'edge' = general per-edge kernel; 'node' = "
+                         "collapsed SpMV recurrence (fast synchronous "
+                         "collect-all only, the throughput path)")
+    ap.add_argument("--drain", type=int, default=None,
+                    help="msgs processed per node per round (0=unbounded; "
+                         "reference semantics: 1)")
+    ap.add_argument("--timeout", type=int, default=None,
+                    help="collect-all tick timeout / pairwise staleness "
+                         "rounds (reference: 50)")
+    ap.add_argument("--delay-depth", type=int, default=None,
+                    help="in-flight ring depth (latency-warped rounds)")
+    ap.add_argument("--pending-depth", type=int, default=None,
+                    help="per-edge mailbox FIFO depth (default: mode "
+                         "default — 2 in reference mode, 1 in fast mode)")
+    ap.add_argument("--drop-rate", type=float, default=0.0,
+                    help="per-message loss probability (fault injection)")
+
+
 def _build_topology(args):
     from flow_updating_tpu.topology.deployment import load_deployment
     from flow_updating_tpu.topology.generators import GENERATORS
@@ -566,7 +635,8 @@ def cmd_sweep(args) -> int:
             instances, cfg, args.rounds, spec=spec,
             rmse_threshold=args.rmse_threshold,
             max_batch=args.max_batch or None,
-            include_series=args.include_series)
+            include_series=args.include_series,
+            profile=args.profile)
     except ValueError as err:
         raise SystemExit(f"invalid sweep configuration: {err}")
     wall_s = _time.perf_counter() - t0
@@ -675,6 +745,144 @@ def cmd_obs_export_trace(args) -> int:
     return 0
 
 
+def _engine_from_args(args):
+    """Build an Engine from the shared kernel flags (``profile`` and the
+    live ``doctor`` construct exactly the engine ``run`` would)."""
+    from flow_updating_tpu.engine import Engine
+
+    cfg = _make_config(args)
+    if getattr(args, "multichip", "auto") in ("halo", "pod") \
+            and not args.shards:
+        raise SystemExit(
+            f"--multichip {args.multichip} needs --shards N (it is a "
+            "multi-chip distribution strategy)")
+    mesh = None
+    if args.shards:
+        from flow_updating_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.shards)
+    engine = Engine(config=cfg, mesh=mesh,
+                    multichip=getattr(args, "multichip", "auto"),
+                    halo=getattr(args, "halo", "ppermute"),
+                    partition=getattr(args, "partition", "bfs"))
+    engine.set_topology(_build_topology(args))
+    try:
+        engine.build(latency_scale=getattr(args, "latency_scale", 0.0),
+                     seed=args.seed)
+    except (ValueError, NotImplementedError) as err:
+        raise SystemExit(f"invalid flag combination: {err}")
+    return engine
+
+
+def cmd_profile(args) -> int:
+    """``profile``: AOT cost attribution of the configured kernel's
+    round program — XLA's cost/memory analysis plus the
+    compile-vs-execute wall split, written as a
+    ``flow-updating-profile-report/v1`` manifest (obs/profile.py)."""
+    _select_backend(args.backend, n_virtual_devices=args.shards or None)
+    engine = _engine_from_args(args)
+    try:
+        prof = engine.profile(args.rounds, execute=not args.no_execute)
+    except (ValueError, NotImplementedError) as err:
+        raise SystemExit(f"profile: {err}")
+    if args.report:
+        from flow_updating_tpu.obs.report import (
+            build_profile_manifest,
+            write_report,
+        )
+
+        write_report(args.report, build_profile_manifest(
+            argv=getattr(args, "_argv", None), config=engine.config,
+            topo=engine.topology, profile=prof,
+        ))
+        prof["report_path"] = args.report
+    print(json.dumps(prof))
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """``doctor``: rule-based health verdicts (obs/health.py) over saved
+    manifests, the recorded baselines, and/or a live telemetry run.
+    Exit code 1 on any failing check (warnings too under ``--strict``) —
+    the CI contract."""
+    from flow_updating_tpu.obs import health
+
+    checks = []
+    for path in args.reports:
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as err:
+            raise SystemExit(f"doctor: cannot read {path}: {err}")
+        for c in health.diagnose_manifest(manifest):
+            c.evidence.setdefault("source", path)
+            checks.append(c)
+    if args.baselines is not None:
+        try:
+            with open(args.baselines) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as err:
+            raise SystemExit(
+                f"doctor: cannot read baselines {args.baselines}: {err}")
+        c = health.check_baselines(data)
+        c.evidence.setdefault("source", args.baselines)
+        checks.append(c)
+    if args.generator or args.deployment:
+        _select_backend(args.backend,
+                        n_virtual_devices=args.shards or None)
+        from flow_updating_tpu.obs.report import environment_info
+        from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+        engine = _engine_from_args(args)
+        try:
+            series = engine.run_telemetry(args.rounds,
+                                          TelemetrySpec.full())
+        except (ValueError, NotImplementedError) as err:
+            raise SystemExit(f"doctor: {err}")
+        dtype = engine.config.dtype
+        checks.extend(health.diagnose_series(
+            series, threshold=args.rmse_threshold, dtype=dtype))
+        checks.append(health.check_environment(
+            environment_info(), config={"dtype": dtype}))
+        # enrich like cmd_run's printed report: check_report scales its
+        # mass tolerance by true_mean x nodes — a bare report would be
+        # judged at scale 1.0 and false-fail any topology with mass >> 1
+        report = engine.convergence_report()
+        report["true_mean"] = engine.topology.true_mean
+        report["nodes"] = engine.topology.num_nodes
+        checks.append(health.check_report(report, dtype=dtype))
+    if not checks:
+        raise SystemExit(
+            "doctor: nothing to judge — pass saved report paths, "
+            "--baselines, or a topology (--generator/--deployment) for "
+            "a live run")
+    print(json.dumps({"overall": health.overall(checks),
+                      "checks": [c.to_jsonable() for c in checks]}))
+    return health.exit_code(checks, strict=args.strict)
+
+
+def cmd_regress(args) -> int:
+    """``regress``: gate a fresh bench result / profile manifest against
+    the artifact history (obs/regress.py); exit 1 beyond the recorded
+    spread."""
+    from flow_updating_tpu.obs import health, regress
+
+    def _load(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as err:
+            raise SystemExit(f"regress: cannot read {path}: {err}")
+
+    fresh = _load(args.fresh)
+    against = _load(args.against) if args.against else None
+    checks = regress.gate(fresh, history_pattern=args.history,
+                          against=against, margin_pct=args.margin)
+    print(json.dumps({"overall": health.overall(checks),
+                      "checks": [c.to_jsonable() for c in checks]}))
+    return health.exit_code(checks)
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="flow_updating_tpu",
@@ -685,67 +893,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     run = sub.add_parser("run", help="one aggregation run")
     _add_common(run)
-    run.add_argument("--variant", default="collectall",
-                     choices=("collectall", "pairwise"))
-    run.add_argument("--fire-policy", default=None,
-                     choices=("reference", "every_round"),
-                     help="'reference' = faithful async dynamics; "
-                          "'every_round' = fast synchronous mode")
-    run.add_argument("--delivery", default="gather",
-                     choices=("gather", "scatter", "benes", "benes_fused"),
-                     help="message-delivery formulation (identical "
-                          "semantics; gather avoids TPU scatters, benes "
-                          "avoids TPU gathers too, benes_fused runs the "
-                          "benes network as fused Pallas passes — the "
-                          "fastest TPU form)")
-    run.add_argument("--spmv", default="xla",
-                     choices=("xla", "pallas", "benes", "benes_fused",
-                              "structured"),
-                     help="node-kernel neighbor-sum implementation "
-                          "(benes_fused batches the permutation-network "
-                          "stages into Pallas HBM passes; structured uses "
-                          "the generator's closed-form stencil — regular "
-                          "topologies only)")
-    run.add_argument("--segment", default="auto",
-                     choices=("auto", "segment", "ell", "benes",
-                              "benes_fused"),
-                     help="edge-kernel per-node reduction layout: jax.ops "
-                          "segment primitives vs scatter-free degree-"
-                          "bucketed ELL gather+row-reduce")
-    run.add_argument("--multichip", default="auto",
-                     choices=("auto", "halo", "pod"),
-                     help="distribution strategy under --shards: 'auto' "
-                          "= GSPMD (XLA places collectives), 'halo' = "
-                          "explicitly scheduled shard_map halo-exchange "
-                          "kernel (edge kernel only), 'pod' = pod-sharded "
-                          "fat-tree stencil (node kernel, "
-                          "--spmv structured, fat_tree generator with "
-                          "shards dividing k; one (k/2,)-element psum "
-                          "per round)")
-    run.add_argument("--halo", default="ppermute",
-                     choices=("ppermute", "allgather"),
-                     help="halo kernel's cut-edge exchange collective")
-    run.add_argument("--partition", default="bfs",
-                     choices=("bfs", "contiguous"),
-                     help="halo kernel's node partition order")
-    run.add_argument("--shards", type=int, default=0,
-                     help="shard the node axis over N devices (GSPMD over a "
-                          "jax Mesh; 0 = single device)")
-    run.add_argument("--kernel", default="edge", choices=("edge", "node"),
-                     help="'edge' = general per-edge kernel; 'node' = "
-                          "collapsed SpMV recurrence (fast synchronous "
-                          "collect-all only, the throughput path)")
-    run.add_argument("--drain", type=int, default=None,
-                     help="msgs processed per node per round (0=unbounded; "
-                          "reference semantics: 1)")
-    run.add_argument("--timeout", type=int, default=None,
-                     help="collect-all tick timeout / pairwise staleness "
-                          "rounds (reference: 50)")
-    run.add_argument("--delay-depth", type=int, default=None,
-                     help="in-flight ring depth (latency-warped rounds)")
-    run.add_argument("--pending-depth", type=int, default=None,
-                     help="per-edge mailbox FIFO depth (default: mode "
-                          "default — 2 in reference mode, 1 in fast mode)")
+    _add_kernel_flags(run)
     run.add_argument("--fidelity", action="store_true",
                      help="the measured-best network-fidelity preset for "
                           "the chosen --variant (faithful dynamics + "
@@ -783,8 +931,6 @@ def build_parser() -> argparse.ArgumentParser:
                           "estimate) (flowupdating-collectall.py:13-19); "
                           "the PDU's fields are fixed-size, so the "
                           "constant is exact for this protocol")
-    run.add_argument("--drop-rate", type=float, default=0.0,
-                     help="per-message loss probability (fault injection)")
     run.add_argument("--rounds", type=int, default=None,
                      help="run exactly N rounds (no watcher)")
     run.add_argument("--until-rmse", type=float, default=None,
@@ -935,6 +1081,10 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--include-series", action="store_true",
                     help="embed each instance's full per-round series "
                          "in the manifest records (large)")
+    sw.add_argument("--profile", action="store_true",
+                    help="attach per-bucket AOT cost attribution "
+                         "(flops, bytes, peak memory, compile wall — "
+                         "obs/profile.py) to the sweep summary/manifest")
     sw.add_argument("--report", metavar="PATH",
                     help="write the flow-updating-sweep-report/v1 "
                          "manifest (one record per instance) to PATH")
@@ -972,6 +1122,74 @@ def build_parser() -> argparse.ArgumentParser:
                      help="output path (default: <eventlog>.trace.json; "
                           "'-' = stdout)")
     exp.set_defaults(fn=cmd_obs_export_trace)
+
+    pr = sub.add_parser(
+        "profile",
+        help="AOT cost attribution of the configured kernel's round "
+             "program: XLA cost/memory analysis (flops, bytes accessed, "
+             "peak memory), compile-vs-execute wall split, device "
+             "memory stats and compile-cache counters — a pure "
+             "observer, the plain program is untouched (obs/profile.py)")
+    _add_common(pr)
+    _add_kernel_flags(pr)
+    pr.add_argument("--latency-scale", type=float, default=0.0,
+                    help=">0: latency-warped delays from platform "
+                         "latencies (as in `run`)")
+    pr.add_argument("--rounds", type=int, default=64,
+                    help="scan length to attribute (static — flops scale "
+                         "with it; the per_round block amortizes)")
+    pr.add_argument("--no-execute", action="store_true",
+                    help="skip the timed execution (cost/memory + "
+                         "compile split only)")
+    pr.add_argument("--report", metavar="PATH",
+                    help="write the flow-updating-profile-report/v1 "
+                         "manifest (argv, config, topology fingerprint, "
+                         "environment, attribution) to PATH")
+    pr.set_defaults(fn=cmd_profile)
+
+    dr = sub.add_parser(
+        "doctor",
+        help="rule-based health verdicts with evidence: NaN/divergence "
+             "watchdog, RMSE-stall detection, mass-conservation and "
+             "antisymmetry drift, environment sanity, recorded-baseline "
+             "validity — on saved manifests and/or a live telemetry "
+             "run; exit 1 on any fail (obs/health.py)")
+    _add_common(dr)
+    _add_kernel_flags(dr)
+    dr.add_argument("reports", nargs="*", metavar="REPORT.json",
+                    help="saved flow-updating-*-report/v1 manifests to "
+                         "judge")
+    dr.add_argument("--latency-scale", type=float, default=0.0)
+    dr.add_argument("--rounds", type=int, default=200,
+                    help="live-run length (with --generator/"
+                         "--deployment)")
+    dr.add_argument("--rmse-threshold", type=float, default=1e-6,
+                    help="convergence threshold for the stall check")
+    dr.add_argument("--baselines", nargs="?",
+                    const="BASELINE_MEASURED.json", metavar="PATH",
+                    help="audit recorded DES baselines against the "
+                         "spread validity gate (default file: "
+                         "BASELINE_MEASURED.json)")
+    dr.add_argument("--strict", action="store_true",
+                    help="warnings also exit 1")
+    dr.set_defaults(fn=cmd_doctor)
+
+    rg = sub.add_parser(
+        "regress",
+        help="perf regression gate: compare a fresh bench result line "
+             "or profile manifest against the BENCH_* artifact history "
+             "/ a reference manifest, flagging drops beyond the "
+             "recorded spread; exit 1 on regression (obs/regress.py)")
+    rg.add_argument("--fresh", required=True, metavar="PATH",
+                    help="fresh bench JSON line or profile manifest")
+    rg.add_argument("--against", metavar="PATH",
+                    help="reference profile manifest to compare against")
+    rg.add_argument("--history", default="BENCH_*.json", metavar="GLOB",
+                    help="bench artifact history (default: BENCH_*.json "
+                         "in the working directory)")
+    rg.add_argument("--margin", type=float, default=None, metavar="PCT",
+                    help="override the allowed drop/growth percentage")
+    rg.set_defaults(fn=cmd_regress)
 
     return ap
 

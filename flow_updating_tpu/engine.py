@@ -292,6 +292,15 @@ class Engine:
         node-collapsed kernel, or an ActorKernel driving a VectorActor)."""
         return self.config.kernel == "node" or self._custom_actor is not None
 
+    @property
+    def _kernel_kind(self) -> str:
+        """The kernel dispatch mode: 'edge' (single-device and GSPMD),
+        'node', 'halo', or 'pod' — the key telemetry support and cost
+        attribution dispatch on."""
+        return ("halo" if self._halo_mode else
+                "pod" if self._pod_mode else
+                "node" if self._node_like else "edge")
+
     def load_deployment(self, path: str, function: str | None = None) -> "Engine":
         if function is None and len(self._registered) == 1:
             function = next(iter(self._registered))
@@ -971,9 +980,7 @@ class Engine:
                 "telemetry series cover the built-in kernels; a custom "
                 "VectorActor defines its own carry — sample it from the "
                 "actor's scan instead")
-        kind = ("halo" if self._halo_mode else
-                "pod" if self._pod_mode else
-                "node" if self._node_like else "edge")
+        kind = self._kernel_kind
         spec = spec.for_kernel(kind)
         import jax
         import jax.numpy as jnp
@@ -1023,6 +1030,78 @@ class Engine:
         self._clock += n * TICK_INTERVAL
         return TelemetrySeries({k: np.asarray(v) for k, v in
                                 series.items()})
+
+    def profile(self, n: int, *, execute: bool = True) -> dict:
+        """AOT cost attribution of the configured kernel's plain
+        ``n``-round program: XLA's own ``cost_analysis()`` (flops, bytes
+        accessed) and ``memory_analysis()`` (argument/output/temp/peak
+        bytes) for the exact executable :meth:`run_rounds` dispatches,
+        plus the compile-vs-execute wall split, device
+        ``memory_stats()`` (TPU), and the profile layer's compile-cache
+        hit counters.
+
+        Profiling is a pure observer: it lowers the SAME jitted
+        function with the SAME arguments the plain path calls (each
+        kernel's ``round_program`` hook), never instruments the scan,
+        and does not advance engine state — the timed execution runs
+        from the current state and its result is discarded
+        (tests/test_profile.py asserts program identity and
+        state-untouched).
+        """
+        from flow_updating_tpu.obs import profile as _prof
+
+        if n <= 0:
+            raise ValueError("profile needs a positive round count")
+        if self.state is None:
+            self.build()
+        if self._custom_actor is not None:
+            raise NotImplementedError(
+                "cost attribution covers the built-in kernels; a custom "
+                "VectorActor owns its scan — lower it with "
+                "obs.profile.profile_program directly")
+        kind = self._kernel_kind
+        if kind == "halo":
+            from flow_updating_tpu.parallel import sharded
+
+            fn, args, nd = sharded.round_program(
+                self.state, self._halo_plan, self.config, self.mesh, n,
+                arrays=self._halo_arrays, halo=self.halo)
+        elif kind == "pod":
+            fn, args, nd = self._node_kernel.round_program(self.state, n)
+        elif kind == "node":
+            from flow_updating_tpu.models import sync
+
+            if not isinstance(self._node_kernel, sync.NodeKernel):
+                raise NotImplementedError(
+                    f"cost attribution is not wired into "
+                    f"{type(self._node_kernel).__name__} yet — use the "
+                    "plain NodeKernel, the pod kernel, or the edge "
+                    "kernel")
+            fn, args, nd = self._node_kernel.round_program(self.state, n)
+        else:
+            fn, args, nd = (run_rounds,
+                            (self.state, self._topo_arrays, self.config, n),
+                            2)
+        record = _prof.profile_program(fn, args, n_dynamic=nd,
+                                       execute=execute, label=kind)
+        record.update({
+            "mode": kind,
+            "rounds": n,
+            "per_round": _prof.per_round(record, n),
+            "topology": {"nodes": self.topology.num_nodes,
+                         "edges": self.topology.num_edges},
+            "config": {"kernel": self.config.kernel,
+                       "variant": self.config.variant,
+                       "fire_policy": self.config.fire_policy,
+                       "spmv": self.config.spmv,
+                       "delivery": self.config.delivery,
+                       "dtype": self.config.dtype,
+                       "multichip": (self.multichip
+                                     if self.mesh is not None else None),
+                       "shards": (int(self.mesh.devices.size)
+                                  if self.mesh is not None else 0)},
+        })
+        return record
 
     def run_until_rmse(
         self, threshold: float, max_rounds: int = 100_000,

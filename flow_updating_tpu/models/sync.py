@@ -277,6 +277,15 @@ class NodeKernel:
     def run(self, state: NodeSyncState, num_rounds: int) -> NodeSyncState:
         return run_rounds_node(state, self.arrays, self.cfg, num_rounds)
 
+    def round_program(self, state: NodeSyncState, num_rounds: int):
+        """``(jitted_fn, full_args, n_dynamic)`` for the plain round
+        scan — the AOT cost-attribution hook
+        (:mod:`flow_updating_tpu.obs.profile`).  The function/argument
+        split is exactly what :meth:`run` calls, so the profiled
+        executable IS the plain program."""
+        return (run_rounds_node,
+                (state, self.arrays, self.cfg, num_rounds), 2)
+
     def run_streamed(self, state: NodeSyncState, num_rounds: int,
                      observe_every: int, emit) -> NodeSyncState:
         return run_rounds_node_streamed(

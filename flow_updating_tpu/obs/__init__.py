@@ -1,17 +1,29 @@
 """Observability subsystem: device-resident telemetry, run manifests,
-and the DES trace exporter.
+the DES trace exporter, and the measurement-to-verdict layer.
 
-Three pillars (docs/OBSERVABILITY.md):
+Six pillars (docs/OBSERVABILITY.md):
 
 * :mod:`~flow_updating_tpu.obs.telemetry` — the metric spec/series
   contract for per-round series accumulated *inside* the compiled round
   scan (no ``jax.debug.callback`` in the hot path; one bulk host
   transfer at the end).  The per-kernel runners live with their kernels.
-* :mod:`~flow_updating_tpu.obs.report` — the self-describing JSON run
-  manifest every CLI entry point can emit (``--report``).
+* :mod:`~flow_updating_tpu.obs.report` — the self-describing JSON
+  manifests every CLI entry point can emit (``--report``): run, sweep,
+  and profile schemas.
 * :mod:`~flow_updating_tpu.obs.trace` — EventLog JSONL -> Chrome
   trace-event / Perfetto converter (``obs export-trace``), the TPU-native
   answer to SimGrid's Paje traces.
+* :mod:`~flow_updating_tpu.obs.profile` — AOT cost attribution
+  (flops / bytes / peak memory / compile-vs-execute split) for every
+  kernel dispatch mode (``Engine.profile``, the ``profile`` subcommand,
+  ``bench.py --profile``).
+* :mod:`~flow_updating_tpu.obs.health` — rule-based health verdicts
+  over series and manifests (the ``doctor`` subcommand): NaN/divergence
+  watchdog, stall detection, invariant drift, environment and recorded-
+  baseline sanity.
+* :mod:`~flow_updating_tpu.obs.regress` — fresh bench/profile reports
+  gated against the artifact history and recorded spreads (the
+  ``regress`` subcommand; CI-consumable exit codes).
 
 ``observer_sample`` is re-exported here as the ONE watch-record shape:
 every streamed-observer emit site and :meth:`TelemetrySeries.
@@ -26,7 +38,13 @@ from flow_updating_tpu.obs.telemetry import (
     TelemetrySeries,
     TelemetrySpec,
 )
-from flow_updating_tpu.obs.report import build_manifest, write_report
+from flow_updating_tpu.obs.health import CheckResult, diagnose_manifest
+from flow_updating_tpu.obs.profile import profile_program
+from flow_updating_tpu.obs.report import (
+    build_manifest,
+    build_profile_manifest,
+    write_report,
+)
 from flow_updating_tpu.obs.trace import eventlog_to_chrome_trace, read_eventlog
 from flow_updating_tpu.utils.metrics import observer_sample
 
@@ -34,9 +52,13 @@ __all__ = [
     "ALL_METRICS",
     "DEFAULT_METRICS",
     "SUPPORTED_METRICS",
+    "CheckResult",
     "TelemetrySeries",
     "TelemetrySpec",
     "build_manifest",
+    "build_profile_manifest",
+    "diagnose_manifest",
+    "profile_program",
     "write_report",
     "eventlog_to_chrome_trace",
     "read_eventlog",

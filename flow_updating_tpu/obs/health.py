@@ -1,0 +1,443 @@
+"""Rule-based run health: machine verdicts instead of eyeballed series.
+
+Telemetry (PR 2) samples Flow-Updating's core invariants — mass
+conservation and flow antisymmetry — and records convergence series, but
+nothing *judges* them: a NaN'd run, a stalled RMSE plateau, or a slow
+mass leak under churn is only visible by reading the curves.  This
+module turns each of those into a check returning a
+:class:`CheckResult` (``pass`` / ``warn`` / ``fail`` / ``skip`` with
+evidence), and the ``doctor`` CLI subcommand runs them — live on a
+fresh telemetry run, or offline on any saved
+``flow-updating-*-report/v1`` manifest — with a CI-consumable exit
+code.
+
+Checks (each standalone; ``diagnose_series`` / ``diagnose_manifest``
+bundle them):
+
+* :func:`check_divergence` — NaN/Inf watchdog over every series plus
+  runaway-RMSE detection (the estimate moving *away* from the mean);
+* :func:`check_stall` — RMSE plateau above the convergence threshold
+  (converged-flat is a pass; stuck-flat is the stall);
+* :func:`check_mass_conservation` — |mass_residual| beyond what the
+  dtype's float tolerance explains (the paper's invariant);
+* :func:`check_antisymmetry` — max |flow[e] + flow[rev e]| beyond float
+  tolerance (edge-ledger kernels only);
+* :func:`check_environment` — backend sanity from a manifest's
+  ``environment`` block (backend init failures, x64-vs-dtype mismatch);
+* :func:`check_baselines` — recorded DES baselines violating the
+  current :data:`SPREAD_VALIDITY_PCT` gate (entries written before the
+  gate tightened; ``quarantined`` entries are acknowledged, not
+  re-flagged).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+PASS, WARN, FAIL, SKIP = "pass", "warn", "fail", "skip"
+
+_ORDER = {SKIP: 0, PASS: 1, WARN: 2, FAIL: 3}
+
+#: A recorded DES baseline whose min-max spread exceeds this percentage
+#: of the mean is too noisy to divide a headline by.  Mirrored by
+#: ``bench.SPREAD_VALIDITY_PCT`` (bench.py must stay importable without
+#: jax in the parent process, so it cannot import this module at top
+#: level); tests/test_doctor.py pins the two equal.
+SPREAD_VALIDITY_PCT = 35.0
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """One check's verdict: machine-readable status + human evidence."""
+
+    name: str
+    status: str
+    summary: str
+    evidence: dict = dataclasses.field(default_factory=dict)
+
+    def to_jsonable(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def overall(results) -> str:
+    """The run's verdict: the worst individual status (skip < pass <
+    warn < fail); ``skip`` if nothing ran."""
+    results = list(results)
+    if not results:
+        return SKIP
+    return max(results, key=lambda r: _ORDER[r.status]).status
+
+
+def exit_code(results, strict: bool = False) -> int:
+    """CI contract: 0 healthy, 1 on any ``fail`` (``warn`` too under
+    ``strict``)."""
+    worst = overall(results)
+    if worst == FAIL or (strict and worst == WARN):
+        return 1
+    return 0
+
+
+# ---- series access -------------------------------------------------------
+
+def _get(series, name):
+    """Uniform metric access over TelemetrySeries and plain dicts;
+    None when the metric was not recorded."""
+    if series is None:
+        return None
+    try:
+        if name not in series:
+            return None
+        return np.asarray(series[name], dtype=np.float64)
+    except TypeError:
+        return None
+
+
+def _metric_names(series) -> tuple:
+    if series is None:
+        return ()
+    if hasattr(series, "metrics"):
+        return tuple(series.metrics)
+    return tuple(k for k in series if k != "t")
+
+
+def _pooled(arr):
+    """Per-feature series pooled to one value per round (worst feature
+    magnitude) — invariant checks judge the worst offender."""
+    a = np.asarray(arr, dtype=np.float64)
+    return np.max(np.abs(a), axis=tuple(range(1, a.ndim))) if a.ndim > 1 \
+        else np.abs(a)
+
+
+def _float_tol(scale: float, dtype: str | None, rtol: float | None) -> float:
+    """Accumulated-roundoff allowance: ``rtol`` when given, else 64 ULPs
+    of the series' own magnitude (a generous bound for a few thousand
+    adds), floored away from zero."""
+    if rtol is not None:
+        return float(max(rtol * scale, 1e-300))
+    eps = float(np.finfo(np.dtype(dtype or "float32")).eps)
+    return float(max(64.0 * eps * scale, 64.0 * eps))
+
+
+def _inflight_allowance(series, w: int, factor: float) -> float:
+    """What in-flight traffic explains: sent-but-undelivered messages
+    perturb the mass/antisymmetry ledgers transiently (the invariant is
+    exact only at quiescence — utils/metrics.py), and each in-flight
+    message carries an O(per-node error) update.  The allowance is
+    ``factor`` x the tail's worst per-node error x the active node
+    count; at convergence it vanishes and the float tolerance is all
+    that remains."""
+    mae = _get(series, "max_abs_err")
+    if mae is None or mae.size == 0:
+        return 0.0
+    worst = float(np.max(_pooled(mae)[-w:]))
+    act = _get(series, "active")
+    n = float(np.max(act[-w:])) if act is not None and act.size else 1.0
+    return factor * worst * max(n, 1.0)
+
+
+# ---- series checks -------------------------------------------------------
+
+def check_divergence(series, *, explode_factor: float = 10.0,
+                     threshold: float = 1e-6) -> CheckResult:
+    """NaN/Inf watchdog over every recorded metric, plus runaway RMSE:
+    a final RMSE ``explode_factor``x above its starting point is moving
+    away from the mean, not toward it.  A final RMSE at or below
+    ``threshold`` is never divergence, whatever the ratio says — a
+    checkpoint-resumed run can START at the convergence floor, where
+    roundoff wobble easily exceeds any multiple of the start."""
+    name = "nan_divergence"
+    metrics = _metric_names(series)
+    if not metrics:
+        return CheckResult(name, SKIP, "no telemetry series to judge")
+    for m in metrics:
+        v = np.asarray(_get(series, m))
+        bad = ~np.isfinite(v)
+        if bad.any():
+            first = int(np.argwhere(bad)[0][0])
+            return CheckResult(
+                name, FAIL,
+                f"non-finite {m} from round index {first}",
+                {"metric": m, "first_bad_round": first,
+                 "bad_rounds": int(bad.any(axis=tuple(range(1, v.ndim)))
+                                   .sum() if v.ndim > 1 else bad.sum())})
+    rmse = _get(series, "rmse")
+    if rmse is None or rmse.size < 2:
+        return CheckResult(name, PASS, "all series finite",
+                           {"metrics": list(metrics)})
+    start, final = float(rmse[0]), float(rmse[-1])
+    if final > threshold and final > explode_factor * max(start, 1e-300):
+        return CheckResult(
+            name, FAIL,
+            f"rmse diverged: {start:.3e} -> {final:.3e} "
+            f"(> {explode_factor:g}x start)",
+            {"start_rmse": start, "final_rmse": final,
+             "explode_factor": explode_factor})
+    return CheckResult(name, PASS, "all series finite, rmse not diverging",
+                       {"start_rmse": start, "final_rmse": final})
+
+
+def check_stall(series, *, threshold: float = 1e-6, window: int = 32,
+                min_drop: float = 0.05) -> CheckResult:
+    """RMSE plateau: still above ``threshold`` yet improving less than
+    ``min_drop`` (fractional) over the trailing ``window`` rounds.  A
+    converged series is flat *at* the threshold — that is a pass, not a
+    stall."""
+    name = "rmse_stall"
+    rmse = _get(series, "rmse")
+    if rmse is None or rmse.size == 0:
+        return CheckResult(name, SKIP, "no rmse series recorded")
+    if not np.isfinite(rmse).all():
+        return CheckResult(name, SKIP,
+                           "rmse non-finite (see nan_divergence)")
+    final = float(rmse[-1])
+    if final <= threshold:
+        return CheckResult(name, PASS,
+                           f"converged (rmse {final:.3e} <= "
+                           f"{threshold:g})",
+                           {"final_rmse": final, "threshold": threshold})
+    if rmse.size < 8:
+        return CheckResult(name, SKIP,
+                           f"series too short to judge ({rmse.size} rounds)")
+    w = min(int(window), rmse.size - 1)
+    ref = float(rmse[-1 - w])
+    drop = 1.0 - final / ref if ref > 0 else 0.0
+    if drop < min_drop:
+        return CheckResult(
+            name, WARN,
+            f"rmse plateaued at {final:.3e} ({100 * drop:.1f}% drop over "
+            f"last {w} rounds, still above {threshold:g})",
+            {"final_rmse": final, "window": w, "drop_fraction": drop,
+             "threshold": threshold})
+    return CheckResult(name, PASS,
+                       f"still improving ({100 * drop:.1f}% over last "
+                       f"{w} rounds)",
+                       {"final_rmse": final, "window": w,
+                        "drop_fraction": drop})
+
+
+def check_mass_conservation(series, *, dtype: str | None = None,
+                            rtol: float | None = None, tail: int = 8,
+                            inflight_factor: float = 2.0) -> CheckResult:
+    """Flow-Updating's mass invariant: the alive-masked estimate sum
+    equals the input sum up to float roundoff *plus in-flight traffic*
+    (sent-but-undelivered messages perturb it transiently; it is exact
+    at quiescence).  The check therefore judges the trailing ``tail``
+    rounds — where a healthy run has settled — against 64 ULPs of the
+    mass magnitude plus the in-flight allowance; a residual the traffic
+    cannot explain is a leak."""
+    name = "mass_conservation"
+    res = _get(series, "mass_residual")
+    if res is None or res.size == 0:
+        return CheckResult(name, SKIP, "no mass_residual series recorded")
+    res_mag = _pooled(res)
+    if not np.isfinite(res_mag).all():
+        return CheckResult(name, FAIL, "non-finite mass_residual",
+                           {"first_bad_round": int(np.argwhere(
+                               ~np.isfinite(res_mag))[0][0])})
+    w = max(min(int(tail), res_mag.size), 1)
+    mass = _get(series, "mass")
+    scale = float(np.max(_pooled(mass))) if mass is not None and \
+        mass.size else 1.0
+    allowance = _inflight_allowance(series, w, inflight_factor)
+    tol = _float_tol(max(scale, 1.0), dtype, rtol) + allowance
+    tail_mag = res_mag[-w:]
+    worst_i = int(np.argmax(tail_mag))
+    worst = float(tail_mag[worst_i])
+    ev = {"max_abs_residual": worst,
+          "round_index": res_mag.size - w + worst_i,
+          "tail_rounds": w, "tolerance": tol,
+          "inflight_allowance": allowance, "mass_scale": scale}
+    if worst > tol:
+        return CheckResult(
+            name, FAIL,
+            f"mass leak: |residual| {worst:.3e} over the last {w} "
+            f"rounds exceeds tolerance {tol:.3e} (float roundoff + "
+            "in-flight allowance)",
+            ev)
+    return CheckResult(name, PASS,
+                       f"mass conserved (tail |residual| <= {worst:.3e})",
+                       ev)
+
+
+def check_antisymmetry(series, *, dtype: str | None = None,
+                       rtol: float | None = None, tail: int = 8,
+                       inflight_factor: float = 2.0) -> CheckResult:
+    """Flow antisymmetry: max |flow[e] + flow[rev e]| within float
+    tolerance once in-flight updates are accounted for (a sent,
+    undelivered flow update leaves the pair transiently unbalanced —
+    reference semantics).  Judged on the trailing ``tail`` rounds like
+    the mass check.  Only edge-ledger kernels record it; absent =
+    skip."""
+    name = "antisymmetry"
+    anti = _get(series, "antisymmetry")
+    if anti is None or anti.size == 0:
+        return CheckResult(
+            name, SKIP,
+            "no antisymmetry series (node-collapsed/halo kernels keep "
+            "no pairable edge ledgers)")
+    mag = _pooled(anti)
+    if not np.isfinite(mag).all():
+        return CheckResult(name, FAIL, "non-finite antisymmetry residual")
+    w = max(min(int(tail), mag.size), 1)
+    allowance = _inflight_allowance(series, w, inflight_factor)
+    tol = _float_tol(1.0, dtype, rtol) + allowance
+    tail_mag = mag[-w:]
+    worst_i = int(np.argmax(tail_mag))
+    worst = float(tail_mag[worst_i])
+    ev = {"max_violation": worst,
+          "round_index": mag.size - w + worst_i, "tail_rounds": w,
+          "tolerance": tol, "inflight_allowance": allowance}
+    if worst > tol:
+        return CheckResult(
+            name, FAIL,
+            f"antisymmetry violated: {worst:.3e} over the last {w} "
+            f"rounds exceeds tolerance {tol:.3e}",
+            ev)
+    return CheckResult(name, PASS,
+                       f"flows antisymmetric (tail <= {worst:.3e})", ev)
+
+
+# ---- manifest / environment / baseline checks ----------------------------
+
+def check_environment(env: dict | None, *, config: dict | None = None
+                      ) -> CheckResult:
+    """Backend sanity from a manifest's ``environment`` block: a
+    backend that failed to initialize is a fail; float64 configs on a
+    non-x64 runtime silently downcast — a warn."""
+    name = "environment"
+    if not env:
+        return CheckResult(name, SKIP, "no environment record")
+    if "backend_error" in env:
+        return CheckResult(name, FAIL,
+                           f"backend failed to initialize: "
+                           f"{env['backend_error']}",
+                           {"backend_error": env["backend_error"]})
+    if int(env.get("device_count", 1)) < 1:
+        return CheckResult(name, FAIL, "no devices visible",
+                           {"device_count": env.get("device_count")})
+    dtype = (config or {}).get("dtype")
+    if dtype == "float64" and not env.get("x64", True):
+        return CheckResult(
+            name, WARN,
+            "config asks for float64 but jax x64 is disabled — arrays "
+            "silently downcast to float32",
+            {"dtype": dtype, "x64": env.get("x64")})
+    return CheckResult(name, PASS,
+                       f"backend {env.get('backend', '?')} with "
+                       f"{env.get('device_count', '?')} device(s)",
+                       {k: env.get(k) for k in
+                        ("backend", "device_kind", "device_count", "jax")
+                        if k in env})
+
+
+def check_baselines(data: dict, *, gate: float = SPREAD_VALIDITY_PCT
+                    ) -> CheckResult:
+    """Audit ``BASELINE_MEASURED.json``: entries recorded before the
+    spread gate tightened may carry a min-max spread the current gate
+    would refuse — every ``vs_baseline`` ratio dividing by one is
+    leaning on noise.  ``quarantined: true`` entries are excluded from
+    ratio computation already (bench.recorded_baseline skips them), so
+    they are acknowledged, not re-flagged."""
+    name = "baseline_validity"
+    if not data:
+        return CheckResult(name, SKIP, "no recorded baselines")
+    bad, quarantined = [], []
+    for key, entry in data.items():
+        if not isinstance(entry, dict):
+            continue
+        if entry.get("quarantined"):
+            quarantined.append(key)
+            continue
+        spread = (entry.get("des") or {}).get("spread_pct")
+        if spread is not None and spread > gate:
+            bad.append({"key": key, "spread_pct": spread})
+    ev = {"gate_pct": gate, "violations": bad, "quarantined": quarantined}
+    if bad:
+        keys = ", ".join(f"{b['key']} ({b['spread_pct']:g}%)" for b in bad)
+        return CheckResult(
+            name, FAIL,
+            f"recorded baseline(s) exceed the {gate:g}% spread gate: "
+            f"{keys} — re-measure or quarantine them",
+            ev)
+    return CheckResult(name, PASS,
+                       f"all recorded baselines within the {gate:g}% "
+                       f"spread gate"
+                       + (f" ({len(quarantined)} quarantined)"
+                          if quarantined else ""),
+                       ev)
+
+
+def check_report(report: dict | None, *, dtype: str | None = None
+                 ) -> CheckResult:
+    """Final-state sanity from a run manifest's convergence report:
+    non-finite rmse or a mass residual beyond float tolerance at the
+    end of the run."""
+    name = "final_report"
+    if not report:
+        return CheckResult(name, SKIP, "no convergence report")
+    rmse = report.get("rmse")
+    if rmse is not None and not math.isfinite(float(rmse)):
+        return CheckResult(name, FAIL, f"final rmse is {rmse}",
+                           {"rmse": rmse})
+    residual = report.get("mass_residual")
+    if residual is not None:
+        mag = float(np.max(np.abs(np.asarray(residual, dtype=np.float64))))
+        tol = _float_tol(max(abs(float(report.get("true_mean", 1.0)))
+                             * float(report.get("nodes", 1)), 1.0),
+                         dtype, None)
+        if not math.isfinite(mag):
+            return CheckResult(name, FAIL, "non-finite final mass residual",
+                               {"mass_residual": residual})
+        if mag > tol:
+            return CheckResult(
+                name, FAIL,
+                f"final mass residual {mag:.3e} > tolerance {tol:.3e}",
+                {"mass_residual": mag, "tolerance": tol})
+    return CheckResult(name, PASS, "final report sane",
+                       {k: report.get(k) for k in
+                        ("rmse", "mass_residual", "t") if k in report})
+
+
+# ---- bundles -------------------------------------------------------------
+
+def diagnose_series(series, *, threshold: float = 1e-6,
+                    dtype: str | None = None) -> list:
+    """The full series rule set (live doctor / manifest telemetry)."""
+    return [
+        check_divergence(series, threshold=threshold),
+        check_stall(series, threshold=threshold),
+        check_mass_conservation(series, dtype=dtype),
+        check_antisymmetry(series, dtype=dtype),
+    ]
+
+
+def diagnose_manifest(manifest: dict) -> list:
+    """Judge a saved ``flow-updating-*-report/v1`` manifest: the
+    environment block, the final convergence report, and — when the run
+    recorded telemetry — the per-round series."""
+    config = manifest.get("config") or {}
+    if isinstance(config, dict) and "round" in config:
+        config = config.get("round") or {}
+    dtype = config.get("dtype") if isinstance(config, dict) else None
+    checks = [check_environment(manifest.get("environment"),
+                                config=config if isinstance(config, dict)
+                                else None)]
+    report = manifest.get("report")
+    if isinstance(report, dict):
+        checks.append(check_report(report, dtype=dtype))
+    tel = manifest.get("telemetry")
+    if isinstance(tel, dict) and tel.get("series"):
+        checks.extend(diagnose_series(tel["series"], dtype=dtype))
+    instances = manifest.get("instances")
+    if isinstance(instances, list) and instances:
+        n_conv = sum(1 for r in instances
+                     if (r.get("convergence") or {}).get("converged"))
+        status = PASS if n_conv else WARN
+        checks.append(CheckResult(
+            "sweep_convergence", status,
+            f"{n_conv}/{len(instances)} sweep instances converged",
+            {"converged": n_conv, "instances": len(instances)}))
+    return checks

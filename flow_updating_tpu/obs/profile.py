@@ -1,0 +1,242 @@
+"""AOT cost attribution: what a compiled round program *costs*.
+
+The telemetry subsystem (PR 2) records what happened per round; nothing
+records what the compiled program itself costs — FLOPs, bytes moved
+through HBM, device-memory residency, or how the wall time splits
+between XLA compilation and execution.  That attribution is exactly what
+communication-vs-compute trade-off work optimizes for (Gossip-PGA,
+arXiv:2105.09080), and it is available *without instrumenting the
+program*: ``jit(f).lower(...).compile()`` hands back XLA's own
+``cost_analysis()`` / ``memory_analysis()`` for the exact executable the
+plain path runs.  Profiling is therefore a pure *observer* — the
+program it measures is bit-identical to the un-profiled one (asserted in
+tests/test_profile.py).
+
+Entry points:
+
+* :func:`profile_program` — lower + compile + (optionally) execute one
+  jitted callable, returning the normalized attribution record;
+* :meth:`Engine.profile <flow_updating_tpu.engine.Engine.profile>` —
+  attribution for the engine's configured kernel dispatch mode
+  (edge / node / halo / pod);
+* the ``profile`` CLI subcommand and ``bench.py --profile`` — the same
+  record written as a ``flow-updating-profile-report/v1`` manifest;
+* batched sweeps (``sweep --profile``) attach one record per shape
+  bucket to the sweep manifest.
+
+Repeated profiles of the same program are served from a small in-process
+executable cache (so ``Engine.profile`` is cheap to call mid-run); the
+hit/miss counters are part of every record — the "did this recompile?"
+question the compile-cache counters exist to answer.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+#: process-wide AOT-executable cache counters (every record carries a
+#: snapshot; reset_cache() zeroes them — test isolation)
+CACHE_STATS = {"hits": 0, "misses": 0}
+
+_COMPILED: dict = {}
+
+#: CompiledMemoryStats field -> record key.  ``peak_memory_in_bytes`` is
+#: only populated by some backends (TPU); see the fallback below.
+_MEM_FIELDS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+    "peak_memory_in_bytes": "peak_bytes",
+}
+
+
+def reset_cache() -> None:
+    """Drop cached executables and zero the hit/miss counters."""
+    _COMPILED.clear()
+    CACHE_STATS["hits"] = CACHE_STATS["misses"] = 0
+
+
+def _num(x):
+    if isinstance(x, (np.floating, np.integer)):
+        return x.item()
+    return x
+
+
+#: program-level cost_analysis keys worth recording; the per-operand
+#: breakdown ("bytes accessed3{}", "utilization17{}", ...) is dozens of
+#: keys of manifest noise
+_RAW_KEYS = ("flops", "bytes accessed", "bytes accessedout{}",
+             "transcendentals", "optimal_seconds", "utilization")
+
+
+def normalize_cost_analysis(ca) -> dict:
+    """XLA's ``cost_analysis()`` across jax versions (list-of-dict per
+    partition, or a bare dict) -> ``{flops, bytes_accessed, raw}``."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    raw = {str(k): _num(v) for k, v in dict(ca or {}).items()
+           if isinstance(v, (int, float, np.floating, np.integer))
+           and str(k) in _RAW_KEYS}
+    return {
+        "flops": raw.get("flops"),
+        "bytes_accessed": raw.get("bytes accessed"),
+        "raw": raw,
+    }
+
+
+def normalize_memory_analysis(ma) -> dict:
+    """``memory_analysis()`` -> byte counts.  ``peak_bytes`` uses XLA's
+    own peak when the backend reports one; otherwise the live-set bound
+    arguments + outputs + temps - aliased (what the program holds
+    resident while running) with ``peak_source`` saying so."""
+    if ma is None:
+        return {"available": False}
+    out: dict = {"available": True}
+    for field, key in _MEM_FIELDS.items():
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[key] = int(v)
+    if "peak_bytes" not in out:
+        out["peak_bytes"] = (out.get("argument_bytes", 0)
+                             + out.get("output_bytes", 0)
+                             + out.get("temp_bytes", 0)
+                             - out.get("alias_bytes", 0))
+        out["peak_source"] = "arguments+outputs+temps-aliased"
+    else:
+        out["peak_source"] = "xla_peak_memory"
+    return out
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """The runtime allocator's view (``device.memory_stats()``): live
+    ``bytes_in_use`` / high-water ``peak_bytes_in_use`` on TPU; None on
+    backends that keep no stats (CPU)."""
+    import jax
+
+    d = device if device is not None else jax.devices()[0]
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {str(k): _num(v) for k, v in stats.items()}
+
+
+def _jit_cache_size(fn):
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+def _fingerprint(fn, args) -> tuple:
+    """Executable-cache key: the callable plus every argument's aval (or
+    its hash/repr for static leaves) — two calls with the same key lower
+    to the same XLA program."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(args)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append(("arr", tuple(shape), str(dtype)))
+        else:
+            try:
+                sig.append(("st", hash(leaf)))
+            except TypeError:
+                sig.append(("st", repr(leaf)))
+    return (fn, str(treedef), tuple(sig))
+
+
+def profile_program(fn, args=(), *, n_dynamic=None, execute=True,
+                    label=None, device=None) -> dict:
+    """Lower + compile ``fn(*args)`` ahead of time and return the cost
+    attribution record.
+
+    ``fn`` is a ``jax.jit``-wrapped callable; ``args`` is the FULL
+    argument tuple (static argnames included, exactly as a normal call);
+    ``n_dynamic`` is how many leading args are dynamic — the compiled
+    executable is invoked with ``args[:n_dynamic]`` (default: all).
+    ``execute=False`` skips the timed execution (cost/memory only).
+
+    The compiled executable is cached on the argument fingerprint, so
+    repeated profiles of an unchanged program are hits (compile wall
+    time is then the cached miss's measurement, flagged ``cache_hit``).
+    Profiling never touches the jit call cache — the plain path's
+    program is exactly what it was.
+    """
+    import jax
+
+    key = _fingerprint(fn, args)
+    hit = key in _COMPILED
+    if hit:
+        CACHE_STATS["hits"] += 1
+        compiled, lower_s, compile_s = _COMPILED[key]
+    else:
+        CACHE_STATS["misses"] += 1
+        t0 = time.perf_counter()
+        lowered = fn.lower(*args)
+        lower_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        _COMPILED[key] = (compiled, lower_s, compile_s)
+
+    try:
+        cost = normalize_cost_analysis(compiled.cost_analysis())
+    except Exception as exc:
+        cost = {"flops": None, "bytes_accessed": None, "raw": {},
+                "error": f"{type(exc).__name__}: {exc}"}
+    try:
+        memory = normalize_memory_analysis(compiled.memory_analysis())
+    except Exception as exc:
+        memory = {"available": False,
+                  "error": f"{type(exc).__name__}: {exc}"}
+
+    execute_s = None
+    if execute:
+        dyn = args if n_dynamic is None else args[:n_dynamic]
+        t0 = time.perf_counter()
+        out = compiled(*dyn)
+        jax.block_until_ready(out)
+        execute_s = time.perf_counter() - t0
+        del out
+
+    return {
+        "label": label,
+        "cost": cost,
+        "memory": memory,
+        "timings": {
+            "lower_s": round(lower_s, 6),
+            "compile_s": round(compile_s, 6),
+            "execute_s": (round(execute_s, 6)
+                          if execute_s is not None else None),
+        },
+        "compile_cache": {
+            "cache_hit": hit,
+            "hits": CACHE_STATS["hits"],
+            "misses": CACHE_STATS["misses"],
+            "jit_cache_size": _jit_cache_size(fn),
+        },
+        "device_memory_stats": device_memory_stats(device),
+    }
+
+
+def per_round(record: dict, rounds: int) -> dict:
+    """Amortize a whole-scan attribution over its round count — the
+    figure to compare across scan lengths and against round-rate
+    benches."""
+    r = max(int(rounds), 1)
+    cost = record.get("cost", {})
+    out = {}
+    for key in ("flops", "bytes_accessed"):
+        v = cost.get(key)
+        out[key] = (v / r) if isinstance(v, (int, float)) else None
+    return out

@@ -1,0 +1,232 @@
+"""Bench/profile regression gating: the perf trajectory, machine-checked.
+
+The repo accumulates ``BENCH_*.json`` headline artifacts and recorded
+DES baselines (``BASELINE_MEASURED.json``), but nothing *reads* them —
+a PR that halves the round rate ships unless a human happens to diff
+the JSON.  This module compares a fresh measurement against the
+history and flags drops beyond the recorded spread, with a
+CI-consumable exit code (the ``regress`` CLI subcommand).
+
+Two comparison shapes:
+
+* **bench**: a fresh ``bench.py`` result line vs the ``BENCH_*.json``
+  history.  Docs are grouped by ``(metric, unit, backend)`` — a CPU
+  fallback never gates a TPU headline — and the allowed drop below the
+  best recorded value is the larger of the history's own min-max
+  spread and a noise floor (the same validity logic the DES baseline
+  gate uses: spread is what the record itself proved the measurement
+  can wobble).
+* **profile**: a fresh ``flow-updating-profile-report/v1`` manifest vs
+  a reference one.  FLOPs / bytes-accessed / peak-bytes are properties
+  of the compiled program — deterministic, so any growth beyond the
+  margin is a real cost regression, not noise; wall times are judged
+  only at a much coarser margin.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+
+from flow_updating_tpu.obs.health import (
+    FAIL,
+    PASS,
+    SKIP,
+    WARN,
+    CheckResult,
+)
+
+#: minimum allowed drop (percent) before a bench value counts as a
+#: regression — two clean runs on the same machine wobble this much
+FLOOR_PCT = 10.0
+
+#: deterministic program-cost metrics: growth beyond this is real
+PROGRAM_MARGIN_PCT = 2.0
+
+#: wall-clock metrics (compile/execute) are machine-noisy; only flag
+#: coarse blowups
+WALL_MARGIN_PCT = 50.0
+
+
+def load_history(pattern: str) -> list:
+    """``(path, doc)`` for every parseable bench artifact matching
+    ``pattern``, oldest first (glob order is lexicographic, which the
+    ``BENCH_r<N>`` naming makes chronological).  Driver-wrapped
+    artifacts (the repo's ``BENCH_r*.json``: ``{n, cmd, rc, parsed}``)
+    are unwrapped to their ``parsed`` result line."""
+    out = []
+    for path in sorted(_glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if "metric" not in doc and isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        if "metric" in doc:
+            out.append((path, doc))
+    return out
+
+
+def _bench_group(doc: dict) -> tuple:
+    return (doc.get("metric"), doc.get("unit"), doc.get("backend"))
+
+
+def compare_bench(fresh: dict, history, *, margin_pct: float | None = None,
+                  floor_pct: float = FLOOR_PCT) -> list:
+    """Judge a fresh bench doc against same-group history entries."""
+    name = "bench_regression"
+    value = fresh.get("value")
+    if value is None:
+        return [CheckResult(name, FAIL,
+                            "fresh bench carries no measurement "
+                            "(value is null)",
+                            {"fresh": fresh.get("metric")})]
+    if fresh.get("ok") is False:
+        return [CheckResult(
+            name, WARN,
+            "fresh bench is a degraded/fallback measurement "
+            f"({fresh.get('degraded', 'ok=false')}) — not gated",
+            {"degraded": fresh.get("degraded")})]
+    group = _bench_group(fresh)
+    same = [(p, d) for p, d in history
+            if _bench_group(d) == group and d.get("value") is not None
+            and d.get("ok") is not False]
+    if not same:
+        return [CheckResult(
+            name, SKIP,
+            f"no history for metric {group[0]!r} on backend "
+            f"{group[2]!r}",
+            {"metric": group[0], "backend": group[2]})]
+    values = [d["value"] for _, d in same]
+    best = max(values)
+    best_path = next(p for p, d in same if d["value"] == best)
+    hist_spread = (100.0 * (best - min(values)) / best) if best > 0 else 0.0
+    allowed = (margin_pct if margin_pct is not None
+               else max(hist_spread, floor_pct))
+    drop = 100.0 * (best - value) / best if best > 0 else 0.0
+    ev = {"fresh_value": value, "best_value": best,
+          "best_artifact": os.path.basename(best_path),
+          "history_runs": len(same), "history_spread_pct":
+          round(hist_spread, 1), "allowed_drop_pct": round(allowed, 1),
+          "drop_pct": round(drop, 1)}
+    if drop > allowed:
+        return [CheckResult(
+            name, FAIL,
+            f"regression: {value:g} is {drop:.1f}% below the best "
+            f"recorded {best:g} ({os.path.basename(best_path)}), "
+            f"beyond the {allowed:.1f}% spread",
+            ev)]
+    verdict = ("new best" if value >= best else
+               f"within {allowed:.1f}% of the best recorded")
+    return [CheckResult(name, PASS, f"{value:g} {fresh.get('unit', '')}: "
+                        f"{verdict}", ev)]
+
+
+def _profile_block(doc: dict) -> dict | None:
+    """The attribution record inside either a bare ``Engine.profile``
+    dict or a profile manifest."""
+    if not isinstance(doc, dict):
+        return None
+    if "cost" in doc and "timings" in doc:
+        return doc
+    prof = doc.get("profile")
+    if isinstance(prof, dict):
+        return _profile_block(prof) or prof
+    return None
+
+
+def _pct_growth(new, old) -> float | None:
+    if not isinstance(new, (int, float)) or not isinstance(old, (int, float)):
+        return None
+    if old <= 0:
+        return None
+    return 100.0 * (new - old) / old
+
+
+def compare_profile(fresh: dict, against: dict, *,
+                    margin_pct: float = PROGRAM_MARGIN_PCT) -> list:
+    """Judge a fresh profile record against a reference one."""
+    f, a = _profile_block(fresh), _profile_block(against)
+    if f is None or a is None:
+        return [CheckResult("profile_regression", SKIP,
+                            "one of the documents carries no profile "
+                            "record")]
+    checks = []
+    program_metrics = (
+        ("flops", (f.get("cost") or {}).get("flops"),
+         (a.get("cost") or {}).get("flops")),
+        ("bytes_accessed", (f.get("cost") or {}).get("bytes_accessed"),
+         (a.get("cost") or {}).get("bytes_accessed")),
+        ("peak_bytes", (f.get("memory") or {}).get("peak_bytes"),
+         (a.get("memory") or {}).get("peak_bytes")),
+    )
+    for metric, new, old in program_metrics:
+        name = f"profile_{metric}"
+        growth = _pct_growth(new, old)
+        if growth is None:
+            checks.append(CheckResult(name, SKIP,
+                                      f"{metric} not recorded on both "
+                                      "sides"))
+            continue
+        ev = {"fresh": new, "reference": old,
+              "growth_pct": round(growth, 2),
+              "margin_pct": margin_pct}
+        if growth > margin_pct:
+            checks.append(CheckResult(
+                name, FAIL,
+                f"{metric} grew {growth:.1f}% ({old:g} -> {new:g}) — "
+                "the compiled program got more expensive",
+                ev))
+        else:
+            checks.append(CheckResult(
+                name, PASS, f"{metric} within {margin_pct:g}% "
+                f"({growth:+.1f}%)", ev))
+    new_t = (f.get("timings") or {}).get("execute_s")
+    old_t = (a.get("timings") or {}).get("execute_s")
+    growth = _pct_growth(new_t, old_t)
+    if growth is not None:
+        ev = {"fresh_s": new_t, "reference_s": old_t,
+              "growth_pct": round(growth, 1),
+              "margin_pct": WALL_MARGIN_PCT}
+        if growth > WALL_MARGIN_PCT:
+            checks.append(CheckResult(
+                "profile_execute_wall", WARN,
+                f"execution wall time grew {growth:.0f}% "
+                f"({old_t:g}s -> {new_t:g}s) — wall noise or a real "
+                "slowdown; re-measure",
+                ev))
+        else:
+            checks.append(CheckResult(
+                "profile_execute_wall", PASS,
+                f"execution wall within {WALL_MARGIN_PCT:g}% "
+                f"({growth:+.0f}%)", ev))
+    return checks
+
+
+def gate(fresh: dict, *, history_pattern: str | None = None,
+         against: dict | None = None,
+         margin_pct: float | None = None) -> list:
+    """Dispatch on document shape: profile manifests compare against a
+    reference manifest; bench lines compare against the artifact
+    history."""
+    if isinstance(fresh, dict) and "metric" not in fresh \
+            and isinstance(fresh.get("parsed"), dict):
+        fresh = fresh["parsed"]  # driver-wrapped artifact
+    if _profile_block(fresh) is not None and against is not None:
+        return compare_profile(fresh, against,
+                               **({"margin_pct": margin_pct}
+                                  if margin_pct is not None else {}))
+    if "metric" in fresh:
+        history = load_history(history_pattern or "BENCH_*.json")
+        return compare_bench(fresh, history, margin_pct=margin_pct)
+    if _profile_block(fresh) is not None:
+        return [CheckResult("profile_regression", SKIP,
+                            "profile document needs --against REFERENCE "
+                            "to compare with")]
+    return [CheckResult("regression", SKIP,
+                        "unrecognized document shape (neither a bench "
+                        "result line nor a profile report)")]

@@ -28,6 +28,7 @@ import time
 
 SCHEMA = "flow-updating-run-report/v1"
 SWEEP_SCHEMA = "flow-updating-sweep-report/v1"
+PROFILE_SCHEMA = "flow-updating-profile-report/v1"
 
 
 def environment_info() -> dict:
@@ -132,6 +133,29 @@ def build_sweep_manifest(*, argv=None, config=None, instances=None,
         "summary": dict(summary) if summary else None,
         "timings": dict(timings) if timings else None,
         "instances": list(instances) if instances is not None else [],
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_profile_manifest(*, argv=None, config=None, topo=None,
+                           profile=None, extra=None) -> dict:
+    """Assemble the profile-shaped v1 manifest: the run manifest's
+    argv/config/topology/environment binding around one AOT cost
+    attribution record (``Engine.profile()`` /
+    :func:`flow_updating_tpu.obs.profile.profile_program` output)."""
+    manifest = {
+        "schema": PROFILE_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "topology": topology_summary(topo) if topo is not None else None,
+        "environment": environment_info(),
+        "profile": profile,
     }
     if extra:
         manifest.update(extra)

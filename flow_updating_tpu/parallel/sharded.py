@@ -654,6 +654,18 @@ def run_rounds_sharded(
     O(cut) traffic — the default and the multi-pod path) or ``'allgather'``
     (broadcast; one collective, competitive at small S).
     """
+    fn, args, _ = round_program(state, plan, cfg, mesh, num_rounds,
+                                arrays=arrays, halo=halo)
+    return fn(*args)
+
+
+def round_program(state, plan: ShardPlan, cfg: RoundConfig,
+                  mesh: jax.sharding.Mesh, num_rounds: int,
+                  arrays=None, halo: str = "ppermute"):
+    """``(jitted_fn, full_args, n_dynamic)`` for the plain sharded round
+    scan — :func:`run_rounds_sharded` calls through this, and the AOT
+    cost-attribution layer (:mod:`flow_updating_tpu.obs.profile`) lowers
+    the same split, so the profiled executable IS the plain program."""
     if cfg.needs_coloring and plan.num_colors == 0:
         raise ValueError(
             "fast synchronous pairwise needs the edge coloring in the "
@@ -669,10 +681,9 @@ def run_rounds_sharded(
     if arrays is None:
         arrays = plan_device_arrays(plan, mesh)
     plan_arrays, halo_tables, perm = arrays
-    return _run_sharded(
-        state, plan_arrays, halo_tables, perm, cfg, mesh, num_rounds,
-        plan.Eb, plan.perm_offsets, halo, plan.num_colors,
-    )
+    return (_run_sharded,
+            (state, plan_arrays, halo_tables, perm, cfg, mesh, num_rounds,
+             plan.Eb, plan.perm_offsets, halo, plan.num_colors), 4)
 
 
 def _halo_telemetry_sample(st: FlowUpdatingState, pl: PlanArrays, spec,

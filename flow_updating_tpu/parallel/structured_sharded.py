@@ -158,6 +158,15 @@ class PodShardedFatTreeKernel:
         return self._run_jit(state, self.value, self.inv_depp1, self.deg,
                              num_rounds)
 
+    def round_program(self, state: PodState, num_rounds: int):
+        """``(jitted_fn, full_args, n_dynamic)`` for the plain pod round
+        scan — the AOT cost-attribution hook
+        (:mod:`flow_updating_tpu.obs.profile`); exactly what :meth:`run`
+        dispatches, so the profiled executable IS the plain program."""
+        return (self._run_jit,
+                (state, self.value, self.inv_depp1, self.deg, num_rounds),
+                4)
+
     def run_streamed(self, state: PodState, num_rounds: int,
                      observe_every: int, emit) -> PodState:
         """Host-chunked observer; the emit record shape is

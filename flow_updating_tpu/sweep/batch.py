@@ -71,6 +71,21 @@ def _run_bucket_telemetry(states, arrays, params, means, threshold, cfg,
     return states, conv, series
 
 
+def bucket_program(bucket, cfg, num_rounds: int, spec,
+                   rmse_threshold: float = 0.0):
+    """``(jitted_fn, full_args, n_dynamic)`` for the bucket's vmapped
+    telemetry scan — the AOT cost-attribution hook (``sweep --profile``
+    attaches one record per bucket to the sweep manifest).  The same
+    function/argument split :func:`run_bucket_telemetry` dispatches, so
+    the profiled executable IS the bucket's program."""
+    mean_dt = cfg.jnp_dtype
+    return (_run_bucket_telemetry,
+            (bucket.states, bucket.arrays, bucket.params,
+             jnp.asarray(bucket.means, mean_dt),
+             jnp.asarray(rmse_threshold, mean_dt), cfg, num_rounds, spec),
+            5)
+
+
 def run_bucket_telemetry(bucket, cfg, num_rounds: int, spec,
                          rmse_threshold: float = 0.0):
     """One compiled vmapped scan with per-round, per-lane telemetry.

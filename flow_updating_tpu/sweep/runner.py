@@ -47,7 +47,7 @@ def grid_instances(topos, seeds=(0,), drop_rates=(None,),
 
 def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
               rmse_threshold: float = 1e-6, max_batch: int | None = None,
-              include_series: bool = False):
+              include_series: bool = False, profile: bool = False):
     """Pack ``instances``, run every bucket, reduce to per-instance
     records.
 
@@ -57,6 +57,13 @@ def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
     ``include_series``, the per-round metric series; ``summary`` carries
     sweep-level aggregates (bucket shapes = compile count, wall time,
     converged count).
+
+    ``profile=True`` AOT-compiles each bucket's vmapped program once
+    more through the cost-attribution layer (obs/profile.py) and
+    attaches flops / bytes / peak-memory / compile-wall per bucket to
+    ``summary['buckets']`` — the per-bucket attribution the sweep
+    manifest records.  The execution split comes from the real run
+    (``run_s`` per bucket), so attribution never re-runs the sweep.
     """
     from flow_updating_tpu.obs.telemetry import TelemetrySpec
 
@@ -71,12 +78,28 @@ def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
     buckets = pack_instances(instances, cfg, max_batch=max_batch)
     pack_s = time.perf_counter() - t0
 
+    bucket_profiles: list = []
+    if profile:
+        from flow_updating_tpu.obs.profile import per_round, profile_program
+        from flow_updating_tpu.sweep.batch import bucket_program
+
+        for bucket in buckets:
+            fn, args, nd = bucket_program(bucket, cfg, rounds, spec,
+                                          rmse_threshold=rmse_threshold)
+            rec = profile_program(fn, args, n_dynamic=nd, execute=False,
+                                  label=f"bucket{bucket.shape}")
+            rec["per_round"] = per_round(rec, rounds)
+            bucket_profiles.append(rec)
+
     records: list = [None] * len(instances)
     converged = 0
+    bucket_run_s: list = []
     t0 = time.perf_counter()
     for bucket in buckets:
+        tb0 = time.perf_counter()
         _states, conv, series = run_bucket_telemetry(
             bucket, cfg, rounds, spec, rmse_threshold=rmse_threshold)
+        bucket_run_s.append(round(time.perf_counter() - tb0, 6))
         for lane, meta in enumerate(bucket.meta):
             rmse_series = series["rmse"][lane]
             rec = dict(meta)
@@ -105,10 +128,16 @@ def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
          np.shape(np.asarray(b.means)),
          b.params.drop_rate is None)
         for b in buckets}
+    bucket_rows = []
+    for i, b in enumerate(buckets):
+        row = {"shape": list(map(int, b.shape)), "size": b.size,
+               "run_s": bucket_run_s[i]}
+        if bucket_profiles:
+            row["profile"] = bucket_profiles[i]
+        bucket_rows.append(row)
     summary = {
         "instances": len(records),
-        "buckets": [{"shape": list(map(int, b.shape)), "size": b.size}
-                    for b in buckets],
+        "buckets": bucket_rows,
         "compiled_programs": len(compile_keys),
         "rounds": int(rounds),
         "converged": converged,

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""CI observability smoke: profile + doctor on a small topology.
+
+Runs the three measurement-to-verdict pillars end to end on CPU and
+leaves the manifests in ``--outdir`` (the tier1 workflow uploads them
+as build artifacts):
+
+1. ``profile`` — AOT cost attribution of the edge and node kernels on a
+   small ring, written as ``flow-updating-profile-report/v1`` manifests;
+2. ``run --telemetry --report`` — a real telemetry run manifest;
+3. ``doctor`` — judges the run manifest (and the profile manifests'
+   environment blocks); any failing check fails the job.
+
+Exit code: the doctor's (0 healthy; 1 on any failing check).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--generator", default="ring:64:2",
+                    help="smoke topology")
+    ap.add_argument("--rounds", type=int, default=200)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    from flow_updating_tpu.cli import main as cli_main
+
+    prof_edge = os.path.join(args.outdir, "profile_edge.json")
+    rc = cli_main(["profile", "--backend", "cpu",
+                   "--generator", args.generator,
+                   "--rounds", "32", "--report", prof_edge])
+    if rc != 0:
+        print(f"obs_smoke: edge profile failed (rc={rc})",
+              file=sys.stderr)
+        return rc or 1
+
+    prof_node = os.path.join(args.outdir, "profile_node.json")
+    rc = cli_main(["profile", "--backend", "cpu",
+                   "--generator", args.generator,
+                   "--kernel", "node", "--fire-policy", "every_round",
+                   "--rounds", "32", "--report", prof_node])
+    if rc != 0:
+        print(f"obs_smoke: node profile failed (rc={rc})",
+              file=sys.stderr)
+        return rc or 1
+
+    run_manifest = os.path.join(args.outdir, "run_telemetry.json")
+    rc = cli_main(["run", "--backend", "cpu",
+                   "--generator", args.generator,
+                   "--fire-policy", "every_round",
+                   "--rounds", str(args.rounds),
+                   "--telemetry", "full", "--report", run_manifest])
+    if rc != 0:
+        print(f"obs_smoke: telemetry run failed (rc={rc})",
+              file=sys.stderr)
+        return rc or 1
+
+    return cli_main(["doctor", run_manifest, prof_edge, prof_node])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

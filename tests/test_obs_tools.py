@@ -274,3 +274,70 @@ def test_export_trace_missing_and_garbage_input(tmp_path, capsys):
     bad.write_text("not json\n{broken\n")
     with pytest.raises(SystemExit, match="no parseable"):
         cli_main(["obs", "export-trace", str(bad)])
+
+
+# ---- environment_info failure path + manifest schema round-trips ---------
+# (profiling/doctor PR satellites: the manifest layer must survive a
+# backend that cannot initialize, and all three schemas must round-trip
+# through write_report byte-faithfully enough to be judged offline)
+
+def test_environment_info_backend_failure(monkeypatch):
+    """A backend init failure lands in the manifest as backend_error —
+    the manifest is still written, still carries python/numpy facts,
+    and the doctor turns the error into a fail verdict."""
+    import numpy as np_mod
+
+    from flow_updating_tpu.obs import health
+    from flow_updating_tpu.obs import report as rpt
+
+    def _boom():
+        raise RuntimeError("no backend tunnel")
+
+    monkeypatch.setattr(jax, "devices", _boom)
+    info = rpt.environment_info()
+    assert info["backend_error"] == "RuntimeError: no backend tunnel"
+    assert info["python"]
+    assert info["numpy"] == np_mod.__version__
+    assert "backend" not in info
+    assert health.check_environment(info).status == "fail"
+    json.dumps(info)
+
+
+def test_manifest_schemas_roundtrip(tmp_path):
+    """build_manifest / build_sweep_manifest / build_profile_manifest ->
+    write_report -> json.load preserves schema tag, argv/config binding
+    and the payload for all three schemas."""
+    from flow_updating_tpu.obs import report as rpt
+
+    topo = ring(8, k=2, seed=0)
+    cfg = RoundConfig.fast(variant="collectall")
+    run_m = rpt.build_manifest(
+        argv=["run", "--x"], config=cfg, topo=topo,
+        report={"rmse": 1e-7, "t": 5}, timings={"run_s": 0.25})
+    sweep_m = rpt.build_sweep_manifest(
+        argv=["sweep"], config=cfg,
+        instances=[{"instance": 0, "seed": 3,
+                    "convergence": {"converged": True}}],
+        summary={"instances": 1, "buckets": [{"shape": [10, 40]}]})
+    prof_m = rpt.build_profile_manifest(
+        argv=["profile"], config=cfg, topo=topo,
+        profile={"mode": "edge", "cost": {"flops": 123.0},
+                 "memory": {"peak_bytes": 4096},
+                 "timings": {"compile_s": 0.5, "execute_s": 0.01}})
+    for m, schema in ((run_m, rpt.SCHEMA), (sweep_m, rpt.SWEEP_SCHEMA),
+                      (prof_m, rpt.PROFILE_SCHEMA)):
+        path = tmp_path / (schema.split("/")[0] + ".json")
+        rpt.write_report(str(path), m)
+        loaded = json.loads(path.read_text())
+        assert loaded["schema"] == schema
+        assert loaded["argv"] == m["argv"]
+        assert loaded["config"]["variant"] == "collectall"
+        assert loaded["environment"]["python"]
+    loaded = json.loads((tmp_path / "flow-updating-profile-report.json")
+                        .read_text())
+    assert loaded["profile"]["cost"]["flops"] == 123.0
+    assert loaded["profile"]["memory"]["peak_bytes"] == 4096
+    assert loaded["topology"]["num_nodes"] == 8
+    sw = json.loads((tmp_path / "flow-updating-sweep-report.json")
+                    .read_text())
+    assert sw["instances"][0]["convergence"]["converged"] is True

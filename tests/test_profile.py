@@ -1,0 +1,201 @@
+"""AOT cost attribution (obs/profile.py + Engine.profile).
+
+The contract under test:
+
+* every kernel dispatch mode — edge, node, halo shard_map, pod-sharded
+  stencil — reports flops, bytes accessed, peak device memory and the
+  compile-vs-execute wall split;
+* profiling is a pure observer: the plain program's lowering is
+  bit-identical before and after a profile call, state evolution is
+  unchanged, and Engine.profile never advances the engine clock/state;
+* repeated profiles of an unchanged program hit the executable cache;
+* the `profile` CLI subcommand writes the
+  flow-updating-profile-report/v1 manifest; sweeps attach per-bucket
+  attribution; bench.py's helper attributes the headline config.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.cli import main as cli_main
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs import profile as obs_profile
+from flow_updating_tpu.obs.report import PROFILE_SCHEMA
+from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.topology.generators import erdos_renyi, fat_tree, ring
+
+
+def _make_engine(mode: str) -> Engine:
+    if mode == "edge":
+        return Engine(config=RoundConfig.reference(dtype="float64")) \
+            .set_topology(ring(32, k=2, seed=0))
+    if mode == "node":
+        return Engine(config=RoundConfig.fast(kernel="node",
+                                              dtype="float64")) \
+            .set_topology(ring(32, k=2, seed=0))
+    mesh = make_mesh(2)
+    if mode == "halo":
+        return Engine(config=RoundConfig.fast(dtype="float64"),
+                      mesh=mesh, multichip="halo") \
+            .set_topology(erdos_renyi(48, avg_degree=4.0, seed=3))
+    assert mode == "pod"
+    return Engine(config=RoundConfig.fast(kernel="node",
+                                          spmv="structured",
+                                          dtype="float64"),
+                  mesh=mesh, multichip="pod") \
+        .set_topology(fat_tree(4, seed=0))
+
+
+@pytest.mark.parametrize("mode", ["edge", "node", "halo", "pod"])
+def test_profile_attribution_all_modes(mode):
+    """Flops / bytes / peak memory / compile-vs-execute split present
+    and positive on every kernel dispatch mode."""
+    e = _make_engine(mode).build()
+    rec = e.profile(6)
+    assert rec["mode"] == mode
+    assert rec["cost"]["flops"] > 0
+    assert rec["cost"]["bytes_accessed"] > 0
+    assert rec["memory"]["available"]
+    assert rec["memory"]["peak_bytes"] > 0
+    assert rec["timings"]["compile_s"] > 0
+    assert rec["timings"]["execute_s"] is not None
+    assert rec["timings"]["execute_s"] > 0
+    assert rec["per_round"]["flops"] == pytest.approx(
+        rec["cost"]["flops"] / 6)
+    # the attribution is a pure observer: state never advanced
+    assert int(np.asarray(e.state.t).ravel()[0]) == 0
+    assert e.clock == 0.0
+    json.dumps(rec)  # manifest-ready
+
+
+def test_profile_leaves_plain_program_identical():
+    """The acceptance gate: with profiling off (i.e. not calling it —
+    there is no instrumented twin), the plain path lowers to the
+    bit-identical program before and after a profile, and state
+    evolution is unchanged by an interleaved profile call."""
+    topo = ring(24, k=2, seed=0)
+    cfg = RoundConfig.fast(dtype="float64")
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg)
+    text_before = run_rounds.lower(state, arrays, cfg, 12).as_text()
+
+    e1 = Engine(config=cfg).set_topology(topo).build()
+    e1.profile(12)
+    text_after = run_rounds.lower(state, arrays, cfg, 12).as_text()
+    assert text_before == text_after
+
+    e1.run_rounds(30)
+    e2 = Engine(config=cfg).set_topology(topo).build()
+    e2.run_rounds(30)
+    np.testing.assert_array_equal(np.asarray(e1.state.flow),
+                                  np.asarray(e2.state.flow))
+    np.testing.assert_array_equal(np.asarray(e1.state.value),
+                                  np.asarray(e2.state.value))
+
+
+def test_profile_executable_cache_hits():
+    obs_profile.reset_cache()
+    e = _make_engine("node").build()
+    first = e.profile(5)
+    again = e.profile(5)
+    assert not first["compile_cache"]["cache_hit"]
+    assert again["compile_cache"]["cache_hit"]
+    assert again["compile_cache"]["hits"] >= 1
+    # same compile measurement is reused, execution re-timed
+    assert (again["timings"]["compile_s"]
+            == first["timings"]["compile_s"])
+    other = e.profile(7)  # different static round count = new program
+    assert not other["compile_cache"]["cache_hit"]
+
+
+def test_profile_rejects_nonpositive_rounds():
+    e = _make_engine("edge")
+    with pytest.raises(ValueError, match="positive"):
+        e.profile(0)
+
+
+def test_profile_cli_writes_manifest(tmp_path, capsys):
+    out = tmp_path / "prof.json"
+    rc = cli_main(["profile", "--generator", "ring:24:2",
+                   "--rounds", "8", "--report", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == PROFILE_SCHEMA
+    assert doc["profile"]["cost"]["flops"] > 0
+    assert doc["profile"]["memory"]["peak_bytes"] > 0
+    assert doc["topology"]["num_nodes"] == 24
+    assert doc["environment"]["python"]
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["report_path"] == str(out)
+    assert line["mode"] == "edge"
+
+
+def test_profile_cli_no_execute(capsys):
+    rc = cli_main(["profile", "--generator", "ring:16:2", "--rounds", "4",
+                   "--kernel", "node", "--fire-policy", "every_round",
+                   "--no-execute"])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["timings"]["execute_s"] is None
+    assert line["cost"]["flops"] > 0
+
+
+def test_sweep_attaches_per_bucket_attribution():
+    from flow_updating_tpu.sweep import grid_instances, run_sweep
+
+    topo = ring(16, k=2, seed=0)
+    insts = grid_instances([("ring:16:2", topo)], seeds=[0, 1])
+    cfg = RoundConfig.reference(dtype="float64")
+    _records, summary = run_sweep(insts, cfg, 20, profile=True)
+    assert len(summary["buckets"]) == 1
+    b = summary["buckets"][0]
+    assert b["run_s"] > 0
+    prof = b["profile"]
+    assert prof["cost"]["flops"] > 0
+    assert prof["memory"]["peak_bytes"] > 0
+    # attribution compiles, never re-runs the sweep
+    assert prof["timings"]["execute_s"] is None
+    json.dumps(summary)
+
+
+def test_bench_profile_attribution_helper():
+    import bench
+
+    topo = bench.build_topology(4)
+    args = types.SimpleNamespace(kernel="node", spmv="auto", features=0,
+                                 fire_policy="fast", variant="collectall",
+                                 segment="auto", delivery="gather")
+    rec = bench.profile_attribution(topo, args,
+                                    {"kernel": "node", "spmv": "xla"},
+                                    rounds=8)
+    assert rec["mode"] == "node"
+    assert rec["cost"]["flops"] > 0
+    assert rec["memory"]["peak_bytes"] > 0
+    assert rec["per_round"]["flops"] > 0
+
+
+def test_bench_runner_exposes_the_measured_program():
+    """profile_attribution lowers make_runner's OWN round_program split,
+    so the attributed executable is the one the timed closure runs —
+    for both kernels."""
+    import bench
+
+    topo = bench.build_topology(4)
+    for kw in ({"kernel": "node", "spmv": "xla"},
+               {"kernel": "edge", "fire_policy": "reference"}):
+        run, _ = bench.make_runner(topo, **kw)
+        fn, fargs, nd = run.round_program(4)
+        out_direct = run(4)
+        out_program = fn(*fargs)
+        leaf = (out_direct.S if kw["kernel"] == "node"
+                else out_direct.flow)
+        leaf2 = (out_program.S if kw["kernel"] == "node"
+                 else out_program.flow)
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(leaf2))
+        assert nd <= len(fargs)
