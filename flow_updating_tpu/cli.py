@@ -24,6 +24,12 @@ Subcommands:
     topology (the SimGrid-CPU-class baseline) and print its convergence
     report — for apples-to-apples comparisons from the shell.
 
+``inspect``
+    Topology-resolved observability: record per-node/per-edge metric
+    fields on a live run, localize faults (``--blame``), diff two runs
+    (``--diff``), render heatmaps (``--heatmap``) — obs/fields.py,
+    obs/inspect.py, docs/OBSERVABILITY.md §7.
+
 ``train``
     Decentralized gossip-SGD / FedAvg on the vector-payload substrate
     (:mod:`flow_updating_tpu.workloads`): each node holds a parameter
@@ -725,6 +731,22 @@ def cmd_obs_export_trace(args) -> int:
 
     if not os.path.exists(args.eventlog):
         raise SystemExit(f"no such event log: {args.eventlog}")
+    # a run/sweep/profile/field MANIFEST is a single JSON document, not a
+    # JSONL event log — the most common mix-up; name the fix instead of
+    # reporting zero records (or worse, tracing a half-parsed file)
+    try:
+        with open(args.eventlog) as f:
+            doc = json.load(f)
+    except (ValueError, OSError):
+        doc = None
+    if isinstance(doc, dict) and "schema" in doc:
+        # a one-record JSONL event log also parses as a single JSON
+        # object; only the schema key marks a manifest
+        raise SystemExit(
+            f"{args.eventlog}: this is a {doc['schema']} manifest, not "
+            "an event log — export-trace consumes the JSONL file "
+            "written by `run --event-log PATH` (manifests are judged "
+            "by `doctor`, field manifests by `inspect`)")
     records = read_eventlog(args.eventlog)
     if not records:
         raise SystemExit(
@@ -797,6 +819,151 @@ def cmd_profile(args) -> int:
         ))
         prof["report_path"] = args.report
     print(json.dumps(prof))
+    return 0
+
+
+def _load_field_series(path: str):
+    """A manifest's fields block as a FieldSeries, with mix-ups named."""
+    from flow_updating_tpu.obs.fields import FieldSeries
+
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as err:
+        raise SystemExit(f"inspect: cannot read {path}: {err}")
+    if not isinstance(manifest, dict):
+        raise SystemExit(
+            f"inspect: {path} is not a manifest (expected a JSON object "
+            "with a 'fields' block — write one with `inspect --report` "
+            "or `run`'s field flags)")
+    block = manifest.get("fields")
+    if not isinstance(block, dict):
+        schema = manifest.get("schema", "unknown schema")
+        raise SystemExit(
+            f"inspect: {path} ({schema}) has no per-node/per-edge "
+            "fields block — record one with `inspect --generator ... "
+            "--fields ... --report PATH` (global-telemetry manifests "
+            "are judged by `doctor`)")
+    return FieldSeries.from_jsonable(block)
+
+
+def _emit_json(doc: dict, output: str | None) -> None:
+    if output and output != "-":
+        with open(output, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+            f.write("\n")
+        print(json.dumps({"output": output}))
+    else:
+        print(json.dumps(doc, default=str))
+
+
+def cmd_inspect(args) -> int:
+    """``inspect``: topology-resolved observability — record per-node /
+    per-edge metric fields on a live run (``--fields``, with
+    ``--field-stride``/``--field-topk`` memory bounding), localize
+    faults (``--blame``: straggler nodes, leaking edge pairs, divergence
+    origins), diff two runs (``--diff A B``) and render ASCII heatmaps
+    over the topology (``--heatmap FIELD``).  Field manifests use the
+    ``flow-updating-field-report/v1`` schema (obs/report.py)."""
+    from flow_updating_tpu.obs import inspect as _inspect
+
+    if args.diff:
+        a_path, b_path = args.diff
+        sa, sb = _load_field_series(a_path), _load_field_series(b_path)
+        try:
+            out = _inspect.diff_fields(sa, sb, atol=args.diff_atol)
+        except ValueError as err:
+            raise SystemExit(f"inspect --diff: {err}")
+        _emit_json({"a": a_path, "b": b_path, **out}, args.output)
+        return 0
+
+    targets = []
+    if args.generator or args.deployment:
+        from flow_updating_tpu.obs.fields import FieldSpec
+
+        try:
+            spec = FieldSpec.parse(
+                args.fields if args.fields is not None else "default",
+                stride=args.field_stride, topk=args.field_topk,
+                tol=args.conv_tol)
+        except ValueError as err:
+            raise SystemExit(f"--fields: {err}")
+        if not spec.enabled:
+            raise SystemExit(
+                "--fields off records nothing to inspect; pick a field "
+                "list (or 'default'/'full')")
+        _select_backend(args.backend,
+                        n_virtual_devices=args.shards or None)
+        import time as _time
+
+        engine = _engine_from_args(args)
+        t0 = _time.perf_counter()
+        try:
+            series = engine.run_fields(args.rounds, spec)
+        except (ValueError, NotImplementedError) as err:
+            raise SystemExit(f"inspect: {err}")
+        run_s = _time.perf_counter() - t0
+        if args.report:
+            from flow_updating_tpu.obs.report import (
+                build_field_manifest,
+                write_report,
+            )
+
+            report = engine.convergence_report()
+            report["true_mean"] = engine.topology.true_mean
+            report["nodes"] = engine.topology.num_nodes
+            write_report(args.report, build_field_manifest(
+                argv=getattr(args, "_argv", None), config=engine.config,
+                topo=engine.topology, fields=series, report=report,
+                timings={"run_s": round(run_s, 6)}))
+        targets.append((args.report or "<live>", series))
+    for path in args.reports:
+        targets.append((path, _load_field_series(path)))
+    if not targets:
+        raise SystemExit(
+            "inspect: nothing to inspect — pass saved field-manifest "
+            "paths, --diff A B, or a topology (--generator/"
+            "--deployment) for a live field recording")
+
+    if args.heatmap:
+        # human view: the rendered grid(s), not JSON
+        for path, series in targets:
+            if args.heatmap not in series:
+                raise SystemExit(
+                    f"inspect: field {args.heatmap!r} was not recorded "
+                    f"in {path} (have: {', '.join(series.fields)})")
+            vals = series[args.heatmap]
+            if args.heatmap != "node_conv_round":
+                try:
+                    vals = vals[args.heatmap_round]
+                except IndexError:
+                    raise SystemExit(
+                        f"inspect: --heatmap-round {args.heatmap_round} "
+                        f"outside the {len(series)} recorded rows")
+            if series.topk_idx is not None:
+                raise SystemExit(
+                    "inspect: heatmaps need full field rows; this run "
+                    "recorded only the topk worst nodes")
+            # topology coordinates are per-NODE; edge fields wrap in
+            # edge-id order instead
+            coords = (series.coords if args.heatmap not in series.edge
+                      else None)
+            print(f"# {path}: {args.heatmap}"
+                  + ("" if args.heatmap == "node_conv_round" else
+                     f" @ t={int(series.t[args.heatmap_round])}"))
+            print(_inspect.ascii_heatmap(vals, coords,
+                                         width=args.heatmap_width))
+        return 0
+
+    out = []
+    for path, series in targets:
+        entry = {"source": path, "fields": series.summary()}
+        if args.blame:
+            entry["blame"] = _inspect.blame(
+                series, threshold=args.rmse_threshold)
+        out.append(entry)
+    _emit_json(out[0] if len(out) == 1 else {"inspected": out},
+               args.output)
     return 0
 
 
@@ -1146,6 +1313,72 @@ def build_parser() -> argparse.ArgumentParser:
                          "manifest (argv, config, topology fingerprint, "
                          "environment, attribution) to PATH")
     pr.set_defaults(fn=cmd_profile)
+
+    ins = sub.add_parser(
+        "inspect",
+        help="topology-resolved observability: record per-node/per-edge "
+             "metric fields on a live run (device-resident, "
+             "stride/topk memory bounding), localize faults with "
+             "--blame (straggler nodes, leaking edge pairs, divergence "
+             "origins), diff two runs (--diff A B), render ASCII "
+             "heatmaps over the topology (--heatmap FIELD) — "
+             "flow-updating-field-report/v1 manifests (obs/fields.py, "
+             "obs/inspect.py)")
+    _add_common(ins)
+    _add_kernel_flags(ins)
+    ins.add_argument("reports", nargs="*", metavar="FIELDS.json",
+                     help="saved field manifests to inspect")
+    ins.add_argument("--latency-scale", type=float, default=0.0)
+    ins.add_argument("--rounds", type=int, default=200,
+                     help="live-run length (with --generator/"
+                          "--deployment); must be a multiple of "
+                          "--field-stride")
+    ins.add_argument("--fields", nargs="?", const="default",
+                     metavar="FIELDS",
+                     help="field selection for the live run: 'default', "
+                          "'full', or a comma list from: node_err, "
+                          "node_mass, node_mass_residual, node_fired, "
+                          "node_conv_round, edge_flow, edge_stale")
+    ins.add_argument("--field-stride", type=int, default=1, metavar="K",
+                     help="record every K-th round only (memory bound; "
+                          "state evolution is unchanged)")
+    ins.add_argument("--field-topk", type=int, default=0, metavar="M",
+                     help="record only the M worst nodes per round "
+                          "(ranked by |node_err|; single-device/GSPMD "
+                          "kernels)")
+    ins.add_argument("--conv-tol", type=float, default=1e-6,
+                     help="per-node convergence-frontier tolerance for "
+                          "node_conv_round")
+    ins.add_argument("--rmse-threshold", type=float, default=1e-6,
+                     help="stall-blame threshold: nodes above it with a "
+                          "flat error trend rank as stragglers")
+    ins.add_argument("--blame", action="store_true",
+                     help="rank culprit node/edge ids per failing "
+                          "symptom (stall stragglers, leaking edge "
+                          "pairs, divergence origin)")
+    ins.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                     help="align two field manifests on their common "
+                          "round grid and report per-node/per-metric "
+                          "deltas (identical-seed runs diff to zero)")
+    ins.add_argument("--diff-atol", type=float, default=0.0,
+                     help="absolute tolerance under which --diff "
+                          "deltas count as identical")
+    ins.add_argument("--heatmap", metavar="FIELD",
+                     help="render FIELD as an ASCII heatmap over the "
+                          "generator's coordinates (plain text output)")
+    ins.add_argument("--heatmap-round", type=int, default=-1,
+                     help="recorded row to render (default: last)")
+    ins.add_argument("--heatmap-width", type=int, default=64,
+                     help="wrap width when the topology has no "
+                          "coordinates")
+    ins.add_argument("--report", metavar="PATH",
+                     help="write the live run's "
+                          "flow-updating-field-report/v1 manifest to "
+                          "PATH")
+    ins.add_argument("-o", "--output", default=None, metavar="PATH",
+                     help="write the JSON result (summary/blame/diff) "
+                          "to PATH instead of stdout")
+    ins.set_defaults(fn=cmd_inspect)
 
     dr = sub.add_parser(
         "doctor",

@@ -1031,6 +1031,155 @@ class Engine:
         return TelemetrySeries({k: np.asarray(v) for k, v in
                                 series.items()})
 
+    def run_fields(self, n: int, spec=None):
+        """Run ``n`` rounds as ONE compiled scan that records the
+        ``spec``-selected PER-NODE / PER-EDGE metric fields on device
+        (:mod:`flow_updating_tpu.obs.fields`): same zero-callback design
+        as :meth:`run_telemetry`, one bulk transfer at the end, but at
+        topology resolution — the raw material for fault localization
+        (``inspect --blame``) and run-to-run diffing.
+
+        Dispatches to the kernel's fields runner (edge single-device and
+        GSPMD, node-collapsed, halo shard_map, pod-sharded stencil) and
+        re-assembles everything into ORIGINAL node/edge order.  A
+        disabled spec runs the PLAIN kernel — program-identical to
+        :meth:`run_rounds` — and returns an empty series; ``spec.stride``
+        bounds memory by recording every k-th round (state evolution is
+        bit-identical to the plain path at any stride)."""
+        from flow_updating_tpu.obs.fields import (
+            EDGE_FIELDS,
+            FieldSeries,
+            FieldSpec,
+        )
+
+        spec = FieldSpec.default() if spec is None else spec
+        if self.state is None:
+            self.build()
+        if not spec.enabled or self._killed or n <= 0:
+            self.run_rounds(n)
+            return FieldSeries.empty()
+        if self._custom_actor is not None:
+            raise NotImplementedError(
+                "field series cover the built-in kernels; a custom "
+                "VectorActor defines its own carry — sample it from the "
+                "actor's scan instead")
+        kind = self._kernel_kind
+        spec = spec.for_kernel(kind)
+        if not spec.enabled:
+            self.run_rounds(n)
+            return FieldSeries.empty()
+        if n % spec.stride:
+            raise ValueError(
+                f"round count {n} must be a multiple of the field "
+                f"stride {spec.stride}")
+        import jax
+        import jax.numpy as jnp
+
+        mean = jnp.asarray(self.topology.true_mean, self.config.jnp_dtype)
+        node: dict = {}
+        edge: dict = {}
+        conv = None
+        topk_idx = None
+        if kind == "halo":
+            from flow_updating_tpu.parallel import sharded
+
+            state, conv_b, series = sharded.run_rounds_sharded_fields(
+                self.state, self._halo_plan, self.config, self.mesh, n,
+                spec, mean, arrays=self._halo_arrays, halo=self.halo)
+            series = jax.device_get(series)
+            t = np.asarray(series.pop("t"))[0]
+            active = np.asarray(series.pop("active"))[0]
+            for name, v in series.items():
+                if name in EDGE_FIELDS:
+                    edge[name] = sharded.gather_edge_field_series(
+                        v, self._halo_plan, self.topology)
+                else:
+                    node[name] = sharded.gather_node_field_series(
+                        v, self._halo_plan)
+            if spec.has("node_conv_round"):
+                conv = sharded.gather_node_array(
+                    np.asarray(conv_b), self._halo_plan)
+        elif kind == "pod":
+            state, conv_s, series = self._node_kernel.run_fields(
+                self.state, n, spec)
+            series = jax.device_get(series)
+            t = np.asarray(series.pop("t"))[0]
+            active = np.asarray(series.pop("active"))[0]
+            for name, secs in series.items():
+                node[name] = self._node_kernel.flatten_field_series(secs)
+            if spec.has("node_conv_round"):
+                conv = self._node_kernel.flatten_field_final(
+                    jax.device_get(conv_s))
+        elif kind == "node":
+            from flow_updating_tpu.models import sync
+
+            if not isinstance(self._node_kernel, sync.NodeKernel):
+                raise NotImplementedError(
+                    f"field recording is not wired into "
+                    f"{type(self._node_kernel).__name__} yet — use the "
+                    "plain NodeKernel (spmv='xla'|'pallas'|'benes'|"
+                    "'structured'), the pod kernel, or the edge kernel")
+            state, conv_p, series = self._node_kernel.run_fields(
+                self.state, n, spec)
+            series = jax.device_get(series)
+            t = np.asarray(series.pop("t"))
+            active = np.asarray(series.pop("active"))
+            if "topk_idx" in series:
+                topk_idx = self._node_kernel.original_node_ids(
+                    np.asarray(series.pop("topk_idx")))
+                node.update({k: np.asarray(v) for k, v in series.items()})
+            else:
+                node.update({
+                    k: self._node_kernel.unpermute_series(np.asarray(v))
+                    for k, v in series.items()})
+            if spec.has("node_conv_round"):
+                conv = self._node_kernel._unpermute(np.asarray(conv_p))
+        else:
+            from flow_updating_tpu.models.rounds import run_rounds_fields
+
+            state, conv_p, series = run_rounds_fields(
+                self.state, self._topo_arrays, self.config, n, spec, mean)
+            series = jax.device_get(series)
+            t = np.asarray(series.pop("t"))
+            active = np.asarray(series.pop("active"))
+            n_real = self._n_real  # GSPMD mesh padding (None = exact)
+            E = self.topology.num_edges
+            if "topk_idx" in series:
+                # padded nodes are born dead (err masked to 0), so real
+                # ids outrank them and indices are already original ids —
+                # except when a real node's error is exactly 0 and
+                # top_k's index tie-break surfaces a ghost slot: map
+                # those to -1 (the node kernel's padding convention)
+                topk_idx = np.asarray(series.pop("topk_idx"))
+                if n_real is not None:
+                    topk_idx = np.where(topk_idx < n_real, topk_idx, -1)
+            for name, v in series.items():
+                v = np.asarray(v)
+                if name in EDGE_FIELDS:
+                    edge[name] = v[:, :E]
+                elif topk_idx is not None:
+                    node[name] = v
+                else:
+                    node[name] = v[:, :n_real] if n_real is not None else v
+            if spec.has("node_conv_round"):
+                conv = np.asarray(conv_p)
+                if n_real is not None:
+                    conv = conv[:n_real]
+        self.state = state
+        self._clock += n * TICK_INTERVAL
+        edges = None
+        if edge:
+            topo = self.topology
+            edges = {"src": np.asarray(topo.src),
+                     "dst": np.asarray(topo.dst),
+                     "rev": np.asarray(topo.rev)}
+        from flow_updating_tpu.obs.inspect import node_coordinates
+
+        return FieldSeries(
+            t=t, active=active, node=node, edge=edge, conv_round=conv,
+            topk_idx=topk_idx, spec=spec, edges=edges,
+            coords=node_coordinates(self.topology))
+
     def profile(self, n: int, *, execute: bool = True) -> dict:
         """AOT cost attribution of the configured kernel's plain
         ``n``-round program: XLA's own ``cost_analysis()`` (flops, bytes

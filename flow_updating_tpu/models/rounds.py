@@ -813,6 +813,108 @@ def run_rounds_telemetry(
     return state, series
 
 
+def _pool_abs(x):
+    """Per-entity magnitude with trailing feature axes pooled (max |.|)."""
+    if x.ndim > 1:
+        return jnp.max(jnp.abs(x), axis=tuple(range(1, x.ndim)))
+    return jnp.abs(x)
+
+
+def _pool_sum(x):
+    """Signed feature pooling (sum) — preserves flow antisymmetry."""
+    if x.ndim > 1:
+        return jnp.sum(x, axis=tuple(range(1, x.ndim)))
+    return x
+
+
+def field_sample(state, topo, spec, mean):
+    """One recorded row of per-node/per-edge fields for the edge kernel
+    (device-side, inside the scan — no callbacks).  ``spec`` is a static
+    :class:`~flow_updating_tpu.obs.fields.FieldSpec`.  Returns
+    ``(row, err)`` where ``err`` is the alive-masked signed estimate
+    error (None when no selected field needs it) — the convergence
+    frontier and topk ranking reuse it.
+
+    The masking matches :func:`telemetry_sample` exactly: alive nodes
+    only (mesh-padding dummies are born dead), so reducing each field
+    reproduces the global telemetry series (tests/test_fields.py)."""
+    row = {"t": state.t, "active": jnp.sum(state.alive.astype(jnp.int32))}
+    err = None
+    need_est = any(spec.has(f) for f in
+                   ("node_err", "node_mass", "node_mass_residual",
+                    "node_conv_round"))
+    if need_est:
+        est = node_estimates(state, topo)
+        a_ex = _ex(state.alive, est)
+        err = jnp.where(a_ex, est - mean, 0)
+        if spec.has("node_err"):
+            row["node_err"] = err
+        if spec.has("node_mass"):
+            row["node_mass"] = jnp.where(a_ex, est, 0)
+        if spec.has("node_mass_residual"):
+            row["node_mass_residual"] = jnp.where(a_ex, est - state.value, 0)
+    if spec.has("node_fired"):
+        row["node_fired"] = state.fired
+    if spec.has("edge_flow"):
+        row["edge_flow"] = _pool_sum(state.flow)
+    if spec.has("edge_stale"):
+        row["edge_stale"] = state.t - state.stamp
+    return row, err
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_rounds", "spec")
+)
+def run_rounds_fields(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int,
+    spec, true_mean, params: RoundParams | None = None,
+):
+    """Run ``num_rounds`` rounds as one compiled scan, accumulating the
+    ``spec``-selected per-node/per-edge FIELD rows on device (scan ys).
+    Returns ``(state, conv_round, series)`` — ``conv_round`` is the
+    ``(N,)`` int32 convergence frontier (-1 = never within ``spec.tol``),
+    ``series`` maps field name to a ``(R/stride, ...)`` device array.
+
+    Recording is a pure observer: the scan body applies the exact
+    :func:`round_step` sequence, so the state evolution is bit-identical
+    to :func:`run_rounds` at any stride (asserted in
+    tests/test_fields.py).  A disabled spec is rejected — callers
+    dispatch to :func:`run_rounds` instead (``Engine.run_fields``)."""
+    if not spec.enabled:
+        raise ValueError(
+            "field spec is disabled; run run_rounds() instead (the "
+            "Engine.run_fields dispatcher handles this)")
+    stride = spec.stride
+    if num_rounds % stride:
+        raise ValueError(
+            f"num_rounds={num_rounds} must be a multiple of the field "
+            f"stride {stride}")
+    mean = jnp.asarray(true_mean, state.value.dtype)
+    N = topo.out_deg.shape[0]
+    conv0 = jnp.full((N,), -1, jnp.int32)
+    track_conv = spec.has("node_conv_round")
+
+    def chunk(carry, _):
+        s, conv = carry
+        s = jax.lax.fori_loop(
+            0, stride, lambda _, x: round_step(x, topo, cfg, params=params),
+            s)
+        row, err = field_sample(s, topo, spec, mean)
+        if track_conv:
+            within = (_pool_abs(err) <= spec.tol) & s.alive
+            conv = jnp.where((conv < 0) & within, s.t, conv)
+        if spec.topk:
+            _, idx = jax.lax.top_k(_pool_abs(err), spec.topk)
+            for name in spec.node_series_fields:
+                row[name] = row[name][idx]
+            row["topk_idx"] = idx.astype(jnp.int32)
+        return (s, conv), row
+
+    (state, conv), series = jax.lax.scan(
+        chunk, (state, conv0), None, length=num_rounds // stride)
+    return state, conv, series
+
+
 @functools.partial(
     jax.jit, static_argnames=("cfg", "num_rounds", "observe_every")
 )

@@ -301,6 +301,31 @@ class NodeKernel:
             self.topo.true_mean,
         )
 
+    def run_fields(self, state: NodeSyncState, num_rounds: int, spec):
+        """Device-resident per-node field rows (see
+        :func:`run_rounds_node_fields`); returns ``(state, conv_round,
+        series)`` — all node-axis arrays still in the kernel's PADDED
+        PERMUTED order (``Engine.run_fields`` unpermutes via
+        :meth:`unpermute_series`)."""
+        return run_rounds_node_fields(
+            state, self.arrays, self.cfg, num_rounds, spec,
+            self.topo.true_mean)
+
+    def unpermute_series(self, padded: np.ndarray) -> np.ndarray:
+        """Unpermute a stacked ``(R, M, ...)`` per-node field series back
+        to ``(R, N, ...)`` original node order."""
+        out = np.empty((padded.shape[0], self.topo.num_nodes)
+                       + padded.shape[2:], padded.dtype)
+        out[:, self._perm] = padded[:, self._pos_of_real]
+        return out
+
+    def original_node_ids(self, padded_idx: np.ndarray) -> np.ndarray:
+        """Map padded-slot indices (e.g. a recorded ``topk_idx`` row) to
+        original node ids; padding slots map to -1."""
+        inv = np.full(self.padded_size, -1, np.int64)
+        inv[self._pos_of_real] = self._perm
+        return inv[np.asarray(padded_idx)]
+
     def _unpermute(self, padded: np.ndarray) -> np.ndarray:
         out = np.empty((self.topo.num_nodes,) + padded.shape[1:],
                        padded.dtype)
@@ -430,6 +455,79 @@ def run_rounds_node_telemetry(
 
     state, series = jax.lax.scan(body, state, None, length=num_rounds)
     return state, series
+
+
+def node_field_sample(s: NodeSyncState, arrs: NodeSyncArrays, spec,
+                      mean):
+    """One recorded row of per-node fields for the node-collapsed kernel
+    (padded permuted order — the host unpermutes).  Masking matches
+    :func:`node_telemetry_sample`: communicating rows only (deg > 0), so
+    reductions reproduce the node kernel's global series.  In fast sync
+    mode every communicating node fires every round, hence
+    ``node_fired = t`` per real row."""
+    real = arrs.inv_depp1 < 1.0
+    row = {"t": s.t, "active": jnp.sum(real.astype(jnp.int32))}
+    err = None
+    need_est = any(spec.has(f) for f in
+                   ("node_err", "node_mass", "node_mass_residual",
+                    "node_conv_round"))
+    if need_est:
+        est = arrs.value + s.G
+        r_ex = _ex(real, est)
+        err = jnp.where(r_ex, est - mean, 0)
+        if spec.has("node_err"):
+            row["node_err"] = err
+        if spec.has("node_mass"):
+            row["node_mass"] = jnp.where(r_ex, est, 0)
+        if spec.has("node_mass_residual"):
+            row["node_mass_residual"] = jnp.where(r_ex, est - arrs.value, 0)
+    if spec.has("node_fired"):
+        row["node_fired"] = s.t * real.astype(jnp.int32)
+    return row, err, real
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds", "spec"))
+def run_rounds_node_fields(
+    state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig,
+    num_rounds: int, spec, true_mean,
+):
+    """Node-kernel twin of
+    :func:`flow_updating_tpu.models.rounds.run_rounds_fields`: one
+    compiled scan, per-node field rows as ys every ``spec.stride``
+    rounds, the convergence frontier as an extra carry.  Returns
+    ``(state, conv_round, series)`` in padded permuted node order."""
+    from flow_updating_tpu.models.rounds import _pool_abs
+
+    if not spec.enabled:
+        raise ValueError(
+            "field spec is disabled; run run_rounds_node() instead")
+    stride = spec.stride
+    if num_rounds % stride:
+        raise ValueError(
+            f"num_rounds={num_rounds} must be a multiple of the field "
+            f"stride {stride}")
+    mean = jnp.asarray(true_mean, state.S.dtype)
+    conv0 = jnp.full(state.S.shape[:1], -1, jnp.int32)
+    track_conv = spec.has("node_conv_round")
+
+    def chunk(carry, _):
+        s, conv = carry
+        s = jax.lax.fori_loop(
+            0, stride, lambda _, x: node_round_step(x, arrs, cfg), s)
+        row, err, real = node_field_sample(s, arrs, spec, mean)
+        if track_conv:
+            within = (_pool_abs(err) <= spec.tol) & real
+            conv = jnp.where((conv < 0) & within, s.t, conv)
+        if spec.topk:
+            _, idx = jax.lax.top_k(_pool_abs(err), spec.topk)
+            for name in spec.node_series_fields:
+                row[name] = row[name][idx]
+            row["topk_idx"] = idx.astype(jnp.int32)
+        return (s, conv), row
+
+    (state, conv), series = jax.lax.scan(
+        chunk, (state, conv0), None, length=num_rounds // stride)
+    return state, conv, series
 
 
 def _node_sample(s: NodeSyncState, arrs: NodeSyncArrays, mean):

@@ -1,7 +1,15 @@
 """Observability subsystem: device-resident telemetry, run manifests,
 the DES trace exporter, and the measurement-to-verdict layer.
 
-Six pillars (docs/OBSERVABILITY.md):
+Seven pillars (docs/OBSERVABILITY.md):
+
+* :mod:`~flow_updating_tpu.obs.fields` +
+  :mod:`~flow_updating_tpu.obs.inspect` — TOPOLOGY-RESOLVED
+  observability: per-node/per-edge metric fields riding the round scan
+  (stride/topk memory bounding), fault localization ("blame": straggler
+  nodes, leaking edge pairs, divergence origins), run-to-run diffing and
+  topology heatmaps (the ``inspect`` subcommand;
+  ``flow-updating-field-report/v1`` manifests).
 
 * :mod:`~flow_updating_tpu.obs.telemetry` — the metric spec/series
   contract for per-round series accumulated *inside* the compiled round
@@ -38,9 +46,17 @@ from flow_updating_tpu.obs.telemetry import (
     TelemetrySeries,
     TelemetrySpec,
 )
+from flow_updating_tpu.obs.fields import (
+    ALL_FIELDS,
+    SUPPORTED_FIELDS,
+    FieldSeries,
+    FieldSpec,
+)
 from flow_updating_tpu.obs.health import CheckResult, diagnose_manifest
+from flow_updating_tpu.obs.inspect import ascii_heatmap, blame, diff_fields
 from flow_updating_tpu.obs.profile import profile_program
 from flow_updating_tpu.obs.report import (
+    build_field_manifest,
     build_manifest,
     build_profile_manifest,
     write_report,
@@ -49,15 +65,23 @@ from flow_updating_tpu.obs.trace import eventlog_to_chrome_trace, read_eventlog
 from flow_updating_tpu.utils.metrics import observer_sample
 
 __all__ = [
+    "ALL_FIELDS",
     "ALL_METRICS",
     "DEFAULT_METRICS",
+    "SUPPORTED_FIELDS",
     "SUPPORTED_METRICS",
     "CheckResult",
+    "FieldSeries",
+    "FieldSpec",
     "TelemetrySeries",
     "TelemetrySpec",
+    "ascii_heatmap",
+    "blame",
+    "build_field_manifest",
     "build_manifest",
     "build_profile_manifest",
     "diagnose_manifest",
+    "diff_fields",
     "profile_program",
     "write_report",
     "eventlog_to_chrome_trace",

@@ -37,6 +37,8 @@ import math
 
 import numpy as np
 
+from flow_updating_tpu.obs.report import SCHEMA as SCHEMA_RUN
+
 PASS, WARN, FAIL, SKIP = "pass", "warn", "fail", "skip"
 
 _ORDER = {SKIP: 0, PASS: 1, WARN: 2, FAIL: 3}
@@ -414,10 +416,53 @@ def diagnose_series(series, *, threshold: float = 1e-6,
     ]
 
 
+#: Which blame symptom localizes which failing series check — the
+#: culprit attachment map for field manifests.
+_FIELD_CULPRITS = {
+    "rmse_stall": "stall",
+    "mass_conservation": "leak",
+    "nan_divergence": "divergence",
+}
+
+
+def attach_field_culprits(checks, fields_block: dict) -> None:
+    """Enrich non-passing series checks with culprit node/edge ids from
+    a manifest's ``fields`` block (``inspect``'s blame layer): a stall
+    cites its straggler nodes, a mass leak its non-antisymmetric edge
+    pairs, a divergence its origin node — the localization the global
+    series alone cannot provide."""
+    from flow_updating_tpu.obs import inspect as _inspect
+
+    try:
+        verdicts = _inspect.blame(fields_block)
+    except (ValueError, TypeError, KeyError) as exc:
+        for c in checks:
+            if c.name in _FIELD_CULPRITS:
+                c.evidence.setdefault(
+                    "culprits_error", f"{type(exc).__name__}: {exc}")
+        return
+    for c in checks:
+        symptom = _FIELD_CULPRITS.get(c.name)
+        if symptom is None or c.status not in (WARN, FAIL):
+            continue
+        culprits = verdicts.get(symptom)
+        if culprits:
+            c.evidence["culprits"] = culprits
+
+
 def diagnose_manifest(manifest: dict) -> list:
     """Judge a saved ``flow-updating-*-report/v1`` manifest: the
     environment block, the final convergence report, and — when the run
-    recorded telemetry — the per-round series."""
+    recorded telemetry — the per-round series.  A manifest that recorded
+    nothing judgeable degrades to an explicit skip (how to record is in
+    the summary), never a traceback; a field manifest's non-passing
+    series checks additionally cite culprit node/edge ids
+    (:func:`attach_field_culprits`)."""
+    if not isinstance(manifest, dict):
+        raise ValueError(
+            f"expected a flow-updating-*-report/v1 manifest (a JSON "
+            f"object), got {type(manifest).__name__} — event logs are "
+            "JSONL and belong to `obs export-trace`, not doctor")
     config = manifest.get("config") or {}
     if isinstance(config, dict) and "round" in config:
         config = config.get("round") or {}
@@ -429,8 +474,18 @@ def diagnose_manifest(manifest: dict) -> list:
     if isinstance(report, dict):
         checks.append(check_report(report, dtype=dtype))
     tel = manifest.get("telemetry")
+    series_checks: list = []
     if isinstance(tel, dict) and tel.get("series"):
-        checks.extend(diagnose_series(tel["series"], dtype=dtype))
+        series_checks = diagnose_series(tel["series"], dtype=dtype)
+        checks.extend(series_checks)
+    elif manifest.get("schema") == SCHEMA_RUN:
+        checks.append(CheckResult(
+            "telemetry", SKIP,
+            "run manifest has no telemetry series — record one with "
+            "`run --telemetry --report PATH` for series-level checks"))
+    fields = manifest.get("fields")
+    if isinstance(fields, dict):
+        attach_field_culprits(series_checks, fields)
     instances = manifest.get("instances")
     if isinstance(instances, list) and instances:
         n_conv = sum(1 for r in instances

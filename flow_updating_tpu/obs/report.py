@@ -29,6 +29,7 @@ import time
 SCHEMA = "flow-updating-run-report/v1"
 SWEEP_SCHEMA = "flow-updating-sweep-report/v1"
 PROFILE_SCHEMA = "flow-updating-profile-report/v1"
+FIELD_SCHEMA = "flow-updating-field-report/v1"
 
 
 def environment_info() -> dict:
@@ -157,6 +158,45 @@ def build_profile_manifest(*, argv=None, config=None, topo=None,
         "environment": environment_info(),
         "profile": profile,
     }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_field_manifest(*, argv=None, config=None, topo=None,
+                         fields=None, report=None, timings=None,
+                         extra=None) -> dict:
+    """Assemble the field-shaped v1 manifest: the run manifest's
+    argv/config/topology/environment binding around one
+    :class:`~flow_updating_tpu.obs.fields.FieldSeries` — the per-node /
+    per-edge field block plus (when the run recorded full rows) the
+    GLOBAL series re-derived by reducing the fields, under the standard
+    ``telemetry`` key so the doctor's series checks run unchanged on
+    field manifests (and can then cite culprit ids from the fields —
+    obs/health.py)."""
+    manifest = {
+        "schema": FIELD_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "topology": topology_summary(topo) if topo is not None else None,
+        "environment": environment_info(),
+        "timings": dict(timings) if timings else None,
+        "report": report,
+    }
+    if fields is not None and fields:
+        manifest["fields"] = fields.to_jsonable()
+        reduced = fields.reduced_series()
+        if reduced:
+            manifest["telemetry"] = {
+                "metrics": [k for k in reduced if k != "t"],
+                "rounds": len(fields),
+                "derived_from": "fields",
+                "series": reduced,
+            }
     if extra:
         manifest.update(extra)
     return manifest

@@ -108,8 +108,14 @@ class TelemetrySpec:
         names = tuple(m.strip() for m in text.split(",") if m.strip())
         unknown = [m for m in names if m not in ALL_METRICS]
         if unknown:
+            # a typo must fail loudly with the whole vocabulary (and a
+            # closest-match hint) — silently recording nothing is the
+            # failure mode this guards against
+            from flow_updating_tpu.obs.fields import _suggest
+
             raise ValueError(
-                f"unknown telemetry metric(s) {unknown}; valid: "
+                f"unknown telemetry metric(s) {unknown}"
+                f"{_suggest(unknown[0], ALL_METRICS)}; valid: "
                 f"{', '.join(ALL_METRICS)} (or 'default'/'full'/'off')")
         # canonical order regardless of user order — stable jit keys
         return cls(metrics=tuple(m for m in ALL_METRICS if m in names))

@@ -823,6 +823,174 @@ def run_rounds_sharded_telemetry(
     return state, {k: v[0] for k, v in series.items()}
 
 
+def _halo_field_sample(st: FlowUpdatingState, pl: PlanArrays, spec, mean,
+                       Nb: int):
+    """One recorded per-node/per-edge field row on one shard, in the
+    LOCAL block layout (the host gathers back to original order with
+    :func:`gather_node_field_series` / :func:`gather_edge_field_series`).
+    Only ``t``/``active`` are collective (one scalar psum); the fields
+    themselves stay shard-local.  Masking matches
+    :func:`_halo_telemetry_sample` (padding rows are dead dummies)."""
+    from flow_updating_tpu.models.rounds import _pool_sum
+
+    row = {"t": st.t,
+           "active": jax.lax.psum(
+               jnp.sum(st.alive.astype(jnp.int32)), NODE_AXIS)}
+    err = None
+    need_est = any(spec.has(f) for f in
+                   ("node_err", "node_mass", "node_mass_residual",
+                    "node_conv_round"))
+    if need_est:
+        est = st.value - jax.ops.segment_sum(
+            st.flow, pl.src_local, num_segments=Nb)
+        a_ex = _ex(st.alive, est)
+        err = jnp.where(a_ex, est - mean, 0)
+        if spec.has("node_err"):
+            row["node_err"] = err
+        if spec.has("node_mass"):
+            row["node_mass"] = jnp.where(a_ex, est, 0)
+        if spec.has("node_mass_residual"):
+            row["node_mass_residual"] = jnp.where(a_ex, est - st.value, 0)
+    if spec.has("node_fired"):
+        row["node_fired"] = st.fired
+    if spec.has("edge_flow"):
+        row["edge_flow"] = _pool_sum(st.flow)
+    if spec.has("edge_stale"):
+        row["edge_stale"] = st.t - st.stamp
+    return row, err
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh", "num_rounds", "Eb", "Nb", "offsets",
+                     "halo_mode", "num_colors", "spec"),
+)
+def _run_sharded_fields(state, arrays, halo, perm, mean, cfg, mesh,
+                        num_rounds, Eb, Nb, offsets, halo_mode,
+                        num_colors, spec):
+    from flow_updating_tpu.models.rounds import _pool_abs
+
+    state_specs = jax.tree.map(_spec, state)
+    plan_specs = jax.tree.map(_spec, arrays)
+    halo_specs = jax.tree.map(lambda x: P(), halo)
+    perm_specs = jax.tree.map(_spec, perm)
+    S = mesh.devices.size
+    stride = spec.stride
+    track_conv = spec.has("node_conv_round")
+
+    def body(st_s, pl_s, halo_t, pm_s, mean_r):
+        st = jax.tree.map(lambda x: x[0], st_s)
+        pl = jax.tree.map(lambda x: x[0], pl_s)
+        pm = jax.tree.map(lambda x: x[0], pm_s)
+
+        def one_round(_, s):
+            if cfg.needs_coloring:
+                return _local_round_fastpair(
+                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
+                    num_colors)[0]
+            return _local_round(
+                s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode)[0]
+
+        def chunk(carry, _):
+            s, conv = carry
+            s = jax.lax.fori_loop(0, stride, one_round, s)
+            row, err = _halo_field_sample(s, pl, spec, mean_r, Nb)
+            if track_conv:
+                within = (_pool_abs(err) <= spec.tol) & s.alive
+                conv = jnp.where((conv < 0) & within, s.t, conv)
+            return (s, conv), row
+
+        conv0 = jnp.full((Nb,), -1, jnp.int32)
+        (st, conv), series = jax.lax.scan(
+            chunk, (st, conv0), None, length=num_rounds // stride)
+        # stack a unit shard axis on everything so the out_specs can
+        # concatenate the per-shard blocks (host reassembles from them)
+        return (jax.tree.map(lambda x: x[None], st), conv[None],
+                jax.tree.map(lambda x: x[None], series))
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, plan_specs, halo_specs, perm_specs, P()),
+        out_specs=(state_specs, P(NODE_AXIS), P(NODE_AXIS)),
+        check_vma=False,
+    )
+    return fn(state, arrays, halo, perm, mean)
+
+
+def run_rounds_sharded_fields(
+    state: FlowUpdatingState,
+    plan: ShardPlan,
+    cfg: RoundConfig,
+    mesh: jax.sharding.Mesh,
+    num_rounds: int,
+    spec,
+    true_mean,
+    arrays: tuple[PlanArrays, HaloTables, PermTables] | None = None,
+    halo: str = "ppermute",
+):
+    """Fields twin of :func:`run_rounds_sharded_telemetry`: one compiled
+    shard_map'd scan whose ys are the shard-local field blocks.  Returns
+    ``(state, conv_round, series)`` with ``conv_round`` ``(S, Nb)`` and
+    each series leaf ``(S, R, Nb/Eb, ...)`` — still blocked;
+    ``Engine.run_fields`` gathers them to original order."""
+    if not spec.enabled:
+        raise ValueError(
+            "field spec is disabled; run run_rounds_sharded() instead")
+    if num_rounds % spec.stride:
+        raise ValueError(
+            f"num_rounds={num_rounds} must be a multiple of the field "
+            f"stride {spec.stride}")
+    if cfg.needs_coloring and plan.num_colors == 0:
+        raise ValueError(
+            "fast synchronous pairwise needs the edge coloring in the "
+            "plan: build it with plan_sharding(..., coloring=True)"
+        )
+    if halo not in ("ppermute", "allgather"):
+        raise ValueError(f"unknown halo mode {halo!r}")
+    if cfg.contention:
+        raise NotImplementedError(
+            "contention is single-device (per-round link flow counts are a "
+            "global reduction; fidelity runs are platform-scale)"
+        )
+    if arrays is None:
+        arrays = plan_device_arrays(plan, mesh)
+    plan_arrays, halo_tables, perm = arrays
+    mean = jnp.asarray(true_mean, state.value.dtype)
+    return _run_sharded_fields(
+        state, plan_arrays, halo_tables, perm, mean, cfg, mesh, num_rounds,
+        plan.Eb, plan.Nb, plan.perm_offsets, halo, plan.num_colors, spec,
+    )
+
+
+def gather_node_field_series(x, plan: ShardPlan) -> np.ndarray:
+    """A stacked per-node field series ``(S, R, Nb, ...)`` -> ``(R, N,
+    ...)`` in the caller's original node order (drops the per-shard dummy
+    row and the tail padding, undoes any partition reorder)."""
+    x = np.asarray(x)
+    R = x.shape[1]
+    rest = x.shape[3:]
+    x = x[:, :, : plan.cap]
+    x = np.moveaxis(x, 0, 1).reshape((R, plan.num_shards * plan.cap)
+                                     + rest)[:, : plan.topo.num_nodes]
+    if plan.order is None:
+        return x.copy()
+    out = np.empty_like(x)
+    out[:, plan.order] = x
+    return out
+
+
+def gather_edge_field_series(x, plan: ShardPlan, orig_topo) -> np.ndarray:
+    """A stacked per-edge field series ``(S, R, Eb)`` -> ``(R, E)`` in
+    ``orig_topo``'s edge order (via the plan's edge ownership map)."""
+    if plan.edge_shard is None:
+        raise ValueError("plan lacks the edge ownership map")
+    e_of_orig = _edge_map_to_original(plan, orig_topo)
+    es = plan.edge_shard[e_of_orig]
+    ep = plan.edge_slot[e_of_orig]
+    return np.asarray(x)[es, :, ep].T
+
+
 def gather_estimates(state: FlowUpdatingState, plan: ShardPlan) -> np.ndarray:
     """Per-node estimates in the caller's *original* node order
     (host-side; undoes both the block layout and any partition reorder)."""

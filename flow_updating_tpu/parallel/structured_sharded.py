@@ -144,6 +144,32 @@ class PodShardedFatTreeKernel:
 
         self._run_tel_jit = _run_tel
 
+        @functools.partial(
+            jax.jit, static_argnames=("num_rounds", "spec"))
+        def _run_fld(state: PodState, value, inv_depp1, deg, mean,
+                     num_rounds: int, spec):
+            st_specs = PodState(t=rep, S=self._specs, G=self._specs,
+                                avg_prev=self._specs, A_prev=self._specs)
+            shmap = shard_map(
+                functools.partial(_scan_rounds_fields,
+                                  num_rounds=num_rounds, spec=spec,
+                                  n=n_nodes),
+                mesh=mesh,
+                in_specs=(st_specs, self._specs, self._specs, self._specs,
+                          rep),
+                out_specs=(st_specs,
+                           jax.sharding.PartitionSpec(NODE_AXIS),
+                           jax.sharding.PartitionSpec(NODE_AXIS)),
+                # the convergence-frontier carry mixes replicated (core)
+                # and pod-sharded sections; the replication checker cannot
+                # prove the core leaf and rejects the scan — the blocks
+                # are reassembled host-side anyway (as in parallel/sharded)
+                check_vma=False,
+            )
+            return shmap(state, value, inv_depp1, deg, mean)
+
+        self._run_fields_jit = _run_fld
+
     @property
     def padded_size(self) -> int:
         """Node-slot count: no padding — sections tile exactly."""
@@ -198,6 +224,47 @@ class PodShardedFatTreeKernel:
             state, self.value, self.inv_depp1, self.deg, mean,
             num_rounds=num_rounds, spec=spec)
         return state, {k: v[0] for k, v in series.items()}
+
+    def run_fields(self, state: PodState, num_rounds: int, spec):
+        """Device-resident per-node field rows, kept in per-section
+        blocks on device (the host flattens with
+        :meth:`flatten_field_series` / :meth:`flatten_field_final`).
+        Returns ``(state, conv_sections, series)`` where each series
+        leaf stacks a leading shard axis."""
+        if num_rounds % spec.stride:
+            raise ValueError(
+                f"num_rounds={num_rounds} must be a multiple of the "
+                f"field stride {spec.stride}")
+        mean = jnp.asarray(self.topo.true_mean, self.value[0].dtype)
+        return self._run_fields_jit(
+            state, self.value, self.inv_depp1, self.deg, mean,
+            num_rounds=num_rounds, spec=spec)
+
+    def flatten_field_series(self, sections) -> np.ndarray:
+        """Per-section stacked series -> ``(R, N)`` flat generator node
+        order.  Pod-sharded sections arrive as ``(S, R, k/S, ...)``
+        (shard-major pods == global pod order); the replicated core as
+        ``(S, R, h, h)`` with identical blocks (take shard 0)."""
+        parts = []
+        last = len(sections) - 1
+        for i, x in enumerate(sections):
+            x = np.asarray(x)
+            if i < last:
+                R = x.shape[1]
+                parts.append(np.moveaxis(x, 0, 1).reshape(R, -1))
+            else:
+                parts.append(x[0].reshape(x.shape[1], -1))
+        return np.concatenate(parts, axis=1)
+
+    def flatten_field_final(self, sections) -> np.ndarray:
+        """One-shot per-node sections (the convergence frontier) ->
+        ``(N,)`` flat generator node order."""
+        parts = []
+        last = len(sections) - 1
+        for i, x in enumerate(sections):
+            x = np.asarray(x)
+            parts.append((x if i < last else x[0]).reshape(-1))
+        return np.concatenate(parts)
 
     def estimates(self, state: PodState) -> np.ndarray:
         """value + G per node, original (generator) node order."""
@@ -328,6 +395,60 @@ def _pod_telemetry_sample(s: PodState, value, spec, mean, n: int,
     if spec.has("active"):
         out["active"] = jnp.asarray(n, jnp.int32)
     return out
+
+
+def _pod_field_sample(s: PodState, value, spec, mean, n: int,
+                      axis_name: str):
+    """One recorded per-node field row across the sections, kept in
+    section layout (the host flattens).  The fat-tree tiles exactly (no
+    padding, no churn on this kernel), so no alive masking is needed; in
+    fast sync mode every node fires every round (``node_fired = t``)."""
+    row = {"t": s.t, "active": jnp.asarray(n, jnp.int32)}
+    err = None
+    need_est = any(spec.has(f) for f in
+                   ("node_err", "node_mass", "node_mass_residual",
+                    "node_conv_round"))
+    if need_est:
+        est = tuple(v + g for v, g in zip(value, s.G))
+        err = tuple(e - mean for e in est)
+        if spec.has("node_err"):
+            row["node_err"] = err
+        if spec.has("node_mass"):
+            row["node_mass"] = est
+        if spec.has("node_mass_residual"):
+            row["node_mass_residual"] = tuple(
+                e - v for e, v in zip(est, value))
+    if spec.has("node_fired"):
+        row["node_fired"] = tuple(
+            jnp.broadcast_to(s.t, v.shape).astype(jnp.int32)
+            for v in value)
+    return row, err
+
+
+def _scan_rounds_fields(state: PodState, value, inv_depp1, deg, mean,
+                        num_rounds: int, spec, n: int):
+    stride = spec.stride
+    track_conv = spec.has("node_conv_round")
+
+    def chunk(carry, _):
+        s, conv = carry
+        s = jax.lax.fori_loop(
+            0, stride,
+            lambda _, x: _round(x, value, inv_depp1, deg, NODE_AXIS), s)
+        row, err = _pod_field_sample(s, value, spec, mean, n, NODE_AXIS)
+        if track_conv:
+            conv = tuple(
+                jnp.where((c < 0) & (jnp.abs(e) <= spec.tol), s.t, c)
+                for c, e in zip(conv, err))
+        return (s, conv), row
+
+    conv0 = tuple(jnp.full(v.shape, -1, jnp.int32) for v in value)
+    (out, conv), series = jax.lax.scan(
+        chunk, (state, conv0), None, length=num_rounds // stride)
+    # unit shard axis on everything so the P(NODE_AXIS) out_specs can
+    # concatenate per-shard blocks (host reads core blocks from shard 0)
+    return (out, jax.tree.map(lambda x: x[None], conv),
+            jax.tree.map(lambda x: x[None], series))
 
 
 def _scan_rounds_telemetry(state: PodState, value, inv_depp1, deg, mean,

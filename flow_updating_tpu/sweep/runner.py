@@ -45,6 +45,34 @@ def grid_instances(topos, seeds=(0,), drop_rates=(None,),
     return instances
 
 
+def _worst_offenders(states, bucket, top: int = 3) -> list:
+    """Per-lane worst-offender summary: the ``top`` highest final
+    per-node absolute errors of each instance, alive-masked (dead ghost
+    padding never ranks).  One vmapped ``node_estimates`` over the final
+    packed states — no extra rounds, no per-node series; the
+    topology-resolved deep dive belongs to ``inspect --fields``."""
+    import jax
+
+    from flow_updating_tpu.models.rounds import node_estimates
+
+    est = np.asarray(jax.vmap(node_estimates)(states, bucket.arrays))
+    means = np.asarray(bucket.means)
+    m = (means.reshape((-1,) + (1,) * (est.ndim - 1))
+         if means.ndim == 1 else means[:, None])   # (B, 1[, D])
+    err = np.abs(est - m)
+    if err.ndim > 2:
+        err = err.max(axis=tuple(range(2, err.ndim)))
+    err = np.where(np.asarray(states.alive), err, -np.inf)
+    out = []
+    for lane in range(err.shape[0]):
+        order = np.argsort(-err[lane])[:top]
+        out.append([
+            {"node": int(i), "abs_err": float(err[lane, i])}
+            for i in order if np.isfinite(err[lane, i])
+        ])
+    return out
+
+
 def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
               rmse_threshold: float = 1e-6, max_batch: int | None = None,
               include_series: bool = False, profile: bool = False):
@@ -100,6 +128,7 @@ def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
         _states, conv, series = run_bucket_telemetry(
             bucket, cfg, rounds, spec, rmse_threshold=rmse_threshold)
         bucket_run_s.append(round(time.perf_counter() - tb0, 6))
+        worst = _worst_offenders(_states, bucket)
         for lane, meta in enumerate(bucket.meta):
             rmse_series = series["rmse"][lane]
             rec = dict(meta)
@@ -111,6 +140,7 @@ def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
                 "final_rmse": float(rmse_series[-1]) if rounds else None,
                 "min_rmse": float(rmse_series.min()) if rounds else None,
             }
+            rec["worst_nodes"] = worst[lane]
             if conv[lane] >= 0:
                 converged += 1
             if include_series:

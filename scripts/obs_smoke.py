@@ -1,15 +1,20 @@
 #!/usr/bin/env python
-"""CI observability smoke: profile + doctor on a small topology.
+"""CI observability smoke: profile + fields + doctor on a small topology.
 
-Runs the three measurement-to-verdict pillars end to end on CPU and
-leaves the manifests in ``--outdir`` (the tier1 workflow uploads them
-as build artifacts):
+Runs the measurement-to-verdict pillars end to end on CPU and leaves
+the manifests in ``--outdir`` (the tier1 workflow uploads them as build
+artifacts):
 
 1. ``profile`` — AOT cost attribution of the edge and node kernels on a
    small ring, written as ``flow-updating-profile-report/v1`` manifests;
 2. ``run --telemetry --report`` — a real telemetry run manifest;
-3. ``doctor`` — judges the run manifest (and the profile manifests'
-   environment blocks); any failing check fails the job.
+3. ``inspect`` — two identical-seed per-node/per-edge FIELD recordings
+   (``flow-updating-field-report/v1``) with blame, then ``--diff``
+   between them — which must report zero deltas;
+4. ``doctor`` — judges the run manifest, the profile manifests'
+   environment blocks AND the field manifest (whose reduced global
+   series runs the standard series checks); any failing check fails
+   the job.
 
 Exit code: the doctor's (0 healthy; 1 on any failing check).
 """
@@ -17,6 +22,7 @@ Exit code: the doctor's (0 healthy; 1 on any failing check).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -66,7 +72,39 @@ def main() -> int:
               file=sys.stderr)
         return rc or 1
 
-    return cli_main(["doctor", run_manifest, prof_edge, prof_node])
+    # topology-resolved fields: two identical-seed recordings with blame,
+    # then the diff — which must come back all-zero
+    fields_a = os.path.join(args.outdir, "fields_a.json")
+    fields_b = os.path.join(args.outdir, "fields_b.json")
+    # stride must divide the (user-overridable) round count
+    stride = next(s for s in (4, 2, 1) if args.rounds % s == 0)
+    inspect_base = ["inspect", "--backend", "cpu",
+                    "--generator", args.generator,
+                    "--fire-policy", "every_round",
+                    "--rounds", str(args.rounds),
+                    "--fields", "full", "--field-stride", str(stride)]
+    for path in (fields_a, fields_b):
+        rc = cli_main(inspect_base + ["--blame", "--report", path])
+        if rc != 0:
+            print(f"obs_smoke: field recording failed (rc={rc})",
+                  file=sys.stderr)
+            return rc or 1
+    diff_out = os.path.join(args.outdir, "fields_diff.json")
+    rc = cli_main(["inspect", "--diff", fields_a, fields_b,
+                   "-o", diff_out])
+    if rc != 0:
+        print(f"obs_smoke: field diff failed (rc={rc})", file=sys.stderr)
+        return rc or 1
+    with open(diff_out) as f:
+        diff = json.load(f)
+    if not diff.get("identical"):
+        print("obs_smoke: identical-seed field runs diff nonzero: "
+              f"max_abs_delta={diff.get('max_abs_delta')}",
+              file=sys.stderr)
+        return 1
+
+    return cli_main(["doctor", run_manifest, prof_edge, prof_node,
+                     fields_a])
 
 
 if __name__ == "__main__":

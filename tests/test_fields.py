@@ -1,0 +1,508 @@
+"""Topology-resolved observability: per-node/per-edge field recording,
+fault localization ("blame") and run diffing.
+
+Contracts pinned here (ISSUE 5 acceptance criteria):
+
+* field/global consistency — reducing each per-node field (device-side,
+  with the same expressions the telemetry sampler uses) reproduces the
+  existing global telemetry series: bit-for-bit on the single-device
+  edge kernel; within 1e-12 on the node/halo/pod kernels, whose gathered
+  fields reduce in original node order while their telemetry reduces in
+  kernel-local order (a pure summation-order difference);
+* recording is a pure observer — fields-off dispatches the EXACT plain
+  program, and fields-on at any stride evolves state bit-identically to
+  the plain path;
+* cross-mode parity — halo (shard_map) and pod (stencil) field outputs
+  match the single-device edge kernel for the same seed, including
+  vector payloads (D > 1) on the halo path;
+* blame finds a synthetically injected straggler (isolated node) and a
+  synthetic leak edge (one-sided flow injection under fast pairwise,
+  whose direct exchange never repairs ledger asymmetry) — rank 1,
+  deterministically;
+* ``inspect --diff`` of two identical-seed runs reports zero deltas.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds, run_rounds_fields
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs import inspect as oi
+from flow_updating_tpu.obs.fields import FieldSeries, FieldSpec
+from flow_updating_tpu.obs.health import diagnose_manifest
+from flow_updating_tpu.obs.report import build_field_manifest
+from flow_updating_tpu.obs.telemetry import TelemetrySpec
+from flow_updating_tpu.parallel import sharded
+from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.topology.generators import (
+    erdos_renyi,
+    fat_tree,
+    ring,
+)
+
+CFG64 = dict(variant="collectall", dtype="float64")
+
+
+def _run_engine_fields(topo, cfg, rounds, spec, **engine_kw):
+    e = Engine(config=cfg, **engine_kw).set_topology(topo).build()
+    return e, e.run_fields(rounds, spec)
+
+
+# ---- pure-observer guarantees -------------------------------------------
+
+def test_fields_off_is_the_plain_program():
+    """A disabled spec dispatches the untouched kernel (same jit cache
+    entry as run_rounds): empty series, bit-identical state, and the
+    plain lowered program is byte-identical before and after a
+    fields-on run exists in the process."""
+    topo = ring(40, k=2, seed=1)
+    cfg = RoundConfig.fast(variant="collectall")
+    arrays = topo.device_arrays()
+    state0 = init_state(topo, cfg)
+    before = run_rounds.lower(state0, arrays, cfg, 30).as_text()
+
+    e = Engine(config=cfg).set_topology(topo).build()
+    series = e.run_fields(30, FieldSpec.off())
+    assert len(series) == 0 and not series
+
+    plain = run_rounds(init_state(topo, cfg), arrays, cfg, 30)
+    np.testing.assert_array_equal(np.asarray(e.state.flow),
+                                  np.asarray(plain.flow))
+
+    # a fields-ON program existing must not perturb the plain lowering
+    e2 = Engine(config=cfg).set_topology(topo).build()
+    e2.run_fields(30, FieldSpec.default())
+    after = run_rounds.lower(state0, arrays, cfg, 30).as_text()
+    assert before == after
+
+
+@pytest.mark.parametrize("stride", [1, 3])
+def test_field_recording_does_not_change_state_evolution(stride):
+    """Fields-on at any stride applies the exact round_step sequence:
+    final state bit-identical to the plain path."""
+    topo = erdos_renyi(40, avg_degree=4.0, seed=7)
+    cfg = RoundConfig.reference(**CFG64)
+    e, series = _run_engine_fields(topo, cfg, 30, FieldSpec.full(
+        stride=stride))
+    assert list(series.t) == list(range(stride, 31, stride))
+
+    plain = Engine(config=cfg).set_topology(topo).build().run_rounds(30)
+    np.testing.assert_array_equal(np.asarray(e.state.flow),
+                                  np.asarray(plain.state.flow))
+    np.testing.assert_array_equal(np.asarray(e.state.buf_valid),
+                                  np.asarray(plain.state.buf_valid))
+
+
+def test_no_callbacks_in_fields_scan():
+    topo = ring(16, k=2, seed=0)
+    cfg = RoundConfig.fast(variant="collectall")
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg)
+    spec = FieldSpec.full().for_kernel("edge")
+    jaxpr = str(jax.make_jaxpr(
+        lambda s: run_rounds_fields(s, arrays, cfg, 8, spec,
+                                    topo.true_mean))(state))
+    assert "callback" not in jaxpr
+
+
+# ---- field/global consistency -------------------------------------------
+
+def _reduce_and_compare(series, tel, *, exact: bool):
+    """Reduce the per-node fields with the telemetry sampler's own
+    expressions (device-side, same shapes) and compare to the recorded
+    global series."""
+    rmse_red = jax.jit(lambda e, c: jnp.sqrt(jnp.sum(e * e) / c))
+    mass_red = jax.jit(lambda m: jnp.sum(m, axis=0))
+    err = series.node["node_err"]
+    feat = int(err[0].size // err.shape[1]) if err.ndim > 1 else 1
+    got = {
+        "rmse": np.array([
+            float(rmse_red(jnp.asarray(err[i]),
+                           jnp.asarray(float(series.active[i]) * feat,
+                                       err.dtype)))
+            for i in range(len(series))]),
+        "max_abs_err": np.array([float(np.max(np.abs(err[i])))
+                                 for i in range(len(series))]),
+        "mass": np.stack([np.asarray(mass_red(
+            jnp.asarray(series.node["node_mass"][i])))
+            for i in range(len(series))]),
+        "mass_residual": np.stack([np.asarray(mass_red(
+            jnp.asarray(series.node["node_mass_residual"][i])))
+            for i in range(len(series))]),
+    }
+    np.testing.assert_array_equal(series.t, np.asarray(tel["t"]))
+    for m in ("rmse", "max_abs_err", "mass"):
+        if exact:
+            np.testing.assert_array_equal(got[m], np.asarray(tel[m]),
+                                          err_msg=m)
+        else:
+            np.testing.assert_allclose(got[m], np.asarray(tel[m]),
+                                       atol=1e-12, err_msg=m)
+    # sum-of-differences vs difference-of-sums: float-tol by construction
+    np.testing.assert_allclose(got["mass_residual"],
+                               np.asarray(tel["mass_residual"]),
+                               atol=1e-12, err_msg="mass_residual")
+    if "active" in tel:
+        np.testing.assert_array_equal(series.active,
+                                      np.asarray(tel["active"]))
+
+
+@pytest.mark.parametrize("mode", ["edge", "node", "halo", "pod"])
+def test_field_global_consistency(mode):
+    """Reducing each per-node field reproduces the global telemetry
+    series in all four dispatch modes — bit-for-bit on the edge kernel
+    (same reduction shapes), 1e-12 where the gathered original-order
+    reduction reassociates the kernel-local sum."""
+    rounds = 24
+    if mode == "pod":
+        topo = fat_tree(4, seed=0)
+        cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                               spmv="structured", dtype="float64")
+        kw = dict(mesh=make_mesh(2), multichip="pod")
+    elif mode == "halo":
+        topo = erdos_renyi(48, avg_degree=4.0, seed=3)
+        cfg = RoundConfig.fast(**CFG64)
+        kw = dict(mesh=make_mesh(2), multichip="halo")
+    elif mode == "node":
+        topo = erdos_renyi(48, avg_degree=4.0, seed=3)
+        cfg = RoundConfig.fast(kernel="node", **CFG64)
+        kw = {}
+    else:
+        topo = erdos_renyi(48, avg_degree=4.0, seed=3)
+        cfg = RoundConfig.reference(**CFG64)
+        kw = {}
+    _, series = _run_engine_fields(topo, cfg, rounds,
+                                   FieldSpec.default(), **kw)
+    e2 = Engine(config=cfg, **kw).set_topology(topo).build()
+    tel = e2.run_telemetry(rounds, TelemetrySpec.default())
+    tel_dict = {m: tel[m] for m in
+                ("rmse", "max_abs_err", "mass", "mass_residual",
+                 "active")}
+    tel_dict["t"] = tel.t
+    _reduce_and_compare(series, tel_dict, exact=(mode == "edge"))
+
+
+# ---- cross-mode parity ---------------------------------------------------
+
+def test_halo_fields_match_single_device_vector_payload():
+    """Halo (shard_map) field output == single-device edge kernel for
+    the same seed, with a D=3 vector payload — node fields per-feature,
+    edge_flow feature-summed, conv frontier integer-equal."""
+    topo = erdos_renyi(48, avg_degree=4.0, seed=3)
+    cfg = RoundConfig.fast(**CFG64)
+    rng = np.random.default_rng(0)
+    values = rng.normal(size=(topo.num_nodes, 3))
+    spec = FieldSpec.full().for_kernel("edge")
+
+    state = init_state(topo, cfg, values=values)
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
+    _, conv_s, single = run_rounds_fields(state, arrays, cfg, 24, spec,
+                                          topo.true_mean)
+    single = jax.device_get(single)
+
+    mesh = make_mesh(2)
+    plan = sharded.plan_sharding(topo, 2)
+    hstate = sharded.init_plan_state(plan, cfg, mesh, values=values)
+    _, conv_h, halo = sharded.run_rounds_sharded_fields(
+        hstate, plan, cfg, mesh, 24, spec.for_kernel("halo"),
+        topo.true_mean)
+    halo = jax.device_get(halo)
+
+    np.testing.assert_array_equal(np.asarray(halo["t"])[0],
+                                  np.asarray(single["t"]))
+    np.testing.assert_array_equal(np.asarray(halo["active"])[0],
+                                  np.asarray(single["active"]))
+    for name in ("node_err", "node_mass", "node_mass_residual",
+                 "node_fired"):
+        got = sharded.gather_node_field_series(halo[name], plan)
+        np.testing.assert_allclose(got, np.asarray(single[name]),
+                                   atol=1e-12, err_msg=name)
+    for name in ("edge_flow", "edge_stale"):
+        got = sharded.gather_edge_field_series(halo[name], plan, topo)
+        np.testing.assert_allclose(got, np.asarray(single[name]),
+                                   atol=1e-12, err_msg=name)
+    np.testing.assert_array_equal(
+        sharded.gather_node_array(np.asarray(conv_h), plan),
+        np.asarray(conv_s))
+
+
+def test_pod_and_gspmd_fields_match_edge():
+    """Pod-sharded stencil and GSPMD edge fields both reproduce the
+    single-device edge kernel's node fields for the same seed."""
+    topo = fat_tree(4, seed=0)
+    cfg = RoundConfig.fast(**CFG64)
+    _, edge_f = _run_engine_fields(topo, cfg, 24, FieldSpec.default())
+
+    pod_cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                               spmv="structured", dtype="float64")
+    _, pod_f = _run_engine_fields(topo, pod_cfg, 24, FieldSpec.default(),
+                                  mesh=make_mesh(2), multichip="pod")
+    _, gspmd_f = _run_engine_fields(topo, cfg, 24, FieldSpec.default(),
+                                    mesh=make_mesh(2), multichip="auto")
+    for name in ("node_err", "node_mass", "node_mass_residual"):
+        np.testing.assert_allclose(pod_f.node[name], edge_f.node[name],
+                                   atol=1e-12, err_msg=f"pod {name}")
+        np.testing.assert_allclose(gspmd_f.node[name], edge_f.node[name],
+                                   atol=1e-12, err_msg=f"gspmd {name}")
+    np.testing.assert_array_equal(pod_f.conv_round, edge_f.conv_round)
+    np.testing.assert_array_equal(gspmd_f.conv_round, edge_f.conv_round)
+
+
+# ---- downsampling knobs --------------------------------------------------
+
+def _straggler_topo():
+    """The planted-straggler scenario: node 5 carries an outlier value
+    (10.0 against a uniform-[0,1) population) and all its incident links
+    fail — it stays alive, keeps its stale estimate, and every healthy
+    node's error is an order of magnitude smaller."""
+    topo = erdos_renyi(32, avg_degree=4.0, seed=2)
+    j = 5
+    values = np.asarray(topo.values).copy()
+    values[j] = 10.0
+    return topo.with_values(values), j
+
+
+def _isolate(engine, topo, j):
+    return engine.fail_links([(j, int(v)) for v in topo.neighbors(j)])
+
+
+def test_topk_records_worst_nodes():
+    """topk keeps the m worst nodes per row: the isolated straggler owns
+    rank 1 of the final row, and recorded values match the full run."""
+    topo, j = _straggler_topo()
+    links = [(j, int(v)) for v in topo.neighbors(j)]
+    cfg = RoundConfig.reference(**CFG64)
+
+    e = Engine(config=cfg).set_topology(topo).build().fail_links(links)
+    full = e.run_fields(60, FieldSpec.default())
+    e2 = Engine(config=cfg).set_topology(topo).build().fail_links(links)
+    topk = e2.run_fields(60, FieldSpec.default(topk=3))
+
+    assert topk.node["node_err"].shape == (60, 3)
+    assert topk.topk_idx.shape == (60, 3)
+    assert int(topk.topk_idx[-1][0]) == j
+    np.testing.assert_array_equal(
+        topk.node["node_err"][-1],
+        full.node["node_err"][-1][topk.topk_idx[-1]])
+
+
+def test_conv_frontier_matches_fields():
+    """node_conv_round is exactly the first recorded round each node's
+    pooled |err| entered tol (alive-masked), derived independently from
+    the node_err series."""
+    topo = ring(32, k=2, seed=1)
+    cfg = RoundConfig.fast(**CFG64)
+    _, series = _run_engine_fields(topo, cfg, 200, FieldSpec.default())
+    mag = series.pooled("node_err")
+    expect = np.full(topo.num_nodes, -1, np.int64)
+    for i in range(len(series)):
+        hit = (mag[i] <= series.spec.tol) & (expect < 0)
+        expect[hit] = series.t[i]
+    np.testing.assert_array_equal(series.conv_round, expect)
+    assert (series.conv_round >= 0).all()  # the ring converges
+
+
+# ---- blame ---------------------------------------------------------------
+
+def test_blame_finds_injected_straggler():
+    """The planted straggler (outlier value, isolated by link failure —
+    alive but stuck with a stale estimate) ranks #1 in the stall
+    blame."""
+    topo, j = _straggler_topo()
+    cfg = RoundConfig.reference(**CFG64)
+    e = _isolate(Engine(config=cfg).set_topology(topo).build(), topo, j)
+    series = e.run_fields(120, FieldSpec.full())
+
+    verdict = oi.blame(series)
+    assert verdict["stall"], "expected straggler culprits"
+    assert verdict["stall"][0]["node"] == j
+    assert verdict["divergence"] is None
+
+
+def test_blame_finds_injected_leak_edge():
+    """A one-sided flow injection under fast pairwise (direct exchange
+    adds exactly antisymmetric increments, so the asymmetry persists)
+    ranks the planted edge pair #1 in the leak blame — and shows up as a
+    real mass residual."""
+    topo = erdos_renyi(32, avg_degree=4.0, seed=4)
+    cfg = RoundConfig.fast(variant="pairwise", dtype="float64")
+    e = Engine(config=cfg).set_topology(topo).build()
+    e.run_rounds(10)
+    leak_e = 7
+    e.state = e.state.replace(
+        flow=e.state.flow.at[leak_e].add(0.5))
+    series = e.run_fields(20, FieldSpec.full())
+
+    verdict = oi.blame(series)
+    assert verdict["leak"], "expected leak culprits"
+    pair = {verdict["leak"][0]["edge"], verdict["leak"][0]["rev"]}
+    assert pair == {leak_e, int(topo.rev[leak_e])}
+    assert verdict["leak"][0]["residual"] == pytest.approx(0.5, rel=1e-9)
+    # the injected flow really leaks mass (estimate sum shifts by -0.5)
+    resid = np.sum(series.node["node_mass_residual"][-1])
+    assert resid == pytest.approx(-0.5, abs=1e-9)
+
+
+def test_blame_finds_divergence_origin():
+    """A planted non-finite value names its node and first bad round."""
+    topo = ring(24, k=2, seed=0)
+    cfg = RoundConfig.fast(**CFG64)
+    e = Engine(config=cfg).set_topology(topo).build()
+    e.state = e.state.replace(value=e.state.value.at[5].set(np.nan))
+    series = e.run_fields(12, FieldSpec.default())
+    div = oi.blame_divergence(series)
+    assert div is not None
+    assert 5 in div["nodes"]
+    assert div["round"] == int(series.t[0])
+
+
+# ---- diff ----------------------------------------------------------------
+
+def test_diff_identical_runs_is_zero():
+    topo = erdos_renyi(32, avg_degree=4.0, seed=5)
+    cfg = RoundConfig.reference(**CFG64)
+    _, a = _run_engine_fields(topo, cfg, 40, FieldSpec.full())
+    _, b = _run_engine_fields(topo, cfg, 40, FieldSpec.full())
+    d = oi.diff_fields(a, b)
+    assert d["identical"] and d["max_abs_delta"] == 0.0
+    assert d["rounds_compared"] == 40
+
+
+def test_diff_localizes_a_perturbation():
+    """healthy vs straggler run on the same topology: the diff names
+    the straggler among the worst deltas and aligns stride-mismatched
+    grids on common rounds."""
+    topo, j = _straggler_topo()
+    cfg = RoundConfig.reference(**CFG64)
+    _, a = _run_engine_fields(topo, cfg, 60, FieldSpec.default())
+    e = _isolate(Engine(config=cfg).set_topology(topo).build(), topo, j)
+    b = e.run_fields(60, FieldSpec.default(stride=2))
+    d = oi.diff_fields(a, b)
+    assert not d["identical"]
+    assert d["rounds_compared"] == 30  # stride-2 grid intersected
+    worst = d["fields"]["node_err"]["worst"]
+    assert any(w["node"] == j for w in worst)
+
+
+# ---- manifests, doctor integration, CLI ---------------------------------
+
+def _straggler_manifest(tmp_path):
+    """A field manifest whose reduced rmse series plateaus at the
+    straggler's floor (240 rounds, stride 2 — past the reference
+    timeout bootstrap at t=50, long enough for the healthy nodes to
+    settle)."""
+    topo, j = _straggler_topo()
+    cfg = RoundConfig.reference(**CFG64)
+    e = _isolate(Engine(config=cfg).set_topology(topo).build(), topo, j)
+    series = e.run_fields(240, FieldSpec.full(stride=2))
+    manifest = build_field_manifest(
+        argv=["test"], config=cfg, topo=topo, fields=series,
+        report={"rmse": 1.0, "true_mean": topo.true_mean,
+                "nodes": topo.num_nodes})
+    path = tmp_path / "fields.json"
+    path.write_text(json.dumps(manifest, default=str))
+    return path, j
+
+
+def test_field_manifest_roundtrip_and_doctor_culprits(tmp_path):
+    """The field manifest carries a reduced global series the doctor
+    judges as usual — and its stall verdict now CITES the straggler
+    node id in its evidence."""
+    path, j = _straggler_manifest(tmp_path)
+    manifest = json.loads(path.read_text())
+    assert manifest["schema"] == "flow-updating-field-report/v1"
+
+    # round-trip: the block reloads into an identical series
+    series = FieldSeries.from_jsonable(manifest["fields"])
+    assert oi.diff_fields(series, series)["identical"]
+
+    checks = {c.name: c for c in diagnose_manifest(manifest)}
+    stall = checks["rmse_stall"]
+    assert stall.status == "warn"
+    assert stall.evidence["culprits"][0]["node"] == j
+
+
+def test_inspect_cli_blame_and_diff(tmp_path, capsys):
+    """`inspect --blame` names the planted straggler rank-1;
+    `inspect --diff` of two identical-seed runs reports zero deltas."""
+    from flow_updating_tpu.cli import main
+
+    base = ["inspect", "--backend", "cpu", "--generator",
+            "erdos_renyi:32:4", "--seed", "2", "--rounds", "40",
+            "--fields", "full"]
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    assert main(base + ["--report", a]) == 0
+    capsys.readouterr()
+    assert main(base + ["--report", b]) == 0
+    capsys.readouterr()
+
+    assert main(["inspect", "--diff", a, b]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["identical"] and out["max_abs_delta"] == 0.0
+
+    path, j = _straggler_manifest(tmp_path)
+    assert main(["inspect", str(path), "--blame"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["blame"]["stall"][0]["node"] == j
+
+    # heatmap renders (plain text, one char per node somewhere)
+    assert main(["inspect", str(path), "--heatmap", "node_err"]) == 0
+    assert "node_err" in capsys.readouterr().out
+
+
+def test_inspect_and_export_trace_degrade_gracefully(tmp_path, capsys):
+    """Manifest/event-log mix-ups exit 1 with a message naming the fix,
+    never a traceback; doctor handles a telemetry-less run manifest."""
+    from flow_updating_tpu.cli import cmd_doctor, main
+
+    run_manifest = tmp_path / "run.json"
+    run_manifest.write_text(json.dumps({
+        "schema": "flow-updating-run-report/v1",
+        "environment": {"backend": "cpu", "device_count": 1},
+        "report": {"rmse": 1e-9, "t": 10},
+    }))
+    # export-trace on a manifest: clear message, exit 1
+    with pytest.raises(SystemExit) as exc:
+        main(["obs", "export-trace", str(run_manifest)])
+    assert "manifest, not an event log" in str(exc.value)
+
+    # inspect on a fields-less manifest: clear message, exit 1
+    with pytest.raises(SystemExit) as exc:
+        main(["inspect", str(run_manifest)])
+    assert "no per-node/per-edge fields block" in str(exc.value)
+
+    # doctor on a manifest with no telemetry series: explicit skip, rc 0
+    import argparse
+    args = argparse.Namespace(
+        reports=[str(run_manifest)], baselines=None, generator=None,
+        deployment=None, strict=False)
+    assert cmd_doctor(args) == 0
+    out = json.loads(capsys.readouterr().out)
+    names = {c["name"]: c["status"] for c in out["checks"]}
+    assert names.get("telemetry") == "skip"
+
+
+def test_fieldspec_parse_rejects_unknown_with_vocabulary():
+    with pytest.raises(ValueError, match="node_err"):
+        FieldSpec.parse("node_er")  # vocabulary + did-you-mean listed
+    with pytest.raises(ValueError, match="did you mean 'node_err'"):
+        FieldSpec.parse("node_er")
+    with pytest.raises(ValueError, match="not recordable"):
+        FieldSpec.parse("edge_flow").for_kernel("node")
+    # presets narrow silently; topk validation bites on sharded kernels
+    assert "edge_flow" not in FieldSpec.full().for_kernel("pod").fields
+    with pytest.raises(ValueError, match="topk"):
+        FieldSpec.default(topk=4).for_kernel("halo")
+    with pytest.raises(ValueError, match="node_err"):
+        FieldSpec(fields=("node_mass",), topk=2).for_kernel("edge")
+
+
+def test_telemetry_parse_suggests_correction():
+    with pytest.raises(ValueError, match="did you mean 'rmse'"):
+        TelemetrySpec.parse("rsme")
