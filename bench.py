@@ -77,7 +77,7 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 segment: str = "auto", fire_policy: str = "fast",
                 variant: str = "collectall", delivery: str = "gather",
                 delay_depth: int | None = None, features: int = 0,
-                values=None):
+                values=None, plan=None):
     """Build the fast collect-all measurement closure for one topology.
 
     Returns ``(run, read_est)``: ``run(r)`` executes an r-round compiled
@@ -122,7 +122,9 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 "the node-collapsed kernel is collect-all only; pairwise "
                 "runs on the edge kernel (--kernel edge)")
         cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv=spmv)
-        k = sync.NodeKernel(topo, cfg, values=vals)
+        # ``plan`` (spmv='banded') reuses a pre-compiled ExecutionPlan so
+        # the planner's host work is paid once per bench, not per runner
+        k = sync.NodeKernel(topo, cfg, values=vals, plan=plan)
         state = k.init_state()
 
         def run(r):
@@ -177,7 +179,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
                 variant: str = "collectall",
                 delivery: str = "gather",
                 delay_depth: int | None = None,
-                features: int = 0) -> dict:
+                features: int = 0, plan=None) -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: each executable launch carries a large fixed tunnel
@@ -197,7 +199,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
                                 segment=segment, fire_policy=fire_policy,
                                 variant=variant, delivery=delivery,
                                 delay_depth=delay_depth, features=features,
-                                values=vals)
+                                values=vals, plan=plan)
     plan_s = time.perf_counter() - t0  # host work: ELL build, Benes
     #                                    routing, fused-pass planning
 
@@ -651,6 +653,126 @@ def run_sweep_bench(args) -> dict:
     }
 
 
+#: generator-name abbreviations for stable baseline keys (ba100k_planned)
+_GEN_ABBREV = {"barabasi_albert": "ba", "erdos_renyi": "er",
+               "community": "community", "fat_tree": "ft",
+               "grid2d": "grid", "torus2d": "torus", "ring": "ring",
+               "hypercube": "hcube", "complete": "complete"}
+
+
+def _generator_slug(spec: str, num_nodes: int) -> str:
+    """Stable baseline key stem: 'barabasi_albert:100000:4' -> 'ba100k'.
+
+    The '_planned' suffix is appended by the caller — these keys are
+    DISJOINT from the fat-tree records (k160, k96_*) and from the DES
+    generator baselines (ba100k_collectall), so a compiled-plan row can
+    never shadow either."""
+    name = _GEN_ABBREV.get(spec.split(":")[0], spec.split(":")[0])
+    if num_nodes >= 1000 and num_nodes % 1000 == 0:
+        return f"{name}{num_nodes // 1000}k"
+    return f"{name}{num_nodes}"
+
+
+def run_generator_bench(args) -> dict:
+    """The ``--generator`` measurement body: compiled-plan throughput on
+    an arbitrary graph, gated against the general ``xla`` edge path.
+
+    Runs the topology compiler's auto selection (plan/select.py) for the
+    ambient backend, measures the CHOSEN plan plus the two reference
+    candidates (node/xla and the edge path), headlines the chosen plan
+    and reports ``vs_baseline`` against the edge-path comparator — the
+    ~22 r/s-at-1M-nodes general path the planner exists to beat (ROADMAP
+    open item 1).  The comparator is recorded under the stable
+    ``<slug>_planned`` baseline key (keep-the-fastest semantics, exactly
+    like the sweep rows; fat-tree records live under different keys and
+    are never shadowed).  The per-candidate measured rates land in
+    ``extra.measured`` so the doctor's ``plan_selection`` check can
+    audit "auto picked a slower plan than available" offline.
+    """
+    import jax
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.plan import select_plan
+    from flow_updating_tpu.topology.generators import topology_from_spec
+
+    topo = topology_from_spec(args.generator)
+    n, e = topo.num_nodes, topo.num_edges
+    cfg = RoundConfig.fast(variant="collectall")
+    decision = select_plan(topo, cfg)
+    chosen = decision.kernel + (f"/{decision.spmv}" if decision.spmv
+                                else "/gather")
+
+    rows = {}
+    measured = {}
+
+    def _measure(label, **kw):
+        try:
+            row = measure_tpu(topo, args.rounds, **kw)
+            rows[label] = row
+            measured[label] = row["rounds_per_sec"]
+        except Exception as exc:  # keep the candidates already in hand
+            rows[label] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        return rows[label]
+
+    plan_kw = {}
+    if decision.spmv == "banded":
+        plan_kw["plan"] = decision.plan
+    tpu = _measure(chosen, kernel=decision.kernel,
+                   spmv=decision.spmv or "xla", **plan_kw)
+    if "error" in tpu:
+        raise RuntimeError(
+            f"planned measurement failed: {tpu['error']}")
+    if chosen != "node/xla" and decision.kernel == "node":
+        _measure("node/xla", kernel="node", spmv="xla")
+    edge = _measure("edge/gather", kernel="edge")
+
+    slug = _generator_slug(args.generator, n)
+    base_key = f"{slug}_planned"
+    if "error" not in edge:
+        comparator = {
+            "rounds_per_sec": edge["rounds_per_sec"],
+            "ticks": edge["rounds"],
+            "repeats": 1,
+            "spread_pct": 0.0,
+            "note": ("general xla edge-path jax comparator (the path "
+                     "the topology compiler generalizes past; not a "
+                     "DES measurement)"),
+        }
+        record_baseline(base_key, baseline_entry(topo, comparator))
+    base_rps = recorded_baseline(base_key)
+    base_src = "recorded"
+    if base_rps is None and "error" not in edge:
+        base_rps, base_src = edge["rounds_per_sec"], "measured"
+
+    return {
+        "metric": (f"gossip rounds/sec, {n} nodes "
+                   f"({args.generator}, planned, fast synchronous)"),
+        "value": round(tpu["rounds_per_sec"], 2),
+        "unit": "rounds/sec",
+        "backend": {"axon": "tpu"}.get(tpu["platform"], tpu["platform"]),
+        # vs_baseline divides by the EDGE-PATH baseline of record: the
+        # compiled plan's win over the general path, gated by `regress`
+        "vs_baseline": (round(tpu["rounds_per_sec"] / base_rps, 2)
+                        if base_rps else None),
+        "extra": {
+            "nodes": n,
+            "directed_edges": e,
+            "plan": decision.describe(),
+            "chosen": chosen,
+            "measured": {k: round(v, 4) for k, v in measured.items()},
+            "candidates": {
+                k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                    for kk, vv in v.items()}
+                for k, v in rows.items()},
+            "baseline_rounds_per_sec": (round(base_rps, 4)
+                                        if base_rps else None),
+            "baseline_source": base_src,
+            "baseline_key": _baseline_key(base_key),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--fat-tree-k", type=int, default=None,
@@ -658,6 +780,15 @@ def parse_args(argv=None):
                          "vertices; with --sweep, default 16 — a "
                          "B-sized bucket of small instances is the "
                          "batching win)")
+    ap.add_argument("--generator", metavar="SPEC", default=None,
+                    help="bench an arbitrary generator topology instead "
+                         "of the fat-tree, e.g. 'barabasi_albert:100000:"
+                         "4', 'erdos_renyi:10000', 'community:100000:16'"
+                         ": the topology compiler's auto-selected plan "
+                         "is the headline, gated against the general "
+                         "xla edge path under the stable "
+                         "'<slug>_planned' baseline key (ba100k_planned)"
+                         " — fat-tree records are never shadowed")
     ap.add_argument("--rounds", type=int, default=64,
                     help="starting timed scan length (grows adaptively while "
                          "each launch stays under the tunnel execution cap; "
@@ -745,6 +876,20 @@ def parse_args(argv=None):
     if args.sweep and args.features:
         ap.error("--sweep rows measure the scalar payload; combine "
                  "--features with the single-instance bench")
+    if args.generator and args.sweep:
+        ap.error("--generator rows are single-instance compiled-plan "
+                 "measurements; sweep grids over generators live in the "
+                 "`sweep` CLI subcommand")
+    if args.generator and args.fat_tree_k != (16 if args.sweep else 160):
+        ap.error("--generator replaces the fat-tree topology; drop "
+                 "--fat-tree-k")
+    if args.generator and (args.features or args.kernel != "node"
+                           or args.spmv != "auto"
+                           or args.fire_policy != "fast"
+                           or args.variant != "collectall"):
+        ap.error("--generator measures the planner's auto selection for "
+                 "the fast synchronous collect-all headline; kernel/"
+                 "spmv/fire-policy/variant/features flags do not apply")
     if args.sweep and args.profile:
         ap.error("--profile attributes the single-instance headline "
                  "program; per-bucket sweep attribution lives in the "
@@ -762,6 +907,8 @@ def run_bench(args) -> dict:
     """The measurement body (runs in a child with a settled backend)."""
     if args.sweep:
         return run_sweep_bench(args)
+    if args.generator:
+        return run_generator_bench(args)
     topo = build_topology(args.fat_tree_k)
     n, e = topo.num_nodes, topo.num_edges
 
@@ -1111,9 +1258,16 @@ def main():
 
             # no topo= here: rebuilding the k160 fat-tree just for a
             # fingerprint would double the host-side planning cost; the
-            # result already carries nodes/edges/config
+            # result already carries nodes/edges/config.  Generator rows
+            # lift the plan decision + measured candidate rates to the
+            # manifest top level, where the doctor's plan_selection
+            # check audits "auto picked a slower plan than available".
+            extra = None
+            if args.generator and "plan" in result.get("extra", {}):
+                extra = {"plan": result["extra"]["plan"],
+                         "measured": result["extra"]["measured"]}
             write_report(args.report, build_manifest(
-                argv=sys.argv[1:], report=result,
+                argv=sys.argv[1:], report=result, extra=extra,
             ))
         print(json.dumps(result))
         return

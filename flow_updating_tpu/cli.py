@@ -143,6 +143,15 @@ def _add_kernel_flags(ap: argparse.ArgumentParser) -> None:
                     help="'edge' = general per-edge kernel; 'node' = "
                          "collapsed SpMV recurrence (fast synchronous "
                          "collect-all only, the throughput path)")
+    ap.add_argument("--plan", default="off", choices=("off", "auto"),
+                    help="'auto' = topology compiler: after the topology "
+                         "resolves, pick the fastest correct kernel/spmv "
+                         "for (topology, backend) — the structured "
+                         "stencil on generator-regular graphs, the "
+                         "compiled RCM-band + Benes/gather-remainder "
+                         "plan on arbitrary graphs (overrides --kernel/"
+                         "--spmv; never changes the requested dynamics; "
+                         "see the `plan` subcommand and docs/PLANNER.md)")
     ap.add_argument("--drain", type=int, default=None,
                     help="msgs processed per node per round (0=unbounded; "
                          "reference semantics: 1)")
@@ -160,24 +169,14 @@ def _add_kernel_flags(ap: argparse.ArgumentParser) -> None:
 
 def _build_topology(args):
     from flow_updating_tpu.topology.deployment import load_deployment
-    from flow_updating_tpu.topology.generators import GENERATORS
+    from flow_updating_tpu.topology.generators import topology_from_spec
     from flow_updating_tpu.topology.platform import load_platform
 
     if args.generator:
-        parts = args.generator.split(":")
-        name = parts[0]
-        if name not in GENERATORS:
-            raise SystemExit(
-                f"unknown generator {name!r}; have {sorted(GENERATORS)}"
-            )
         try:
-            params = [
-                int(p) if p.lstrip("-").isdigit() else float(p)
-                for p in parts[1:]
-            ]
-        except ValueError:
-            raise SystemExit(f"bad generator parameters in {args.generator!r}")
-        return GENERATORS[name](*params, seed=args.seed)
+            return topology_from_spec(args.generator, seed=args.seed)
+        except ValueError as err:
+            raise SystemExit(str(err))
     if args.deployment:
         from flow_updating_tpu.engine import TICK_INTERVAL
 
@@ -307,7 +306,8 @@ def cmd_run(args) -> int:
                     multichip=getattr(args, "multichip", "auto"),
                     halo=getattr(args, "halo", "ppermute"),
                     partition=getattr(args, "partition", "bfs"),
-                    event_log=event_log)
+                    event_log=event_log,
+                    plan=getattr(args, "plan", "off"))
     engine.set_topology(_build_topology(args))
     t_build0 = _time.perf_counter()
     if args.resume:
@@ -413,6 +413,8 @@ def cmd_run(args) -> int:
     report["edges"] = engine.topology.num_edges
     report["variant"] = engine.config.variant
     report["fire_policy"] = engine.config.fire_policy
+    if engine.plan_report() is not None:
+        report["plan"] = engine.plan_report()
     if telemetry_series is not None:
         # summary on stdout; the full series belongs in --report/--event-log
         report["telemetry"] = telemetry_series.summary()
@@ -588,21 +590,14 @@ def cmd_sweep(args) -> int:
     from flow_updating_tpu.models.config import RoundConfig
     from flow_updating_tpu.obs.telemetry import TelemetrySpec
     from flow_updating_tpu.sweep import grid_instances, run_sweep
-    from flow_updating_tpu.topology.generators import GENERATORS
+    from flow_updating_tpu.topology.generators import topology_from_spec
 
     topos = []
     for spec in args.generator:
-        parts = spec.split(":")
-        name = parts[0]
-        if name not in GENERATORS:
-            raise SystemExit(
-                f"unknown generator {name!r}; have {sorted(GENERATORS)}")
         try:
-            gparams = [int(p) if p.lstrip("-").isdigit() else float(p)
-                       for p in parts[1:]]
-        except ValueError:
-            raise SystemExit(f"bad generator parameters in {spec!r}")
-        topos.append((spec, GENERATORS[name](*gparams, seed=args.seed)))
+            topos.append((spec, topology_from_spec(spec, seed=args.seed)))
+        except ValueError as err:
+            raise SystemExit(str(err))
 
     drop_rates = _csv_list(args.drop_rates, float, "--drop-rates")
     timeouts = _csv_list(args.timeouts, int, "--timeouts")
@@ -786,7 +781,8 @@ def _engine_from_args(args):
     engine = Engine(config=cfg, mesh=mesh,
                     multichip=getattr(args, "multichip", "auto"),
                     halo=getattr(args, "halo", "ppermute"),
-                    partition=getattr(args, "partition", "bfs"))
+                    partition=getattr(args, "partition", "bfs"),
+                    plan=getattr(args, "plan", "off"))
     engine.set_topology(_build_topology(args))
     try:
         engine.build(latency_scale=getattr(args, "latency_scale", 0.0),
@@ -964,6 +960,65 @@ def cmd_inspect(args) -> int:
         out.append(entry)
     _emit_json(out[0] if len(out) == 1 else {"inspected": out},
                args.output)
+    return 0
+
+
+def cmd_plan(args) -> int:
+    """``plan``: run the topology compiler standalone — compile the
+    graph, print the auto-selection decision with band occupancy and
+    predicted per-candidate cost, optionally as a human-readable
+    explanation (``--explain``) and/or a
+    ``flow-updating-plan-report/v1`` manifest (``--report``)."""
+    _select_backend(args.backend)
+    from flow_updating_tpu.plan import select_plan
+    from flow_updating_tpu.plan.rcm import offset_profile
+
+    cfg = _make_config(args)
+    topo = _build_topology(args)
+    try:
+        decision = select_plan(
+            topo, cfg, backend=args.plan_backend or None,
+            probe="aot" if args.probe else "analytic",
+            max_lanes=args.max_lanes, min_fill=args.min_fill,
+            remainder=args.remainder)
+    except (ValueError, NotImplementedError) as err:
+        raise SystemExit(f"plan: {err}")
+    doc = decision.describe()
+    doc["nodes"] = topo.num_nodes
+    doc["directed_edges"] = topo.num_edges
+    if args.explain:
+        lines = [f"# decision: {doc['kernel']}"
+                 + (f"/{doc['spmv']}" if doc.get("spmv") else "")
+                 + f" on {doc['backend']}",
+                 f"# {decision.reason}"]
+        numeric = {c: v for c, v in doc.get("predicted_cost", {}).items()
+                   if isinstance(v, (int, float))}
+        for cand, cost in sorted(numeric.items(), key=lambda kv: kv[1]):
+            lines.append(f"#   {cand:<16} predicted {cost:,.0f}")
+        plan = decision.plan
+        if plan is not None:
+            offs, counts = offset_profile(topo, plan.order, top=16)
+            lines.append(
+                f"# band occupancy after RCM (top diagonals of "
+                f"{topo.num_nodes} rows; kept lanes marked *):")
+            kept = set(plan.spmv.offsets)
+            for d, c in zip(offs, counts):
+                mark = "*" if int(d) in kept else " "
+                lines.append(
+                    f"# {mark} offset {int(d):+6d}: {int(c):8d} edges "
+                    f"({100.0 * c / max(topo.num_nodes, 1):5.1f}% fill)")
+        print("\n".join(lines), file=sys.stderr)
+    if args.report:
+        from flow_updating_tpu.obs.report import (
+            build_plan_manifest,
+            write_report,
+        )
+
+        write_report(args.report, build_plan_manifest(
+            argv=getattr(args, "_argv", None), config=cfg, topo=topo,
+            plan=doc))
+        doc["report_path"] = args.report
+    print(json.dumps(doc))
     return 0
 
 
@@ -1379,6 +1434,47 @@ def build_parser() -> argparse.ArgumentParser:
                      help="write the JSON result (summary/blame/diff) "
                           "to PATH instead of stdout")
     ins.set_defaults(fn=cmd_inspect)
+
+    pl = sub.add_parser(
+        "plan",
+        help="topology compiler standalone: compile any graph into an "
+             "RCM-band + remainder execution plan, print the chosen "
+             "kernel/spmv with band occupancy and predicted cost "
+             "(--explain for the human-readable breakdown), write the "
+             "flow-updating-plan-report/v1 manifest (--report) — "
+             "flow_updating_tpu.plan, docs/PLANNER.md")
+    _add_common(pl)
+    _add_kernel_flags(pl)
+    pl.add_argument("--plan-backend", default=None,
+                    choices=("tpu", "cpu"),
+                    help="rank candidates for this backend's cost model "
+                         "instead of the ambient one (a TPU plan can be "
+                         "inspected from a CPU session)")
+    pl.add_argument("--probe", action="store_true",
+                    help="rank candidates by XLA's own cost_analysis of "
+                         "the lowered programs (obs/profile.py AOT) "
+                         "instead of the analytic HBM-traffic model — "
+                         "compiles each candidate once")
+    pl.add_argument("--max-lanes", type=int, default=96,
+                    help="dense roll-lane budget (each kept diagonal "
+                         "costs one streamed pass per neighbor sum)")
+    pl.add_argument("--min-fill", type=float, default=None,
+                    help="occupancy floor for keeping a diagonal as a "
+                         "band lane, as a fraction of N (default: the "
+                         "backend's break-even 3/gather_cost)")
+    pl.add_argument("--remainder", default="auto",
+                    choices=("auto", "gather", "benes", "none"),
+                    help="route for out-of-band edges: Benes permutation "
+                         "lanes (gather-free, the TPU form), plain "
+                         "bucketed ELL gather, or refuse any remainder")
+    pl.add_argument("--explain", action="store_true",
+                    help="print the human-readable decision breakdown "
+                         "(band occupancy table, predicted costs) to "
+                         "stderr alongside the JSON")
+    pl.add_argument("--report", metavar="PATH",
+                    help="write the flow-updating-plan-report/v1 "
+                         "manifest to PATH")
+    pl.set_defaults(fn=cmd_plan)
 
     dr = sub.add_parser(
         "doctor",

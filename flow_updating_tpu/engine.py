@@ -91,7 +91,8 @@ class Engine:
     def __init__(self, argv=None, config: RoundConfig | None = None,
                  mesh=None, multichip: str = "auto",
                  halo: str = "ppermute", partition: str = "bfs",
-                 host_actors: bool = False, event_log=None):
+                 host_actors: bool = False, event_log=None,
+                 plan="off"):
         # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
         # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
         # accepts a ready RoundConfig here.  ``mesh`` (a jax.sharding.Mesh
@@ -110,8 +111,34 @@ class Engine:
         #            (parallel/structured_sharded.py): node kernel,
         #            spmv='structured', fat-tree topologies with S | k;
         #            one (k/2,)-element psum per round.
+        # ``plan`` turns on the topology compiler (flow_updating_tpu.plan):
+        #   'off'  — historical dispatch, exactly the configured flags.
+        #   'auto' — after the topology resolves, pick the fastest correct
+        #            kernel/spmv for (topology, backend): the structured
+        #            stencil on generator-regular graphs, the compiled
+        #            RCM-band + Benes/gather-remainder plan or the generic
+        #            layouts on arbitrary graphs (plan/select.py).  Only
+        #            ever changes WHICH implementation of the requested
+        #            dynamics runs, never the dynamics themselves.
+        #   an ExecutionPlan / PlanDecision instance — use it as-is.
         if multichip not in ("auto", "halo", "pod"):
             raise ValueError(f"unknown multichip mode {multichip!r}")
+        if isinstance(plan, str):
+            if plan not in ("off", "auto"):
+                raise ValueError(
+                    f"unknown plan mode {plan!r}: use 'off', 'auto', or "
+                    "pass a compiled flow_updating_tpu.plan "
+                    "ExecutionPlan / PlanDecision")
+        elif plan is not None:
+            from flow_updating_tpu.plan import ExecutionPlan
+            from flow_updating_tpu.plan.select import PlanDecision
+
+            if not isinstance(plan, (ExecutionPlan, PlanDecision)):
+                # a dict/describe() output/bool must not silently run
+                # auto-selection in place of the caller's intended plan
+                raise TypeError(
+                    f"plan= takes 'off', 'auto', an ExecutionPlan or a "
+                    f"PlanDecision; got {type(plan).__name__}")
         self.argv = list(argv) if argv else []
         self.config = config or RoundConfig.fast()
         self.config = self._apply_argv_cfg(self.config)
@@ -129,6 +156,9 @@ class Engine:
         self._killed = False
         self._n_real: int | None = None   # real node count when mesh-padded
         self._halo_plan = None
+        self.plan_spec = plan
+        self.plan_decision = None   # PlanDecision once build() resolved it
+        self._plan = None           # ExecutionPlan handed to the NodeKernel
         self.netzone_root = _NetzoneShim(self)
         # optional EventLog sink for engine lifecycle records ("advance"
         # compiled-chunk dispatches, "kill_all") — together with the s4u
@@ -430,7 +460,8 @@ class Engine:
                 )
             else:
                 self._node_kernel = sync.NodeKernel(
-                    self.topology, self.config, mesh=self.mesh
+                    self.topology, self.config, mesh=self.mesh,
+                    plan=self._plan,
                 )
             self._topo_arrays = None
             return
@@ -518,9 +549,79 @@ class Engine:
                 delivery_benes=self.config.delivery_benes_mode,
             )
 
+    def _apply_plan(self) -> None:
+        """Resolve ``plan=`` into a concrete kernel/spmv choice (the
+        topology compiler's auto mode, ROADMAP open item 1).
+
+        Runs between topology resolution and array preparation: the
+        decision may rewrite ``self.config``'s kernel/spmv fields — and
+        only those; the requested dynamics (variant, fire policy, drop,
+        delays) are inputs to the selection, never outputs.  The chosen
+        :class:`~flow_updating_tpu.plan.compile.ExecutionPlan` (RCM
+        order + band masks + remainder route) is handed to the
+        NodeKernel, whose existing permutation machinery keeps every
+        readback, telemetry row and field series in ORIGINAL node order.
+        """
+        if self.plan_spec in (None, "off"):
+            return
+        if (self.mesh is not None or self.host_actors
+                or self._custom_actor is not None):
+            logger.info(
+                "plan=%r: multi-chip / custom-actor dispatch is not "
+                "planned yet; keeping the configured execution mode",
+                self.plan_spec)
+            return
+        from flow_updating_tpu.plan import ExecutionPlan, select_plan
+        from flow_updating_tpu.plan.select import PlanDecision
+
+        feats = 0
+        vals = self.topology.values
+        if vals is not None and getattr(vals, "ndim", 1) > 1:
+            feats = int(vals.size // vals.shape[0])
+        spec = self.plan_spec
+        if isinstance(spec, ExecutionPlan):
+            decision = PlanDecision(
+                kernel="node", spmv="banded", plan=spec,
+                backend="explicit", predicted={},
+                reason="explicit ExecutionPlan passed to Engine(plan=)")
+        elif isinstance(spec, PlanDecision):
+            decision = spec
+        else:  # 'auto'
+            decision = select_plan(self.topology, self.config,
+                                   features=feats)
+        if decision.kernel == "node" and not \
+                self.config.is_fast_sync_collectall:
+            raise ValueError(
+                "the supplied plan selects the node kernel, but this "
+                "config runs dynamics only the edge kernel implements "
+                f"({self.config.variant!r}/{self.config.fire_policy!r}"
+                f"/drop={self.config.drop_rate}) — use plan='auto' to "
+                "let selection respect the config")
+        import dataclasses
+
+        if decision.kernel == "node":
+            self.config = dataclasses.replace(
+                self.config, kernel="node", spmv=decision.spmv)
+            self._plan = decision.plan if decision.spmv == "banded" \
+                else None
+        else:
+            self.config = dataclasses.replace(self.config, kernel="edge")
+            self._plan = None
+        self.plan_decision = decision
+        logger.info("plan: %s", decision.reason)
+
+    def plan_report(self) -> dict | None:
+        """JSON-ready record of the plan decision (None when planning
+        was off or fell back) — the ``plan`` block of run and plan
+        manifests (``flow-updating-plan-report/v1``)."""
+        if self.plan_decision is None:
+            return None
+        return self.plan_decision.describe()
+
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
         """Resolve deployment(+platform) into topology + fresh state."""
         self._resolve_topology(latency_scale)
+        self._apply_plan()
         self._prepare_arrays(latency_scale)
         if self._halo_mode:
             from flow_updating_tpu.parallel import sharded

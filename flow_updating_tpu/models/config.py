@@ -214,7 +214,12 @@ class RoundConfig:
     #                                    'structured' (closed-form stencil
     #                                    for regular generator topologies,
     #                                    ops/structured.py — requires
-    #                                    Topology.structure)
+    #                                    Topology.structure) |
+    #                                    'banded' (topology-compiled masked
+    #                                    -roll bands + Benes/gather
+    #                                    remainder for ARBITRARY graphs,
+    #                                    flow_updating_tpu.plan — RCM
+    #                                    reorder handled by the kernel)
     segment_impl: str = "auto"         # edge-kernel per-node reductions:
     #                                    'segment' (jax.ops segment_* —
     #                                    scatter-based lowering) | 'ell'
@@ -252,7 +257,7 @@ class RoundConfig:
                                  "benes_fused"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas", "benes", "benes_fused",
-                             "structured"):
+                             "structured", "banded"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
         if self.segment_impl not in ("auto", "segment", "ell", "benes",
                                      "benes_fused"):

@@ -248,6 +248,19 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
     return state, process
 
 
+def _align_drop(keep, topo):
+    """Per-message loss draws are keyed by ORIGINAL edge id: on a
+    topology-compiler-reordered graph (``topo.drop_perm`` set by
+    ``plan.reorder_topology_stable``) the threefry draw for plan edge e
+    is the one its original edge would have received, so a planned
+    drop>0 run replays the exact original loss realization (bit-exact
+    state evolution after unpermutation, tests/test_plan.py).  Identity
+    (the common case) is free."""
+    if getattr(topo, "drop_perm", None) is None:
+        return keep
+    return keep[topo.drop_perm]
+
+
 def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
               params: RoundParams | None = None):
     """Tick + averaging + ledger update; outgoing messages are *computed*
@@ -439,11 +452,11 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
         # statically (None is pytree structure, not a traced value).
         key, sub = jax.random.split(key)
         keep = jax.random.bernoulli(sub, 1.0 - params.drop_rate, (E,))
-        send_mask = send_mask & keep
+        send_mask = send_mask & _align_drop(keep, topo)
     elif params is None and cfg.drop_rate > 0.0:
         key, sub = jax.random.split(key)
         keep = jax.random.bernoulli(sub, 1.0 - cfg.drop_rate, (E,))
-        send_mask = send_mask & keep
+        send_mask = send_mask & _align_drop(keep, topo)
 
     state = state.replace(
         flow=new_flow,

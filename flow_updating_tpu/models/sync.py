@@ -73,6 +73,12 @@ class NodeSyncArrays:
     ns_struct: object = struct.field(pytree_node=False, default=None)
     #                        spmv='structured': closed-form adjacency
     #                        descriptor (ops/structured.py; frozen+hashable)
+    ns_band_leaves: object = None
+    #                        spmv='banded': BandedLeaves pytree (roll masks,
+    #                        remainder mats/network masks — plan/banded.py)
+    ns_band: object = struct.field(pytree_node=False, default=None)
+    #                        spmv='banded': static BandedSpmvPlan
+    #                        (identity-hashed, like ns_plan)
 
 
 def _check_cfg(cfg: RoundConfig) -> None:
@@ -101,14 +107,20 @@ class NodeKernel:
     """
 
     def __init__(self, topo: Topology, cfg: RoundConfig,
-                 row_multiple: int = 1, mesh=None, values=None):
+                 row_multiple: int = 1, mesh=None, values=None,
+                 plan=None):
         """``values`` overrides ``topo.values`` and may be ``(N, D)`` —
         the node-collapsed recurrence is linear in the payload, so a
         vector run is exactly D independent scalar recurrences sharing
         one neighbor-sum schedule (the workloads substrate,
         :mod:`flow_updating_tpu.workloads`).  Vector payloads run the
-        'xla' neighbor sum: the pallas/benes/structured layouts reshape
-        the node axis into circuit/stencil geometry and stay scalar."""
+        'xla' or 'banded' neighbor sum (rolls broadcast over the
+        feature axis); the pallas/benes/structured layouts reshape the
+        node axis into circuit/stencil geometry and stay scalar.
+
+        ``plan`` (spmv='banded' only) supplies a pre-compiled
+        :class:`~flow_updating_tpu.plan.compile.ExecutionPlan`; omitted,
+        the kernel compiles one itself (``plan.compile_topology``)."""
         _check_cfg(cfg)
         self.topo = topo
         self.cfg = cfg
@@ -116,15 +128,15 @@ class NodeKernel:
             topo.values if values is None else values, np.float64)
         check_payload_values(self._values, topo.num_nodes)
         self.feature_shape = tuple(self._values.shape[1:])
-        if self.feature_shape and cfg.spmv != "xla":
+        if self.feature_shape and cfg.spmv not in ("xla", "banded"):
             raise ValueError(
                 f"vector payloads run the node kernel with spmv='xla' "
-                f"(spmv={cfg.spmv!r} reshapes the node axis into "
-                "circuit/stencil geometry; use the edge kernel for "
+                f"or 'banded' (spmv={cfg.spmv!r} reshapes the node axis "
+                "into circuit/stencil geometry; use the edge kernel for "
                 "vector runs on those paths)")
         import math
 
-        if cfg.spmv in ("pallas", "benes", "benes_fused"):
+        if cfg.spmv in ("pallas", "benes", "benes_fused", "banded"):
             if mesh is not None:
                 # a config-validity error: the CLI's build/resume handlers
                 # turn ValueError into a clean "invalid flag combination"
@@ -152,6 +164,9 @@ class NodeKernel:
         if cfg.spmv == "structured":
             self._init_structured(topo, dt)
             self._place_on_mesh()
+            return
+        if cfg.spmv == "banded":
+            self._init_banded(topo, dt, plan)
             return
         ell = topo.ell_buckets()
 
@@ -202,6 +217,57 @@ class NodeKernel:
         )
         self._place_on_mesh()
 
+    def _init_banded(self, topo: Topology, dt, plan) -> None:
+        """spmv='banded': node vectors live in the topology compiler's
+        RCM order (``plan.order[new] = old``; the existing
+        ``_perm``/``_unpermute`` machinery restores original node order
+        for every readback, field series and topk id), padding appended
+        at the tail.  The neighbor sum runs the plan's masked-roll bands
+        plus its Benes/gather remainder (``plan/banded.py``) — the
+        generalization of the structured stencil to arbitrary graphs."""
+        features = int(np.prod(self.feature_shape)) \
+            if self.feature_shape else 0
+        if plan is None:
+            from flow_updating_tpu.plan import compile_topology
+
+            plan = compile_topology(topo, features=features)
+        if plan.num_nodes != topo.num_nodes:
+            raise ValueError(
+                f"execution plan covers {plan.num_nodes} nodes but the "
+                f"topology has {topo.num_nodes} — compile the plan from "
+                "this topology (plan.compile_topology)")
+        from flow_updating_tpu.plan.compile import _topo_key
+
+        if plan.source_key and plan.source_key != _topo_key(topo):
+            # same node count is NOT the same graph: foreign banded
+            # masks would silently compute a different protocol
+            raise ValueError(
+                "execution plan was compiled from a different topology "
+                "(edge-content fingerprint mismatch) — recompile with "
+                "plan.compile_topology(topo)")
+        if features and plan.spmv.rem_mode == "benes":
+            raise ValueError(
+                "this plan routes its remainder through scalar Benes "
+                "lanes; vector payloads need a gather-remainder plan — "
+                f"compile_topology(topo, features={features})")
+        self.plan = plan
+        n = topo.num_nodes
+        self.padded_size = M = _ceil_to(n, self.row_multiple)
+        self._pos_of_real = np.arange(n, dtype=np.int64)
+        self._perm = np.asarray(plan.order, np.int64)
+        value = np.zeros((M,) + self.feature_shape, np.float64)
+        deg = np.zeros(M, np.float64)
+        value[:n] = self._values[self._perm]
+        deg[:n] = topo.out_deg[self._perm]
+        self.arrays = NodeSyncArrays(
+            value=jnp.asarray(value, dt),
+            inv_depp1=jnp.asarray(1.0 / (deg + 1.0), dt),
+            deg=jnp.asarray(deg, dt),
+            mats=(),
+            ns_band_leaves=plan.leaves,
+            ns_band=plan.spmv,
+        )
+
     def _init_structured(self, topo: Topology, dt) -> None:
         """spmv='structured': identity node order (no gather to bucket —
         the ELL degree permutation would only obfuscate the stencil's
@@ -209,10 +275,16 @@ class NodeKernel:
         struct = topo.structure
         if struct is None:
             raise ValueError(
-                "spmv='structured' needs a topology whose generator "
-                "attached a closed-form adjacency descriptor (ring, "
-                "grid2d, complete, fat_tree); this topology has none — "
-                "use spmv='xla'|'benes'|'benes_fused'"
+                "spmv='structured' is the closed-form stencil for "
+                "topologies whose GENERATOR proves their regularity "
+                "(ring, grid2d, torus2d, hypercube, complete, fat_tree) "
+                "— this topology carries no structure descriptor.  For "
+                "arbitrary graphs use the topology compiler instead: "
+                "Engine(plan='auto') / --plan auto picks the fastest "
+                "correct path automatically, spmv='banded' forces the "
+                "compiled RCM-band plan, and "
+                "spmv='xla'|'benes'|'benes_fused' are the generic "
+                "neighbor-sum layouts"
             )
         if struct.n != topo.num_nodes:
             raise ValueError(
@@ -373,6 +445,10 @@ def node_round_step(
         from flow_updating_tpu.ops.structured import structured_neighbor_sum
 
         A_cur = structured_neighbor_sum(avg, arrs.ns_struct)
+    elif cfg.spmv == "banded":
+        from flow_updating_tpu.plan.banded import banded_neighbor_sum
+
+        A_cur = banded_neighbor_sum(avg, arrs.ns_band, arrs.ns_band_leaves)
     else:
         A_cur = neighbor_sum(avg, arrs.mats)
     deg = _ex(arrs.deg, arrs.value)

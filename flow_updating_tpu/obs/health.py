@@ -372,6 +372,56 @@ def check_baselines(data: dict, *, gate: float = SPREAD_VALIDITY_PCT
                        ev)
 
 
+def check_plan(plan: dict | None, measured: dict | None = None, *,
+               margin_pct: float = 10.0) -> CheckResult:
+    """Audit a topology-compiler decision (``flow-updating-plan-report/
+    v1``): when the manifest carries per-candidate MEASURED rates
+    (``bench.py --generator`` records them), the chosen plan must be
+    within ``margin_pct`` of the fastest measured candidate — "auto
+    picked a slower plan than available" is a warn with the evidence
+    named.  Without measurements the prediction is acknowledged, not
+    judged."""
+    name = "plan_selection"
+    if not plan:
+        return CheckResult(name, SKIP, "no plan decision recorded")
+    chosen = plan.get("kernel", "?")
+    # candidate labels pair kernel/impl; edge decisions carry spmv=None
+    # but every measured block keys the edge candidate 'edge/gather'
+    chosen = f"{chosen}/{plan.get('spmv') or 'gather'}"
+    if not measured:
+        return CheckResult(
+            name, PASS,
+            f"plan {chosen} selected (predicted only — record measured "
+            "candidate rates to audit the choice)",
+            {"chosen": chosen,
+             "predicted_cost": plan.get("predicted_cost")})
+    rates = {k: float(v) for k, v in measured.items()
+             if isinstance(v, (int, float)) and float(v) > 0}
+    if not rates:
+        return CheckResult(name, SKIP, "measured block carries no rates",
+                           {"measured": measured})
+    best = max(rates, key=rates.get)
+    chosen_rate = rates.get(chosen)
+    ev = {"chosen": chosen, "measured_rounds_per_sec": rates,
+          "fastest": best, "margin_pct": margin_pct}
+    if chosen_rate is None:
+        return CheckResult(
+            name, WARN,
+            f"chosen plan {chosen} has no measured rate "
+            f"(measured: {sorted(rates)})", ev)
+    if chosen_rate < rates[best] * (1.0 - margin_pct / 100.0):
+        return CheckResult(
+            name, WARN,
+            f"auto picked a slower plan than available: {chosen} at "
+            f"{chosen_rate:.4g} r/s vs {best} at {rates[best]:.4g} r/s "
+            f"({100 * (1 - chosen_rate / rates[best]):.1f}% slower)",
+            ev)
+    return CheckResult(
+        name, PASS,
+        f"chosen plan {chosen} is the fastest measured candidate "
+        f"(within {margin_pct:g}%)", ev)
+
+
 def check_report(report: dict | None, *, dtype: str | None = None
                  ) -> CheckResult:
     """Final-state sanity from a run manifest's convergence report:
@@ -486,6 +536,11 @@ def diagnose_manifest(manifest: dict) -> list:
     fields = manifest.get("fields")
     if isinstance(fields, dict):
         attach_field_culprits(series_checks, fields)
+    plan_block = manifest.get("plan")
+    if not isinstance(plan_block, dict) and isinstance(report, dict):
+        plan_block = report.get("plan")  # run manifests embed it there
+    if isinstance(plan_block, dict):
+        checks.append(check_plan(plan_block, manifest.get("measured")))
     instances = manifest.get("instances")
     if isinstance(instances, list) and instances:
         n_conv = sum(1 for r in instances

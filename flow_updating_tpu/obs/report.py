@@ -30,6 +30,7 @@ SCHEMA = "flow-updating-run-report/v1"
 SWEEP_SCHEMA = "flow-updating-sweep-report/v1"
 PROFILE_SCHEMA = "flow-updating-profile-report/v1"
 FIELD_SCHEMA = "flow-updating-field-report/v1"
+PLAN_SCHEMA = "flow-updating-plan-report/v1"
 
 
 def environment_info() -> dict:
@@ -158,6 +159,35 @@ def build_profile_manifest(*, argv=None, config=None, topo=None,
         "environment": environment_info(),
         "profile": profile,
     }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_plan_manifest(*, argv=None, config=None, topo=None,
+                        plan=None, measured=None, extra=None) -> dict:
+    """Assemble the plan-shaped v1 manifest: the run manifest's
+    argv/config/topology/environment binding around one topology-compiler
+    decision (``PlanDecision.describe()`` — kernel/spmv choice, band
+    statistics, predicted per-candidate cost).  ``measured`` optionally
+    records per-candidate measured rates (``{candidate:
+    rounds_per_sec}``, e.g. from ``bench.py --generator``) so the doctor
+    can audit "auto picked a slower plan than available"
+    (``obs.health.check_plan``)."""
+    manifest = {
+        "schema": PLAN_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "topology": topology_summary(topo) if topo is not None else None,
+        "environment": environment_info(),
+        "plan": dict(plan) if plan else None,
+    }
+    if measured:
+        manifest["measured"] = dict(measured)
     if extra:
         manifest.update(extra)
     return manifest

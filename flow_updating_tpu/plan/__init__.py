@@ -1,0 +1,51 @@
+"""Topology compiler: any graph -> a near-regular execution plan.
+
+The structured stencil (``ops/structured.py``) holds the perf record
+because the fat-tree's regularity turns the neighbor-sum gather into
+dense shifted slices; the general ``xla`` edge path on the same graph is
+~900x slower (ROADMAP open item 1).  This package closes that gap for
+*arbitrary* graphs with the "sparse graphs on dense hardware" recipe of
+arXiv:1906.11786:
+
+1. **Reorder** — reverse Cuthill-McKee over the symmetric adjacency
+   (:mod:`flow_updating_tpu.plan.rcm`) concentrates edges near the
+   diagonal;
+2. **Band** — high-occupancy diagonals execute as dense masked rolls,
+   exactly the shape that makes the structured stencil fast
+   (:mod:`flow_updating_tpu.plan.banded`);
+3. **Remainder** — what the bands do not absorb routes through the
+   existing Benes permutation lanes (``ops/spmv_benes.py``) or a plain
+   gather, whichever the backend prefers.
+
+:func:`compile_topology` produces the static
+:class:`~flow_updating_tpu.plan.compile.ExecutionPlan`;
+:func:`select_plan` is the auto-mode policy (``Engine(plan='auto')``)
+choosing kernel/spmv per (topology, backend) from analytic or AOT cost
+models (``obs/profile.py``).
+"""
+
+from flow_updating_tpu.plan.banded import (
+    BandedLeaves,
+    BandedSpmvPlan,
+    banded_neighbor_sum,
+)
+from flow_updating_tpu.plan.compile import (
+    ExecutionPlan,
+    compile_topology,
+    reorder_topology_stable,
+)
+from flow_updating_tpu.plan.rcm import adjacency_bandwidth, rcm_order
+from flow_updating_tpu.plan.select import PlanDecision, select_plan
+
+__all__ = [
+    "BandedLeaves",
+    "BandedSpmvPlan",
+    "ExecutionPlan",
+    "PlanDecision",
+    "adjacency_bandwidth",
+    "banded_neighbor_sum",
+    "compile_topology",
+    "rcm_order",
+    "reorder_topology_stable",
+    "select_plan",
+]

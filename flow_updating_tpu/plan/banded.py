@@ -1,0 +1,245 @@
+"""Banded neighbor sums: occupied diagonals as dense masked rolls.
+
+After RCM reordering, most edges of a structured-ish graph sit on a few
+near-full diagonals of the adjacency.  Each such diagonal ``d``
+contributes ``where(mask_d, roll(x, -d), 0)`` to the neighbor sum — one
+dense streamed pass per band, the exact shape that makes
+``ops/structured.py`` fast, with a mask instead of closed-form index
+arithmetic.  Edges on low-occupancy diagonals form the *remainder*,
+routed through either
+
+* the existing Benes permutation lanes (``ops/spmv_benes.py`` plans the
+  remainder's ELL matrices as a gather-free switching network, and a
+  second small Benes network un-permutes the bucket-ordered rows back to
+  RCM order — no dynamic gather anywhere, the TPU form), or
+* a plain bucketed ELL gather + row-reduce (the CPU/small-graph form).
+
+The plan object is identity-hashed static metadata (like
+``NeighborSumPlan``); the big mask/index arrays travel separately as
+pytree leaves (:class:`BandedLeaves`) so they never become jaxpr
+constants.  Exactness vs the generic gather neighbor sum is asserted in
+``tests/test_plan.py`` (bit-for-bit on integer-valued payloads, where
+float addition is exact regardless of order).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.utils import struct
+
+
+@struct.dataclass
+class BandedLeaves:
+    """Device-side arrays of one banded plan (pytree leaves)."""
+
+    band_masks: tuple      # per kept offset: (n,) bool — row u has edge u->u+d
+    rem_mats: tuple = ()   # 'gather': bucketed (rows, w) int32 neighbor mats
+    #                        in RCM node space (pad index n -> zero slot)
+    rem_pos: object = None  # 'gather': (n,) int32 — RCM row -> bucket position
+    rem_ns_masks: tuple = ()      # 'benes': remainder network stage masks
+    rem_unperm_masks: tuple = ()  # 'benes': bucket-order -> RCM-order masks
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class BandedSpmvPlan:
+    """Static banded-spmv descriptor (identity-hashed, jit-static).
+
+    ``offsets`` are the kept signed diagonals in ascending order;
+    ``rem_mode`` is 'none' | 'gather' | 'benes'.  The companion
+    :class:`BandedLeaves` (built by :func:`build_banded`) carries the
+    arrays.
+    """
+
+    n: int                     # real node count (RCM space)
+    offsets: tuple             # kept signed diagonals, ascending
+    in_band_edges: int
+    remainder_edges: int
+    rem_mode: str
+    rem_bucket_shapes: tuple = ()
+    rem_ns_plan: object = None       # spmv_benes.NeighborSumPlan ('benes')
+    rem_unperm_plan: object = None   # permute.PaddedPermPlan ('benes')
+
+    @property
+    def coverage(self) -> float:
+        """In-band fraction of the directed edges."""
+        total = self.in_band_edges + self.remainder_edges
+        return self.in_band_edges / total if total else 1.0
+
+
+def _remainder_ell(n: int, src: np.ndarray, dst: np.ndarray):
+    """Degree-bucketed ELL matrices for the remainder adjacency, rows
+    grouped by next-pow2 remainder degree (same policy as
+    ``Topology.ell_buckets``: the power of two is only the grouping key;
+    stored width is the bucket's true max degree).  Returns
+    ``(mats, pos)`` with ``pos[row] = position of RCM row`` in the
+    concatenated bucket output."""
+    deg = np.bincount(src, minlength=n).astype(np.int64)
+    row_start = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=row_start[1:])
+    wkey = np.zeros(n, np.int64)
+    nz = deg > 0
+    wkey[nz] = 1 << np.ceil(np.log2(deg[nz])).astype(np.int64)
+    order = np.argsort(wkey, kind="stable").astype(np.int64)
+    pos = np.empty(n, np.int64)
+    pos[order] = np.arange(n, dtype=np.int64)
+    mats = []
+    sorted_w = wkey[order]
+    start = 0
+    while start < n:
+        key = sorted_w[start]
+        end = int(np.searchsorted(sorted_w, key, side="right"))
+        rows = order[start:end]
+        w = int(deg[rows].max()) if key else 0
+        if w == 0:
+            mats.append(np.empty((len(rows), 0), np.int32))
+        else:
+            lo = row_start[rows]
+            d = deg[rows]
+            ar = np.arange(w, dtype=np.int64)
+            valid = ar[None, :] < d[:, None]
+            col = np.where(valid, lo[:, None] + ar[None, :], 0)
+            mats.append(np.where(valid, dst[col], n).astype(np.int32))
+        start = end
+    return tuple(mats), pos.astype(np.int32)
+
+
+def build_banded(n: int, src: np.ndarray, dst: np.ndarray, *,
+                 max_lanes: int = 96, min_fill: float = 0.05,
+                 remainder: str = "auto", features: int = 0,
+                 ) -> tuple[BandedSpmvPlan, BandedLeaves]:
+    """Build the banded plan for an adjacency already in RCM node order.
+
+    ``src``/``dst`` are the directed edges (RCM ids, any order).  A
+    diagonal d is kept as a band lane while it holds at least
+    ``min_fill * n`` edges, up to ``max_lanes`` lanes (most-occupied
+    first): each lane costs ~3 streamed passes over the n-vector
+    regardless of fill, and absorbs ``count_d`` edges from the
+    remainder's per-edge (gather or network) cost — so the economic
+    floor is ``count_d > 3 / gather_cost_ratio * n`` and the caller
+    tunes ``min_fill`` per backend (``plan/select.py``: ~0.03 on TPU
+    where gathers serialize, ~0.75 on CPU).  RCM makes this work:
+    bandwidth B means the surviving offsets are FEW (<= 2B+1), and on
+    lattice/community graphs most hold O(n) edges.  ``remainder`` is
+    'auto' | 'gather' | 'benes' | 'none' ('none' raises if any edge is
+    left over; 'auto' plans Benes lanes only when the native router
+    makes that tractable, else gathers).  Vector payloads (``features >
+    0``) ride the rolls natively but force the gather remainder (the
+    Benes lane packing is scalar)."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    E = len(src)
+    offs = dst - src
+    uq, counts = (np.unique(offs, return_counts=True) if E
+                  else (np.empty(0, np.int64), np.empty(0, np.int64)))
+    rank = np.argsort(-counts, kind="stable")
+    uq, counts = uq[rank], counts[rank]
+    keep_mask = counts >= max(min_fill * n, 1.0)
+    kept = uq[keep_mask][:max_lanes]
+    kept = np.sort(kept)
+
+    band_masks = []
+    in_band = np.zeros(E, bool)
+    for d in kept:
+        sel = offs == d
+        m = np.zeros(n, bool)
+        m[src[sel]] = True
+        band_masks.append(m)
+        in_band |= sel
+    n_in = int(in_band.sum())
+    rem_src, rem_dst = src[~in_band], dst[~in_band]
+    n_rem = E - n_in
+
+    mode = remainder
+    if mode == "none" and n_rem:
+        raise ValueError(
+            f"remainder='none' but {n_rem} edge(s) fall outside the "
+            f"{len(kept)} kept band(s) — allow a remainder path "
+            "('gather'/'benes'/'auto') or widen min_fill/max_lanes")
+    if n_rem == 0:
+        mode = "none"
+    elif mode == "auto":
+        mode = "gather"
+        if not features:
+            from flow_updating_tpu import native
+
+            # the Benes router in pure python takes hours at scale; only
+            # the C++ router makes the remainder network tractable
+            if native.available() and n_rem >= 1 << 12:
+                mode = "benes"
+    if features and mode == "benes":
+        raise ValueError(
+            "remainder='benes' packs scalar lanes; vector payloads "
+            "route the remainder through 'gather'")
+
+    rem_mats: tuple = ()
+    rem_pos = None
+    rem_ns_plan = None
+    rem_ns_masks: tuple = ()
+    rem_unperm_plan = None
+    rem_unperm_masks: tuple = ()
+    shapes: tuple = ()
+    if mode in ("gather", "benes"):
+        rem_mats, rem_pos = _remainder_ell(n, rem_src, rem_dst)
+        shapes = tuple(m.shape for m in rem_mats)
+        if mode == "benes":
+            from flow_updating_tpu.ops.permute import padded_perm_plan
+            from flow_updating_tpu.ops.spmv_benes import plan_neighbor_sum
+
+            # m1 = n + 1: the zero slot follows the generic convention
+            rem_ns_plan = plan_neighbor_sum(rem_mats, n + 1)
+            rem_ns_masks = rem_ns_plan.device_masks()
+            rem_unperm_plan = padded_perm_plan(rem_pos.astype(np.int64))
+            rem_unperm_masks = rem_unperm_plan.device_masks()
+            rem_mats, rem_pos = (), None  # network replaces the gather
+
+    import jax.numpy as jnp
+
+    leaves = BandedLeaves(
+        band_masks=tuple(jnp.asarray(m) for m in band_masks),
+        rem_mats=tuple(jnp.asarray(m) for m in rem_mats),
+        rem_pos=None if rem_pos is None else jnp.asarray(rem_pos),
+        rem_ns_masks=rem_ns_masks,
+        rem_unperm_masks=rem_unperm_masks,
+    )
+    plan = BandedSpmvPlan(
+        n=n, offsets=tuple(int(d) for d in kept), in_band_edges=n_in,
+        remainder_edges=n_rem, rem_mode=mode, rem_bucket_shapes=shapes,
+        rem_ns_plan=rem_ns_plan, rem_unperm_plan=rem_unperm_plan,
+    )
+    return plan, leaves
+
+
+def banded_neighbor_sum(x, plan: BandedSpmvPlan, leaves: BandedLeaves):
+    """A(x) over the first ``plan.n`` entries of a (possibly padded) RCM
+    -ordered vector; padding slots get 0, matching
+    :func:`ops.structured.structured_neighbor_sum`.  ``x`` may carry a
+    trailing feature axis — the rolls and the gather remainder broadcast
+    over it."""
+    import jax.numpy as jnp
+
+    n = plan.n
+    xv = x[:n]
+    feat = xv.shape[1:]
+    acc = jnp.zeros_like(xv)
+    for d, mask in zip(plan.offsets, leaves.band_masks):
+        contrib = jnp.roll(xv, -d, axis=0)
+        m = mask.reshape(mask.shape + (1,) * len(feat))
+        acc = acc + jnp.where(m, contrib, 0)
+    if plan.rem_mode == "gather":
+        from flow_updating_tpu.models.sync import neighbor_sum
+
+        acc = acc + neighbor_sum(xv, leaves.rem_mats)[leaves.rem_pos]
+    elif plan.rem_mode == "benes":
+        from flow_updating_tpu.ops.permute import apply_padded_perm
+        from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
+
+        a = neighbor_sum_benes(xv, plan.rem_ns_plan, leaves.rem_ns_masks)
+        acc = acc + apply_padded_perm(a, plan.rem_unperm_plan,
+                                      leaves.rem_unperm_masks)
+    if x.shape[0] == n:
+        return acc
+    pad = jnp.zeros((x.shape[0] - n,) + feat, x.dtype)
+    return jnp.concatenate([acc, pad])

@@ -1,0 +1,221 @@
+"""Auto-mode plan selection: pick the fastest correct execution per
+(topology, backend).
+
+``Engine(plan='auto')`` calls :func:`select_plan` after resolving the
+topology: candidates are enumerated from what the config *permits*
+(the node-collapsed kernel covers exactly the fast synchronous
+collect-all mode; everything else runs the edge kernel), each candidate
+gets a predicted per-round cost from an analytic HBM-traffic model
+(streamed element-passes, with the backend's dynamic-gather penalty —
+the measured ~10 ns/element scalar-loop lowering on TPU is why the
+Benes/banded paths exist at all, BENCH_NOTES.md), and the cheapest wins.
+``probe='aot'`` replaces the analytic numbers with XLA's own
+``cost_analysis()`` bytes/flops for the lowered candidate programs
+(:mod:`flow_updating_tpu.obs.profile` — the ``plan --probe`` CLI path).
+
+The fat-tree record is protected by construction: a topology carrying a
+generator structure descriptor always selects the structured stencil
+(its closed-form indexing beats any masked-band emulation of itself).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.plan.compile import ExecutionPlan, compile_topology
+
+#: relative cost of one dynamically-gathered element vs one streamed
+#: element, per backend.  TPU lowers x[idx] to a scalar loop at ~10 ns
+#: per element (BENCH_NOTES.md) while a dense streamed pass moves ~200 G
+#: elements/s — a ratio of order 2000, which is exactly why the k=160
+#: Benes network (~90 streamed stages) beats the one-gather xla path by
+#: an order of magnitude.  CPU gathers are vectorized but cache-hostile.
+#: 'axon' is the tunneled TPU platform name.
+GATHER_COST = {"tpu": 2000.0, "axon": 2000.0, "cpu": 8.0}
+DEFAULT_GATHER_COST = 8.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanDecision:
+    """What auto mode chose, and why (manifest-ready)."""
+
+    kernel: str                 # 'edge' | 'node'
+    spmv: str | None            # node kernel only
+    plan: ExecutionPlan | None  # banded plans carry the compiled plan
+    backend: str
+    predicted: dict             # candidate -> predicted per-round cost
+    reason: str
+
+    def describe(self) -> dict:
+        out = {
+            "kernel": self.kernel,
+            "spmv": self.spmv,
+            "backend": self.backend,
+            "predicted_cost": {k: (round(float(v), 1)
+                                   if isinstance(v, (int, float)) else v)
+                               for k, v in self.predicted.items()},
+            "reason": self.reason,
+        }
+        if self.plan is not None:
+            out["plan"] = self.plan.describe()
+        return out
+
+
+def _backend_name(backend: str | None) -> str:
+    if backend:
+        return backend
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def _analytic_costs(topo, plan: ExecutionPlan | None, backend: str,
+                    candidates) -> dict:
+    """Predicted per-round cost in streamed-element-pass units."""
+    N = float(topo.num_nodes)
+    E = float(topo.num_edges)
+    cg = GATHER_COST.get(backend, DEFAULT_GATHER_COST)
+    out = {}
+    for cand in candidates:
+        if cand == "node/structured":
+            out[cand] = 4.0 * N
+        elif cand == "node/xla":
+            # bucketed gather of E neighbor slots + elementwise recurrence
+            out[cand] = cg * E + 6.0 * N
+        elif cand == "node/banded":
+            s = plan.spmv
+            lanes = len(s.offsets)
+            cost = 3.0 * lanes * N + 6.0 * N
+            if s.rem_mode == "gather":
+                cost += cg * (s.remainder_edges + N)  # + unpermute gather
+            elif s.rem_mode == "benes":
+                P = float(s.rem_ns_plan.P)
+                stages = len(s.rem_ns_plan.stages.dists)
+                cost += stages * P
+                cost += len(s.rem_unperm_plan.stages.dists) \
+                    * float(s.rem_unperm_plan.stages.n)
+            out[cand] = cost
+        elif cand == "node/benes":
+            from flow_updating_tpu.ops.permute import next_pow2
+
+            P = float(next_pow2(int(E + N + 1)))
+            out[cand] = (3 * np.log2(max(P, 2)) + 2) * P + 6.0 * N
+        elif cand == "edge/gather":
+            # ~a dozen streamed passes over (E,) state + 3 edge gathers
+            out[cand] = 12.0 * E + 3.0 * cg * E
+        else:
+            raise ValueError(f"unknown candidate {cand!r}")
+    return out
+
+
+def _aot_costs(topo, cfg, plan, candidates) -> dict:
+    """Replace analytic predictions with XLA ``cost_analysis`` bytes for
+    the actually-lowered 1-round programs (CPU-safe; compiles each
+    candidate once)."""
+    import dataclasses as _dc
+
+    from flow_updating_tpu.obs.profile import profile_program
+
+    out = {}
+    for cand in candidates:
+        kernel, _, impl = cand.partition("/")
+        try:
+            if kernel == "node":
+                from flow_updating_tpu.models import sync
+
+                c = _dc.replace(cfg, kernel="node", spmv=impl)
+                k = sync.NodeKernel(topo, c, plan=plan)
+                fn, args, nd = k.round_program(k.init_state(), 1)
+            else:
+                from flow_updating_tpu.models.rounds import run_rounds
+                from flow_updating_tpu.models.state import init_state
+
+                c = _dc.replace(cfg, kernel="edge")
+                arrays = topo.device_arrays(coloring=c.needs_coloring)
+                fn, args, nd = (run_rounds,
+                                (init_state(topo, c), arrays, c, 1), 2)
+            rec = profile_program(fn, args, n_dynamic=nd, execute=False,
+                                  label=f"plan:{cand}")
+            bytes_ = rec["cost"].get("bytes_accessed")
+            out[cand] = float(bytes_) if bytes_ else float("inf")
+        except Exception as exc:  # a candidate that fails to lower loses
+            out[cand] = float("inf")
+            out[f"{cand}#error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
+def select_plan(topo, cfg, *, backend: str | None = None,
+                features: int = 0, probe: str = "analytic",
+                max_lanes: int = 96, min_fill: float | None = None,
+                remainder: str = "auto") -> PlanDecision:
+    """Choose kernel/spmv for ``(topo, cfg, backend)``.
+
+    Returns a :class:`PlanDecision`; ``decision.plan`` is the compiled
+    :class:`ExecutionPlan` when the banded path won (or was a
+    candidate), else None.  ``probe='aot'`` ranks candidates by XLA's
+    own cost analysis instead of the analytic model."""
+    backend = _backend_name(backend)
+    if not cfg.is_fast_sync_collectall:
+        # only the edge kernel implements these dynamics; there is one
+        # correct program, nothing to rank
+        return PlanDecision(
+            kernel="edge", spmv=None, plan=None, backend=backend,
+            predicted={}, reason=(
+                "config requires the general edge kernel "
+                f"(variant={cfg.variant!r}, fire_policy="
+                f"{cfg.fire_policy!r}, drop_rate={cfg.drop_rate}); "
+                "plan reordering stays available via "
+                "plan.compile_topology for locality studies"))
+    if topo.structure is not None and not features:
+        return PlanDecision(
+            kernel="node", spmv="structured", plan=None, backend=backend,
+            predicted={}, reason=(
+                "generator attached a closed-form structure descriptor "
+                f"({type(topo.structure).__name__}): the exact stencil "
+                "beats any banded emulation of itself"))
+    if topo.virtual:
+        raise ValueError(
+            "cannot plan a virtual topology (no edge arrays); it only "
+            "runs the structured stencil")
+    cg = GATHER_COST.get(backend, DEFAULT_GATHER_COST)
+    if min_fill is None:
+        # lane economics: one roll lane costs ~3 streamed passes over
+        # the n-vector and absorbs count_d edges of per-edge gather cost
+        # — the break-even diagonal fill is 3/cg (clamped to sane bounds)
+        min_fill = float(np.clip(3.0 / cg, 1.0 / 64.0, 0.75))
+    if remainder == "auto" and not features and cg >= 100.0:
+        # on a gather-hostile backend even a tiny remainder should ride
+        # the Benes lanes: the bucketed-gather fallback pays cg on the
+        # N-element unpermute alone.  Routing needs the C++ router to be
+        # tractable at scale; without it the gather fallback stands.
+        from flow_updating_tpu import native
+
+        if native.available():
+            remainder = "benes"
+    plan = compile_topology(topo, max_lanes=max_lanes, min_fill=min_fill,
+                            remainder=remainder, features=features)
+    candidates = ["node/banded", "node/xla", "edge/gather"]
+    if probe == "aot":
+        predicted = _aot_costs(topo, cfg, plan, candidates)
+    else:
+        predicted = _analytic_costs(topo, plan, backend, candidates)
+    best = min((c for c in candidates if c in predicted),
+               key=lambda c: predicted[c])
+    kernel, _, impl = best.partition("/")
+    s = plan.spmv
+    return PlanDecision(
+        kernel=kernel, spmv=impl if kernel == "node" else None,
+        plan=plan,  # losers keep the plan attached: stats feed manifests
+        backend=backend, predicted=predicted,
+        reason=(f"{best} predicted cheapest on {backend} "
+                f"(bands cover {100 * s.coverage:.1f}% of edges in "
+                f"{len(s.offsets)} lane(s), remainder via "
+                f"{s.rem_mode}; bandwidth "
+                f"{plan.stats['bandwidth_before']} -> "
+                f"{plan.stats['bandwidth_after']} after RCM)"),
+    )

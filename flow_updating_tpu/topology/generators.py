@@ -147,6 +147,62 @@ def barabasi_albert(n: int, m: int = 4, seed: int = 0, values=None) -> Topology:
     return _finish(n, np.concatenate(pairs), seed, values)
 
 
+def community(n: int, c: int = 8, k_in: float = 8.0, k_out: float = 0.5,
+              seed: int = 0, values=None) -> Topology:
+    """Planted-partition graph: ``c`` dense communities bridged sparsely.
+
+    Nodes split into ``c`` contiguous blocks; inside each block an
+    Erdős–Rényi layer with average degree ``k_in`` plus a random
+    Hamiltonian backbone (intra-community connectivity); between blocks
+    ``n * k_out / 2`` random bridge edges plus one guaranteed bridge per
+    consecutive block pair (whole-graph connectivity).  ``k_out <<
+    k_in`` gives the conductance-bottleneck regime (slow mixing across
+    bridges) — the hard benchmark case the scenario roadmap names, and
+    the friendly case for the topology compiler: blocks are contiguous,
+    so RCM leaves the adjacency near-block-diagonal and the banded
+    executor covers most edges with a few dense lanes."""
+    if c < 1:
+        raise ValueError("community count c must be >= 1")
+    c = int(min(c, n)) or 1
+    rng = np.random.default_rng(seed)
+    bounds = np.linspace(0, n, c + 1).astype(np.int64)
+    pairs = []
+    for b in range(c):
+        lo, hi = int(bounds[b]), int(bounds[b + 1])
+        size = hi - lo
+        if size < 2:
+            continue
+        m = int(size * k_in / 2)
+        u = rng.integers(lo, hi, size=m, dtype=np.int64)
+        v = rng.integers(lo, hi, size=m, dtype=np.int64)
+        perm = lo + rng.permutation(size).astype(np.int64)
+        backbone = np.stack([perm, np.roll(perm, -1)], axis=1)
+        pairs.append(np.stack([u, v], axis=1))
+        pairs.append(backbone)
+    m_x = int(n * k_out / 2)
+    if c > 1 and m_x:
+        u = rng.integers(0, n, size=m_x, dtype=np.int64)
+        # a bridge must leave its community: draw the partner from the
+        # complement by offsetting past the block and wrapping
+        block = np.searchsorted(bounds, u, side="right") - 1
+        lo, hi = bounds[block], bounds[block + 1]
+        # v = (hi + off) mod n with off < n - block_size sweeps exactly
+        # the complement [hi, n) ∪ [0, lo) of u's block — never a
+        # self-loop, never intra-community
+        off = rng.integers(0, np.maximum(n - (hi - lo), 1), dtype=np.int64)
+        v = (hi + off) % n
+        pairs.append(np.stack([u, v], axis=1))
+    if c > 1:
+        # guaranteed chain of bridges: consecutive blocks stay connected
+        # whatever the random draw did
+        chain_u = bounds[1:-1] - 1
+        chain_v = bounds[1:-1]
+        pairs.append(np.stack([chain_u, chain_v], axis=1))
+    all_pairs = (np.concatenate(pairs) if pairs
+                 else np.empty((0, 2), np.int64))
+    return _finish(n, all_pairs, seed, values)
+
+
 def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True,
              materialize_edges: bool = True) -> Topology:
     """Al-Fares k-ary fat-tree; all hosts *and* switches are graph vertices.
@@ -237,6 +293,26 @@ def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True,
     return dataclasses.replace(topo, structure=FatTreeStruct(k=k))
 
 
+def topology_from_spec(spec: str, seed: int = 0) -> Topology:
+    """Build a topology from the CLI's ``name:params`` grammar
+    (``'barabasi_albert:100000:4'``, ``'ring:64:2'``) — the ONE parser
+    behind ``run``/``sweep``/``plan``'s ``--generator`` flags and
+    ``bench.py --generator``.  Integer-looking params parse as int,
+    the rest as float; unknown names raise ValueError listing the
+    registry."""
+    parts = spec.split(":")
+    name = parts[0]
+    if name not in GENERATORS:
+        raise ValueError(
+            f"unknown generator {name!r}; have {sorted(GENERATORS)}")
+    try:
+        params = [int(p) if p.lstrip("-").isdigit() else float(p)
+                  for p in parts[1:]]
+    except ValueError:
+        raise ValueError(f"bad generator parameters in {spec!r}")
+    return GENERATORS[name](*params, seed=seed)
+
+
 GENERATORS = {
     "ring": ring,
     "grid2d": grid2d,
@@ -245,5 +321,6 @@ GENERATORS = {
     "complete": complete,
     "erdos_renyi": erdos_renyi,
     "barabasi_albert": barabasi_albert,
+    "community": community,
     "fat_tree": fat_tree,
 }

@@ -79,6 +79,14 @@ class Topology:
     #                                          regular graphs); only the
     #                                          structured stencil can run —
     #                                          edge-consuming layouts raise
+    drop_perm: np.ndarray | None = None      # (E,) int32 new-edge -> ORIGINAL
+    #                                          edge id, set by the topology
+    #                                          compiler's stable reorder
+    #                                          (plan/compile.py): per-message
+    #                                          loss draws stay keyed by
+    #                                          original edge id, so a planned
+    #                                          drop>0 run replays the exact
+    #                                          original loss realization
 
     @property
     def num_edges(self) -> int:
@@ -357,6 +365,8 @@ class Topology:
             src=jnp.asarray(self.src),
             dst=jnp.asarray(self.dst),
             rev=jnp.asarray(self.rev),
+            drop_perm=(None if self.drop_perm is None
+                       else jnp.asarray(self.drop_perm)),
             out_deg=jnp.asarray(self.out_deg),
             row_start=jnp.asarray(self.row_start, dtype=jnp.int32),
             edge_rank=jnp.asarray(self.edge_rank),
@@ -414,6 +424,11 @@ class TopoArrays:
     row_start: object
     edge_rank: object
     delay: object
+    drop_perm: object = None  # (E,) i32 plan-edge -> original edge id: the
+    #                           topology compiler's stable reorder keys the
+    #                           per-message loss draw by ORIGINAL edge id so
+    #                           planned drop>0 runs are bit-exact vs the
+    #                           unplanned kernel; None = identity
     edge_color: object = None
     num_colors: int = struct.field(pytree_node=False, default=0)
     sweep_edge_rows: object = None  # (N, W) i32 out-edge indices, pad = E —

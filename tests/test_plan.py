@@ -1,0 +1,471 @@
+"""Topology compiler: RCM + banded execution plans (flow_updating_tpu.plan).
+
+The guarantees under test:
+
+* the banded neighbor sum (masked rolls + Benes/gather remainder) equals
+  the generic gather neighbor sum EXACTLY — asserted bit-for-bit on
+  integer-valued payloads, where float addition is order-independent;
+* a planned EDGE-kernel run (RCM reorder with the stable edge
+  relabeling) evolves bit-for-bit like the original-order kernel after
+  unpermutation — scalar and vector payloads, drop>0 included (the
+  ``drop_perm`` lane keys threefry draws by original edge id);
+* the banded NODE kernel matches the edge kernel's trajectory to float
+  tolerance on irregular graphs (same bar as spmv='xla'/'structured');
+* ``Engine(plan='auto')`` picks the structured stencil on fat-trees and
+  respects the requested dynamics, and its readbacks / field series /
+  topk ids come back in ORIGINAL node order;
+* the ``plan`` CLI and manifests round-trip, and the doctor flags "auto
+  picked a slower plan than available".
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.models import sync
+from flow_updating_tpu.plan import (
+    adjacency_bandwidth,
+    banded_neighbor_sum,
+    compile_topology,
+    rcm_order,
+    reorder_topology_stable,
+    select_plan,
+)
+from flow_updating_tpu.plan.banded import build_banded
+from flow_updating_tpu.topology.generators import (
+    barabasi_albert,
+    community,
+    erdos_renyi,
+    fat_tree,
+    ring,
+)
+from flow_updating_tpu.topology.graph import build_topology
+
+
+def star(n: int, seed: int = 0):
+    hub = np.zeros(n - 1, np.int64)
+    pairs = np.stack([hub, np.arange(1, n, dtype=np.int64)], axis=1)
+    return build_topology(n, pairs, seed=seed, warn_asymmetric=False)
+
+
+def path(n: int, seed: int = 0):
+    i = np.arange(n - 1, dtype=np.int64)
+    pairs = np.stack([i, i + 1], axis=1)
+    return build_topology(n, pairs, seed=seed, warn_asymmetric=False)
+
+
+IRREGULAR = [
+    ("ba", lambda: barabasi_albert(300, m=3, seed=2)),
+    ("er", lambda: erdos_renyi(250, avg_degree=6.0, seed=1)),
+    ("community", lambda: community(320, c=4, k_in=8.0, k_out=0.4,
+                                    seed=3)),
+    ("star", lambda: star(96, seed=4)),
+    ("path", lambda: path(120, seed=5)),
+]
+
+
+# ---- RCM ----------------------------------------------------------------
+
+def test_rcm_is_a_permutation_and_reduces_path_bandwidth():
+    # a shuffled path has huge bandwidth; RCM must recover ~1
+    n = 200
+    rng = np.random.default_rng(0)
+    relabel = rng.permutation(n).astype(np.int64)
+    i = np.arange(n - 1, dtype=np.int64)
+    pairs = np.stack([relabel[i], relabel[i + 1]], axis=1)
+    topo = build_topology(n, pairs, warn_asymmetric=False)
+    order = rcm_order(topo)
+    assert sorted(order.tolist()) == list(range(n))
+    assert adjacency_bandwidth(topo, order) == 1
+    assert adjacency_bandwidth(topo) > 10
+
+
+def test_rcm_covers_disconnected_components_and_isolated_nodes():
+    # two components + one isolated node
+    pairs = np.array([[0, 1], [1, 2], [4, 5], [5, 6]], np.int64)
+    topo = build_topology(8, pairs, warn_asymmetric=False)
+    order = rcm_order(topo)
+    assert sorted(order.tolist()) == list(range(8))
+
+
+# ---- banded neighbor sum -------------------------------------------------
+
+@pytest.mark.parametrize("name,make", IRREGULAR)
+@pytest.mark.parametrize("remainder", ["gather", "benes"])
+def test_banded_neighbor_sum_bit_exact_on_integer_payloads(
+        name, make, remainder):
+    import jax.numpy as jnp
+
+    topo = make()
+    plan = compile_topology(topo, remainder=remainder)
+    assert plan.spmv.in_band_edges + plan.spmv.remainder_edges \
+        == topo.num_edges
+    x = np.arange(1, topo.num_nodes + 1, dtype=np.float64)
+    xr = x[plan.order]
+    got = np.asarray(banded_neighbor_sum(jnp.asarray(xr), plan.spmv,
+                                         plan.leaves))
+    ref = np.zeros(topo.num_nodes)
+    np.add.at(ref, plan.topo.src, xr[plan.topo.dst])
+    # integer values: float addition is exact, any summation order gives
+    # the same bits — this checks COVERAGE exactly, not approximately
+    assert np.array_equal(got, ref), name
+
+
+def test_banded_neighbor_sum_vector_payload_and_padding():
+    import jax.numpy as jnp
+
+    topo = barabasi_albert(150, m=3, seed=7)
+    plan = compile_topology(topo, features=3)
+    assert plan.spmv.rem_mode in ("gather", "none")
+    n = topo.num_nodes
+    x = np.arange(1.0, 3 * n + 1).reshape(n, 3)
+    padded = np.concatenate([x[plan.order], np.zeros((5, 3))])
+    got = np.asarray(banded_neighbor_sum(jnp.asarray(padded), plan.spmv,
+                                         plan.leaves))
+    assert got.shape == (n + 5, 3)
+    assert np.all(got[n:] == 0)
+    ref = np.zeros((n, 3))
+    np.add.at(ref, plan.topo.src, x[plan.order][plan.topo.dst])
+    assert np.array_equal(got[:n], ref)
+
+
+def test_build_banded_remainder_none_raises_when_edges_left():
+    topo = barabasi_albert(100, m=3, seed=0)
+    with pytest.raises(ValueError, match="remainder"):
+        build_banded(topo.num_nodes, topo.src, topo.dst,
+                     remainder="none", min_fill=0.9)
+
+
+# ---- planned edge kernel: bit-exact vs original order --------------------
+
+def _edge_run(topo, cfg, rounds, values=None, coloring=False):
+    arrays = topo.device_arrays(coloring=coloring)
+    state = init_state(topo, cfg, seed=0, values=values)
+    out = run_rounds(state, arrays, cfg, rounds)
+    return np.asarray(node_estimates(out, arrays)), out
+
+
+@pytest.mark.parametrize("name,make", IRREGULAR)
+def test_planned_edge_run_bit_exact(name, make):
+    topo = make()
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    plan = compile_topology(topo)
+    est, out = _edge_run(topo, cfg, 37)
+    est_p, out_p = _edge_run(plan.topo, cfg, 37)
+    # bit-for-bit: same reductions in the same order, only relabeled
+    assert np.array_equal(plan.unpermute_nodes(est_p), est), name
+    assert np.array_equal(plan.unpermute_edges(np.asarray(out_p.flow)),
+                          np.asarray(out.flow)), name
+
+
+def test_planned_edge_run_bit_exact_with_drop_and_vector_payload():
+    topo = barabasi_albert(200, m=3, seed=9)
+    plan = compile_topology(topo)
+    rng = np.random.default_rng(11)
+    vals = rng.normal(size=(topo.num_nodes, 3))
+    for cfg in [
+        RoundConfig.fast(variant="collectall", dtype="float64",
+                         drop_rate=0.3),
+        RoundConfig.reference(variant="collectall", dtype="float64",
+                              drop_rate=0.15),
+    ]:
+        est, out = _edge_run(topo, cfg, 41, values=vals)
+        est_p, out_p = _edge_run(plan.topo, cfg, 41,
+                                 values=vals[plan.order])
+        # drop>0: the drop_perm lane replays the ORIGINAL edge's
+        # threefry draw, so the loss realization is identical
+        assert np.array_equal(plan.unpermute_nodes(est_p), est)
+        assert np.array_equal(
+            plan.unpermute_edges(np.asarray(out_p.flow)),
+            np.asarray(out.flow))
+
+
+def test_planned_edge_run_bit_exact_fast_pairwise():
+    topo = erdos_renyi(150, avg_degree=5.0, seed=3)
+    topo.edge_coloring()  # cache BEFORE reorder so the plan carries it
+    plan = compile_topology(topo)
+    cfg = RoundConfig.fast(variant="pairwise", dtype="float64")
+    est, _ = _edge_run(topo, cfg, 30, coloring=True)
+    est_p, _ = _edge_run(plan.topo, cfg, 30, coloring=True)
+    assert np.array_equal(plan.unpermute_nodes(est_p), est)
+
+
+def test_reorder_stable_preserves_row_order_and_involution():
+    topo = barabasi_albert(120, m=3, seed=1)
+    plan = compile_topology(topo)
+    t2, e_order = reorder_topology_stable(topo, plan.order)
+    rev = np.asarray(t2.rev)
+    assert np.array_equal(rev[rev], np.arange(t2.num_edges))
+    # within-row original edge order preserved: the original edge ids of
+    # each new row must be ascending in ORIGINAL row position
+    inv_n = plan.inv_order
+    for u_new in (0, 5, t2.num_nodes - 1):
+        lo, hi = t2.row_start[u_new], t2.row_start[u_new + 1]
+        orig_ids = e_order[lo:hi]
+        assert np.all(np.diff(orig_ids) > 0)  # original CSR positions
+        assert np.all(inv_n[topo.src[orig_ids]] == u_new)
+
+
+# ---- banded node kernel --------------------------------------------------
+
+@pytest.mark.parametrize("name,make", IRREGULAR)
+def test_banded_node_kernel_matches_edge_kernel(name, make):
+    topo = make()
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64",
+                           kernel="node", spmv="banded")
+    k = sync.NodeKernel(topo, cfg)
+    out = k.run(k.init_state(), 50)
+    est = k.estimates(out)
+    ecfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    e_est, _ = _edge_run(topo, ecfg, 50)
+    np.testing.assert_allclose(est, e_est, rtol=1e-9, atol=1e-9,
+                               err_msg=name)
+
+
+def test_banded_node_kernel_vector_payload():
+    topo = community(200, c=4, k_in=6.0, k_out=0.5, seed=2)
+    rng = np.random.default_rng(5)
+    vals = rng.normal(size=(topo.num_nodes, 4))
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64",
+                           kernel="node", spmv="banded")
+    k = sync.NodeKernel(topo, cfg, values=vals)
+    est = k.estimates(k.run(k.init_state(), 40))
+    ecfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    arrays = topo.device_arrays()
+    out = run_rounds(init_state(topo, ecfg, values=vals), arrays, ecfg, 40)
+    e_est = np.asarray(node_estimates(out, arrays))
+    np.testing.assert_allclose(est, e_est, rtol=1e-9, atol=1e-9)
+
+
+# ---- auto selection ------------------------------------------------------
+
+def test_select_structured_on_fat_tree_and_regular_graphs():
+    cfg = RoundConfig.fast(variant="collectall")
+    for topo in (fat_tree(4, seed=0), ring(64, k=2, seed=0)):
+        d = select_plan(topo, cfg, backend="tpu")
+        assert (d.kernel, d.spmv) == ("node", "structured")
+
+
+def test_select_banded_benes_on_irregular_graphs_for_tpu():
+    cfg = RoundConfig.fast(variant="collectall")
+    for _, make in IRREGULAR[:3]:   # ba / er / community
+        d = select_plan(make(), cfg, backend="tpu")
+        assert (d.kernel, d.spmv) == ("node", "banded")
+        assert d.plan.spmv.rem_mode in ("benes", "none")
+        assert d.predicted["node/banded"] <= d.predicted["node/xla"]
+
+
+def test_select_respects_edge_only_dynamics():
+    topo = barabasi_albert(100, m=3, seed=0)
+    for cfg in [RoundConfig.reference(variant="collectall"),
+                RoundConfig.fast(variant="collectall", drop_rate=0.1)]:
+        d = select_plan(topo, cfg, backend="tpu")
+        assert d.kernel == "edge" and d.plan is None
+
+
+# ---- Engine(plan='auto') -------------------------------------------------
+
+def _engine(topo, plan="off", **cfg_kw):
+    from flow_updating_tpu.engine import Engine
+
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64",
+                           **cfg_kw)
+    return Engine(config=cfg, plan=plan).set_topology(topo).build()
+
+
+def test_engine_auto_runs_node_kernel_and_matches_edge():
+    topo = community(240, c=4, k_in=7.0, k_out=0.4, seed=1)
+    e = _engine(topo, plan="auto")
+    assert e.config.kernel == "node"
+    assert e.plan_decision is not None
+    e.run_rounds(80)
+    e2 = _engine(topo)          # plain edge engine
+    e2.run_rounds(80)
+    np.testing.assert_allclose(e.estimates(), e2.estimates(),
+                               rtol=1e-9, atol=1e-9)
+    rep = e.plan_report()
+    assert rep["kernel"] == "node" and "predicted_cost" in rep
+
+
+def test_engine_auto_keeps_structured_on_fat_tree():
+    e = _engine(fat_tree(4, seed=0), plan="auto")
+    assert (e.config.kernel, e.config.spmv) == ("node", "structured")
+
+
+def test_engine_explicit_plan_forces_banded():
+    topo = barabasi_albert(150, m=3, seed=4)
+    plan = compile_topology(topo)
+    e = _engine(topo, plan=plan)
+    assert (e.config.kernel, e.config.spmv) == ("node", "banded")
+    e.run_rounds(60)
+    e2 = _engine(topo)
+    e2.run_rounds(60)
+    np.testing.assert_allclose(e.estimates(), e2.estimates(),
+                               rtol=1e-9, atol=1e-9)
+
+
+def test_engine_auto_fields_restore_original_node_order():
+    from flow_updating_tpu.obs.fields import FieldSpec
+
+    topo = barabasi_albert(180, m=3, seed=6)
+    plan = compile_topology(topo)
+    spec = FieldSpec.parse("node_err,node_fired,node_conv_round")
+    e = _engine(topo, plan=plan)
+    fs = e.run_fields(30, spec)
+    e2 = _engine(topo)
+    fs2 = e2.run_fields(30, spec)
+    # same rounds, same dynamics to float tolerance, ORIGINAL node order
+    np.testing.assert_allclose(fs["node_err"], fs2["node_err"],
+                               rtol=1e-9, atol=1e-9)
+    assert np.array_equal(fs["node_fired"], fs2["node_fired"])
+    assert np.array_equal(fs.conv_round, fs2.conv_round)
+
+
+def test_engine_auto_topk_ids_are_original_ids():
+    from flow_updating_tpu.obs.fields import FieldSpec
+
+    topo = star(80, seed=8)
+    plan = compile_topology(topo)
+    e = _engine(topo, plan=plan)
+    spec = FieldSpec.parse("node_err", topk=5)
+    fs = e.run_fields(10, spec)
+    assert fs.topk_idx is not None
+    assert np.all((fs.topk_idx >= -1) & (fs.topk_idx < topo.num_nodes))
+    e2 = _engine(topo)
+    fs2 = e2.run_fields(10, spec)
+    # the worst-node SETS must agree (ranking ties aside, the planted
+    # star's hub dominates) — ids are original-space on both paths
+    assert fs2.topk_idx[0, 0] == fs.topk_idx[0, 0]
+
+
+def test_engine_rejects_node_plan_for_edge_dynamics():
+    from flow_updating_tpu.engine import Engine
+
+    topo = barabasi_albert(100, m=3, seed=0)
+    plan = compile_topology(topo)
+    cfg = RoundConfig.fast(variant="collectall", drop_rate=0.2)
+    with pytest.raises(ValueError, match="edge kernel"):
+        Engine(config=cfg, plan=plan).set_topology(topo).build()
+
+
+def test_engine_unknown_plan_mode_rejected():
+    from flow_updating_tpu.engine import Engine
+
+    with pytest.raises(ValueError, match="plan mode"):
+        Engine(plan="fastest")
+    # non-plan objects must not silently degrade to auto-selection
+    with pytest.raises(TypeError, match="plan="):
+        Engine(plan=42)
+    with pytest.raises(TypeError, match="plan="):
+        Engine(plan={"kernel": "node"})
+
+
+def test_foreign_plan_rejected_by_content_fingerprint():
+    # same node count, different graph: the banded masks would silently
+    # run the wrong protocol — the source fingerprint must catch it
+    plan_a = compile_topology(erdos_renyi(200, avg_degree=5.0, seed=1))
+    topo_b = barabasi_albert(200, m=3, seed=2)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="banded")
+    with pytest.raises(ValueError, match="different topology"):
+        sync.NodeKernel(topo_b, cfg, plan=plan_a)
+
+
+def test_structured_error_names_the_planner():
+    topo = barabasi_albert(60, m=2, seed=0)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured")
+    with pytest.raises(ValueError, match="plan='auto'"):
+        sync.NodeKernel(topo, cfg)
+
+
+# ---- community generator -------------------------------------------------
+
+def test_community_generator_connected_and_bottlenecked():
+    topo = community(400, c=5, k_in=8.0, k_out=0.2, seed=0)
+    assert topo.num_nodes == 400
+    # symmetric by construction
+    assert np.array_equal(topo.rev[topo.rev],
+                          np.arange(topo.num_edges))
+    # connected: BFS from 0 reaches everything
+    from flow_updating_tpu.topology.graph import locality_order
+
+    order = locality_order(topo)
+    assert sorted(order.tolist()) == list(range(400))
+    seen = np.zeros(400, bool)
+    frontier = [0]
+    seen[0] = True
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in topo.neighbors(u):
+                if not seen[v]:
+                    seen[v] = True
+                    nxt.append(int(v))
+        frontier = nxt
+    assert seen.all()
+    # cross-community edges are the sparse minority
+    block = np.minimum(np.arange(400) // 80, 4)
+    cross = block[topo.src] != block[topo.dst]
+    assert 0 < cross.sum() < 0.2 * topo.num_edges
+
+
+# ---- manifests, doctor, CLI ----------------------------------------------
+
+def test_check_plan_flags_slower_choice():
+    from flow_updating_tpu.obs import health
+
+    plan = {"kernel": "node", "spmv": "banded",
+            "predicted_cost": {"node/banded": 1.0, "node/xla": 2.0}}
+    ok = health.check_plan(plan, {"node/banded": 100.0, "node/xla": 90.0})
+    assert ok.status == health.PASS
+    # edge decisions (spmv None) match the 'edge/gather' measured key
+    edge = health.check_plan({"kernel": "edge", "spmv": None},
+                             {"edge/gather": 5.0, "node/xla": 4.0})
+    assert edge.status == health.PASS
+    bad = health.check_plan(plan, {"node/banded": 50.0, "node/xla": 90.0})
+    assert bad.status == health.WARN
+    assert "slower plan" in bad.summary
+    none = health.check_plan(plan, None)
+    assert none.status == health.PASS
+
+
+def test_plan_cli_and_manifest_roundtrip(tmp_path, capsys):
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.obs import health
+    from flow_updating_tpu.obs.report import PLAN_SCHEMA
+
+    report = tmp_path / "plan.json"
+    rc = cli_main(["plan", "--backend", "cpu",
+                   "--generator", "barabasi_albert:200:3",
+                   "--fire-policy", "every_round",
+                   "--plan-backend", "tpu", "--explain",
+                   "--report", str(report)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["kernel"] == "node" and doc["spmv"] == "banded"
+    manifest = json.loads(report.read_text())
+    assert manifest["schema"] == PLAN_SCHEMA
+    checks = health.diagnose_manifest(manifest)
+    names = {c.name: c.status for c in checks}
+    assert names.get("plan_selection") == health.PASS
+
+
+def test_run_cli_plan_auto(tmp_path, capsys):
+    from flow_updating_tpu.cli import main as cli_main
+
+    report = tmp_path / "run.json"
+    rc = cli_main(["run", "--backend", "cpu",
+                   "--generator", "community:200:4:6:0.5",
+                   "--fire-policy", "every_round", "--plan", "auto",
+                   "--rounds", "60", "--report", str(report)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["plan"]["kernel"] == "node"
+    assert abs(out["mass_residual"]) < 1e-3
+    manifest = json.loads(report.read_text())
+    assert manifest["report"]["plan"]["kernel"] == "node"
