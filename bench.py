@@ -653,6 +653,157 @@ def run_sweep_bench(args) -> dict:
     }
 
 
+def measure_service(topo, segment_rounds: int, epochs: int) -> dict:
+    """Service-mode row: segment throughput of the streaming engine
+    UNDER sustained join/leave/update/edge churn (one membership event
+    batch per segment boundary) vs the static engine running the same
+    compiled scan on the same capacity-padded arrays with no events.
+
+    Both sides dispatch the same ``run_rounds`` program (the service's
+    zero-recompile contract), so the delta is exactly the cost of
+    membership: the host-side free-list bookkeeping plus the O(event)
+    device edits between segments.
+    """
+    import jax
+    import numpy as np
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import run_rounds
+    from flow_updating_tpu.service import ServiceEngine
+
+    cfg = RoundConfig.fast(variant="collectall")
+    maxdeg = int(topo.out_deg.max())
+    svc = ServiceEngine(topo, topo.num_nodes + 8,
+                        degree_budget=maxdeg + 2,
+                        segment_rounds=segment_rounds)
+    static_state = svc.state
+    static_arrays = svc.arrays
+    params = svc.params
+    rng = np.random.default_rng(0)
+
+    slot_holder = [None]
+
+    def churn_run(k: int) -> int:
+        """k segments with one event batch per boundary; returns the
+        number of events applied."""
+        ev = 0
+        for _ in range(k):
+            if slot_holder[0] is None:
+                slot = svc.join(0.5)
+                a = int(rng.integers(0, topo.num_nodes))
+                b = int(rng.integers(0, topo.num_nodes))
+                pairs = [(slot, a)] + ([(slot, b)] if b != a else [])
+                svc.add_edges(pairs)
+                svc.update([a], [float(rng.random())])
+                ev += 2 + len(pairs)
+                slot_holder[0] = slot
+            else:
+                svc.leave([slot_holder[0]])
+                slot_holder[0] = None
+                ev += 1
+            svc.run(segment_rounds)
+        return ev
+
+    def static_run(k: int):
+        s = static_state
+        for _ in range(k):
+            s = run_rounds(s, static_arrays, cfg, segment_rounds,
+                           params=params)
+        jax.block_until_ready(s.flow)
+        return s
+
+    # warm both programs (they are the SAME program — one compile)
+    t0 = time.perf_counter()
+    churn_run(1)
+    compile_s = time.perf_counter() - t0
+    static_run(1)
+
+    rounds = epochs * segment_rounds
+    ts_svc, ts_static, events = [], [], 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        events += churn_run(epochs)
+        ts_svc.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        static_run(epochs)
+        ts_static.append(time.perf_counter() - t0)
+    rate_svc = [rounds / t for t in ts_svc]
+    rate_static = [rounds / t for t in ts_static]
+    mean_svc = sum(rate_svc) / len(rate_svc)
+    mean_static = sum(rate_static) / len(rate_static)
+    return {
+        "segment_rounds": segment_rounds,
+        "epochs_per_repeat": epochs,
+        "repeats": len(ts_svc),
+        "events_applied": events,
+        "service_rounds_per_sec": mean_svc,
+        "service_spread_pct": round(
+            100 * (max(rate_svc) - min(rate_svc)) / mean_svc, 1),
+        "static_rounds_per_sec": mean_static,
+        "static_spread_pct": round(
+            100 * (max(rate_static) - min(rate_static)) / mean_static, 1),
+        "churn_overhead_pct": round(
+            100 * (mean_static / mean_svc - 1.0), 1),
+        "compile_count": svc.compile_count,
+        "compile_s": compile_s,
+        "mass_residual": [float(x) for x in
+                          np.atleast_1d(svc.mass_residual())],
+        "live": svc.live_count,
+        "capacity": svc.capacity,
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def run_service_bench(args) -> dict:
+    """The ``--service`` measurement body (child-side, settled
+    backend)."""
+    topo = build_topology(args.fat_tree_k)
+    n, e = topo.num_nodes, topo.num_edges
+    sv = measure_service(topo, args.segment_rounds,
+                         max(args.rounds // args.segment_rounds, 4))
+
+    # the static same-capacity comparator is this row's baseline of
+    # record; the key is DISJOINT from every other record (bare k keys,
+    # sweep keys) so a service row can never shadow them
+    base_key = f"{args.fat_tree_k}_service"
+    static = {
+        "rounds_per_sec": sv["static_rounds_per_sec"],
+        "ticks": sv["segment_rounds"] * sv["epochs_per_repeat"],
+        "repeats": sv["repeats"],
+        "spread_pct": sv["static_spread_pct"],
+        "note": ("static same-capacity jax comparator (no membership "
+                 "events; not a DES measurement)"),
+    }
+    record_baseline(base_key, baseline_entry(topo, static))
+    base_rps = recorded_baseline(base_key)
+    base_src = "recorded" if base_rps is not None else "measured"
+    if base_rps is None:
+        base_rps = static["rounds_per_sec"]
+
+    return {
+        "metric": (f"service-mode rounds/sec under sustained churn "
+                   f"(fat-tree k={args.fat_tree_k}, {n} nodes, "
+                   f"capacity {sv['capacity']}, "
+                   f"{sv['events_applied']} events)"),
+        "value": round(sv["service_rounds_per_sec"], 2),
+        "unit": "rounds/sec",
+        "backend": {"axon": "tpu"}.get(sv["platform"], sv["platform"]),
+        "vs_baseline": (round(sv["service_rounds_per_sec"] / base_rps, 3)
+                        if base_rps else None),
+        "extra": {
+            "nodes": n,
+            "directed_edges": e,
+            "service": {k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in sv.items()},
+            "baseline_rounds_per_sec": (round(base_rps, 4)
+                                        if base_rps else None),
+            "baseline_source": base_src,
+            "baseline_key": _baseline_key(base_key),
+        },
+    }
+
+
 #: generator-name abbreviations for stable baseline keys (ba100k_planned)
 _GEN_ABBREV = {"barabasi_albert": "ba", "erdos_renyi": "er",
                "community": "community", "fat_tree": "ft",
@@ -837,6 +988,15 @@ def parse_args(argv=None):
                     help="with --sweep: instances per bucket (the "
                          "baseline key carries this, so sweep rows "
                          "never shadow single-instance records)")
+    ap.add_argument("--service", action="store_true",
+                    help="service-mode row: segment throughput of the "
+                         "streaming engine under sustained join/leave/"
+                         "update/edge churn vs the static engine at the "
+                         "same capacity (edge kernel; records under the "
+                         "disjoint '<k>_service' baseline key)")
+    ap.add_argument("--segment-rounds", type=int, default=64,
+                    help="with --service: compiled scan length between "
+                         "membership event batches")
     ap.add_argument("--des-ticks", type=int, default=10,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--des-repeats", type=int, default=3,
@@ -863,7 +1023,13 @@ def parse_args(argv=None):
                          "rides in the result's extra.profile")
     args = ap.parse_args(argv)
     if args.fat_tree_k is None:
-        args.fat_tree_k = 16 if args.sweep else 160
+        args.fat_tree_k = 16 if (args.sweep or args.service) else 160
+    if args.service and (args.sweep or args.generator or args.features
+                         or args.profile):
+        ap.error("--service is its own row: it cannot combine with "
+                 "--sweep/--generator/--features/--profile")
+    if args.service and args.segment_rounds < 1:
+        ap.error("--segment-rounds must be >= 1")
     # reject impossible combinations HERE: in auto-backend mode a child-
     # side ValueError would first burn the ~290s TPU probe and surface as
     # a degraded-bench diagnostic instead of a usage error
@@ -907,6 +1073,8 @@ def run_bench(args) -> dict:
     """The measurement body (runs in a child with a settled backend)."""
     if args.sweep:
         return run_sweep_bench(args)
+    if args.service:
+        return run_service_bench(args)
     if args.generator:
         return run_generator_bench(args)
     topo = build_topology(args.fat_tree_k)

@@ -663,6 +663,180 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def _parse_service_events(lines):
+    """Parse a ``serve`` event script into (lineno, verb, args) tuples.
+
+    Grammar (one event per line, ``#`` comments):
+
+    ``run K`` | ``join V[,V...]`` | ``leave IDS`` | ``update ID V[,V...]``
+    | ``add-edge U V`` | ``remove-edge U V`` | ``suspend IDS`` |
+    ``resume IDS`` | ``estimates [MAX_STALENESS]`` | ``checkpoint PATH``
+    """
+    def _ids(tok):
+        return [int(x) for x in tok.split(",")]
+
+    def _vals(tok):
+        v = [float(x) for x in tok.split(",")]
+        return v[0] if len(v) == 1 else v
+
+    out = []
+    for lineno, raw in enumerate(lines, start=1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        toks = line.split()
+        verb, rest = toks[0], toks[1:]
+        try:
+            if verb == "run":
+                out.append((lineno, "run", (int(rest[0]),)))
+            elif verb == "join":
+                out.append((lineno, "join", (_vals(rest[0]),)))
+            elif verb in ("leave", "suspend", "resume"):
+                out.append((lineno, verb, (_ids(rest[0]),)))
+            elif verb == "update":
+                out.append((lineno, "update", (_ids(rest[0]),
+                                               _vals(rest[1]))))
+            elif verb in ("add-edge", "remove-edge"):
+                out.append((lineno, verb, (int(rest[0]), int(rest[1]))))
+            elif verb == "estimates":
+                k = int(rest[0]) if rest else None
+                out.append((lineno, "estimates", (k,)))
+            elif verb == "checkpoint":
+                out.append((lineno, "checkpoint", (rest[0],)))
+            else:
+                raise SystemExit(
+                    f"events line {lineno}: unknown verb {verb!r} "
+                    "(valid: run, join, leave, update, add-edge, "
+                    "remove-edge, suspend, resume, estimates, "
+                    "checkpoint)")
+        except (IndexError, ValueError) as err:
+            raise SystemExit(
+                f"events line {lineno}: cannot parse {line!r} ({err})")
+    return out
+
+
+def cmd_serve(args) -> int:
+    """``serve``: the streaming service mode — one compiled program at a
+    fixed capacity, scripted (or stdin) membership events applied as
+    device-side edits between scan segments, zero recompiles
+    (flow_updating_tpu.service, docs/SERVICE.md)."""
+    import numpy as np
+
+    _select_backend(args.backend)
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.service import ServiceEngine
+
+    if args.resume:
+        try:
+            svc = ServiceEngine.restore_checkpoint(args.resume)
+        except ValueError as err:
+            raise SystemExit(f"serve: {err}")
+        topo = None
+    else:
+        topo = _build_topology(args)
+        maker = (RoundConfig.reference
+                 if args.fire_policy == "reference" else RoundConfig.fast)
+        kw = dict(variant="collectall", dtype=args.dtype,
+                  drop_rate=args.drop_rate, drain=0)
+        if args.timeout is not None:
+            kw["timeout"] = args.timeout
+        if args.fire_policy == "reference":
+            kw["pending_depth"] = 1
+        try:
+            cfg = maker(**kw)
+            svc = ServiceEngine(
+                topo, args.capacity or topo.num_nodes,
+                degree_budget=args.degree_budget or None,
+                edge_capacity=args.edge_capacity or None,
+                config=cfg, segment_rounds=args.segment_rounds,
+                seed=args.seed)
+        except ValueError as err:
+            raise SystemExit(f"invalid service configuration: {err}")
+
+    if args.events == "-":
+        events = _parse_service_events(sys.stdin.readlines())
+    elif args.events:
+        try:
+            with open(args.events) as f:
+                events = _parse_service_events(f.readlines())
+        except OSError as err:
+            raise SystemExit(f"serve: cannot read events: {err}")
+    else:
+        events = []
+
+    joined = []
+    for lineno, verb, a in events:
+        try:
+            if verb == "run":
+                svc.run(a[0])
+            elif verb == "join":
+                joined.append(svc.join(np.asarray(a[0])))
+            elif verb == "leave":
+                svc.leave(a[0])
+            elif verb == "suspend":
+                svc.suspend(a[0])
+            elif verb == "resume":
+                svc.resume(a[0])
+            elif verb == "update":
+                ids = a[0]
+                vals = np.asarray([a[1]] * len(ids))
+                if svc.feature_shape and np.ndim(a[1]) == 0:
+                    raise ValueError(
+                        f"scalar update value for feature shape "
+                        f"{svc.feature_shape}")
+                svc.update(ids, vals)
+            elif verb == "add-edge":
+                svc.add_edges([a])
+            elif verb == "remove-edge":
+                svc.remove_edges([a])
+            elif verb == "estimates":
+                ids, est = svc.estimates(max_staleness=a[0])
+                print(json.dumps({
+                    "t": svc.clock, "live": len(ids),
+                    "mean_estimate": float(np.mean(est)),
+                    "max_staleness": a[0]}))
+            elif verb == "checkpoint":
+                svc.save_checkpoint(a[0])
+        except (ValueError, RuntimeError) as err:
+            raise SystemExit(f"serve: events line {lineno}: {err}")
+    if args.rounds:
+        try:
+            svc.run(args.rounds)
+        except ValueError as err:
+            raise SystemExit(f"serve: {err}")
+
+    report = svc.convergence_report()
+    if args.checkpoint:
+        svc.save_checkpoint(args.checkpoint)
+    block = svc.service_block()
+    out = {
+        "t": svc.clock,
+        "live": svc.live_count,
+        "members": svc.member_count,
+        "epochs": len(svc.history),
+        "events": block["events_total"],
+        "compile_count": block["compile_count"],
+        "rmse": report["rmse"],
+        "mass_residual": report["mass_residual"],
+    }
+    if joined:
+        out["joined"] = joined
+    if args.report:
+        from flow_updating_tpu.obs.report import (
+            build_service_manifest,
+            write_report,
+        )
+
+        write_report(args.report, build_service_manifest(
+            argv=getattr(args, "_argv", None), config=svc.config,
+            topo=topo, service=svc.service_block(),
+            series=svc.boundary_series(), report=report))
+        out["report_path"] = args.report
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_generate(args) -> int:
     import numpy as np
 
@@ -1311,6 +1485,58 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the flow-updating-sweep-report/v1 "
                          "manifest (one record per instance) to PATH")
     sw.set_defaults(fn=cmd_sweep)
+
+    sv = sub.add_parser(
+        "serve",
+        help="streaming service mode: one program compiled at a fixed "
+             "capacity runs in scan segments while members join, leave, "
+             "update values and rewire edges between segments — zero "
+             "recompiles, per-feature mass conserved, doctor-checkable "
+             "flow-updating-service-report/v1 manifests "
+             "(docs/SERVICE.md)")
+    _add_common(sv)
+    sv.add_argument("--capacity", type=int, default=0,
+                    help="maximum concurrent members (node slots; "
+                         "default: the initial topology's node count — "
+                         "no join headroom)")
+    sv.add_argument("--edge-capacity", type=int, default=0,
+                    help="total directed edge slots (default: initial "
+                         "edges + headroom for the spare node slots)")
+    sv.add_argument("--degree-budget", type=int, default=0,
+                    help="per-member degree budget W (row-matrix width; "
+                         "default: the initial max degree — no add-edge "
+                         "headroom beyond freed slots)")
+    sv.add_argument("--segment-rounds", type=int, default=32,
+                    help="compiled scan length; events apply between "
+                         "segments and `run` counts must be multiples")
+    sv.add_argument("--rounds", type=int, default=0,
+                    help="extra rounds after the event script (a whole "
+                         "number of segments)")
+    sv.add_argument("--events", metavar="FILE",
+                    help="event script ('-' = stdin): run K / join V / "
+                         "leave IDS / update IDS V / add-edge U V / "
+                         "remove-edge U V / suspend IDS / resume IDS / "
+                         "estimates [K] / checkpoint PATH")
+    sv.add_argument("--fire-policy", default="every_round",
+                    choices=("every_round", "reference"),
+                    help="collect-all firing rule (the service runs "
+                         "variant=collectall with unbounded drain)")
+    sv.add_argument("--timeout", type=int, default=None,
+                    help="collect-all tick timeout (reference firing)")
+    sv.add_argument("--drop-rate", type=float, default=0.0,
+                    help="per-message loss probability")
+    sv.add_argument("--dtype", default="float32",
+                    choices=("float32", "float64"))
+    sv.add_argument("--resume", metavar="CKPT",
+                    help="restore a service checkpoint instead of "
+                         "building from a topology (bit-exact resume)")
+    sv.add_argument("--checkpoint", metavar="PATH",
+                    help="save a service checkpoint at exit")
+    sv.add_argument("--report", metavar="PATH",
+                    help="write the flow-updating-service-report/v1 "
+                         "manifest (capacity accounting, per-epoch mass "
+                         "history, compile count) to PATH")
+    sv.set_defaults(fn=cmd_serve)
 
     gen = sub.add_parser("generate", help="topology summary")
     _add_common(gen)

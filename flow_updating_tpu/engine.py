@@ -797,24 +797,25 @@ class Engine:
         and are processed on revival — the protocol's idempotent state
         exchange makes the whole sequence self-healing (the fault model the
         Flow-Updating paper targets; the reference only exercises it through
-        message loss, SURVEY.md §5)."""
+        message loss, SURVEY.md §5).  The mask edit is the shared churn
+        primitive (service/membership.py)."""
+        from flow_updating_tpu.service import membership
+
         self._require_edge_kernel("kill_nodes")
         if self.state is None:
             raise RuntimeError("engine not built")
-        ids = self._node_ids(nodes)
-        self.state = self.state.replace(
-            alive=self.state.alive.at[ids].set(False)
-        )
+        self.state = membership.set_alive(
+            self.state, self._node_ids(nodes), False)
         return self
 
     def revive_nodes(self, nodes) -> "Engine":
+        from flow_updating_tpu.service import membership
+
         self._require_edge_kernel("revive_nodes")
         if self.state is None:
             raise RuntimeError("engine not built")
-        ids = self._node_ids(nodes)
-        self.state = self.state.replace(
-            alive=self.state.alive.at[ids].set(True)
-        )
+        self.state = membership.set_alive(
+            self.state, self._node_ids(nodes), True)
         return self
 
     def _edge_ids(self, links) -> np.ndarray:

@@ -422,6 +422,148 @@ def check_plan(plan: dict | None, measured: dict | None = None, *,
         f"(within {margin_pct:g}%)", ev)
 
 
+def _epoch_tol(sample: dict, scale: float, dtype: str | None,
+               inflight_factor: float = 2.0) -> float:
+    """Per-epoch mass tolerance: float roundoff at the mass magnitude
+    plus the in-flight allowance derived from the SAME boundary sample
+    (worst per-node error x active count — the convention of
+    :func:`_inflight_allowance`)."""
+    mae = float(sample.get("max_abs_err", 0.0) or 0.0)
+    act = float(sample.get("active", 1) or 1)
+    return (_float_tol(max(scale, 1.0), dtype, None)
+            + inflight_factor * mae * max(act, 1.0))
+
+
+def check_service(service: dict | None, *, dtype: str | None = None
+                  ) -> list:
+    """The streaming service's SLO checks (``flow-updating-service-
+    report/v1`` manifests; docs/SERVICE.md):
+
+    * **service_compile** — the zero-recompile contract: the round
+      program compiled at most once across every membership epoch;
+    * **service_capacity** — slot accounting is consistent (live <=
+      members <= capacity; free lists complement the members);
+    * **service_mass** — per-feature mass conserved at EVERY epoch
+      boundary: the live residual within float tolerance + the epoch's
+      own in-flight allowance;
+    * **service_churn_recovery** — the paper's self-healing as an SLO:
+      an epoch that applied membership/edge events must end with a
+      residual no worse than it started (or below tolerance) — churn
+      perturbs mass transiently, the rounds must heal it.
+    """
+    if not service:
+        return [CheckResult("service", SKIP, "no service block recorded")]
+    checks = []
+    dtype = service.get("dtype", dtype)
+
+    compiles = service.get("compile_count")
+    if compiles is None:
+        checks.append(CheckResult("service_compile", SKIP,
+                                  "no compile count recorded"))
+    elif int(compiles) > 1:
+        checks.append(CheckResult(
+            "service_compile", FAIL,
+            f"round program compiled {compiles}x — membership events "
+            "must be mask/buffer edits, never a retrace",
+            {"compile_count": int(compiles)}))
+    else:
+        checks.append(CheckResult(
+            "service_compile", PASS,
+            f"zero recompiles ({compiles} compile across "
+            f"{service.get('events_total', '?')} events)",
+            {"compile_count": int(compiles),
+             "events_total": service.get("events_total")}))
+
+    cap = service.get("capacity") or {}
+    if cap:
+        n_cap = int(cap.get("nodes", 0))
+        members = int(cap.get("members", 0))
+        live = int(cap.get("live", 0))
+        free_n = cap.get("free_node_slots")
+        ok = (live <= members <= n_cap
+              and (free_n is None or free_n == n_cap - members))
+        checks.append(CheckResult(
+            "service_capacity", PASS if ok else FAIL,
+            (f"slot accounting consistent ({members}/{n_cap} members, "
+             f"{live} live)") if ok else
+            (f"slot accounting inconsistent: members={members}, "
+             f"live={live}, capacity={n_cap}, "
+             f"free_node_slots={free_n}"),
+            dict(cap)))
+
+    epochs = service.get("epochs") or []
+    if not epochs:
+        checks.append(CheckResult(
+            "service_mass", SKIP, "no epochs recorded"))
+        return checks
+    scale = 1.0
+    for ep in epochs:
+        for side in ("before", "after"):
+            m = (ep.get(side) or {}).get("mass")
+            if m is not None:
+                scale = max(scale, float(np.max(_pooled(m))))
+    worst = None
+    for ep in epochs:
+        after = ep.get("after") or {}
+        res = after.get("mass_residual")
+        if res is None:
+            continue
+        mag = float(np.max(_pooled(res)))
+        tol = _epoch_tol(after, scale, dtype)
+        if not math.isfinite(mag) or mag > tol:
+            worst = {"epoch": ep.get("epoch"), "residual": mag,
+                     "tolerance": tol}
+            break
+    if worst is not None:
+        checks.append(CheckResult(
+            "service_mass", FAIL,
+            f"per-feature mass leaked at epoch {worst['epoch']} "
+            f"boundary: |residual| {worst['residual']:.3e} > tolerance "
+            f"{worst['tolerance']:.3e} (float roundoff + in-flight "
+            "allowance)", worst))
+    else:
+        checks.append(CheckResult(
+            "service_mass", PASS,
+            f"per-feature mass conserved at all {len(epochs)} epoch "
+            "boundaries (within float tolerance + in-flight allowance)",
+            {"epochs": len(epochs), "mass_scale": scale}))
+
+    churned = [ep for ep in epochs if ep.get("events")]
+    bad = None
+    for ep in churned:
+        before = ep.get("before") or {}
+        after = ep.get("after") or {}
+        if before.get("mass_residual") is None or \
+                after.get("mass_residual") is None:
+            continue
+        r0 = float(np.max(_pooled(before["mass_residual"])))
+        r1 = float(np.max(_pooled(after["mass_residual"])))
+        tol = _epoch_tol(after, scale, dtype)
+        if r1 > max(r0, tol):
+            bad = {"epoch": ep.get("epoch"), "residual_after_events": r0,
+                   "residual_after_rounds": r1, "tolerance": tol,
+                   "events": len(ep.get("events") or [])}
+            break
+    if bad is not None:
+        checks.append(CheckResult(
+            "service_churn_recovery", FAIL,
+            f"post-churn residual did not decay at epoch "
+            f"{bad['epoch']}: {bad['residual_after_events']:.3e} -> "
+            f"{bad['residual_after_rounds']:.3e} after the epoch's "
+            "rounds (self-healing SLO)", bad))
+    elif churned:
+        checks.append(CheckResult(
+            "service_churn_recovery", PASS,
+            f"post-churn residual decayed (or stayed within tolerance) "
+            f"across all {len(churned)} churned epochs",
+            {"churned_epochs": len(churned)}))
+    else:
+        checks.append(CheckResult(
+            "service_churn_recovery", SKIP,
+            "no epoch applied membership events"))
+    return checks
+
+
 def check_report(report: dict | None, *, dtype: str | None = None
                  ) -> CheckResult:
     """Final-state sanity from a run manifest's convergence report:
@@ -541,6 +683,9 @@ def diagnose_manifest(manifest: dict) -> list:
         plan_block = report.get("plan")  # run manifests embed it there
     if isinstance(plan_block, dict):
         checks.append(check_plan(plan_block, manifest.get("measured")))
+    service = manifest.get("service")
+    if isinstance(service, dict):
+        checks.extend(check_service(service, dtype=dtype))
     instances = manifest.get("instances")
     if isinstance(instances, list) and instances:
         n_conv = sum(1 for r in instances

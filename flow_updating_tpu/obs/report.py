@@ -31,6 +31,7 @@ SWEEP_SCHEMA = "flow-updating-sweep-report/v1"
 PROFILE_SCHEMA = "flow-updating-profile-report/v1"
 FIELD_SCHEMA = "flow-updating-field-report/v1"
 PLAN_SCHEMA = "flow-updating-plan-report/v1"
+SERVICE_SCHEMA = "flow-updating-service-report/v1"
 
 
 def environment_info() -> dict:
@@ -227,6 +228,43 @@ def build_field_manifest(*, argv=None, config=None, topo=None,
                 "derived_from": "fields",
                 "series": reduced,
             }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_service_manifest(*, argv=None, config=None, topo=None,
+                           service=None, series=None, report=None,
+                           timings=None, extra=None) -> dict:
+    """Assemble the service-shaped v1 manifest: the run manifest's
+    argv/config/environment binding around a live-engine ``service``
+    block (capacity accounting, per-epoch membership/mass history,
+    compile count — ``ServiceEngine.service_block()``).  ``series`` is
+    the boundary-sample series (one row per segment boundary), embedded
+    under the standard ``telemetry`` key so the doctor's series checks
+    run unchanged; ``topo`` is the INITIAL topology (the graph is
+    mutable state afterwards — the epochs record how it evolved)."""
+    manifest = {
+        "schema": SERVICE_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "topology": topology_summary(topo) if topo is not None else None,
+        "environment": environment_info(),
+        "timings": dict(timings) if timings else None,
+        "report": report,
+        "service": dict(service) if service else None,
+    }
+    if series:
+        manifest["telemetry"] = {
+            "metrics": [k for k in series if k != "t"],
+            "rounds": len(series.get("t", ())),
+            "derived_from": "segment_boundaries",
+            "series": {k: list(v) for k, v in series.items()},
+        }
     if extra:
         manifest.update(extra)
     return manifest

@@ -1,0 +1,30 @@
+"""Streaming service mode: live aggregation with dynamic membership.
+
+The production shape of Flow-Updating (ROADMAP open item 3): a
+long-running engine compiled ONCE for a fixed capacity, advancing in
+scan segments while members join, leave, update values and rewire edges
+between segments — zero recompiles, conserved per-feature mass, and the
+paper's churn tolerance monitored as an SLO by ``doctor``.
+
+* :mod:`flow_updating_tpu.service.engine` — the
+  :class:`~flow_updating_tpu.service.engine.ServiceEngine`: capacity-
+  padded state (the sweep engine's mass-neutral ghost construction,
+  shared via :mod:`flow_updating_tpu.topology.padding`), free-list slot
+  management, O(event)-cost device edits, bounded-staleness estimate
+  reads, versioned checkpoint/restore;
+* :mod:`flow_updating_tpu.service.membership` — the single alive-mask
+  churn implementation shared with the Engine's fault injection and the
+  gossip-SGD trainer's churn schedule.
+
+CLI surface: the ``serve`` subcommand (scripted event files or stdin);
+manifests use the ``flow-updating-service-report/v1`` schema.  See
+docs/SERVICE.md.
+"""
+
+from flow_updating_tpu.service.engine import (
+    ServiceEngine,
+    validate_service_config,
+)
+from flow_updating_tpu.service.membership import set_alive
+
+__all__ = ["ServiceEngine", "validate_service_config", "set_alive"]

@@ -1,0 +1,928 @@
+"""The streaming service engine: live aggregation with dynamic membership.
+
+Every other mode of this repo runs a fixed-N batch job; production
+aggregation is a *service* — users join, leave and update their values
+continuously while the estimate stays live.  The paper's headline
+property (self-healing mass conservation under churn) makes Flow-Updating
+exactly the protocol for this shape, and the capacity-padding trick
+proven offline by the sweep engine makes it compilable: the service
+compiles ONE round program for a fixed capacity ``(n_cap, e_cap)`` and
+then runs indefinitely in scan segments, with every membership event an
+O(event-size) device-side mask/buffer edit between segments — **no
+retrace, no recompile** (tests/test_service.py pins the compile count
+across 100+ events).
+
+Layout
+------
+* **node slots**: ``capacity`` usable slots plus one permanently-dead
+  *parking* slot (the last id).  Live members carry ``alive=True``;
+  free slots are mass-neutral ghosts (value 0, born dead) managed by a
+  lowest-id-first free list, so ``join`` is deterministic slot reuse.
+* **edge slots**: a fixed budget of ``edge_capacity`` directed slots.
+  A free slot is a self-loop parked on the parking slot
+  (``src == dst == park``, ``rev`` = itself, ``edge_ok=False``): the
+  park never fires (dead), so a free slot's ledger stays exactly zero —
+  the mass-neutral pad-edge invariant of
+  :mod:`flow_updating_tpu.topology.padding`, held *dynamically*.
+* **reductions** run over the sweep engine's uniform-width
+  ``(n_cap, W)`` out-edge row matrix (``TopoArrays.sweep_edge_rows``,
+  ``W = degree_budget``): per-node sums gather exactly the edge slots a
+  row lists, so edge membership is data, not program structure — and the
+  row folds are bit-identical to the sorted scatter-add segment ops
+  (ops/segment.py), which is what makes a zero-event service run
+  bit-identical to the plain engine at the same capacity.
+
+Events edit *traced inputs* (state leaves and TopoArrays leaves) with
+``.at[]`` updates of unchanged shape/dtype, so every segment dispatch
+hits the same jit cache entry.  Mass accounting across events:
+
+* ``join`` / ``update`` leave the residual ``sum(est) - sum(value)``
+  over live nodes unchanged **bit-exactly** (a fresh slot has zero
+  flows; a value shift moves ``est`` by the same delta);
+* ``leave`` / ``remove_edges`` detach ledger pairs whose residual
+  contribution is the pair's antisymmetry deficit — zero at quiescence,
+  bounded by the doctor's in-flight allowance mid-flight — and the
+  protocol re-converges to zero residual afterwards (the paper's
+  self-healing, now an SLO checked by ``doctor``).
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+from flow_updating_tpu.models.config import (
+    COLLECTALL,
+    RoundConfig,
+    RoundParams,
+)
+from flow_updating_tpu.topology.padding import (
+    bucket_ceil,
+    edge_rows,
+    pad_topology_to,
+)
+from flow_updating_tpu.service import membership
+
+SERVICE_EVENTS = ("join", "leave", "update", "add_edge", "remove_edge",
+                  "suspend", "resume")
+
+_EST_JIT = None   # process-wide jitted node_estimates (boundary reads)
+
+
+def validate_service_config(cfg: RoundConfig) -> None:
+    """The service's config domain: the subset of round programs whose
+    topology consumption is fully dynamic (edge membership as data).
+
+    Pairwise modes are rejected — fast pairwise fires a static edge
+    coloring and faithful pairwise orders its within-tick scan by the
+    static CSR layout, both of which an edge edit would invalidate.
+    ``drain > 0`` is rejected for the same reason (the round-robin drain
+    priority bakes static per-edge ranks)."""
+    if cfg.kernel != "edge":
+        raise ValueError(
+            "the service engine drives the edge kernel (per-edge state "
+            "carries the membership masks); use kernel='edge'")
+    if cfg.variant != COLLECTALL:
+        raise ValueError(
+            "the service engine runs variant='collectall': pairwise "
+            "modes bake static per-edge structure (edge coloring / CSR "
+            "scan order) that dynamic edge membership would invalidate")
+    if cfg.drain != 0:
+        raise ValueError(
+            "the service engine needs drain=0 (unbounded): the bounded "
+            "drain's round-robin priority bakes static per-edge ranks")
+    if cfg.delivery not in ("gather", "scatter"):
+        raise ValueError(
+            f"the service engine runs delivery='gather'|'scatter'; "
+            f"{cfg.delivery!r} plans a static permutation network")
+    if cfg.segment_impl not in ("auto", "segment"):
+        raise ValueError(
+            f"the service engine runs segment_impl='auto'|'segment' "
+            f"(reductions go through the dynamic row matrix); "
+            f"{cfg.segment_impl!r} builds static layouts")
+    if cfg.contention:
+        raise ValueError(
+            "contention needs a static link model; the service's "
+            "dynamic edge set has none")
+
+
+class ServiceEngine:
+    """A live, capacity-padded Flow-Updating engine (module docstring).
+
+    Parameters
+    ----------
+    topo:
+        The initial membership graph (its nodes are members 0..N-1).
+    capacity:
+        Maximum concurrent members.  One extra hidden slot (the parking
+        ghost) is appended, so the padded node axis is ``capacity + 1``.
+    degree_budget:
+        Per-member out-degree budget W (the row-matrix width).  Defaults
+        to the initial max degree; ``add_edges`` beyond a row's budget
+        raises.
+    edge_capacity:
+        Total directed edge slots.  Defaults to an eighth-pow2 rounding
+        of the initial edge count plus headroom for the spare node slots.
+    config:
+        A :class:`RoundConfig` in the service domain
+        (:func:`validate_service_config`); default
+        ``RoundConfig.fast(variant='collectall')``.
+    segment_rounds:
+        The compiled scan length; ``run`` advances in whole segments.
+    values:
+        Optional ``(N,)`` / ``(N, D)`` initial payloads overriding the
+        topology's values (vector payloads make every mass quantity
+        per-feature).
+    """
+
+    def __init__(self, topo, capacity: int, *, degree_budget: int | None
+                 = None, edge_capacity: int | None = None,
+                 config: RoundConfig | None = None,
+                 segment_rounds: int = 32, seed: int = 0, values=None):
+        import jax.numpy as jnp
+
+        from flow_updating_tpu.models.state import (
+            check_payload_values,
+            init_state,
+        )
+
+        cfg = config or RoundConfig.fast(variant=COLLECTALL)
+        validate_service_config(cfg)
+        N, E = topo.num_nodes, topo.num_edges
+        if capacity < N:
+            raise ValueError(
+                f"capacity={capacity} < initial member count {N}")
+        if segment_rounds < 1:
+            raise ValueError("segment_rounds must be >= 1")
+        max_deg = int(topo.out_deg.max()) if N else 0
+        W = max(max_deg, 1) if degree_budget is None else int(degree_budget)
+        if W < max_deg:
+            raise ValueError(
+                f"degree_budget={W} < initial max degree {max_deg}")
+        n_cap = int(capacity) + 1          # + the parking ghost
+        if edge_capacity is None:
+            e_cap = bucket_ceil(E + 4 * (capacity - N) + 2)
+        else:
+            e_cap = int(edge_capacity)
+            if e_cap < E:
+                raise ValueError(
+                    f"edge_capacity={e_cap} < initial edge count {E}")
+
+        padded = pad_topology_to(topo, n_cap, e_cap, spread="last")
+        arrays = padded.device_arrays()
+        rows = edge_rows(padded, W, e_cap)
+        rows[N:] = e_cap        # ghosts + park list nothing: free slots
+        #                         never enter any row's reduction
+        deg = np.concatenate(
+            [topo.out_deg.astype(np.int32),
+             np.zeros(n_cap - N, np.int32)])   # live degrees only
+        arrays = arrays.replace(
+            sweep_edge_rows=jnp.asarray(rows),
+            out_deg=jnp.asarray(deg),
+        )
+        pv = None
+        if values is not None:
+            vals = np.asarray(values, np.float64)
+            check_payload_values(vals, N)
+            pv = np.concatenate(
+                [vals, np.zeros((n_cap - vals.shape[0],) + vals.shape[1:])],
+                axis=0)
+        state = init_state(padded, cfg, seed=seed, values=pv)
+        state = state.replace(
+            alive=state.alive.at[N:].set(False),
+            edge_ok=state.edge_ok.at[E:].set(False),
+        )
+        params = RoundParams.from_config(cfg)
+        if cfg.drop_rate == 0.0:
+            params = params.without_drop()
+
+        self.config = cfg
+        self.capacity = int(capacity)
+        self.degree_budget = W
+        self.edge_capacity = e_cap
+        self.segment_rounds = int(segment_rounds)
+        self.state = state
+        self.arrays = arrays
+        self.params = params
+        self._n_cap = n_cap
+        self._park = n_cap - 1
+        # host mirrors of the dynamic topology leaves (the free-list /
+        # row-occupancy bookkeeping reads these; device edits mirror them)
+        self._src = np.asarray(padded.src).copy()
+        self._dst = np.asarray(padded.dst).copy()
+        self._rev = np.asarray(padded.rev).copy()
+        self._src[E:] = self._park
+        self._dst[E:] = self._park
+        self._delay = np.asarray(padded.delay).copy()
+        self._deg = deg.copy()
+        self._rows = rows.copy()
+        self._member = np.zeros(n_cap, bool)
+        self._member[:N] = True
+        self._free_nodes = list(range(N, self._park))
+        heapq.heapify(self._free_nodes)
+        self._free_edges = list(range(E, e_cap))
+        heapq.heapify(self._free_edges)
+        self._epoch = 0
+        self._event_counts = {k: 0 for k in SERVICE_EVENTS}
+        self._pending_events = []       # since the last run()
+        self.history: list = []         # one record per epoch (run call)
+        self._samples: list = []        # boundary telemetry rows
+        self._est_cache = None          # (t, est (n_cap,)+F, alive)
+        self._capture_cache_floor()
+        self._sample("init")
+
+    # ---- compile accounting ---------------------------------------------
+    def _capture_cache_floor(self) -> None:
+        from flow_updating_tpu.models.rounds import (
+            run_rounds,
+            run_rounds_telemetry,
+        )
+
+        self._cache0 = (run_rounds._cache_size(),
+                        run_rounds_telemetry._cache_size())
+
+    @property
+    def compile_count(self) -> int:
+        """Compiles of the round program since this service was built —
+        the zero-recompile SLO (must stay at 1: the first segment).
+        Measured on the global jit caches, so it can only over-count
+        (never hide a recompile)."""
+        from flow_updating_tpu.models.rounds import (
+            run_rounds,
+            run_rounds_telemetry,
+        )
+
+        return ((run_rounds._cache_size() - self._cache0[0])
+                + (run_rounds_telemetry._cache_size() - self._cache0[1]))
+
+    # ---- views -----------------------------------------------------------
+    @property
+    def clock(self) -> int:
+        """Completed rounds (the state's round counter)."""
+        return int(np.asarray(self.state.t))
+
+    @property
+    def live_count(self) -> int:
+        return int(np.asarray(self.state.alive).sum())
+
+    @property
+    def member_count(self) -> int:
+        return int(self._member.sum())
+
+    @property
+    def feature_shape(self) -> tuple:
+        return tuple(self.state.value.shape[1:])
+
+    def live_ids(self) -> np.ndarray:
+        return np.where(np.asarray(self.state.alive))[0].astype(np.int32)
+
+    def member_edges(self) -> list:
+        """Current undirected member edges as (u, v) pairs, u < v."""
+        live = self._src != self._park
+        u, v = self._src[live], self._dst[live]
+        keep = u < v
+        return list(zip(u[keep].tolist(), v[keep].tolist()))
+
+    # ---- event plumbing --------------------------------------------------
+    def _log(self, kind: str, **detail) -> None:
+        self._event_counts[kind] += 1
+        detail["kind"] = kind
+        self._pending_events.append(detail)
+        self._est_cache = None   # membership changed: staleness resets
+
+    def _check_member(self, ids, verb: str) -> np.ndarray:
+        ids = membership.as_id_array(ids)
+        for i in ids:
+            i = int(i)
+            if i < 0 or i >= self._park or not self._member[i]:
+                raise ValueError(
+                    f"{verb}: node {i} is not a member "
+                    f"(members occupy slots 0..{self._park - 1})")
+        return ids
+
+    def _edge_slot_of(self, u: int, v: int) -> int | None:
+        """Directed slot u->v, via u's row (O(degree_budget) scan)."""
+        for e in self._rows[u]:
+            if e != self.edge_capacity and self._dst[e] == v:
+                return int(e)
+        return None
+
+    # ---- membership events ----------------------------------------------
+    def join(self, value) -> int:
+        """Admit one member with payload ``value`` (scalar, or a
+        ``(D,)`` vector matching the service's feature shape).  Returns
+        the assigned slot id.  The fresh member has zero flows, so its
+        estimate equals its value and the live mass residual is
+        unchanged bit-exactly.  It starts edgeless — wire it in with
+        :meth:`add_edges`."""
+        import jax.numpy as jnp
+
+        if not self._free_nodes:
+            raise RuntimeError(
+                f"service at capacity: {self.capacity} node slots, "
+                f"{self.member_count} members and no free slot — raise "
+                "capacity= at construction")
+        v = np.asarray(value, np.float64)
+        if v.shape != self.feature_shape:
+            raise ValueError(
+                f"join value shape {v.shape} != service feature shape "
+                f"{self.feature_shape}")
+        slot = heapq.heappop(self._free_nodes)
+        st = self.state
+        z = jnp.zeros(self.feature_shape, st.last_avg.dtype)
+        self.state = st.replace(
+            value=st.value.at[slot].set(jnp.asarray(v, st.value.dtype)),
+            alive=st.alive.at[slot].set(True),
+            ticks=st.ticks.at[slot].set(0),
+            fired=st.fired.at[slot].set(0),
+            last_avg=st.last_avg.at[slot].set(z),
+        )
+        self._member[slot] = True
+        self._log("join", node=int(slot))
+        return int(slot)
+
+    def leave(self, ids) -> "ServiceEngine":
+        """Graceful departure: detach every incident edge pair (both
+        ledger directions zeroed, in-flight on those slots invalidated),
+        then free the slot (dead, value 0).  Each neighbor's estimate
+        absorbs its zeroed ledger entry, so the survivors' mass residual
+        changes only by the detached pairs' antisymmetry deficit — zero
+        at quiescence, within the in-flight allowance mid-flight — and
+        the protocol re-converges (the paper's self-healing)."""
+        import jax.numpy as jnp
+
+        ids = self._check_member(ids, "leave")
+        pairs = set()
+        for u in ids:
+            for e in self._rows[int(u)]:
+                if e != self.edge_capacity:
+                    pairs.add((min(int(e), int(self._rev[e])),
+                               max(int(e), int(self._rev[e]))))
+        if pairs:
+            self._detach_pairs(sorted(pairs))
+        st = self.state
+        idx = jnp.asarray(ids)
+        z = jnp.zeros(ids.shape + self.feature_shape, st.value.dtype)
+        self.state = st.replace(
+            value=st.value.at[idx].set(z),
+            alive=st.alive.at[idx].set(False),
+            ticks=st.ticks.at[idx].set(0),
+            fired=st.fired.at[idx].set(0),
+            last_avg=st.last_avg.at[idx].set(
+                jnp.zeros(ids.shape + self.feature_shape,
+                          st.last_avg.dtype)),
+        )
+        for i in ids:
+            self._member[int(i)] = False
+            heapq.heappush(self._free_nodes, int(i))
+            self._log("leave", node=int(i))
+        return self
+
+    def update(self, ids, values) -> "ServiceEngine":
+        """Overwrite members' input values (the protocol tracks dynamic
+        inputs natively: estimates shift by the same delta as values, so
+        the mass residual is unchanged bit-exactly)."""
+        import jax.numpy as jnp
+
+        ids = self._check_member(ids, "update")
+        vals = np.asarray(values, np.float64)
+        want = ids.shape + self.feature_shape
+        if vals.shape != want:
+            raise ValueError(
+                f"update values shape {vals.shape} != {want} "
+                f"(one row per id, feature shape {self.feature_shape})")
+        self.state = self.state.replace(
+            value=self.state.value.at[jnp.asarray(ids)].set(
+                jnp.asarray(vals, self.state.value.dtype)))
+        for i in ids:
+            self._log("update", node=int(i))
+        return self
+
+    def suspend(self, ids) -> "ServiceEngine":
+        """Temporary failure (the paper's crash churn): alive mask off,
+        ledgers intact — :func:`membership.set_alive`.  A suspended node
+        keeps its slot; :meth:`resume` revives it in place."""
+        ids = self._check_member(ids, "suspend")
+        self.state = membership.set_alive(self.state, ids, False)
+        for i in ids:
+            self._log("suspend", node=int(i))
+        return self
+
+    def resume(self, ids) -> "ServiceEngine":
+        ids = self._check_member(ids, "resume")
+        self.state = membership.set_alive(self.state, ids, True)
+        for i in ids:
+            self._log("resume", node=int(i))
+        return self
+
+    # ---- edge events -----------------------------------------------------
+    def add_edges(self, pairs) -> "ServiceEngine":
+        """Add undirected member edges: each (u, v) claims two free edge
+        slots and one free row-matrix column at each endpoint.  The
+        whole batch is validated first, then applied as one device edit
+        — an invalid pair leaves the service untouched.  Added edges
+        deliver with UNIT delay (a dynamic edge has no platform route;
+        detach resets freed slots to delay 1, so slot reuse never leaks
+        an old latency-derived delay)."""
+        import jax.numpy as jnp
+
+        e_sent = self.edge_capacity
+        eidx, srcs, dsts, revs = [], [], [], []
+        rows_r, rows_c, rows_v = [], [], []
+        nodes, done = [], []
+        # validate + stage against scratch copies; commit only if the
+        # whole batch is admissible
+        rows_scratch = None
+        free_scratch = sorted(self._free_edges)
+        taken = 0
+        for u, v in pairs:
+            u, v = int(u), int(v)
+            if u == v:
+                raise ValueError(f"add_edges: self-loop ({u}, {u})")
+            self._check_member([u, v], "add_edges")
+            if self._edge_slot_of(u, v) is not None or (u, v) in done \
+                    or (v, u) in done:
+                raise ValueError(f"add_edges: edge ({u}, {v}) already "
+                                 "present")
+            if rows_scratch is None:
+                rows_scratch = self._rows.copy()
+            cu = int(np.argmax(rows_scratch[u] == e_sent))
+            cv = int(np.argmax(rows_scratch[v] == e_sent))
+            if rows_scratch[u, cu] != e_sent:
+                raise RuntimeError(
+                    f"add_edges: node {u} is at its degree budget "
+                    f"({self.degree_budget}) — raise degree_budget= at "
+                    "construction")
+            if rows_scratch[v, cv] != e_sent:
+                raise RuntimeError(
+                    f"add_edges: node {v} is at its degree budget "
+                    f"({self.degree_budget}) — raise degree_budget= at "
+                    "construction")
+            if taken + 2 > len(free_scratch):
+                raise RuntimeError(
+                    f"add_edges: edge capacity {self.edge_capacity} "
+                    "exhausted — raise edge_capacity= at construction")
+            e1, e2 = free_scratch[taken], free_scratch[taken + 1]
+            taken += 2
+            rows_scratch[u, cu] = e1
+            rows_scratch[v, cv] = e2
+            eidx += [e1, e2]
+            srcs += [u, v]
+            dsts += [v, u]
+            revs += [e2, e1]
+            rows_r += [u, v]
+            rows_c += [cu, cv]
+            rows_v += [e1, e2]
+            nodes += [u, v]
+            done.append((u, v))
+        if not eidx:
+            return self
+        # commit: host mirrors ...
+        self._rows = rows_scratch
+        self._free_edges = free_scratch[taken:]
+        heapq.heapify(self._free_edges)
+        for e, s, d, r in zip(eidx, srcs, dsts, revs):
+            self._src[e], self._dst[e], self._rev[e] = s, d, r
+        for n in nodes:
+            self._deg[n] += 1
+        for u, v in done:
+            self._log("add_edge", u=u, v=v)
+        # ... then one batched device edit
+        ar = self.arrays
+        ei = jnp.asarray(np.asarray(eidx, np.int32))
+        self.arrays = ar.replace(
+            src=ar.src.at[ei].set(jnp.asarray(np.asarray(srcs, np.int32))),
+            dst=ar.dst.at[ei].set(jnp.asarray(np.asarray(dsts, np.int32))),
+            rev=ar.rev.at[ei].set(jnp.asarray(np.asarray(revs, np.int32))),
+            out_deg=ar.out_deg.at[
+                jnp.asarray(np.asarray(nodes, np.int32))].add(1),
+            sweep_edge_rows=ar.sweep_edge_rows.at[
+                jnp.asarray(np.asarray(rows_r, np.int32)),
+                jnp.asarray(np.asarray(rows_c, np.int32))].set(
+                jnp.asarray(np.asarray(rows_v, np.int32))),
+        )
+        # freed slots are scrubbed at detach time, so the new edges start
+        # with exactly zero ledgers; only the link mask needs flipping
+        self.state = self.state.replace(
+            edge_ok=self.state.edge_ok.at[ei].set(True))
+        return self
+
+    def remove_edges(self, pairs) -> "ServiceEngine":
+        """Remove undirected member edges (ledger pair zeroed — mass-
+        neutral up to the pair's antisymmetry deficit, see :meth:`leave`).
+        Validated as a batch before anything is applied."""
+        todo, logs = [], []
+        for u, v in pairs:
+            u, v = int(u), int(v)
+            self._check_member([u, v], "remove_edges")
+            e1 = self._edge_slot_of(u, v)
+            if e1 is None:
+                raise ValueError(f"remove_edges: no edge ({u}, {v})")
+            e2 = int(self._rev[e1])
+            todo.append((min(e1, e2), max(e1, e2)))
+            logs.append((u, v))
+        if todo:
+            self._detach_pairs(sorted(set(todo)))
+            for u, v in logs:
+                self._log("remove_edge", u=u, v=v)
+        return self
+
+    def _detach_pairs(self, pairs) -> None:
+        """Scrub + park a set of (e, rev e) slot pairs: ledgers, mailbox
+        and ring-buffer lanes zeroed (in-flight on a detached edge is
+        dropped), row-matrix columns cleared, slots onto the free list."""
+        import jax.numpy as jnp
+
+        e_sent = self.edge_capacity
+        eidx, nodes = [], []
+        rows_r, rows_c = [], []
+        for e1, e2 in pairs:
+            for e in (e1, e2):
+                u = int(self._src[e])
+                col = int(np.argmax(self._rows[u] == e))
+                assert self._rows[u, col] == e, "row matrix out of sync"
+                self._rows[u, col] = e_sent
+                rows_r.append(u)
+                rows_c.append(col)
+                self._deg[u] -= 1
+                nodes.append(u)
+                self._src[e] = self._dst[e] = self._park
+                self._rev[e] = e
+                self._delay[e] = 1
+                eidx.append(e)
+                heapq.heappush(self._free_edges, e)
+        ar = self.arrays
+        ei = jnp.asarray(np.asarray(eidx, np.int32))
+        self.arrays = ar.replace(
+            src=ar.src.at[ei].set(self._park),
+            dst=ar.dst.at[ei].set(self._park),
+            rev=ar.rev.at[ei].set(ei),
+            # freed slots return to the pad convention — including UNIT
+            # delay: a latency-derived topology's slot must not leak its
+            # old delivery delay into a later, unrelated edge that
+            # happens to reuse it (re-added edges are unit-delay, like
+            # the initial pad slots)
+            delay=ar.delay.at[ei].set(1),
+            out_deg=ar.out_deg.at[
+                jnp.asarray(np.asarray(nodes, np.int32))].add(-1),
+            sweep_edge_rows=ar.sweep_edge_rows.at[
+                jnp.asarray(np.asarray(rows_r, np.int32)),
+                jnp.asarray(np.asarray(rows_c, np.int32))].set(e_sent),
+        )
+        st = self.state
+        zf = jnp.zeros((len(eidx),) + self.feature_shape, st.flow.dtype)
+        self.state = st.replace(
+            flow=st.flow.at[ei].set(zf),
+            est=st.est.at[ei].set(zf),
+            recv=st.recv.at[ei].set(False),
+            stamp=st.stamp.at[ei].set(0),
+            edge_ok=st.edge_ok.at[ei].set(False),
+            pending_valid=st.pending_valid.at[:, ei].set(False),
+            pending_stamp=st.pending_stamp.at[:, ei].set(0),
+            pending_flow=st.pending_flow.at[:, ei].set(0),
+            pending_est=st.pending_est.at[:, ei].set(0),
+            buf_valid=st.buf_valid.at[:, ei].set(False),
+            buf_flow=st.buf_flow.at[:, ei].set(0),
+            buf_est=st.buf_est.at[:, ei].set(0),
+        )
+
+    # ---- execution -------------------------------------------------------
+    def _estimates_device(self) -> np.ndarray:
+        """(n_cap,)+F current estimates, via a jitted ``node_estimates``
+        (the eager row-fold is ~W dispatches — too slow to pay twice per
+        segment boundary; this is a tiny separate program, not a
+        recompile of the round scan)."""
+        import jax
+
+        from flow_updating_tpu.models.rounds import node_estimates
+
+        global _EST_JIT
+        if _EST_JIT is None:
+            _EST_JIT = jax.jit(node_estimates)
+        return np.asarray(_EST_JIT(self.state, self.arrays))
+
+    def _live_mean(self) -> np.ndarray:
+        alive = np.asarray(self.state.alive)
+        vals = np.asarray(self.state.value)
+        cnt = max(int(alive.sum()), 1)
+        return vals[alive].sum(axis=0) / cnt
+
+    def _sample(self, label: str) -> dict:
+        """One boundary telemetry row (host side, between segments)."""
+        est = self._estimates_device()
+        alive = np.asarray(self.state.alive)
+        vals = np.asarray(self.state.value)
+        live = int(alive.sum())
+        a_ex = alive.reshape(alive.shape + (1,) * (est.ndim - 1))
+        mass = np.where(a_ex, est, 0).sum(axis=0)
+        residual = self._ledger_residual(alive)
+        mean = self._live_mean()
+        err = est[alive] - mean
+        row = {
+            "label": label,
+            "t": self.clock,
+            "active": live,
+            "rmse": float(np.sqrt(np.mean(err * err))) if live else 0.0,
+            "max_abs_err": float(np.max(np.abs(err))) if live else 0.0,
+            "mass": np.atleast_1d(mass).tolist(),
+            "mass_residual": np.atleast_1d(residual).tolist(),
+        }
+        self._samples.append(row)
+        self._est_cache = (self.clock, est, alive)
+        return row
+
+    def run(self, rounds: int, telemetry=None):
+        """Advance ``rounds`` (a whole number of compiled segments) as
+        one membership epoch.  Events queued since the previous ``run``
+        are bound to this epoch's record, and boundary samples (mass /
+        residual / rmse over live members) are taken after the events
+        and after the rounds — the doctor's SLO inputs.
+
+        ``telemetry``: an optional
+        :class:`~flow_updating_tpu.obs.telemetry.TelemetrySpec` — each
+        segment then runs the telemetry scan (same static shape every
+        segment: still one compile) and the per-round series is
+        returned; otherwise returns ``self``.
+        """
+        from flow_updating_tpu.models.rounds import (
+            run_rounds,
+            run_rounds_telemetry,
+        )
+
+        if rounds < 0:
+            raise ValueError("rounds must be >= 0")
+        if rounds % self.segment_rounds:
+            raise ValueError(
+                f"rounds={rounds} must be a whole number of compiled "
+                f"segments (segment_rounds={self.segment_rounds}) — the "
+                "zero-recompile contract fixes the scan length")
+        events = self._pending_events
+        self._pending_events = []
+        if events or not self._samples \
+                or self._samples[-1]["t"] != self.clock:
+            before = self._sample("epoch_start")
+        else:
+            # no events since the last boundary: the state is the one
+            # the previous sample measured — reuse it instead of paying
+            # another device read
+            before = dict(self._samples[-1])
+        series_rows = None
+        if telemetry is not None and not telemetry.enabled:
+            telemetry = None
+        for _ in range(rounds // self.segment_rounds):
+            if telemetry is None:
+                self.state = run_rounds(
+                    self.state, self.arrays, self.config,
+                    self.segment_rounds, params=self.params)
+            else:
+                import jax.numpy as jnp
+
+                mean = jnp.asarray(self._live_mean(),
+                                   self.config.jnp_dtype)
+                self.state, seg = run_rounds_telemetry(
+                    self.state, self.arrays, self.config,
+                    self.segment_rounds, telemetry, mean,
+                    params=self.params)
+                seg = {k: np.asarray(v) for k, v in seg.items()}
+                if series_rows is None:
+                    series_rows = {k: [v] for k, v in seg.items()}
+                else:
+                    for k, v in seg.items():
+                        series_rows[k].append(v)
+        after = self._sample("epoch_end")
+        self.history.append({
+            "epoch": self._epoch,
+            "rounds": int(rounds),
+            "t0": before["t"],
+            "t1": after["t"],
+            "events": [dict(e) for e in events],
+            "live": after["active"],
+            "before": {k: before[k] for k in
+                       ("rmse", "max_abs_err", "mass", "mass_residual",
+                        "active")},
+            "after": {k: after[k] for k in
+                      ("rmse", "max_abs_err", "mass", "mass_residual",
+                       "active")},
+        })
+        self._epoch += 1
+        if series_rows is not None:
+            from flow_updating_tpu.obs.telemetry import TelemetrySeries
+
+            return TelemetrySeries({
+                k: np.concatenate(v) for k, v in series_rows.items()})
+        return self
+
+    # ---- reads -----------------------------------------------------------
+    def estimates(self, max_staleness: int | None = None):
+        """Live members' current estimates: ``(ids, values)`` numpy
+        arrays.  ``max_staleness=k`` accepts the boundary sample if it is
+        at most ``k`` rounds old — a bounded-staleness read that costs
+        nothing while segments run; ``None`` forces a fresh computation.
+        Membership events always invalidate the sample (a read after a
+        join/leave reflects the new membership)."""
+        cache = self._est_cache
+        if (max_staleness is not None and cache is not None
+                and self.clock - cache[0] <= max_staleness):
+            t, est, alive = cache
+        else:
+            est = self._estimates_device()
+            alive = np.asarray(self.state.alive)
+            self._est_cache = (self.clock, est, alive)
+        ids = np.where(alive)[0].astype(np.int32)
+        return ids, est[alive]
+
+    def _ledger_residual(self, alive: np.ndarray) -> np.ndarray:
+        """Per-feature live-mass residual ``sum_alive(est) -
+        sum_alive(value)``, computed in its mathematically equal ledger
+        form ``-sum(flow[e] for live src[e])`` as a fixed-edge-order
+        masked sum.  That form makes the event-conservation contract
+        *bit-exact*: a ``join`` contributes no edge terms, an ``update``
+        touches no flow, so neither can move the residual by even a ulp
+        (tests/test_service.py pins this); ``leave``/``remove_edges``
+        move it by exactly the detached pairs' antisymmetry deficit."""
+        flow = np.asarray(self.state.flow)
+        live_e = alive[self._src]
+        mask = live_e.reshape(live_e.shape + (1,) * (flow.ndim - 1))
+        return -np.where(mask, flow, 0).sum(axis=0)
+
+    def mass_residual(self) -> np.ndarray:
+        """(D,) (or scalar as (1,)) per-feature live-mass residual now
+        (the ledger form — see :meth:`_ledger_residual`)."""
+        return np.atleast_1d(
+            self._ledger_residual(np.asarray(self.state.alive)))
+
+    def convergence_report(self) -> dict:
+        s = self._sample("report")
+        flow = np.asarray(self.state.flow)
+        anti = flow + flow[self._rev]
+        return {
+            "t": self.clock,
+            "rmse": s["rmse"],
+            "max_abs_err": s["max_abs_err"],
+            "mass_residual": s["mass_residual"],
+            "antisymmetry_residual": float(np.max(np.abs(anti))),
+            "live": self.live_count,
+            # scalar scale for check_report's tolerance; the per-feature
+            # vector rides alongside
+            "true_mean": float(np.max(np.abs(self._live_mean()))),
+            "true_mean_per_feature": np.atleast_1d(
+                self._live_mean()).tolist(),
+            "nodes": self.live_count,
+        }
+
+    def service_block(self) -> dict:
+        """The manifest's ``service`` block: capacity accounting, epoch
+        history, compile count — the inputs of ``doctor``'s service SLO
+        checks (obs/health.check_service)."""
+        return {
+            "capacity": {
+                "nodes": self.capacity,
+                "edges": self.edge_capacity,
+                "degree_budget": self.degree_budget,
+                "live": self.live_count,
+                "members": self.member_count,
+                "free_node_slots": len(self._free_nodes),
+                "free_edge_slots": len(self._free_edges),
+            },
+            "segment_rounds": self.segment_rounds,
+            "compile_count": self.compile_count,
+            "epochs": [dict(h) for h in self.history],
+            "events_total": int(sum(self._event_counts.values())),
+            "event_counts": {k: v for k, v in self._event_counts.items()
+                             if v},
+            "dtype": self.config.dtype,
+        }
+
+    def boundary_series(self) -> dict:
+        """The boundary samples as a telemetry-shaped series dict (one
+        row per segment boundary) — doctor's standard series checks run
+        on it unchanged."""
+        if not self._samples:
+            return {}
+        keys = ("t", "rmse", "max_abs_err", "mass", "mass_residual",
+                "active")
+        return {k: [s[k] for s in self._samples] for k in keys}
+
+    # ---- durability ------------------------------------------------------
+    def save_checkpoint(self, path: str) -> "ServiceEngine":
+        """Write the full service state — protocol state, dynamic
+        topology leaves, free lists, epoch counters — as one versioned
+        archive (utils/checkpoint.py, ``service-checkpoint`` schema).
+        Restore via :meth:`restore_checkpoint`; round-trip is bit-exact
+        (tests/test_service.py)."""
+        from flow_updating_tpu.utils.checkpoint import (
+            save_service_checkpoint,
+        )
+
+        topo_arrays = {
+            "src": self._src, "dst": self._dst, "rev": self._rev,
+            "out_deg": self._deg, "rows": self._rows,
+            "delay": self._delay,
+            "free_nodes": np.asarray(sorted(self._free_nodes), np.int32),
+            "free_edges": np.asarray(sorted(self._free_edges), np.int32),
+            "member": self._member,
+        }
+        meta = {
+            "capacity": self.capacity,
+            "edge_capacity": self.edge_capacity,
+            "degree_budget": self.degree_budget,
+            "segment_rounds": self.segment_rounds,
+            "epoch": self._epoch,
+            "event_counts": dict(self._event_counts),
+        }
+        save_service_checkpoint(path, self.state, self.config,
+                                topo_arrays, meta)
+        return self
+
+    @classmethod
+    def restore_checkpoint(cls, path: str) -> "ServiceEngine":
+        """Rebuild a service from :meth:`save_checkpoint`'s archive —
+        same capacity, same membership, bit-exact state."""
+        from flow_updating_tpu.utils.checkpoint import (
+            load_service_checkpoint,
+        )
+
+        import jax
+        import jax.numpy as jnp
+
+        state, cfg, topo_arrays, meta = load_service_checkpoint(path)
+        self = object.__new__(cls)
+        self.config = cfg
+        self.capacity = int(meta["capacity"])
+        self.edge_capacity = int(meta["edge_capacity"])
+        self.degree_budget = int(meta["degree_budget"])
+        self.segment_rounds = int(meta["segment_rounds"])
+        self._n_cap = self.capacity + 1
+        self._park = self.capacity
+        # device-resident leaves: the jit fast path keys on concrete
+        # input types, so numpy-leaved state would retrace the round
+        # program — breaking the zero-recompile contract on resume
+        self.state = jax.tree.map(jnp.asarray, state)
+        self._src = topo_arrays["src"].astype(np.int32)
+        self._dst = topo_arrays["dst"].astype(np.int32)
+        self._rev = topo_arrays["rev"].astype(np.int32)
+        self._deg = topo_arrays["out_deg"].astype(np.int32)
+        self._rows = topo_arrays["rows"].astype(np.int32)
+        self._delay = topo_arrays["delay"].astype(np.int32)
+        self._member = topo_arrays["member"].astype(bool)
+        self._free_nodes = topo_arrays["free_nodes"].astype(int).tolist()
+        heapq.heapify(self._free_nodes)
+        self._free_edges = topo_arrays["free_edges"].astype(int).tolist()
+        heapq.heapify(self._free_edges)
+        # rebuild the device topology pytree from the mirrors; the
+        # treedef matches the constructed path (one jit cache entry
+        # whichever way the service came up)
+        row_start = np.zeros(self._n_cap + 1, np.int64)
+        np.cumsum(np.bincount(self._src, minlength=self._n_cap),
+                  out=row_start[1:])
+        self.arrays = _service_topo_arrays(
+            self._src, self._dst, self._rev, self._deg, row_start,
+            self._rows, self._delay)
+        params = RoundParams.from_config(cfg)
+        self.params = (params.without_drop() if cfg.drop_rate == 0.0
+                       else params)
+        self._epoch = int(meta.get("epoch", 0))
+        self._event_counts = {k: 0 for k in SERVICE_EVENTS}
+        self._event_counts.update(meta.get("event_counts", {}))
+        self._pending_events = []
+        self.history = []
+        self._samples = []
+        self._est_cache = None
+        self._capture_cache_floor()
+        self._sample("restore")
+        return self
+
+
+def _service_topo_arrays(src, dst, rev, deg, row_start, rows, delay):
+    """Assemble the service's TopoArrays pytree from host mirrors
+    (restore path) — shape/dtype-identical to the constructed path.
+
+    ``row_start``/``edge_rank``/``deg_e`` are DEAD leaves under the
+    service config domain: their only consumers in the round kernel are
+    the drain>0 priority pick and the faithful-pairwise scan, both
+    rejected by :func:`validate_service_config` (a post-churn src array
+    is not CSR-sorted, so a bincount row_start would be meaningless
+    anyway).  They are rebuilt here solely so the pytree treedef and
+    leaf set match the constructed path — the live leaves the kernel
+    reads (src, rev, out_deg, delay, sweep_edge_rows) come from the
+    checkpointed mirrors bit-exactly.  Relaxing the config domain means
+    carrying these as mirrors too."""
+    import jax.numpy as jnp
+
+    from flow_updating_tpu.topology.graph import TopoArrays
+
+    E = src.shape[0]
+    edge_rank = (np.arange(E, dtype=np.int64)
+                 - row_start[src]).astype(np.int32)
+    return TopoArrays(
+        src=jnp.asarray(src),
+        dst=jnp.asarray(dst),
+        rev=jnp.asarray(rev),
+        out_deg=jnp.asarray(deg),
+        row_start=jnp.asarray(row_start, dtype=jnp.int32),
+        edge_rank=jnp.asarray(edge_rank),
+        delay=jnp.asarray(delay),
+        deg_e=jnp.asarray(deg[src]),
+        sweep_edge_rows=jnp.asarray(rows),
+    )

@@ -1,0 +1,38 @@
+"""Membership primitives shared by every churn surface.
+
+Three call sites used to hand-roll the same alive-mask edit: the
+Engine's fault injection (``kill_nodes``/``revive_nodes``), the
+gossip-SGD trainer's mid-training churn schedule, and now the streaming
+service's suspend/resume path.  One implementation lives here so "node
+churn" means exactly one thing everywhere: flipping the alive mask of a
+:class:`~flow_updating_tpu.models.state.FlowUpdatingState` — dead nodes
+stop firing, sending and draining; their ledgers stay intact, so a
+revived node re-joins with its flow state and the protocol self-heals
+(the Flow-Updating paper's fault model).
+
+The service's *graceful* departure (``ServiceEngine.leave``) builds on
+this plus ledger detachment; temporary failure (``suspend``/``resume``,
+``kill_nodes``/``revive_nodes``) is the bare mask flip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def as_id_array(ids) -> np.ndarray:
+    """Normalize a node-id collection to a (k,) int32 numpy array."""
+    arr = np.atleast_1d(np.asarray(ids, np.int32))
+    if arr.ndim != 1:
+        raise ValueError(f"node ids must be a flat sequence, got shape "
+                         f"{arr.shape}")
+    return arr
+
+
+def set_alive(state, ids, alive: bool):
+    """Flip the liveness mask of ``ids`` (ledgers untouched — the
+    temporary-failure churn of the paper; see module docstring)."""
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(as_id_array(ids))
+    return state.replace(alive=state.alive.at[idx].set(bool(alive)))

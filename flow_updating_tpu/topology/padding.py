@@ -1,0 +1,165 @@
+"""Capacity padding: ghost nodes, self-loop pad edges, row layouts.
+
+The mass-neutral padding trick — append dead ghost nodes and
+``edge_ok=False`` self-loop pad edges so a topology fills a fixed
+``(n_pad, e_pad)`` capacity without perturbing the protocol — was proven
+by the batched sweep engine (:mod:`flow_updating_tpu.sweep.pack`) and is
+promoted here so the streaming service engine
+(:mod:`flow_updating_tpu.service`) shares ONE construction.  The rules
+(asserted by tests/test_sweep.py and tests/test_service.py):
+
+* **ghost nodes** are appended after the real nodes with value 0 and are
+  *born dead* (``alive=False`` in the packed state): they never fire,
+  never drain, and every alive-masked metric (rmse, mass, active)
+  excludes them — the instance's true mean and per-feature mass are
+  untouched;
+* **pad edges** are self-loops on ghost nodes with ``edge_ok=False`` (a
+  failed link loses every message put on it) and ``rev`` mapped to
+  themselves, appended after the real edges.  Because edges sort by
+  ``(src, dst)`` and every ghost id exceeds every real id, the real edge
+  arrays stay a bit-identical *prefix* of the padded arrays;
+* the **edge coloring** of a padded topology extends the real coloring
+  with color ``-1`` on pad self-loops (``src == dst`` never enters the
+  matching), which no round ever fires.
+
+Two ghost-placement policies serve the two consumers:
+
+* ``spread='even'`` (the sweep's historical layout, bit-exact-pinned by
+  tests/test_sweep.py): pad self-loops are spread evenly across ALL
+  ghosts, capping every row's degree — which bounds the uniform row
+  width W of the batched reduction layout;
+* ``spread='last'`` (the service layout): every pad self-loop parks on
+  the LAST ghost — the service's permanently-dead parking slot — so the
+  remaining ghosts are clean, zero-degree node slots a ``join`` can
+  claim, and a freed edge slot always has a dead node to park on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.topology.graph import Topology
+
+
+def pow2_ceil(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def bucket_ceil(x: int) -> int:
+    """Round up to an eighth-power-of-two boundary: at most 12.5% pad
+    waste per axis, at most 8 bucket sizes per octave (the
+    compile-count/pad-waste trade)."""
+    g = max(pow2_ceil(x) // 8, 1)
+    return ((int(x) + g - 1) // g) * g
+
+
+def pad_topology_to(topo: Topology, n_pad: int, e_pad: int,
+                    spread: str = "even") -> Topology:
+    """Pad ``topo`` to exactly ``(n_pad, e_pad)`` with ghost nodes and
+    self-loop pad edges placed per ``spread`` (see module docstring).
+    The real arrays remain a prefix; ghost values are 0."""
+    topo._require_edges("pad_topology_to (capacity packing)")
+    if spread not in ("even", "last"):
+        raise ValueError(f"unknown ghost-placement policy {spread!r} "
+                         "(use 'even' or 'last')")
+    N, E = topo.num_nodes, topo.num_edges
+    if n_pad <= N:
+        raise ValueError(
+            f"n_pad={n_pad} must exceed the real node count {N} (at "
+            "least one ghost node carries the pad edges)")
+    if e_pad < E:
+        raise ValueError(f"e_pad={e_pad} < real edge count {E}")
+    pad_n = n_pad - N
+    pad_e = e_pad - E
+    if spread == "even":
+        # ghost i in [N, n_pad) takes an even contiguous share of the pad
+        # self-loops; (g, g) pairs sort ascending by g, so the edge list
+        # stays (src, dst)-sorted with the real edges as a prefix
+        ghost_of = (N + (np.arange(pad_e, dtype=np.int64) * pad_n)
+                    // max(pad_e, 1) % pad_n) if pad_e else \
+            np.empty(0, np.int64)
+        ghost_of = np.sort(ghost_of).astype(np.int32)
+    else:
+        # every pad self-loop on the LAST ghost (the service's parking
+        # slot); still sorted — the park id exceeds every other id
+        ghost_of = np.full(pad_e, n_pad - 1, np.int32)
+
+    src = np.concatenate([topo.src, ghost_of])
+    dst = np.concatenate([topo.dst, ghost_of])
+    # self-loops reverse to themselves: rev stays an involution and the
+    # antisymmetry permutation is the identity on the pad slice
+    rev = np.concatenate([topo.rev, np.arange(E, e_pad, dtype=np.int32)])
+    ghost_deg = np.bincount(ghost_of - N, minlength=pad_n) \
+        if pad_e else np.zeros(pad_n, np.int64)
+    pad_rank = (np.arange(pad_e, dtype=np.int64)
+                - np.concatenate([[0], np.cumsum(ghost_deg)])[
+                    ghost_of - N]) if pad_e else np.empty(0, np.int64)
+    edge_rank = np.concatenate(
+        [topo.edge_rank, pad_rank.astype(np.int32)])
+    delay = np.concatenate([topo.delay, np.ones(pad_e, np.int32)])
+    out_deg = np.concatenate(
+        [topo.out_deg, ghost_deg.astype(np.int32)])
+    values = np.concatenate([topo.values, np.zeros(pad_n)])
+    counts = np.bincount(src, minlength=n_pad)
+    row_start = np.zeros(n_pad + 1, np.int64)
+    np.cumsum(counts, out=row_start[1:])
+
+    padded = dataclasses.replace(
+        topo,
+        num_nodes=n_pad,
+        src=src,
+        dst=dst,
+        rev=rev,
+        out_deg=out_deg,
+        row_start=row_start,
+        edge_rank=edge_rank,
+        delay=delay,
+        values=values,
+        names=None,
+        speeds=None,
+        bandwidth=None,
+        latency_s=None,
+        adopted=None,
+        # the link-contention model is rejected by the packers (link
+        # route tables don't batch); drop the arrays for consistency
+        edge_links=None,
+        link_ser_rounds=None,
+        link_shared=None,
+        lat_rounds=None,
+        # a structure descriptor indexes the UNpadded node layout
+        structure=None,
+    )
+    # carry a computed coloring through (extended with -1 on pad
+    # self-loops) so the padded instance runs the SAME matching sequence;
+    # an uncached coloring recomputes identically (src==dst edges never
+    # enter the matching)
+    cached = getattr(topo, "_edge_coloring", None)
+    if cached is not None:
+        col, c = cached
+        col = np.concatenate([col, np.full(pad_e, -1, np.int32)])
+        object.__setattr__(padded, "_edge_coloring", (col, c))
+    return padded
+
+
+def edge_rows(padded: Topology, width: int, e_pad: int) -> np.ndarray:
+    """The (N_pad, W) out-edge index matrix of the scatter-free row
+    reduction layout (pad slot = e_pad; see ops/segment.rows_segment_*)."""
+    lo = padded.row_start[:-1]
+    deg = padded.out_deg.astype(np.int64)
+    ar = np.arange(width, dtype=np.int64)
+    valid = ar[None, :] < deg[:, None]
+    return np.where(valid, lo[:, None] + ar[None, :], e_pad).astype(
+        np.int32)
+
+
+def row_width(topo: Topology, n_pad: int, e_pad: int) -> int:
+    """Uniform row width this instance needs in an ``(n_pad, e_pad)``
+    bucket under even ghost spreading: its real max degree, or the
+    evenly-spread ghost degree if that is larger."""
+    pad_n = n_pad - topo.num_nodes
+    pad_e = e_pad - topo.num_edges
+    ghost_deg = -(-pad_e // pad_n) if pad_n and pad_e else 0
+    real = int(topo.out_deg.max()) if topo.num_nodes else 0
+    return max(real, ghost_deg, 1)

@@ -36,6 +36,14 @@ from flow_updating_tpu.models.state import FlowUpdatingState
 #    pending_stamp field (models/state.py) — v1 checkpoints cannot resume.
 FORMAT_VERSION = 2
 
+# Service checkpoints (ServiceEngine.save_checkpoint) version their own
+# schema on top of the archive format: the dynamic-topology mirror set
+# (src/dst/rev/out_deg/rows/delay/free lists/member mask) is part of the
+# contract, so adding or renaming one bumps this.
+SERVICE_FORMAT_VERSION = 1
+_SERVICE_TOPO_KEYS = ("src", "dst", "rev", "out_deg", "rows", "delay",
+                      "free_nodes", "free_edges", "member")
+
 
 def _state_classes() -> dict:
     from flow_updating_tpu.models.sync import NodeSyncState
@@ -73,12 +81,43 @@ def _write_archive(path: str, manifest: dict, arrays: dict) -> None:
     os.replace(tmp, path)
 
 
-def _read_manifest(z) -> dict:
-    manifest = json.loads(bytes(z["__manifest__"]).decode())
-    if manifest["format_version"] != FORMAT_VERSION:
+def _open_archive(path: str):
+    """Open a checkpoint archive with failures translated into errors
+    that name the FILE and the likely fix — a truncated copy, a partial
+    download, or a non-checkpoint file must never surface as a raw
+    zipfile/pickle traceback."""
+    import zipfile
+
+    try:
+        return np.load(path)
+    except FileNotFoundError:
+        raise ValueError(f"checkpoint {path}: no such file")
+    except (zipfile.BadZipFile, EOFError, OSError, ValueError) as exc:
         raise ValueError(
-            f"checkpoint format {manifest['format_version']} != "
-            f"{FORMAT_VERSION}")
+            f"checkpoint {path}: not a readable checkpoint archive "
+            f"({type(exc).__name__}: {exc}) — the file is truncated, "
+            "still being written, or not a checkpoint at all")
+
+
+def _read_manifest(z, path: str) -> dict:
+    if "__manifest__" not in z.files:
+        raise ValueError(
+            f"checkpoint {path}: no manifest record — the archive is "
+            "not a flow_updating_tpu checkpoint (or was truncated "
+            "mid-write; checkpoints are written atomically, so re-save)")
+    try:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ValueError(
+            f"checkpoint {path}: manifest is corrupt "
+            f"({type(exc).__name__}: {exc})")
+    got = manifest.get("format_version")
+    if got != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {path}: format version {got}, but this runtime "
+            f"reads version {FORMAT_VERSION} — re-create the checkpoint "
+            "with the current code (format 1 predates the depth-Q "
+            "mailbox arrays and cannot be migrated)")
     return manifest
 
 
@@ -126,8 +165,8 @@ def load_checkpoint(
     If ``topo`` is given and the checkpoint carries a fingerprint, they must
     match — a checkpoint can never be resumed against a different graph.
     """
-    with np.load(path) as z:
-        manifest = _read_manifest(z)
+    with _open_archive(path) as z:
+        manifest = _read_manifest(z, path)
         fields = {}
         aux_color = None
         for key in z.files:
@@ -233,8 +272,8 @@ def load_actor_checkpoint(path, template, actor_name: str, topo=None):
     """
     import jax.tree_util as jtu
 
-    with np.load(path) as z:
-        manifest = _read_manifest(z)
+    with _open_archive(path) as z:
+        manifest = _read_manifest(z, path)
         if manifest.get("state_class") != "ActorCarry":
             raise ValueError(
                 f"not a VectorActor checkpoint "
@@ -297,3 +336,98 @@ def load_actor_checkpoint(path, template, actor_name: str, topo=None):
             dev = jax.device_put(dev, sh)
         leaves.append(dev)
     return jtu.tree_unflatten(treedef, leaves), manifest.get("extra", {})
+
+
+# ---- service checkpoints (ServiceEngine) --------------------------------
+#
+# A service checkpoint is a run checkpoint PLUS the dynamic topology the
+# membership events have produced: the live src/dst/rev/out_deg/row-
+# matrix/delay mirrors, the free-slot lists and the member mask.  There
+# is no topology fingerprint — the graph is mutable state, not an input
+# — so the whole mirror set is archived and the schema is versioned
+# separately (SERVICE_FORMAT_VERSION) on top of the archive format.
+
+def save_service_checkpoint(path: str, state: FlowUpdatingState,
+                            cfg: RoundConfig, topo_arrays: dict,
+                            meta: dict) -> None:
+    """Write one atomic service checkpoint (state + dynamic topology +
+    capacity metadata).  ``topo_arrays`` must carry exactly the
+    :data:`_SERVICE_TOPO_KEYS` mirrors; ``meta`` is the JSON capacity /
+    epoch block echoed back by :func:`load_service_checkpoint`."""
+    missing = set(_SERVICE_TOPO_KEYS) - set(topo_arrays)
+    if missing:
+        raise ValueError(
+            f"service checkpoint needs topology mirrors {sorted(missing)}")
+    arrays = {}
+    for name in state.__dataclass_fields__:
+        arrays[f"state.{name}"] = np.asarray(
+            jax.device_get(getattr(state, name)))
+    for key in _SERVICE_TOPO_KEYS:
+        arrays[f"svc.{key}"] = np.asarray(topo_arrays[key])
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "state_class": type(state).__name__,
+        "service_version": SERVICE_FORMAT_VERSION,
+        "config": dataclasses.asdict(cfg),
+        "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+        "service": dict(meta),
+        "extra": {},
+    }
+    _write_archive(path, manifest, arrays)
+
+
+def load_service_checkpoint(path: str):
+    """Read a service checkpoint.  Returns
+    ``(state, config, topo_arrays, meta)``; raises a ValueError naming
+    the file and the expected schema on a non-service archive, a version
+    mismatch, or a truncated/incomplete mirror set."""
+    with _open_archive(path) as z:
+        manifest = _read_manifest(z, path)
+        if "service_version" not in manifest:
+            raise ValueError(
+                f"checkpoint {path}: not a service checkpoint "
+                f"(state_class={manifest.get('state_class')!r}, no "
+                "service_version) — service archives are written by "
+                "ServiceEngine.save_checkpoint; plain run checkpoints "
+                "restore via Engine.restore_checkpoint")
+        got = manifest["service_version"]
+        if got != SERVICE_FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path}: service schema version {got}, but "
+                f"this runtime reads version {SERVICE_FORMAT_VERSION} — "
+                "re-create the checkpoint with the current code")
+        fields = {k[len("state."):]: z[k] for k in z.files
+                  if k.startswith("state.")}
+        svc = {k[len("svc."):]: z[k] for k in z.files
+               if k.startswith("svc.")}
+    want = set(FlowUpdatingState.__dataclass_fields__)
+    have = set(fields)
+    if have != want:
+        raise ValueError(
+            f"checkpoint {path}: state fields mismatch — missing "
+            f"{sorted(want - have)}, unexpected {sorted(have - want)} "
+            "(truncated archive, or saved by an incompatible version)")
+    missing = set(_SERVICE_TOPO_KEYS) - set(svc)
+    if missing:
+        raise ValueError(
+            f"checkpoint {path}: service topology mirrors missing "
+            f"{sorted(missing)} (truncated archive?)")
+    saved_dtypes = manifest.get("dtypes", {})
+    for name, arr in fields.items():
+        saved = saved_dtypes.get(f"state.{name}")
+        if saved is not None and str(arr.dtype) != saved:
+            raise ValueError(
+                f"checkpoint {path}: leaf {name!r} dtype {arr.dtype} "
+                f"does not match its manifest entry {saved!r} (corrupt "
+                "archive?)")
+        canonical = jax.dtypes.canonicalize_dtype(arr.dtype)
+        if canonical != arr.dtype:
+            warnings.warn(
+                f"service checkpoint leaf {name!r} was saved as "
+                f"{arr.dtype} but this runtime canonicalizes it to "
+                f"{canonical} — casting explicitly; the resume is NOT "
+                "bit-exact", stacklevel=2)
+            fields[name] = arr.astype(canonical)
+    cfg = RoundConfig(**manifest["config"])
+    state = FlowUpdatingState(**fields)
+    return state, cfg, svc, manifest.get("service", {})

@@ -186,15 +186,18 @@ class GossipSGDTrainer:
         return per_feature_mass_residual(self.state, self.arrays)
 
     # -- fault injection -------------------------------------------------
+    # churn is the service membership primitive (ONE implementation for
+    # the trainer's schedule, the Engine's fault injection and the
+    # streaming service's suspend/resume — service/membership.py)
     def kill_nodes(self, nodes) -> None:
-        ids = jnp.asarray(np.asarray(nodes, np.int32))
-        self.state = self.state.replace(
-            alive=self.state.alive.at[ids].set(False))
+        from flow_updating_tpu.service import membership
+
+        self.state = membership.set_alive(self.state, nodes, False)
 
     def revive_nodes(self, nodes) -> None:
-        ids = jnp.asarray(np.asarray(nodes, np.int32))
-        self.state = self.state.replace(
-            alive=self.state.alive.at[ids].set(True))
+        from flow_updating_tpu.service import membership
+
+        self.state = membership.set_alive(self.state, nodes, True)
 
     # -- training --------------------------------------------------------
     def step(self) -> None:

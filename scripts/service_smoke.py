@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""CI service smoke: the streaming engine's acceptance scenario.
+
+Runs the ISSUE-7 acceptance criteria end to end on the CPU proxy and
+leaves the manifest in ``--outdir`` (uploaded by the tier1 workflow):
+
+1. build a ``ServiceEngine`` at capacity >= 100k nodes;
+2. drive >= 100 scripted join/leave/update/edge events interleaved with
+   compiled scan segments — asserting the round program compiles
+   EXACTLY once across the whole run (zero recompiles);
+3. mid-run, checkpoint -> restore -> continue BOTH services and assert
+   the trajectories stay bit-exact on every state leaf;
+4. write the ``flow-updating-service-report/v1`` manifest and run
+   ``doctor`` over it — per-feature mass conserved at every membership
+   epoch, post-churn residual decays, capacity accounting consistent.
+
+Exit code: the doctor's (0 healthy; 1 on any failing check), or 1 on
+any assertion above.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--nodes", type=int, default=99_000,
+                    help="initial members (ring:N:2)")
+    ap.add_argument("--capacity", type=int, default=100_000,
+                    help="node-slot capacity (acceptance floor: 100k)")
+    ap.add_argument("--events", type=int, default=120,
+                    help="membership/edge events to apply (floor: 100)")
+    ap.add_argument("--segment-rounds", type=int, default=8)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.models.rounds import run_rounds
+    from flow_updating_tpu.obs.report import (
+        build_service_manifest,
+        write_report,
+    )
+    from flow_updating_tpu.service import ServiceEngine
+    from flow_updating_tpu.topology.generators import ring
+
+    t0 = time.perf_counter()
+    topo = ring(args.nodes, k=2, seed=0)
+    svc = ServiceEngine(topo, args.capacity, degree_budget=6,
+                        segment_rounds=args.segment_rounds, seed=0)
+    print(f"service_smoke: capacity {svc.capacity} nodes / "
+          f"{svc.edge_capacity} edge slots, {svc.live_count} members, "
+          f"built in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    import tempfile
+
+    cache0 = run_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    held: list = []
+    events = 0
+    ckpt_done = False
+    # checkpoint lives in a scratch dir, not the uploaded artifacts
+    # (a 100k-capacity archive is tens of MB of CI noise)
+    scratch = tempfile.mkdtemp(prefix="service-smoke-")
+    path = os.path.join(scratch, "service_smoke.npz")
+    while events < args.events:
+        if held and (len(held) >= 16 or rng.random() < 0.4):
+            svc.leave([held.pop()])
+            events += 1
+        else:
+            slot = svc.join(float(rng.random()))
+            a = int(rng.integers(0, args.nodes))
+            svc.add_edges([(slot, a)])
+            svc.update([a], [float(rng.random())])
+            held.append(slot)
+            events += 3
+        svc.run(args.segment_rounds)
+        if events >= args.events // 2 and not ckpt_done:
+            # mid-churn durability: checkpoint -> restore -> both
+            # continue -> bit-exact
+            svc.save_checkpoint(path)
+            twin = ServiceEngine.restore_checkpoint(path)
+            svc.run(2 * args.segment_rounds)
+            twin.run(2 * args.segment_rounds)
+            for name in svc.state.__dataclass_fields__:
+                a_, b_ = (np.asarray(getattr(svc.state, name)),
+                          np.asarray(getattr(twin.state, name)))
+                if not np.array_equal(a_, b_):
+                    print(f"service_smoke: leaf {name} diverged after "
+                          "checkpoint restore", file=sys.stderr)
+                    return 1
+            ckpt_done = True
+            print("service_smoke: checkpoint -> restore -> continue is "
+                  "bit-exact", file=sys.stderr)
+    # quiet tail: the self-healing SLO needs the last churned epoch to
+    # have recovered
+    svc.run(8 * args.segment_rounds)
+
+    compiles = run_rounds._cache_size() - cache0
+    if compiles != 1:
+        print(f"service_smoke: round program compiled {compiles}x over "
+              f"{events} events (expected exactly 1)", file=sys.stderr)
+        return 1
+    print(f"service_smoke: {events} events, {svc.clock} rounds, "
+          f"1 compile, live={svc.live_count}, "
+          f"|residual|={float(np.max(np.abs(svc.mass_residual()))):.3e}, "
+          f"{time.perf_counter() - t0:.1f}s total", file=sys.stderr)
+
+    manifest_path = os.path.join(args.outdir, "service_report.json")
+    write_report(manifest_path, build_service_manifest(
+        argv=sys.argv[1:], config=svc.config, topo=topo,
+        service=svc.service_block(), series=svc.boundary_series(),
+        report=svc.convergence_report()))
+    return cli_main(["doctor", manifest_path])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

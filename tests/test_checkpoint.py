@@ -66,6 +66,48 @@ def test_edge_coloring_cached_through_checkpoint(tmp_path):
     assert cached[1] == C
 
 
+def test_truncated_checkpoint_names_file_and_fix(tmp_path):
+    """A clipped archive must surface as a ValueError naming the FILE
+    and the truncation, never a raw zipfile traceback."""
+    cfg = RoundConfig.fast()
+    topo = ring(8, k=1, seed=0)
+    path = str(tmp_path / "full.npz")
+    save_checkpoint(path, init_state(topo, cfg), cfg, topo=topo)
+    clipped = str(tmp_path / "clipped.npz")
+    blob = open(path, "rb").read()
+    open(clipped, "wb").write(blob[: len(blob) // 4])
+    with pytest.raises(ValueError, match="clipped.npz.*truncated"):
+        load_checkpoint(clipped)
+    with pytest.raises(ValueError, match="no such file"):
+        load_checkpoint(str(tmp_path / "never-written.npz"))
+    # a random non-archive file is named too
+    junk = str(tmp_path / "junk.npz")
+    open(junk, "w").write("this is not a checkpoint")
+    with pytest.raises(ValueError, match="junk.npz"):
+        load_checkpoint(junk)
+
+
+def test_format_version_mismatch_names_file_and_versions(tmp_path):
+    from flow_updating_tpu.utils import checkpoint as ck
+
+    cfg = RoundConfig.fast()
+    topo = ring(8, k=1, seed=0)
+    path = str(tmp_path / "v1.npz")
+    save_checkpoint(path, init_state(topo, cfg), cfg, topo=topo)
+    import json
+
+    with np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    manifest["format_version"] = 1
+    old = str(tmp_path / "old-format.npz")
+    ck._write_archive(old, manifest, arrays)
+    with pytest.raises(
+            ValueError,
+            match=r"old-format.npz.*version 1.*reads version 2"):
+        load_checkpoint(old)
+
+
 def test_topology_mismatch_rejected(tmp_path):
     cfg = RoundConfig.fast()
     topo = ring(16, k=2, seed=0)

@@ -1,0 +1,452 @@
+"""Streaming service engine: churn conformance suite (docs/SERVICE.md).
+
+Contracts pinned here:
+
+* **no-op parity** — a service run with ZERO events is bit-identical to
+  the plain engine (``run_rounds``) at the same capacity, for both
+  collect-all firing policies: the row-matrix reductions reproduce the
+  sorted scatter-add's exact addition order and the capacity padding is
+  mass-neutral;
+* **event conservation** — ``join`` and ``update`` leave the live-mass
+  residual (ledger form) unchanged BIT-EXACTLY; a full join/leave/
+  update/edge-edit sequence keeps per-feature mass within the in-flight
+  allowance at every segment boundary, and the post-churn residual
+  decays (the paper's self-healing as the doctor's SLO);
+* **zero recompiles** — the round program compiles exactly once across
+  100+ membership events (the `run_rounds` jit cache is the witness, as
+  in tests/test_sweep.py);
+* **durability** — service checkpoints (versioned schema) round-trip
+  bit-exactly: a restored service continues on the identical
+  trajectory, reuses the same free slots, and never recompiles;
+* **reads** — ``estimates(max_staleness=k)`` serves the boundary sample
+  within its staleness bound and refreshes beyond it; events always
+  invalidate it;
+* **manifest** — ``serve`` writes ``flow-updating-service-report/v1``
+  and ``doctor`` passes it (service_compile / service_mass /
+  service_churn_recovery / service_capacity checks).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.cli import main as cli_main
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs import health
+from flow_updating_tpu.service import ServiceEngine
+from flow_updating_tpu.topology.generators import grid2d, ring
+from flow_updating_tpu.topology.padding import pad_topology_to
+
+
+def _cfg(fire_policy="every_round"):
+    return RoundConfig(variant="collectall", fire_policy=fire_policy,
+                       dtype="float64")
+
+
+def _plain_comparator(topo, svc, cfg, seed):
+    """The plain engine at the service's capacity: the same padded
+    layout, masks and seed, run through the historical static path."""
+    padded = pad_topology_to(topo, svc.capacity + 1, svc.edge_capacity,
+                             spread="last")
+    arrays = padded.device_arrays()
+    st = init_state(padded, cfg, seed=seed)
+    st = st.replace(
+        alive=st.alive.at[topo.num_nodes:].set(False),
+        edge_ok=st.edge_ok.at[topo.num_edges:].set(False))
+    return st, arrays
+
+
+# ---- no-op parity --------------------------------------------------------
+
+@pytest.mark.parametrize("fire_policy", ["every_round", "reference"])
+def test_noop_service_bitexact_vs_plain_engine(fire_policy):
+    topo = ring(12, k=2, seed=3)
+    cfg = _cfg(fire_policy)
+    svc = ServiceEngine(topo, capacity=20, config=cfg, segment_rounds=8,
+                        seed=1, degree_budget=6)
+    st, arrays = _plain_comparator(topo, svc, cfg, seed=1)
+    ref = run_rounds(st, arrays, cfg, 24)
+    svc.run(24)
+    for name in ref.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, name)),
+            np.asarray(getattr(svc.state, name)),
+            err_msg=f"leaf {name} diverged from the plain engine")
+
+
+# ---- event mass conservation ---------------------------------------------
+
+def test_join_and_update_are_mass_neutral_bitexact():
+    topo = grid2d(4, 4, seed=0)
+    svc = ServiceEngine(topo, capacity=24, config=_cfg(),
+                        segment_rounds=8, degree_budget=6)
+    svc.run(16)   # mid-flight state: residual is NOT zero here
+    r0 = svc.mass_residual().copy()
+    assert np.any(r0 != 0.0)   # the test is meaningful mid-flight
+    nid = svc.join(0.77)
+    np.testing.assert_array_equal(svc.mass_residual(), r0)
+    svc.update([3, 5], [2.5, -1.25])
+    np.testing.assert_array_equal(svc.mass_residual(), r0)
+    # wiring the new node in adds zero-flow ledgers: still bit-neutral
+    svc.add_edges([(nid, 0), (nid, 3)])
+    np.testing.assert_array_equal(svc.mass_residual(), r0)
+
+
+def test_churn_sequence_conserves_mass_and_recovers():
+    """A join/leave/update/edge-edit sequence over several epochs: the
+    value-plane mass follows the event ledger bit-exactly, every
+    boundary residual passes the doctor's service_mass check, and the
+    post-churn residual decays to the float floor."""
+    rng = np.random.default_rng(7)
+    vals = rng.normal(size=(16, 2))          # per-feature mass (D=2)
+    topo = grid2d(4, 4, seed=1)
+    svc = ServiceEngine(topo, capacity=24, config=_cfg(),
+                        segment_rounds=16, degree_budget=8, values=vals)
+    expected = vals.sum(axis=0)
+
+    svc.run(32)
+    j1 = svc.join(np.array([0.5, -0.5]))
+    svc.add_edges([(j1, 0), (j1, 5)])
+    expected = expected + np.array([0.5, -0.5])
+    svc.run(32)
+    svc.update([2], [[1.0, 1.0]])
+    expected = expected - vals[2] + np.array([1.0, 1.0])
+    svc.leave([3])          # departs with its (never-updated) values
+    expected = expected - vals[3]
+    svc.remove_edges([(0, 1)])
+    svc.run(32)
+    # quiet epochs: self-healing drives the residual to the float floor
+    svc.run(64)
+
+    alive = np.asarray(svc.state.alive)
+    value_mass = np.asarray(svc.state.value)[alive].sum(axis=0)
+    np.testing.assert_allclose(value_mass, expected, rtol=0, atol=1e-12)
+
+    checks = health.check_service(svc.service_block(), dtype="float64")
+    by_name = {c.name: c for c in checks}
+    assert by_name["service_mass"].status == health.PASS, \
+        by_name["service_mass"].summary
+    assert by_name["service_churn_recovery"].status == health.PASS, \
+        by_name["service_churn_recovery"].summary
+    assert by_name["service_capacity"].status == health.PASS
+    assert np.max(np.abs(svc.mass_residual())) < 1e-9
+
+
+def test_leave_detaches_ledgers_and_inflight():
+    """After a leave, the departed node's slots are fully scrubbed: free
+    edge slots are parked self-loops with zero ledgers and no in-flight
+    traffic — the dynamic pad-edge invariant."""
+    topo = ring(10, k=2, seed=0)
+    svc = ServiceEngine(topo, capacity=16, config=_cfg(),
+                        segment_rounds=4, degree_budget=6)
+    svc.run(12)
+    svc.leave([4])
+    free = np.asarray(sorted(svc._free_edges))
+    park = svc._park
+    assert (svc._src[free] == park).all()
+    assert (svc._dst[free] == park).all()
+    assert (svc._rev[free] == free).all()
+    assert not np.asarray(svc.state.flow)[free].any()
+    assert not np.asarray(svc.state.est)[free].any()
+    assert not np.asarray(svc.state.buf_valid)[:, free].any()
+    assert not np.asarray(svc.state.pending_valid)[:, free].any()
+    assert not np.asarray(svc.state.edge_ok)[free].any()
+    # survivors re-converge on the survivors' mean
+    svc.run(64)
+    ids, est = svc.estimates()
+    assert 4 not in ids
+    mean = np.asarray(svc.state.value)[np.asarray(svc.state.alive)].mean()
+    assert np.max(np.abs(est - mean)) < 1e-9
+
+
+def test_freed_edge_slots_reset_to_unit_delay():
+    """A latency-derived delay must not leak from a removed edge into a
+    later, unrelated edge that reuses its slot: detach resets freed
+    slots to the pad convention (unit delay)."""
+    import dataclasses
+
+    base = ring(8, k=1, seed=0)
+    topo = dataclasses.replace(
+        base, delay=np.full(base.num_edges, 3, np.int32))
+    cfg = RoundConfig(variant="collectall", fire_policy="every_round",
+                      delay_depth=4, dtype="float64")
+    svc = ServiceEngine(topo, capacity=10, config=cfg,
+                        segment_rounds=4, degree_budget=4)
+    e_uv = svc._edge_slot_of(0, 1)
+    assert int(svc._delay[e_uv]) == 3
+    svc.run(4)
+    svc.remove_edges([(0, 1)])
+    freed = {e_uv, int(svc._rev[e_uv])}
+    svc.add_edges([(0, 2)])   # not a ring-k=1 edge; reuses freed slots
+    e_new = svc._edge_slot_of(0, 2)
+    assert e_new in freed or int(svc._rev[e_new]) in freed
+    assert int(svc._delay[e_new]) == 1
+    assert int(np.asarray(svc.arrays.delay)[e_new]) == 1
+    svc.run(8)   # still runs clean with the mixed delays
+    assert svc.compile_count <= 1
+
+
+# ---- zero recompiles -----------------------------------------------------
+
+def test_compile_count_one_across_100_events():
+    topo = ring(24, k=2, seed=2)
+    svc = ServiceEngine(topo, capacity=40, config=_cfg(),
+                        segment_rounds=4, degree_budget=6,
+                        edge_capacity=160)
+    n0 = run_rounds._cache_size()
+    svc.run(4)
+    assert run_rounds._cache_size() == n0 + 1
+    rng = np.random.default_rng(0)
+    held = []
+    events = 0
+    while events < 110:
+        if held and (len(held) >= 12 or rng.random() < 0.4):
+            slot = held.pop()
+            svc.leave([slot])
+            events += 1
+        else:
+            slot = svc.join(float(rng.random()))
+            a = int(rng.choice(24))
+            svc.add_edges([(slot, a)])
+            svc.update([a], [float(rng.random())])
+            held.append(slot)
+            events += 3
+        svc.run(4)
+    assert svc.compile_count == 1
+    assert run_rounds._cache_size() == n0 + 1, \
+        "membership events must never retrace the round program"
+    # the doctor's SLO check agrees
+    by_name = {c.name: c for c in
+               health.check_service(svc.service_block(), dtype="float64")}
+    assert by_name["service_compile"].status == health.PASS
+    assert by_name["service_mass"].status == health.PASS
+
+
+# ---- durability ----------------------------------------------------------
+
+def test_service_checkpoint_roundtrip_bitexact(tmp_path):
+    topo = grid2d(4, 4, seed=3)
+    svc = ServiceEngine(topo, capacity=24, config=_cfg(),
+                        segment_rounds=8, degree_budget=8)
+    svc.run(16)
+    j = svc.join(0.9)
+    svc.add_edges([(j, 0)])
+    svc.leave([7])
+    svc.run(16)
+
+    path = str(tmp_path / "svc.npz")
+    svc.save_checkpoint(path)
+    twin = ServiceEngine.restore_checkpoint(path)
+    assert twin.capacity == svc.capacity
+    assert twin.member_count == svc.member_count
+
+    # identical continuation: same rounds, same events, same slots
+    for s in (svc, twin):
+        s.run(16)
+        slot = s.join(-0.25)
+        assert slot == 7, "free-list restore must reuse the same slot"
+        s.add_edges([(slot, 1)])
+        s.run(16)
+    for name in svc.state.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(svc.state, name)),
+            np.asarray(getattr(twin.state, name)),
+            err_msg=f"leaf {name} diverged after restore")
+    np.testing.assert_array_equal(svc._rows, twin._rows)
+    np.testing.assert_array_equal(svc._src, twin._src)
+
+
+def test_service_checkpoint_errors(tmp_path):
+    from flow_updating_tpu.utils import checkpoint as ck
+
+    topo = ring(8, k=1, seed=0)
+    svc = ServiceEngine(topo, capacity=10, config=_cfg(),
+                        segment_rounds=4)
+    path = str(tmp_path / "svc.npz")
+    svc.save_checkpoint(path)
+
+    # truncation: clear error naming the file, no raw zipfile traceback
+    clipped = str(tmp_path / "clipped.npz")
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(clipped, "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    with pytest.raises(ValueError, match="clipped.npz.*truncated"):
+        ServiceEngine.restore_checkpoint(clipped)
+
+    # a PLAIN run checkpoint is not a service checkpoint — named fix
+    plain = str(tmp_path / "plain.npz")
+    cfg = _cfg()
+    ck.save_checkpoint(plain, init_state(topo, cfg), cfg, topo=topo)
+    with pytest.raises(ValueError, match="not a service checkpoint"):
+        ServiceEngine.restore_checkpoint(plain)
+
+    # service schema version mismatch: file + both versions named
+    import numpy as _np
+
+    with _np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    manifest["service_version"] = 99
+    old = str(tmp_path / "old.npz")
+    ck._write_archive(old, manifest, arrays)
+    with pytest.raises(ValueError, match="old.npz.*service schema "
+                                         "version 99"):
+        ServiceEngine.restore_checkpoint(old)
+
+
+# ---- bounded-staleness reads ---------------------------------------------
+
+def test_estimates_bounded_staleness():
+    topo = ring(12, k=2, seed=1)
+    svc = ServiceEngine(topo, capacity=16, config=_cfg(),
+                        segment_rounds=8)
+    svc.run(8)
+    # poke a value out of band: a bounded-staleness read keeps serving
+    # the boundary sample (its age is 0 rounds), a fresh read sees it
+    svc.state = svc.state.replace(
+        value=svc.state.value.at[0].add(1.0))
+    ids_stale, est_stale = svc.estimates(max_staleness=100)
+    ids_fresh, est_fresh = svc.estimates()      # None = always fresh
+    assert abs((est_fresh[0] - est_stale[0]) - 1.0) < 1e-9
+    # service events invalidate the sample even at unchanged clock
+    svc.join(0.3)
+    ids3, _ = svc.estimates(max_staleness=10**9)
+    assert len(ids3) == len(ids_fresh) + 1
+
+
+# ---- validation ----------------------------------------------------------
+
+def test_capacity_and_validation_errors():
+    topo = ring(6, k=1, seed=0)
+    svc = ServiceEngine(topo, capacity=7, config=_cfg(),
+                        segment_rounds=4, degree_budget=2,
+                        edge_capacity=16)
+    j = svc.join(1.0)
+    with pytest.raises(RuntimeError, match="at capacity"):
+        svc.join(2.0)
+    with pytest.raises(ValueError, match="not a member"):
+        svc.leave([svc.capacity + 5])
+    with pytest.raises(ValueError, match="already present"):
+        svc.add_edges([(0, 1)])
+    with pytest.raises(ValueError, match="self-loop"):
+        svc.add_edges([(0, 0)])
+    with pytest.raises(RuntimeError, match="degree budget"):
+        svc.add_edges([(0, 3)])   # ring k=1: every node at degree 2
+    with pytest.raises(ValueError, match="no edge"):
+        svc.remove_edges([(0, 3)])
+    with pytest.raises(ValueError, match="rounds=6"):
+        svc.run(6)
+    with pytest.raises(ValueError, match="shape"):
+        svc.update([j], [[1.0, 2.0]])
+    # config domain errors name the offending knob
+    with pytest.raises(ValueError, match="collectall"):
+        ServiceEngine(topo, 8, config=RoundConfig.fast(variant="pairwise"))
+    with pytest.raises(ValueError, match="drain"):
+        ServiceEngine(topo, 8, config=RoundConfig.reference())
+    with pytest.raises(ValueError, match="capacity"):
+        ServiceEngine(topo, 4)
+
+
+# ---- shared churn implementation -----------------------------------------
+
+def test_membership_is_the_shared_churn_primitive():
+    """Engine.kill_nodes, the gossip-SGD trainer and the service's
+    suspend/resume all route through service.membership.set_alive."""
+    from flow_updating_tpu.service import membership
+
+    topo = ring(8, k=1, seed=0)
+    cfg = _cfg()
+    st = init_state(topo, cfg)
+    st2 = membership.set_alive(st, [2, 5], False)
+    assert not np.asarray(st2.alive)[[2, 5]].any()
+    np.testing.assert_array_equal(
+        np.asarray(st2.flow), np.asarray(st.flow))  # ledgers untouched
+
+    svc = ServiceEngine(topo, capacity=10, config=cfg, segment_rounds=4)
+    svc.suspend([2])
+    assert svc.live_count == 7 and svc.member_count == 8
+    svc.resume([2])
+    assert svc.live_count == 8
+
+
+# ---- serve CLI + manifest + doctor ---------------------------------------
+
+def test_serve_cli_manifest_and_doctor(tmp_path, capsys):
+    ev = tmp_path / "events.txt"
+    # the long quiet tail lets the residual decay to the float64 floor
+    # (doctor's final_report judges it against 64 ULPs of the mass; the
+    # in-flight wobble scales with the rmse, which keeps decaying)
+    ev.write_text(
+        "run 32\n"
+        "join 0.5\n"
+        "add-edge 16 0   # wire the new member in\n"
+        "run 32\n"
+        "leave 3\n"
+        "update 7 1.25\n"
+        "run 192\n")
+    rep = str(tmp_path / "svc.json")
+    ckpt = str(tmp_path / "svc.npz")
+    rc = cli_main(["serve", "--backend", "cpu",
+                   "--generator", "ring:16:2", "--capacity", "20",
+                   "--degree-budget", "6", "--segment-rounds", "32",
+                   "--dtype", "float64",
+                   "--events", str(ev), "--report", rep,
+                   "--checkpoint", ckpt])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert rc == 0
+    assert summary["compile_count"] <= 1
+    assert summary["live"] == 16   # 16 - 1 left + 1 joined
+    assert summary["joined"] == [16]
+    assert summary["report_path"] == rep
+
+    m = json.load(open(rep))
+    assert m["schema"] == "flow-updating-service-report/v1"
+    assert m["service"]["capacity"]["nodes"] == 20
+    assert m["service"]["event_counts"]["join"] == 1
+    assert len(m["service"]["epochs"]) == 3
+    assert m["telemetry"]["series"]["mass_residual"]
+
+    # doctor passes the manifest (service checks included)
+    rc = cli_main(["doctor", rep])
+    capsys.readouterr()
+    assert rc == 0
+
+    # bit-exact resume from the saved checkpoint via the CLI
+    rc = cli_main(["serve", "--backend", "cpu", "--resume", ckpt,
+                   "--rounds", "32"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    resumed = json.loads(out)
+    assert rc == 0
+    assert resumed["t"] == summary["t"] + 32
+
+    # event-script errors name the line
+    bad = tmp_path / "bad.txt"
+    bad.write_text("run 32\nfrobnicate 3\n")
+    with pytest.raises(SystemExit, match="line 2.*frobnicate"):
+        cli_main(["serve", "--backend", "cpu", "--generator", "ring:8:1",
+                  "--events", str(bad)])
+
+
+def test_bench_service_baseline_key_isolation(tmp_path, monkeypatch):
+    import bench
+
+    path = str(tmp_path / "baseline.json")
+    monkeypatch.setattr(bench, "MEASURED_PATH", path)
+    k16 = {"des_rounds_per_sec": 100.0, "nodes": 1344, "edges": 6144,
+           "des": {"rounds_per_sec": 100.0, "ticks": 10, "repeats": 3,
+                   "spread_pct": 5.0}}
+    bench.record_baseline("16", k16)
+    service_entry = {
+        "des_rounds_per_sec": 4000.0, "nodes": 1344, "edges": 6144,
+        "des": {"rounds_per_sec": 4000.0, "ticks": 256, "repeats": 3,
+                "spread_pct": 4.0}}
+    bench.record_baseline("16_service", service_entry)
+    data = json.load(open(path))
+    assert set(data) == {"k16", "k16_service"}
+    assert data["k16"]["des_rounds_per_sec"] == 100.0
+    assert bench.recorded_baseline("16_service") == 4000.0
