@@ -804,6 +804,105 @@ def run_service_bench(args) -> dict:
     }
 
 
+def run_scaling_bench(args) -> dict:
+    """The ``--scaling`` measurement body: the weak-scaling ladder
+    (fixed nodes per shard on the virtual CPU mesh) with the overlap
+    halo schedule as the headline.
+
+    Delegates to ``scripts/multichip_scaling.py --weak`` (each shard
+    count needs its own interpreter, and that script owns the timing +
+    parity harness), then records every clean multi-shard overlap row
+    under the stable ``<topo>_scale_s{S}`` baseline key — DISJOINT from
+    the bare ``k<N>`` single-device records, the ``k{k}_sweep_b{B}``
+    sweep keys and ``k16_service`` (same isolation discipline), so a
+    CPU-mesh ladder row can never shadow a single-device record.
+    """
+    import subprocess
+    import tempfile
+    import types
+
+    per = args.scaling_per_shard
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "multichip_scaling.py")
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "ladder.json")
+        cmd = [sys.executable, script, "--weak", str(per), "--weak-only",
+               "--shards", args.scaling_shards, "--out", out]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=5400)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"scaling ladder failed rc={proc.returncode}: "
+                f"{proc.stderr[-2000:]}")
+        with open(out) as f:
+            doc = json.load(f)
+    rows = [r for r in doc.get("results", [])
+            if r.get("ladder") == "weak"]
+    if not rows:
+        raise RuntimeError("scaling ladder produced no weak rows")
+    topo_name = rows[0]["topology"]
+    overlap = sorted((r for r in rows if r["path"] == "halo_overlap"
+                      and r["shards"] > 1), key=lambda r: r["shards"])
+    if not overlap:
+        raise RuntimeError("scaling ladder produced no overlap rows")
+    for r in overlap:
+        if r.get("noisy"):
+            continue   # a degraded timing never becomes the record
+        timing = r.get("timing")
+        if not timing:
+            continue   # no measured quality metadata, nothing to vouch
+        shim = types.SimpleNamespace(
+            num_nodes=r.get("nodes", 0),
+            num_edges=r.get("directed_edges", 0))
+        record_baseline(
+            f"{topo_name}_scale_s{r['shards']}",
+            baseline_entry(shim, {
+                "rounds_per_sec": r["rounds_per_sec"],
+                # the ladder's ACTUAL measurement parameters — the
+                # R-vs-2R harness reports them per row, so the quality
+                # floor and the 35% spread-validity gate judge what was
+                # really measured, never invented metadata
+                "ticks": timing["rounds"], "repeats": timing["repeats"],
+                "spread_pct": timing["spread_pct"],
+                "note": ("weak-scaling ladder overlap-halo row "
+                         "(virtual CPU mesh; scripts/"
+                         "multichip_scaling.py --weak)"),
+            }))
+    clean = [r for r in overlap if not r.get("noisy")]
+    # a degraded timing never becomes the headline either: prefer the
+    # largest-S CLEAN overlap row, and flag the result when none exists
+    head = (clean or overlap)[-1]
+    key = f"{topo_name}_scale_s{head['shards']}"
+    base_rps = recorded_baseline(key)
+    base_src = "recorded" if base_rps is not None else "measured"
+    if base_rps is None:
+        base_rps = head["rounds_per_sec"]
+    degraded = {} if clean else {
+        "ok": False, "degraded": "noisy_scaling_timing"}
+    return {
+        **degraded,
+        "metric": (f"halo-overlap rounds/sec, weak-scaling ladder "
+                   f"S={head['shards']} ({per} nodes/shard, "
+                   "virtual CPU mesh)"),
+        "value": round(head["rounds_per_sec"], 2),
+        "unit": "rounds/sec",
+        "backend": "cpu",
+        "vs_baseline": (round(head["rounds_per_sec"] / base_rps, 3)
+                        if base_rps else None),
+        "extra": {
+            "nodes": head.get("nodes"),
+            "per_shard_nodes": per,
+            "ladder": rows,
+            "per_chip_efficiency": head.get("per_chip_efficiency"),
+            "overlap_ratio": head.get("overlap_ratio"),
+            "baseline_rounds_per_sec": (round(base_rps, 4)
+                                        if base_rps else None),
+            "baseline_source": base_src,
+            "baseline_key": _baseline_key(key),
+        },
+    }
+
+
 #: generator-name abbreviations for stable baseline keys (ba100k_planned)
 _GEN_ABBREV = {"barabasi_albert": "ba", "erdos_renyi": "er",
                "community": "community", "fat_tree": "ft",
@@ -997,6 +1096,18 @@ def parse_args(argv=None):
     ap.add_argument("--segment-rounds", type=int, default=64,
                     help="with --service: compiled scan length between "
                          "membership event batches")
+    ap.add_argument("--scaling", action="store_true",
+                    help="weak-scaling ladder row: fixed nodes per shard "
+                         "on the virtual CPU mesh (scripts/"
+                         "multichip_scaling.py --weak), headline = the "
+                         "overlap halo schedule at the largest shard "
+                         "count; rows record under disjoint "
+                         "'<topo>_scale_s{S}' baseline keys that never "
+                         "shadow single-device records")
+    ap.add_argument("--scaling-per-shard", type=int, default=2048,
+                    help="with --scaling: nodes per shard (ER degree 8)")
+    ap.add_argument("--scaling-shards", default="1,2",
+                    help="with --scaling: comma-separated shard counts")
     ap.add_argument("--des-ticks", type=int, default=10,
                     help="timed baseline DES ticks (heap grows ~E per tick)")
     ap.add_argument("--des-repeats", type=int, default=3,
@@ -1028,6 +1139,17 @@ def parse_args(argv=None):
                          or args.profile):
         ap.error("--service is its own row: it cannot combine with "
                  "--sweep/--generator/--features/--profile")
+    if args.scaling and (args.sweep or args.service or args.generator
+                         or args.features or args.profile):
+        ap.error("--scaling is its own row: it cannot combine with "
+                 "--sweep/--service/--generator/--features/--profile")
+    if args.scaling and args.scaling_per_shard < 64:
+        ap.error("--scaling-per-shard must be >= 64")
+    if args.scaling and args.backend == "tpu":
+        ap.error("--scaling runs on the virtual CPU mesh (per-shard "
+                 "device counts need xla_force_host_platform_device_"
+                 "count child processes); a TPU ladder is not wired yet "
+                 "— drop --backend tpu")
     if args.service and args.segment_rounds < 1:
         ap.error("--segment-rounds must be >= 1")
     # reject impossible combinations HERE: in auto-backend mode a child-
@@ -1407,6 +1529,15 @@ def _run_child(extra_args, cpu_pinned: bool, timeout_s: float = 5400.0,
 
 def main():
     args = parse_args()
+
+    if args.scaling:
+        # the ladder is a virtual-CPU-mesh measurement by definition
+        # (scripts/multichip_scaling.py owns its per-S interpreters and
+        # timing harness) — no TPU probe, no backend child
+        result = run_scaling_bench(args)
+        result.setdefault("ok", True)   # all-noisy ladders stay flagged
+        print(json.dumps(result))
+        return
 
     if os.environ.get(_CHILD_ENV) or args.backend != "auto":
         # settled backend (or explicitly forced): measure and print.

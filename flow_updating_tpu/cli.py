@@ -131,8 +131,16 @@ def _add_kernel_flags(ap: argparse.ArgumentParser) -> None:
                          "shards dividing k; one (k/2,)-element psum "
                          "per round)")
     ap.add_argument("--halo", default="ppermute",
-                    choices=("ppermute", "allgather"),
-                    help="halo kernel's cut-edge exchange collective")
+                    choices=("ppermute", "allgather", "overlap",
+                             "overlap_pallas", "auto"),
+                    help="halo kernel's cut-edge exchange: 'ppermute' "
+                         "point-to-point, 'allgather' broadcast, "
+                         "'overlap' interior/frontier-split schedule "
+                         "(wire hidden behind interior compute; "
+                         "bit-exact vs ppermute), 'overlap_pallas' the "
+                         "split schedule on the Pallas async-remote-"
+                         "copy kernel (TPU), 'auto' ranked from the "
+                         "plan's measured cut-edge bytes")
     ap.add_argument("--partition", default="bfs",
                     choices=("bfs", "contiguous"),
                     help="halo kernel's node partition order")

@@ -105,8 +105,15 @@ class Engine:
         #   'halo' — the explicitly scheduled shard_map halo-exchange
         #            kernel (parallel/sharded.py): edges live with their
         #            source shard, only cut-edge payloads cross chips
-        #            (``halo``: 'ppermute' point-to-point or 'allgather'
-        #            broadcast; ``partition``: 'bfs' or 'contiguous').
+        #            (``halo``: 'ppermute' point-to-point, 'allgather'
+        #            broadcast, 'overlap' interior/frontier-split
+        #            schedule that hides the wire behind interior
+        #            compute [bit-exact vs ppermute], 'overlap_pallas'
+        #            the same schedule with the Pallas async-remote-copy
+        #            kernel carrying the wire, or 'auto' — ranked from
+        #            the plan's measured cut-edge bytes
+        #            [plan.select.select_halo_mode, recorded in
+        #            halo_report()]; ``partition``: 'bfs'/'contiguous').
         #   'pod'  — the pod-sharded fat-tree stencil
         #            (parallel/structured_sharded.py): node kernel,
         #            spmv='structured', fat-tree topologies with S | k;
@@ -123,6 +130,11 @@ class Engine:
         #   an ExecutionPlan / PlanDecision instance — use it as-is.
         if multichip not in ("auto", "halo", "pod"):
             raise ValueError(f"unknown multichip mode {multichip!r}")
+        if halo not in ("ppermute", "allgather", "overlap",
+                        "overlap_pallas", "auto"):
+            raise ValueError(
+                f"unknown halo mode {halo!r}: use 'ppermute', "
+                "'allgather', 'overlap', 'overlap_pallas', or 'auto'")
         if isinstance(plan, str):
             if plan not in ("off", "auto"):
                 raise ValueError(
@@ -156,6 +168,8 @@ class Engine:
         self._killed = False
         self._n_real: int | None = None   # real node count when mesh-padded
         self._halo_plan = None
+        self._halo_resolved = None  # halo='auto' resolution (set at build)
+        self.halo_decision = None   # select_halo_mode evidence when 'auto'
         self.plan_spec = plan
         self.plan_decision = None   # PlanDecision once build() resolved it
         self._plan = None           # ExecutionPlan handed to the NodeKernel
@@ -312,6 +326,45 @@ class Engine:
             and self._custom_actor is None
 
     @property
+    def _ledger_dtype_bytes(self) -> int:
+        """Bytes per ledger element on the halo wire (the flow/estimate
+        payload dtype) — the ONE accounting shared by the halo='auto'
+        ranking and halo_report()'s evidence, so the recorded decision
+        evidence can never use different byte counts than the decision
+        itself."""
+        return 8 if self.config.dtype == "float64" else 4
+
+    @property
+    def _halo_wire(self) -> str:
+        """The concrete exchange mode the halo kernel dispatches with
+        (``halo='auto'`` resolves at build from the plan's measured
+        cut-edge bytes; before build, the serialized default)."""
+        if self._halo_resolved is not None:
+            return self._halo_resolved
+        return "ppermute" if self.halo == "auto" else self.halo
+
+    def halo_report(self) -> dict | None:
+        """JSON-ready record of the halo exchange decision: the
+        requested and resolved modes, the schedule the program actually
+        executes (``'overlap'`` may rewrite to ``'overlap_full'`` at
+        plan time on fat frontiers), plus ``select_halo_mode``'s
+        evidence when 'auto' did the choosing.  None off the halo
+        path."""
+        if not self._halo_mode or self._halo_plan is None:
+            return None
+        from flow_updating_tpu.parallel import overlap as _ovl
+
+        out = {"requested": self.halo, "resolved": self._halo_wire,
+               "schedule": _ovl.resolve_mode(self._halo_plan,
+                                             self._halo_wire),
+               "partition": self.partition,
+               **self._halo_plan.collective_bytes_per_round(
+                   self._ledger_dtype_bytes)}
+        if self.halo_decision is not None:
+            out["decision"] = self.halo_decision
+        return out
+
+    @property
     def _pod_mode(self) -> bool:
         return self.mesh is not None and self.multichip == "pod" \
             and self._custom_actor is None
@@ -424,8 +477,21 @@ class Engine:
                 partition=self.partition,
                 coloring=self.config.needs_coloring,
             )
+            if self.halo == "auto":
+                from flow_updating_tpu.plan.select import select_halo_mode
+
+                self.halo_decision = select_halo_mode(
+                    self._halo_plan,
+                    dtype_bytes=self._ledger_dtype_bytes)
+                self._halo_resolved = self.halo_decision["halo"]
+                logger.info("halo auto: %s", self.halo_decision["reason"])
+            else:
+                self._halo_resolved = self.halo
             self._halo_arrays = sharded.plan_device_arrays(
-                self._halo_plan, self.mesh)
+                self._halo_plan, self.mesh,
+                # the overlap split tables are built only when the
+                # resolved wire dispatches through them
+                halo=self._halo_resolved)
             self._topo_arrays = None
             return
         if self.config.kernel == "node":
@@ -448,7 +514,12 @@ class Engine:
                         "it requires spmv='structured'"
                     )
                 self._node_kernel = PodShardedFatTreeKernel(
-                    self.topology, self.config, self.mesh
+                    self.topology, self.config, self.mesh,
+                    # the pod stencil's overlap schedule is the same
+                    # math reordered (early psum, core last): free to
+                    # take whenever overlap is requested or auto-picked
+                    overlap=self.halo in ("overlap", "overlap_pallas",
+                                          "auto"),
                 )
             elif self.mesh is not None and self.config.spmv == "benes_fused":
                 from flow_updating_tpu.parallel.spmv_sharded import (
@@ -1031,7 +1102,7 @@ class Engine:
 
             self.state = sharded.run_rounds_sharded(
                 self.state, self._halo_plan, self.config, self.mesh, n,
-                arrays=self._halo_arrays, halo=self.halo)
+                arrays=self._halo_arrays, halo=self._halo_wire)
         elif self._node_like:
             self.state = self._node_kernel.run(self.state, n)
         else:
@@ -1098,7 +1169,7 @@ class Engine:
 
             state, series = sharded.run_rounds_sharded_telemetry(
                 self.state, self._halo_plan, self.config, self.mesh, n,
-                spec, mean, arrays=self._halo_arrays, halo=self.halo)
+                spec, mean, arrays=self._halo_arrays, halo=self._halo_wire)
         elif kind == "pod":
             state, series = self._node_kernel.run_telemetry(
                 self.state, n, spec)
@@ -1187,7 +1258,7 @@ class Engine:
 
             state, conv_b, series = sharded.run_rounds_sharded_fields(
                 self.state, self._halo_plan, self.config, self.mesh, n,
-                spec, mean, arrays=self._halo_arrays, halo=self.halo)
+                spec, mean, arrays=self._halo_arrays, halo=self._halo_wire)
             series = jax.device_get(series)
             t = np.asarray(series.pop("t"))[0]
             active = np.asarray(series.pop("active"))[0]
@@ -1316,7 +1387,7 @@ class Engine:
 
             fn, args, nd = sharded.round_program(
                 self.state, self._halo_plan, self.config, self.mesh, n,
-                arrays=self._halo_arrays, halo=self.halo)
+                arrays=self._halo_arrays, halo=self._halo_wire)
         elif kind == "pod":
             fn, args, nd = self._node_kernel.round_program(self.state, n)
         elif kind == "node":
@@ -1349,9 +1420,21 @@ class Engine:
                        "dtype": self.config.dtype,
                        "multichip": (self.multichip
                                      if self.mesh is not None else None),
+                       "halo": (self._halo_wire if kind == "halo"
+                                else None),
                        "shards": (int(self.mesh.devices.size)
                                   if self.mesh is not None else 0)},
         })
+        if kind == "halo":
+            record["halo"] = self.halo_report()
+            if self._halo_wire in ("overlap", "overlap_pallas"):
+                # overlap-mode manifests carry the measured overlap
+                # ratio (fraction of exchange time hidden behind the
+                # interior pass)
+                record["overlap"] = _prof.overlap_report(
+                    self.state, self._halo_plan, self.config, self.mesh,
+                    n, arrays=self._halo_arrays, execute=execute,
+                    mode=self._halo_wire)
         return record
 
     def run_until_rmse(

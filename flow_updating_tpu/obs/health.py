@@ -422,6 +422,95 @@ def check_plan(plan: dict | None, measured: dict | None = None, *,
         f"(within {margin_pct:g}%)", ev)
 
 
+def scaling_row_efficiency(row: dict, base_rate: float | None) -> float | None:
+    """Per-chip efficiency of one ladder row, as a fraction.
+
+    Rows written by ``scripts/multichip_scaling.py --weak`` carry
+    ``per_chip_efficiency`` directly (weak scaling: ideal rate is FLAT
+    as nodes grow with shards, so efficiency = rate_S / rate_1).  Rows
+    without it are strong-scaling rows on a fixed topology — ideal rate
+    is S x the single-shard rate, so efficiency = rate_S / (S *
+    rate_1), computable only when the same (path, topology) has an S=1
+    row."""
+    eff = row.get("per_chip_efficiency")
+    if eff is not None:
+        return float(eff)
+    rate = row.get("rounds_per_sec")
+    S = int(row.get("shards", 1))
+    if base_rate is None or base_rate <= 0 or rate is None or S < 2:
+        return None
+    return float(rate) / (S * base_rate)
+
+
+def scaling_base_rates(rows) -> dict:
+    """Clean (non-noisy) S=1 anchor rates keyed by ``(path, topology)``
+    — THE base map for per-chip efficiency, shared by the doctor's
+    ``scaling_efficiency`` check and the ``regress`` CI gate so both
+    layers judge the same row set with the same quarantine rule (a
+    degraded baseline timing never anchors a ratio)."""
+    base = {}
+    for r in rows:
+        if not isinstance(r, dict) or r.get("noisy"):
+            continue
+        if int(r.get("shards", 0)) == 1 and \
+                isinstance(r.get("rounds_per_sec"), (int, float)):
+            base[(r.get("path"), r.get("topology"))] = \
+                float(r["rounds_per_sec"])
+    return base
+
+
+def check_scaling_efficiency(doc: dict, *, threshold_pct: float = 50.0
+                             ) -> CheckResult:
+    """Audit a ``MULTICHIP_SCALING_*`` ladder: warn when any shard
+    count's per-chip efficiency drops below ``threshold_pct``, citing
+    the offending path/topology row — the scaling analogue of the
+    ``plan_selection`` check.  Rows flagged ``noisy`` (timing never met
+    the spread gate) are quarantined: counted, never judged."""
+    name = "scaling_efficiency"
+    rows = doc.get("results") if isinstance(doc, dict) else None
+    if not isinstance(rows, list) or not rows:
+        return CheckResult(name, SKIP, "no scaling rows to judge")
+    base = scaling_base_rates(rows)
+    judged, bad, noisy = 0, [], 0
+    for r in rows:
+        if not isinstance(r, dict) or int(r.get("shards", 1)) < 2:
+            continue
+        eff = scaling_row_efficiency(
+            r, base.get((r.get("path"), r.get("topology"))))
+        if eff is None:
+            continue
+        if r.get("noisy"):
+            noisy += 1
+            continue
+        judged += 1
+        if 100.0 * eff < threshold_pct:
+            bad.append({"path": r.get("path"),
+                        "topology": r.get("topology"),
+                        "shards": int(r.get("shards", 0)),
+                        "efficiency_pct": round(100.0 * eff, 1)})
+    ev = {"threshold_pct": threshold_pct, "rows_judged": judged,
+          "noisy_quarantined": noisy, "violations": bad}
+    if not judged and not noisy:
+        return CheckResult(
+            name, SKIP,
+            "no multi-shard row carries a computable per-chip "
+            "efficiency (need per_chip_efficiency or an S=1 row of the "
+            "same path/topology)", ev)
+    if bad:
+        worst = min(bad, key=lambda b: b["efficiency_pct"])
+        return CheckResult(
+            name, WARN,
+            f"per-chip efficiency below {threshold_pct:g}% on "
+            f"{len(bad)} row(s) — worst {worst['path']} / "
+            f"{worst['topology']} at S={worst['shards']}: "
+            f"{worst['efficiency_pct']:g}%", ev)
+    return CheckResult(
+        name, PASS,
+        f"all {judged} multi-shard rows at or above {threshold_pct:g}% "
+        f"per-chip efficiency"
+        + (f" ({noisy} noisy rows quarantined)" if noisy else ""), ev)
+
+
 def _epoch_tol(sample: dict, scale: float, dtype: str | None,
                inflight_factor: float = 2.0) -> float:
     """Per-epoch mass tolerance: float roundoff at the mass magnitude
@@ -686,6 +775,12 @@ def diagnose_manifest(manifest: dict) -> list:
     service = manifest.get("service")
     if isinstance(service, dict):
         checks.extend(check_service(service, dtype=dtype))
+    results = manifest.get("results")
+    if (isinstance(results, list) and results
+            and isinstance(results[0], dict)
+            and "rounds_per_sec" in results[0]):
+        # a MULTICHIP_SCALING_* ladder artifact
+        checks.append(check_scaling_efficiency(manifest))
     instances = manifest.get("instances")
     if isinstance(instances, list) and instances:
         n_conv = sum(1 for r in instances

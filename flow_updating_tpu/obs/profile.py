@@ -32,9 +32,60 @@ question the compile-cache counters exist to answer.
 
 from __future__ import annotations
 
+import re
 import time
 
 import numpy as np
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4,
+    "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+_COLLECTIVES = ("all-gather", "all-reduce", "collective-permute",
+                "reduce-scatter", "all-to-all")
+# `f32[8,522]{1,0} all-gather(...)`; tuple-shaped collectives list every
+# element shape: `(f32[522]{0}, f32[522]{0}) all-reduce(...)`
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# sync form ` = <shape> <kind>(`; async lowering splits each op into a
+# `<kind>-start`/`<kind>-done` pair (see hlo_collective_bytes)
+_COLLECTIVE_RE = re.compile(
+    r"= (.+?) (" + "|".join(_COLLECTIVES) + r")(-start|-done)?\(")
+
+
+def hlo_collective_bytes(hlo_text: str) -> dict:
+    """Sum output bytes of collective ops in optimized HLO, by op kind.
+
+    A ``lax.scan`` body appears once in HLO but executes every round, so
+    on a round-scan program this is PER-ROUND, PER-SHARD traffic (plus
+    any one-time prologue collectives, negligible and included).  Used
+    by ``scripts/multichip_scaling.py`` and by the planned-vs-actual
+    byte budget assertion in ``tests/test_parallel.py``."""
+    per_kind: dict = {k: 0 for k in _COLLECTIVES}
+    count = 0
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        # async pairs (TPU, or CPU/GPU with async collectives — the
+        # overlap regime) are counted at the -done, whose output is the
+        # result shape alone (the -start's tuple aliases the operand
+        # buffers and would double-count)
+        m = _COLLECTIVE_RE.search(s)
+        if not m or m.group(3) == "-start":
+            continue
+        shapes, kind = m.group(1), m.group(2)
+        nbytes = 0
+        for dt, dims in _SHAPE_RE.findall(shapes):
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES[dt]
+        per_kind[kind] += nbytes
+        count += 1
+    return {"total": sum(per_kind.values()), "ops": count,
+            **{k: v for k, v in per_kind.items() if v}}
 
 #: process-wide AOT-executable cache counters (every record carries a
 #: snapshot; reset_cache() zeroes them — test isolation)
@@ -227,6 +278,85 @@ def profile_program(fn, args=(), *, n_dynamic=None, execute=True,
         },
         "device_memory_stats": device_memory_stats(device),
     }
+
+
+def overlap_report(state, plan, cfg, mesh, rounds: int, *, arrays=None,
+                   repeats: int = 3, execute: bool = True,
+                   mode: str = "overlap") -> dict:
+    """Overlap ratio of the halo kernel's split schedule: the fraction
+    of the cut-edge exchange time hidden behind interior compute.
+
+    Times three compilations of the SAME round scan — ``'ppermute'``
+    (the serialized oracle), ``mode`` (the overlap schedule the run
+    actually dispatches: ``'overlap'`` or ``'overlap_pallas'``), and
+    ``'interior'`` (the schedule with the exchange elided, a
+    timing-only probe) — best of ``repeats`` executions each, and
+    reports::
+
+        exchange_s = t_ppermute - t_interior   (the serialized wire)
+        hidden_s   = t_ppermute - t_overlap    (what the split saved)
+        overlap_ratio = hidden_s / exchange_s  (clamped to [0, 1])
+
+    On a backend without async collectives (XLA:CPU) the ratio honestly
+    reads ~0 — the schedule is testable everywhere but only hides wire
+    time where the hardware can overlap it.  Attached to halo-mode
+    profile manifests by :meth:`Engine.profile`."""
+    from flow_updating_tpu.parallel import overlap as _ovl
+    from flow_updating_tpu.parallel import sharded
+
+    if mode not in ("overlap", "overlap_pallas"):
+        raise ValueError(f"overlap_report measures an overlap schedule; "
+                         f"got mode={mode!r}")
+    times: dict = {}
+    for m in ("ppermute", mode, "interior"):
+        fn, args, nd = sharded.round_program(
+            state, plan, cfg, mesh, rounds, arrays=arrays, halo=m,
+            _internal=(m == "interior"))
+        best = None
+        for _ in range(max(int(repeats), 1)):
+            rec = profile_program(fn, args, n_dynamic=nd,
+                                  execute=execute, label=f"halo:{m}")
+            t = rec["timings"]["execute_s"]
+            if t is not None:
+                best = t if best is None else min(best, t)
+            if not execute:
+                break
+        times[m] = best
+    out = {"rounds": int(rounds), "mode": mode,
+           "schedule": _ovl.resolve_mode(plan, mode),
+           "execute_s": {k: (round(v, 6) if v is not None else None)
+                         for k, v in times.items()},
+           "note": (f"overlap_ratio = (t_ppermute - t_{mode}) / "
+                    "(t_ppermute - t_interior); 'interior' is a "
+                    "timing-only probe with the exchange elided")}
+    if any(v is None for v in times.values()):
+        out.update({"exchange_s": None, "hidden_s": None,
+                    "overlap_ratio": None})
+        return out
+    exchange, hidden, ratio = overlap_ratio_from_times(
+        times["ppermute"], times[mode], times["interior"])
+    out.update({"exchange_s": round(exchange, 6),
+                "hidden_s": round(hidden, 6),
+                "overlap_ratio": (round(ratio, 3)
+                                  if ratio is not None else None)})
+    return out
+
+
+def overlap_ratio_from_times(t_serial: float, t_overlap: float,
+                             t_interior: float):
+    """``(exchange_s, hidden_s, overlap_ratio)`` from the three schedule
+    timings — THE definition of the hidden fraction, shared by
+    :func:`overlap_report` and the weak-scaling ladder so the manifest-
+    embedded and banked figures can never use different formulas.
+    ``overlap_ratio`` is None when the serialized wire cost is inside
+    timing noise."""
+    import math
+
+    exchange = max(t_serial - t_interior, 0.0)
+    hidden = max(t_serial - t_overlap, 0.0)
+    ratio = (max(0.0, min(hidden / exchange, 1.0))
+             if exchange > 1e-9 and math.isfinite(exchange) else None)
+    return exchange, hidden, ratio
 
 
 def per_round(record: dict, rounds: int) -> dict:

@@ -21,6 +21,12 @@ Two comparison shapes:
   of the compiled program — deterministic, so any growth beyond the
   margin is a real cost regression, not noise; wall times are judged
   only at a much coarser margin.
+* **scaling**: a fresh ``MULTICHIP_SCALING_*`` ladder vs the banked
+  ladder history — per-chip efficiency per (path, topology, shards)
+  key.  Efficiency is a rate RATIO (rate_S over rate_1 on the same
+  harness), so it gates across machines where raw rounds/s cannot;
+  rows flagged ``noisy`` are quarantined on both sides, exactly like
+  degraded bench artifacts.
 """
 
 from __future__ import annotations
@@ -126,6 +132,102 @@ def compare_bench(fresh: dict, history, *, margin_pct: float | None = None,
                         f"{verdict}", ev)]
 
 
+def load_scaling_history(pattern: str) -> list:
+    """``(path, doc)`` for every parseable scaling-ladder artifact
+    matching ``pattern`` (docs shaped ``{"meta":…, "results":[…]}``)."""
+    out = []
+    for path in sorted(_glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("results"), list):
+            out.append((path, doc))
+    return out
+
+
+def _efficiency_rows(doc: dict) -> dict:
+    """Clean (non-noisy) multi-shard rows' per-chip efficiency, keyed by
+    ``(path, topology, shards)``.  Rows flagged ``noisy`` are
+    quarantined exactly like degraded bench artifacts — never gated,
+    never the record (the BENCH_* convention)."""
+    from flow_updating_tpu.obs.health import (
+        scaling_base_rates,
+        scaling_row_efficiency,
+    )
+
+    base = scaling_base_rates(doc.get("results", []))
+    rows = {}
+    for r in doc.get("results", []):
+        if not isinstance(r, dict) or r.get("noisy") \
+                or int(r.get("shards", 1)) < 2:
+            continue
+        eff = scaling_row_efficiency(
+            r, base.get((r.get("path"), r.get("topology"))))
+        if eff is not None:
+            rows[(r.get("path"), r.get("topology"),
+                  int(r["shards"]))] = eff
+    return rows
+
+
+def compare_scaling(fresh: dict, history, *, margin_pct: float | None = None,
+                    floor_pct: float = FLOOR_PCT) -> list:
+    """Gate a fresh scaling ladder's per-chip efficiency against the
+    banked ``MULTICHIP_SCALING_*`` history — scaling losses fail CI
+    like any perf regression.  Efficiency is a rate RATIO, so it
+    travels across machines far better than raw rounds/s; the allowed
+    drop below the best recorded value is the larger of the history's
+    own spread and the noise floor, per (path, topology, shards) key."""
+    name = "scaling_regression"
+    fresh_rows = _efficiency_rows(fresh)
+    if not fresh_rows:
+        return [CheckResult(
+            name, SKIP,
+            "fresh ladder carries no gateable per-chip efficiency rows "
+            "(noisy rows are quarantined; S=1 rows are the baseline)")]
+    hist_rows = [(p, _efficiency_rows(d)) for p, d in history]
+    checks = []
+    for key, eff in sorted(fresh_rows.items()):
+        same = [(p, rows[key]) for p, rows in hist_rows if key in rows]
+        label = f"{key[0]}/{key[1]}@S={key[2]}"
+        if not same:
+            checks.append(CheckResult(
+                name, SKIP, f"no efficiency history for {label}",
+                {"key": list(key)}))
+            continue
+        values = [v for _, v in same]
+        best = max(values)
+        best_path = next(p for p, v in same if v == best)
+        spread = (100.0 * (best - min(values)) / best) if best > 0 else 0.0
+        allowed = (margin_pct if margin_pct is not None
+                   else max(spread, floor_pct))
+        drop = 100.0 * (best - eff) / best if best > 0 else 0.0
+        ev = {"key": list(key), "fresh_efficiency": round(eff, 4),
+              "best_efficiency": round(best, 4),
+              "best_artifact": os.path.basename(best_path),
+              "history_runs": len(same),
+              "history_spread_pct": round(spread, 1),
+              "allowed_drop_pct": round(allowed, 1),
+              "drop_pct": round(drop, 1)}
+        if drop > allowed:
+            checks.append(CheckResult(
+                name, FAIL,
+                f"scaling regression on {label}: per-chip efficiency "
+                f"{100 * eff:.1f}% is {drop:.1f}% below the best "
+                f"recorded {100 * best:.1f}% "
+                f"({os.path.basename(best_path)}), beyond the "
+                f"{allowed:.1f}% spread", ev))
+        else:
+            verdict = ("new best" if eff >= best
+                       else f"within {allowed:.1f}% of the record")
+            checks.append(CheckResult(
+                name, PASS,
+                f"{label}: {100 * eff:.1f}% per-chip efficiency — "
+                f"{verdict}", ev))
+    return checks
+
+
 def _profile_block(doc: dict) -> dict | None:
     """The attribution record inside either a bare ``Engine.profile``
     dict or a profile manifest."""
@@ -210,12 +312,18 @@ def compare_profile(fresh: dict, against: dict, *,
 def gate(fresh: dict, *, history_pattern: str | None = None,
          against: dict | None = None,
          margin_pct: float | None = None) -> list:
-    """Dispatch on document shape: profile manifests compare against a
-    reference manifest; bench lines compare against the artifact
-    history."""
+    """Dispatch on document shape: scaling ladders gate per-chip
+    efficiency against the ``MULTICHIP_SCALING_*`` history; profile
+    manifests compare against a reference manifest; bench lines compare
+    against the artifact history."""
     if isinstance(fresh, dict) and "metric" not in fresh \
             and isinstance(fresh.get("parsed"), dict):
         fresh = fresh["parsed"]  # driver-wrapped artifact
+    if isinstance(fresh, dict) and isinstance(fresh.get("results"), list):
+        # a MULTICHIP_SCALING_* ladder: gate per-chip efficiency
+        history = load_scaling_history(
+            history_pattern or "MULTICHIP_SCALING_*.json")
+        return compare_scaling(fresh, history, margin_pct=margin_pct)
     if _profile_block(fresh) is not None and against is not None:
         return compare_profile(fresh, against,
                                **({"margin_pct": margin_pct}

@@ -1,0 +1,184 @@
+"""Pallas TPU halo exchange: async remote DMA hidden behind interior work.
+
+The halo kernel's cut-edge exchange (:mod:`flow_updating_tpu.parallel.
+sharded`) ships each shard's boundary payload block to one neighbor per
+plan-time offset.  As ``lax.ppermute`` ops those collectives serialize
+against the round compute unless XLA's latency-hiding scheduler splits
+them; this module is the TPU-native alternative — the SNIPPETS.md
+[1]/[2] right-permute recipe: a ``pl.pallas_call`` running *inside*
+``shard_map`` that
+
+1. **starts** one ``pltpu.make_async_remote_copy`` per shard offset
+   (send/recv DMA semaphores in scratch, logical device ids from
+   ``lax.axis_index``),
+2. **merges while the DMA is in flight** — the intra-shard delivery
+   merge, i.e. every ring-buffer write that does not touch a cut edge,
+   expressed in the receiver-pull (gather) form so it is a dense
+   elementwise select over the ``(D, Eb)`` buffers, and
+3. **waits** on the receive semaphores, handing the received frontier
+   blocks back for the caller to scatter into the cut edges' slots.
+
+The merge is the only work that can sit in the DMA window: a
+``pallas_call`` is a synchronous custom call whose scratch semaphores
+die with the kernel, so ``start()`` and ``wait()`` must share one
+invocation, and the merge operands are the round's fire outputs — the
+kernel therefore launches *after* the interior deliver/fire pass and
+hides the wire behind the O(D*Eb) merge, not the whole interior.  The
+full-interior window is ``halo='overlap'``'s (XLA async ppermutes);
+widening this kernel's window means moving deliver/fire into Pallas.
+
+Semantics are exactly ``lax.ppermute(payload, [(s, (s+d) % S)])`` per
+offset plus the unfused buffer merge — pinned bit-for-bit by
+``tests/test_overlap.py`` in Pallas **interpret mode** on the virtual
+CPU mesh (interpret mode executes the real remote-copy semantics, so
+the shipped kernel is the tested kernel).  Off-TPU callers default to
+interpret mode; the production CPU/GPU path is the ``halo='overlap'``
+ppermute schedule in :mod:`flow_updating_tpu.parallel.overlap`, which
+XLA's async collectives overlap natively.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _exchange_kernel(*refs, offsets, axis_name, axis_size, n_extra):
+    """Kernel body: start every offset's remote copy, run the interior
+    merge while the wire is busy, wait.  ``refs`` lays out as::
+
+        [pay_0 .. pay_{k-1},  extra_in...,        # inputs
+         recv_0 .. recv_{k-1}, extra_out...,      # outputs
+         send_sem_0, recv_sem_0, ...]             # scratch DMA semaphores
+
+    with ``extra`` the interior-merge operands (hit mask, payload
+    planes, ring buffers) when fused, empty for a pure exchange."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = len(offsets)
+    n_out_extra = 3 if n_extra else 0
+    pay = refs[:k]
+    extra_in = refs[k:k + n_extra]
+    recv = refs[k + n_extra:2 * k + n_extra]
+    extra_out = refs[2 * k + n_extra:2 * k + n_extra + n_out_extra]
+    sems = refs[2 * k + n_extra + n_out_extra:]
+
+    me = jax.lax.axis_index(axis_name)
+    ops = []
+    for i, d in enumerate(offsets):
+        nbr = jax.lax.rem(me + np.int32(d), np.int32(axis_size))
+        op = pltpu.make_async_remote_copy(
+            src_ref=pay[i],
+            dst_ref=recv[i],
+            send_sem=sems[2 * i],
+            recv_sem=sems[2 * i + 1],
+            device_id=nbr,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        op.start()
+        ops.append(op)
+
+    if n_extra:
+        # interior merge while the DMAs are in flight: receiver-pull
+        # delivery of intra-shard messages — hit[d, e] selects the
+        # sender's payload plane into the ring-buffer cell, elementwise
+        hit = extra_in[0][...]
+        pflow = extra_in[1][...]
+        pest = extra_in[2][...]
+        bflow = extra_in[3][...]
+        best_ = extra_in[4][...]
+        bvalid = extra_in[5][...]
+        hx = hit
+        while hx.ndim < bflow.ndim:
+            hx = hx[..., None]
+        extra_out[0][...] = jnp.where(hx, pflow[None], bflow)
+        extra_out[1][...] = jnp.where(hx, pest[None], best_)
+        extra_out[2][...] = bvalid | hit
+
+    for op in ops:
+        op.wait()
+
+
+def remote_block_exchange(payloads, offsets, *, axis_name, axis_size,
+                          interpret=None):
+    """Exchange one ``(L, H_d)`` payload block per shard offset.
+
+    ``payloads[i]`` is this shard's block for offset ``offsets[i]``;
+    returns the blocks received from shards ``(me - d) % S`` — exactly
+    ``[lax.ppermute(p, axis, [(s, (s+d) % S) for s in range(S)]) ...]``,
+    but through one Pallas kernel whose remote DMAs all start before any
+    completes.  With no merge workload there is nothing between
+    ``start()`` and ``wait()`` — the exchange itself is serialized (the
+    fast-pairwise caller's case); the overlap window belongs to
+    :func:`fused_exchange_merge`.  ``interpret=None`` auto-selects
+    interpret mode off-TPU.
+    """
+    return _call(payloads, offsets, extra=None, axis_name=axis_name,
+                 axis_size=axis_size, interpret=interpret)
+
+
+def fused_exchange_merge(payloads, offsets, hit, pay_flow, pay_est,
+                         buf_flow, buf_est, buf_valid, *, axis_name,
+                         axis_size, interpret=None):
+    """The fused overlap step: start every boundary DMA, merge the
+    intra-shard deliveries into the ring buffers while the wire is
+    busy, wait.  Returns ``(received_blocks, buf_flow, buf_est,
+    buf_valid)``; the merge is the receiver-pull form ``buf[d, e] =
+    hit[d, e] ? payload[e] : buf[d, e]`` — bit-identical to the
+    unfused scatter (targets are unique, writes are pure replacement).
+    """
+    extra = (hit, pay_flow, pay_est, buf_flow, buf_est, buf_valid)
+    out = _call(payloads, offsets, extra=extra, axis_name=axis_name,
+                axis_size=axis_size, interpret=interpret)
+    k = len(offsets)
+    return list(out[:k]), out[k], out[k + 1], out[k + 2]
+
+
+def _call(payloads, offsets, *, extra, axis_name, axis_size, interpret):
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    offsets = tuple(int(d) for d in offsets)
+    payloads = list(payloads)
+    if not payloads:
+        if extra is None:
+            return []  # no cut edges anywhere: nothing on the wire
+        raise ValueError("fused merge needs at least one offset block")
+    n_extra = 0 if extra is None else len(extra)
+    inputs = payloads + (list(extra) if extra else [])
+    out_shape = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in payloads]
+    if extra:
+        out_shape += [jax.ShapeDtypeStruct(extra[i].shape, extra[i].dtype)
+                      for i in (3, 4, 5)]
+    spec = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+    kwargs = {}
+    if not interpret:
+        # cross-chip DMA kernels need a collective id on real hardware;
+        # the param class moved across jax versions — best effort
+        params_cls = getattr(pltpu, "TPUCompilerParams", None)
+        if params_cls is not None:
+            kwargs["compiler_params"] = params_cls(collective_id=0)
+    out = pl.pallas_call(
+        functools.partial(_exchange_kernel, offsets=offsets,
+                          axis_name=axis_name, axis_size=int(axis_size),
+                          n_extra=n_extra),
+        out_shape=tuple(out_shape),
+        in_specs=[spec] * len(inputs),
+        out_specs=tuple([spec] * len(out_shape)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * (2 * len(offsets)),
+        interpret=interpret,
+        **kwargs,
+    )(*inputs)
+    return list(out) if isinstance(out, (tuple, list)) else [out]

@@ -1,0 +1,515 @@
+"""Interior/frontier-split round schedule: hide the halo wire behind compute.
+
+The plain halo round (:mod:`flow_updating_tpu.parallel.sharded`) is a
+straight line: deliver -> fire -> local scatter -> cut-edge exchange ->
+receive scatter, so every round pays the full wire latency serialized
+after the compute (``MULTICHIP_SCALING_r5.json``: the 2-shard
+``halo_allgather`` path runs at 223.7 r/s where one device does
+5,631).  This module re-schedules the round in the pipelined-gossip
+shape (arXiv:1504.03277, applied at the hardware layer):
+
+1. **frontier pass** — the *cut-edge payloads* are computed first, on a
+   compacted sub-problem containing exactly the frontier rows (nodes
+   owning at least one cut edge) and their out-edge rows.  Per-row
+   segment reductions see the same operands in the same order as the
+   full pass, so the payloads are bit-identical to the unsplit round's
+   (the decomposition parity asserted in ``tests/test_overlap.py``);
+2. **start the exchange** with those payloads — ``lax.ppermute`` per
+   plan-time shard offset (``halo='overlap'``: XLA's async collectives
+   overlap them with everything that follows), or the Pallas
+   ``make_async_remote_copy`` kernel (``halo='overlap_pallas'``,
+   :mod:`flow_updating_tpu.ops.pallas_halo`);
+3. **interior pass** — the full deliver/fire plus the intra-shard
+   delivery merge run while the wire is busy;
+4. **finish the frontier** — consume the received blocks into the cut
+   edges' ring-buffer slots.
+
+What each wire can actually hide differs.  ``'overlap'`` hides the
+whole interior pass: the ppermutes are issued before it and consumed
+after, so a backend with async collectives runs the wire under all of
+step 3.  ``'overlap_pallas'`` is a single synchronous ``pallas_call``,
+and only work *inside* the kernel sits between ``start()`` and
+``wait()`` — that work is the receiver-pull delivery merge, whose
+operands are the interior pass's fire outputs, so the DMAs necessarily
+issue after deliver/fire and the hidden window is the O(D*Eb) merge,
+not the full interior (fast pairwise has no merge, so its Pallas
+exchange is serialized).  Hiding all of step 3 in-kernel would mean
+writing deliver/fire in Pallas; until then ``'overlap'`` is the wider
+window and ``'overlap_pallas'`` is the fused-DMA form of the same
+bit-exact schedule.
+
+The schedule only reorders independent ops: ``halo='overlap'`` is
+bit-exact against ``halo='ppermute'`` (same values, same merge order —
+asserted for every partition mode, scalar and vector payloads, and
+drop>0).  The frontier rows are recomputed by the interior pass (the
+redundancy is O(cut edges), the quantity the partition minimizes); the
+single-pass state always comes from the full-width pass.
+
+``halo='interior'`` is a **timing probe only**: it runs the identical
+schedule with the exchange elided (received payloads never arrive), so
+``t_ppermute - t_interior`` isolates the serialized wire cost and
+``obs.profile.overlap_report`` can report the hidden fraction.  It is
+not a correct protocol mode and the Engine refuses it.
+"""
+
+from __future__ import annotations
+
+import dataclasses as _dc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flow_updating_tpu.models.config import COLLECTALL
+from flow_updating_tpu.models.rounds import deliver_phase, fire_core
+from flow_updating_tpu.models.state import FlowUpdatingState, _ex
+from flow_updating_tpu.parallel.mesh import NODE_AXIS
+from flow_updating_tpu.parallel.sharded import (
+    _lanes,
+    _local_topo,
+    _unlanes,
+)
+from flow_updating_tpu.topology.graph import TopoArrays
+from flow_updating_tpu.utils import struct
+
+#: halo modes implemented by this module ('interior' is the timing
+#: probe; 'overlap_full' is the plan-time fat-frontier resolution of
+#: 'overlap' — see :func:`resolve_mode`)
+OVERLAP_MODES = ("overlap", "overlap_full", "overlap_pallas", "interior")
+
+#: halo mode -> wire implementation for the exchange step
+_WIRE = {"overlap": "ppermute", "overlap_full": "ppermute",
+         "overlap_pallas": "pallas", "interior": "none"}
+
+#: above this fraction of real edges in the frontier, the compact pass
+#: duplicates more deliver/fire work than the early wire start can hide
+#: — 'overlap' then resolves to 'overlap_full', whose full-width payload
+#: replay CSEs with the interior pass (one pass, ppermute-rate compute;
+#: the wire still issues as early as the data allows).  Thin frontiers —
+#: the regime the locality partition produces — keep the compact pass
+#: that makes the early DMA start real.
+COMPACT_FRONTIER_MAX_FRACTION = 0.5
+
+
+def resolve_mode(plan, halo: str) -> str:
+    """Plan-time schedule resolution for ``halo='overlap'``: compact
+    frontier pass when the frontier is thin, full-width payload replay
+    when it is fat (both bit-identical to ppermute; only the redundant
+    compute differs).  Other modes pass through.  The O(S*Eb) frontier
+    count is computed once per plan and cached on it (the plan is
+    immutable after construction; program builders re-resolve on every
+    call)."""
+    if halo != "overlap":
+        return halo
+    cached = getattr(plan, "_overlap_schedule", None)
+    if cached is not None:
+        return cached
+    a = plan.arrays
+    tl = np.asarray(a.tlocal)
+    real = tl < plan.Eb
+    ts = np.asarray(a.tshard)
+    own = np.arange(plan.num_shards, dtype=ts.dtype).reshape(-1, 1)
+    is_cut = (ts != own) & real
+    src = np.asarray(a.src_local)
+    frontier_edges = 0
+    for s in range(plan.num_shards):
+        rows = np.zeros(plan.Nb, bool)
+        rows[src[s, is_cut[s]]] = True
+        frontier_edges += int(rows[src[s]][real[s]].sum())
+    total = max(int(real.sum()), 1)
+    resolved = ("overlap" if frontier_edges <= COMPACT_FRONTIER_MAX_FRACTION
+                * total else "overlap_full")
+    object.__setattr__(plan, "_overlap_schedule", resolved)  # frozen-safe
+    return resolved
+
+
+@struct.dataclass
+class OverlapTables:
+    """Plan-time frontier/interior split metadata, stacked ``(S, ...)``.
+
+    The compact frontier sub-topology holds every frontier row's FULL
+    out-edge row (a row's fire decision needs all of its edges), in the
+    shard's slot order — so compacted per-row reductions replay the
+    full pass's addition order exactly.  Compact row ``Fn`` is the
+    dummy (dead) row that owns the padded entries, mirroring the main
+    kernel's ``Nb-1`` convention."""
+
+    f_nodes: jnp.ndarray     # (S, Fn+1) i32 local node id per compact row
+    #                          (pads + last entry = Nb-1, the dead dummy)
+    f_edges: jnp.ndarray     # (S, Fe) i32 edge slot per compact slot
+    #                          (ascending; pad = Eb sentinel)
+    f_src: jnp.ndarray       # (S, Fe) i32 compact row of each slot
+    #                          (pads -> Fn)
+    f_out_deg: jnp.ndarray   # (S, Fn+1) i32 real out-degree per row
+    f_row_start: jnp.ndarray  # (S, Fn+2) i32 compact CSR offsets
+    f_edge_rank: jnp.ndarray  # (S, Fe) i32 original within-row rank
+    f_delay: jnp.ndarray     # (S, Fe) i32
+    send_pos: tuple          # per offset: (S, Hd) i32 position of each
+    #                          ppermute send slot within f_edges (pad -> Fe)
+    lrev: jnp.ndarray        # (S, Eb) i32 intra-shard sender slot whose
+    #                          message lands in slot r (none -> Eb) — the
+    #                          receiver-pull form of the local delivery,
+    #                          the fused Pallas kernel's interior merge
+
+
+def build_overlap(plan) -> OverlapTables:
+    """Host-side construction from the existing partition metadata."""
+    a = plan.arrays
+    S, Eb, Nb = plan.num_shards, plan.Eb, plan.Nb
+    src = np.asarray(a.src_local)
+    ts = np.asarray(a.tshard)
+    tl = np.asarray(a.tlocal)
+    rank = np.asarray(a.edge_rank)
+    delay = np.asarray(a.delay)
+    out_deg = np.asarray(a.out_deg)
+    own = np.arange(S, dtype=ts.dtype).reshape(S, 1)
+    real = tl < Eb
+    is_cut = (ts != own) & real
+
+    fn_mask = np.zeros((S, Nb), bool)
+    for s in range(S):
+        fn_mask[s, src[s, is_cut[s]]] = True
+    fn_mask[:, Nb - 1] = False          # the dummy row is never frontier
+    fe_mask = fn_mask[np.arange(S)[:, None], src] & real
+    Fn = max(int(fn_mask.sum(1).max()), 1)
+    Fe = max(int(fe_mask.sum(1).max()), 1)
+
+    f_nodes = np.full((S, Fn + 1), Nb - 1, np.int32)
+    f_edges = np.full((S, Fe), Eb, np.int32)
+    f_src = np.full((S, Fe), Fn, np.int32)
+    f_out_deg = np.zeros((S, Fn + 1), np.int32)
+    f_row_start = np.zeros((S, Fn + 2), np.int32)
+    f_edge_rank = np.zeros((S, Fe), np.int32)
+    f_delay = np.ones((S, Fe), np.int32)
+    pos_of_slot = np.full((S, Eb + 1), Fe, np.int64)
+    lrev = np.full((S, Eb), Eb, np.int32)
+    for s in range(S):
+        rows = np.where(fn_mask[s])[0]
+        slots = np.where(fe_mask[s])[0]           # ascending = row-major
+        f_nodes[s, : len(rows)] = rows
+        f_edges[s, : len(slots)] = slots
+        pos_of_slot[s, slots] = np.arange(len(slots))
+        rank_of = np.full(Nb, Fn, np.int64)
+        rank_of[rows] = np.arange(len(rows))
+        f_src[s, : len(slots)] = rank_of[src[s, slots]]
+        f_out_deg[s, : len(rows)] = out_deg[s, rows]
+        counts = np.bincount(f_src[s, : len(slots)], minlength=Fn + 1)
+        counts[Fn] += Fe - len(slots)             # pads live in the dummy row
+        np.cumsum(counts, out=f_row_start[s, 1:])
+        f_edge_rank[s, : len(slots)] = rank[s, slots]
+        f_edge_rank[s, len(slots):] = np.arange(Fe - len(slots))
+        f_delay[s, : len(slots)] = delay[s, slots]
+        # receiver-pull map of the intra-shard delivery: slot r's local
+        # sender is the edge e with tshard[e] == s and tlocal[e] == r
+        loc = np.where((ts[s] == s) & real[s])[0]
+        lrev[s, tl[s, loc]] = loc
+
+    send_pos = tuple(
+        pos_of_slot[np.arange(S)[:, None],
+                    np.minimum(np.asarray(sidx), Eb)].astype(np.int32)
+        for sidx in (plan.perm_tables.send_idx if plan.perm_tables else ())
+    )
+    return OverlapTables(
+        f_nodes=f_nodes, f_edges=f_edges, f_src=f_src,
+        f_out_deg=f_out_deg, f_row_start=f_row_start,
+        f_edge_rank=f_edge_rank, f_delay=f_delay,
+        send_pos=send_pos, lrev=lrev,
+    )
+
+
+def frontier_interior_rows(plan) -> tuple[np.ndarray, np.ndarray]:
+    """Per-shard boolean masks ``(frontier, interior)`` over the real
+    local rows — disjoint, jointly exhaustive (the decomposition's row
+    coverage, asserted in tests)."""
+    a = plan.arrays
+    S, Eb, Nb = plan.num_shards, plan.Eb, plan.Nb
+    tl = np.asarray(a.tlocal)
+    ts = np.asarray(a.tshard)
+    src = np.asarray(a.src_local)
+    real = tl < Eb
+    is_cut = (ts != np.arange(S, dtype=ts.dtype).reshape(S, 1)) & real
+    frontier = np.zeros((S, Nb), bool)
+    for s in range(S):
+        frontier[s, src[s, is_cut[s]]] = True
+    frontier[:, Nb - 1] = False
+    alive_rows = np.zeros((S, Nb), bool)
+    for s in range(S):
+        alive_rows[s, src[s, real[s]]] = True
+    alive_rows[:, Nb - 1] = False
+    return frontier, alive_rows & ~frontier
+
+
+# ---- compact frontier pass ----------------------------------------------
+
+def _ftopo(ov: OverlapTables) -> TopoArrays:
+    # rev is a placeholder: the frontier pass never delivers (that is
+    # the exchange's job), mirroring _local_round's ltopo convention
+    return TopoArrays(
+        src=ov.f_src, dst=ov.f_src, rev=ov.f_src,
+        out_deg=ov.f_out_deg, row_start=ov.f_row_start,
+        edge_rank=ov.f_edge_rank, delay=ov.f_delay,
+    )
+
+
+def _frontier_state(st: FlowUpdatingState, ov: OverlapTables,
+                    Eb: int) -> FlowUpdatingState:
+    """Gather the frontier rows' state (compact layout).  Pad slots are
+    clamped gathers whose edges belong to the dead compact dummy row —
+    they can never receive, fire, or send (same invariant as the main
+    kernel's padding)."""
+    ge = jnp.minimum(ov.f_edges, Eb - 1)
+    e_ok = ov.f_edges < Eb
+    gn = ov.f_nodes
+    edge = lambda x: x[ge]
+    planes = lambda x: x[:, ge]
+    node = lambda x: x[gn]
+    return FlowUpdatingState(
+        t=st.t, value=node(st.value), flow=edge(st.flow),
+        est=edge(st.est), recv=edge(st.recv), ticks=node(st.ticks),
+        stamp=edge(st.stamp), last_avg=node(st.last_avg),
+        fired=node(st.fired), alive=node(st.alive),
+        edge_ok=edge(st.edge_ok) & e_ok,
+        pending_flow=planes(st.pending_flow),
+        pending_est=planes(st.pending_est),
+        pending_valid=planes(st.pending_valid) & e_ok[None],
+        pending_stamp=planes(st.pending_stamp),
+        buf_flow=planes(st.buf_flow), buf_est=planes(st.buf_est),
+        buf_valid=planes(st.buf_valid) & e_ok[None],
+        key=st.key,
+    )
+
+
+def frontier_core(st: FlowUpdatingState, ov: OverlapTables,
+                  cfg, Eb: int):
+    """The compact frontier pass for the message modes: deliver + fire
+    on exactly the frontier rows.  Returns ``(flow, msg_est,
+    send_mask)`` in the compact edge layout — bit-identical to the
+    full pass's values at the same slots (the drop draw is taken
+    full-width from the SAME key split and gathered, so loss
+    realizations agree positionally)."""
+    cst = _frontier_state(st, ov, Eb)
+    cfg0 = _dc.replace(cfg, drop_rate=0.0) if cfg.drop_rate > 0.0 else cfg
+    cst, processed = deliver_phase(cst, _ftopo(ov), cfg0)
+    cst, msg_est, send_mask = fire_core(cst, _ftopo(ov), cfg0, processed)
+    if cfg.drop_rate > 0.0:
+        _, sub = jax.random.split(st.key)
+        keep = jax.random.bernoulli(sub, 1.0 - cfg.drop_rate, (Eb,))
+        send_mask = send_mask & keep[jnp.minimum(ov.f_edges, Eb - 1)]
+    return cst.flow, msg_est, send_mask
+
+
+def _msg_payloads(st, pl, ov, cfg, Eb, perm, offsets, compact: bool):
+    """Per-offset wire blocks for the message modes (bit-equal to
+    ``_local_round``'s ppermute payloads).
+
+    ``compact=True`` runs the compact frontier pass (thin frontiers:
+    the early wire start is real).  ``compact=False`` — the fat-
+    frontier 'overlap_full' resolution — replays the frontier at FULL
+    width, which XLA CSEs with the interior pass into one computation.
+    Message-based pairwise always takes the full-width replay: its
+    segmented affine scan's combine tree is length-dependent
+    (``ops/segscan.py`` uses ``lax.associative_scan``), so a compacted
+    replay would differ in the last ulp."""
+    if cfg.variant == COLLECTALL and compact:
+        flow_f, est_f, send_f = frontier_core(st, ov, cfg, Eb)
+        Fe = ov.f_edges.shape[0]
+        dt = flow_f.dtype
+        payloads = []
+        for di in range(len(offsets)):
+            pos = ov.send_pos[di]
+            in_r = pos < Fe
+            pp = jnp.minimum(pos, Fe - 1)
+            v = (send_f[pp] & in_r).astype(dt)
+            payloads.append(jnp.concatenate(
+                [_lanes(flow_f[pp]), _lanes(est_f[pp]), v[None]]))
+        return payloads
+    ltopo = _local_topo(pl)
+    st2, processed = deliver_phase(st, ltopo, cfg)
+    st2, msg_est, send_mask = fire_core(st2, ltopo, cfg, processed)
+    dt = st2.flow.dtype
+    payloads = []
+    for di in range(len(offsets)):
+        sidx = perm.send_idx[di]
+        in_r = sidx < Eb
+        slc = jnp.minimum(sidx, Eb - 1)
+        v = (send_mask[slc] & in_r).astype(dt)
+        payloads.append(jnp.concatenate(
+            [_lanes(st2.flow[slc]), _lanes(msg_est[slc]), v[None]]))
+    return payloads
+
+
+def _fastpair_payloads(st, ov, pl, Eb, offsets):
+    """Per-offset wire blocks for fast synchronous pairwise: the
+    frontier rows' current estimates + sender-side validity."""
+    ge = jnp.minimum(ov.f_edges, Eb - 1)
+    e_ok = ov.f_edges < Eb
+    gn = ov.f_nodes
+    Fe = ov.f_edges.shape[0]
+    n_rows = gn.shape[0]
+    flow_f = st.flow[ge]
+    est_f = st.value[gn] - jax.ops.segment_sum(
+        flow_f, ov.f_src, num_segments=n_rows)
+    x_u = est_f[ov.f_src]
+    valid_u = st.alive[gn][ov.f_src] & st.edge_ok[ge] & e_ok
+    dt = st.flow.dtype
+    payloads = []
+    for di in range(len(offsets)):
+        pos = ov.send_pos[di]
+        in_r = pos < Fe
+        pp = jnp.minimum(pos, Fe - 1)
+        payloads.append(jnp.concatenate(
+            [_lanes(x_u[pp]), (valid_u[pp] & in_r).astype(dt)[None]]))
+    return payloads
+
+
+def _start_exchange(payloads, offsets, S, wire):
+    """Issue the per-offset exchanges.  ``'ppermute'`` returns the
+    collective results (XLA schedules them async on TPU; consuming them
+    late keeps the overlap window open); ``'none'`` is the interior
+    timing probe (nothing arrives)."""
+    if wire == "none" or not offsets:
+        return []
+    if wire == "ppermute":
+        out = []
+        for di, p in enumerate(payloads):
+            pairs = [(s, (s + offsets[di]) % S) for s in range(S)]
+            out.append(jax.lax.ppermute(p, NODE_AXIS, pairs))
+        return out
+    raise ValueError(f"unknown wire {wire!r}")
+
+
+# ---- the overlap round bodies -------------------------------------------
+
+def local_round_overlap(st, pl, halo, perm, ov, cfg, Eb: int, S: int,
+                        offsets, halo_mode: str):
+    """One split-schedule round on one shard's block (message modes).
+    Drop-in replacement for ``sharded._local_round`` — same return
+    contract, bit-identical state evolution for ``halo='overlap'``."""
+    from flow_updating_tpu.ops import pallas_halo
+
+    wire = _WIRE[halo_mode]
+    me = jax.lax.axis_index(NODE_AXIS)
+    D = cfg.delay_depth
+    nf = st.flow.shape[1] if st.flow.ndim > 1 else 1
+
+    # 1) frontier pass + 2) exchange start
+    got = []
+    if wire != "none" and offsets:
+        payloads = _msg_payloads(st, pl, ov, cfg, Eb, perm, offsets,
+                                 compact=halo_mode != "overlap_full")
+        if wire == "ppermute":
+            got = _start_exchange(payloads, offsets, S, wire)
+
+    # 3) interior pass: full deliver + fire (covers the frontier rows
+    # again at full width — the state of record), then the intra-shard
+    # delivery merge while the wire is busy
+    ltopo = _local_topo(pl)
+    st, processed = deliver_phase(st, ltopo, cfg)
+    st, msg_est, send_mask = fire_core(st, ltopo, cfg, processed)
+    t = st.t
+
+    if wire == "pallas" and offsets:
+        # fused kernel: DMAs start, the receiver-pull merge runs in the
+        # DMA window, then the recv semaphores gate the frontier finish
+        lr = jnp.minimum(ov.lrev, Eb - 1)
+        has_local = ov.lrev < Eb
+        sending_r = send_mask[lr] & has_local
+        slot_r = (t + pl.delay[lr]) % D
+        hit = sending_r[None, :] & (
+            slot_r[None, :] == jnp.arange(D, dtype=slot_r.dtype)[:, None])
+        got, buf_flow, buf_est, buf_valid = \
+            pallas_halo.fused_exchange_merge(
+                payloads, offsets, hit, st.flow[lr], msg_est[lr],
+                st.buf_flow, st.buf_est, st.buf_valid,
+                axis_name=NODE_AXIS, axis_size=S)
+    else:
+        slot = (t + pl.delay) % D
+        local_ok = send_mask & (pl.tshard == me)
+        tgt = jnp.where(local_ok, pl.tlocal, Eb)
+        buf_flow = st.buf_flow.at[slot, tgt].set(st.flow, mode="drop")
+        buf_est = st.buf_est.at[slot, tgt].set(msg_est, mode="drop")
+        buf_valid = st.buf_valid.at[slot, tgt].set(True, mode="drop")
+
+    # 4) finish the frontier rows: consume the received blocks
+    for di in range(len(got)):
+        g = got[di]
+        rv = g[2 * nf] > 0.5
+        rt = perm.recv_tlocal[di]
+        slot_r2 = (t + perm.recv_delay[di]) % D
+        tgt2 = jnp.where(rv & (rt < Eb), rt, Eb)
+        buf_flow = buf_flow.at[slot_r2, tgt2].set(
+            _unlanes(g[:nf], st.flow), mode="drop")
+        buf_est = buf_est.at[slot_r2, tgt2].set(
+            _unlanes(g[nf:2 * nf], st.flow), mode="drop")
+        buf_valid = buf_valid.at[slot_r2, tgt2].set(True, mode="drop")
+
+    st = st.replace(t=t + 1, buf_flow=buf_flow, buf_est=buf_est,
+                    buf_valid=buf_valid)
+    return st, processed, send_mask
+
+
+def local_round_overlap_fastpair(st, pl, halo, perm, ov, cfg, Eb: int,
+                                 S: int, offsets, halo_mode: str,
+                                 num_colors: int):
+    """Split-schedule round for fast synchronous pairwise: the cut
+    endpoints' estimates go on the wire first, the bulk est/partner
+    compute runs behind it, receives finish the frontier's ``x_v``."""
+    from flow_updating_tpu.ops import pallas_halo
+
+    wire = _WIRE[halo_mode]
+    dt = st.flow.dtype
+    t = st.t
+    Nb = st.value.shape[0]
+    half = jnp.asarray(0.5, dt)
+    nf = st.flow.shape[1] if st.flow.ndim > 1 else 1
+
+    got = []
+    if wire != "none" and offsets:
+        payloads = _fastpair_payloads(st, ov, pl, Eb, offsets)
+        if wire == "ppermute":
+            got = _start_exchange(payloads, offsets, S, wire)
+        else:
+            got = pallas_halo.remote_block_exchange(
+                payloads, offsets, axis_name=NODE_AXIS, axis_size=S)
+
+    est_n = st.value - jax.ops.segment_sum(
+        st.flow, pl.src_local, num_segments=Nb)
+    F = st.flow.shape[1:]
+    x_u = est_n[pl.src_local]
+    valid_u = st.alive[pl.src_local] & st.edge_ok
+
+    is_local = (pl.tshard == jax.lax.axis_index(NODE_AXIS)) & (
+        pl.tlocal < Eb)
+    lr = jnp.minimum(pl.tlocal, Eb - 1)
+    x_v = jnp.where(_ex(is_local, x_u), x_u[lr], jnp.asarray(0, dt))
+    valid_v = is_local & valid_u[lr]
+
+    for di in range(len(got)):
+        g = got[di]
+        rt = perm.recv_tlocal[di]
+        tgt = jnp.where(g[nf] > 0.5, jnp.minimum(rt, Eb), Eb)
+        arrived = jnp.zeros((Eb + 1,), bool).at[tgt].set(
+            True, mode="drop")[:Eb]
+        xin = jnp.zeros((Eb + 1,) + F, dt).at[tgt].set(
+            _unlanes(g[:nf], x_u), mode="drop")[:Eb]
+        x_v = jnp.where(_ex(arrived, x_v), xin, x_v)
+        valid_v = valid_v | arrived
+
+    matched = (pl.edge_color == t % num_colors) & valid_u & valid_v
+    m_ex = _ex(matched, x_u)
+    avg_e = (x_u + x_v) * half
+    flow = jnp.where(m_ex, st.flow + (x_u - x_v) * half, st.flow)
+    est_e = jnp.where(m_ex, avg_e, st.est)
+    stamp = jnp.where(matched, t, st.stamp)
+    fire_any = jax.ops.segment_max(
+        matched.astype(jnp.int32), pl.src_local, num_segments=Nb) > 0
+    node_avg = jax.ops.segment_sum(
+        jnp.where(m_ex, avg_e, jnp.asarray(0, dt)), pl.src_local,
+        num_segments=Nb)
+    last_avg = jnp.where(_ex(fire_any, node_avg), node_avg, st.last_avg)
+    st = st.replace(
+        t=t + 1, flow=flow, est=est_e, stamp=stamp, last_avg=last_avg,
+        fired=st.fired + fire_any.astype(jnp.int32),
+    )
+    none = jnp.zeros((Eb,), bool)
+    return st, none, none

@@ -57,6 +57,29 @@ from flow_updating_tpu.topology.graph import Topology, TopoArrays
 
 P = jax.sharding.PartitionSpec
 
+#: public cut-edge exchange modes.  'ppermute' and 'allgather' are the
+#: serialized oracles; 'overlap' is the interior/frontier-split schedule
+#: (ppermute wire, async-overlappable — parallel/overlap.py) and
+#: 'overlap_pallas' its Pallas remote-DMA form (ops/pallas_halo.py).
+HALO_MODES = ("ppermute", "allgather", "overlap", "overlap_pallas")
+
+#: plus the profiling-only interior probe (overlap schedule with the
+#: exchange elided — obs/profile.overlap_report's timing baseline) and
+#: the fat-frontier resolution of 'overlap' (overlap.resolve_mode)
+_HALO_MODES_INTERNAL = HALO_MODES + ("interior", "overlap_full")
+
+
+def _check_halo(halo: str, *, _internal: bool = False) -> None:
+    if halo in (_HALO_MODES_INTERNAL if _internal else HALO_MODES):
+        return
+    if halo in _HALO_MODES_INTERNAL:
+        raise ValueError(
+            f"halo={halo!r} is internal-only (the profiling probe / a "
+            f"plan-time schedule resolution), not a correct protocol "
+            f"mode: use one of {HALO_MODES}")
+    raise ValueError(
+        f"unknown halo mode {halo!r}: use one of {HALO_MODES}")
+
 
 @struct.dataclass
 class PlanArrays:
@@ -139,9 +162,18 @@ class ShardPlan:
         ``allgather``: every shard broadcasts its padded cut-edge payload
         block (flow + estimate arrays of the ledger dtype, plus a separate
         1-byte bool valid array) to all S shards — S * S * H entries.
+        The full-width broadcast is load-bearing: it is the single-
+        collective oracle (simplest possible wire, every receiver sees
+        everything), and the row-subset alternatives ARE the ppermute /
+        overlap modes; tests/test_parallel.py pins this accounting
+        against the compiled program's actual HLO collective bytes so
+        the two can never silently diverge.
         ``ppermute``: each shard sends each per-offset padded block to
         exactly one peer — S * sum(Hd) entries, each 3 lanes of the ledger
         dtype (valid travels as a dtype lane in the stacked payload).
+        ``overlap``/``overlap_pallas`` put exactly the ppermute payloads
+        on the wire (same blocks, earlier in the schedule), so their
+        byte count is reported under the same key.
         """
         S, H = self.num_shards, self.H
         ag_entry = 2 * dtype_bytes + 1   # flow + est + bool valid
@@ -150,9 +182,11 @@ class ShardPlan:
             int(np.asarray(t).shape[1]) for t in (
                 self.perm_tables.send_idx if self.perm_tables else ())
         )
+        pp = S * sum_hd * pp_entry
         return {
             "allgather_bytes": S * S * H * ag_entry,
-            "ppermute_bytes": S * sum_hd * pp_entry,
+            "ppermute_bytes": pp,
+            "overlap_bytes": pp,   # identical wire, overlapped schedule
             "cut_edges": int((np.asarray(self.arrays.halo_idx)
                               < self.Eb).sum()),
             "cut_fraction": round(self.cut_fraction, 4),
@@ -392,18 +426,37 @@ def init_plan_state(
     return jax.device_put(state, _sharding_tree(state, mesh))
 
 
+def _overlap_device_tables(plan: ShardPlan, mesh):
+    """The overlap schedule's frontier-split tables, device-placed like
+    the other per-shard plan arrays."""
+    from flow_updating_tpu.parallel import overlap as _overlap
+
+    ov = jax.tree.map(jnp.asarray, _overlap.build_overlap(plan))
+    return jax.device_put(ov, _sharding_tree(ov, mesh))
+
+
 def plan_device_arrays(
-    plan: ShardPlan, mesh: jax.sharding.Mesh
-) -> tuple[PlanArrays, HaloTables, PermTables]:
+    plan: ShardPlan, mesh: jax.sharding.Mesh, halo: str | None = None
+):
     """Device placement: per-shard arrays (incl. the per-offset ppermute
-    tables) blocked over the mesh, all_gather routing tables replicated."""
+    tables) blocked over the mesh, all_gather routing tables replicated.
+    Returns ``(PlanArrays, HaloTables, PermTables, OverlapTables)``;
+    the overlap split tables are an O(S*Eb) host construction the
+    serialized modes never read, so they are built only when ``halo``
+    is an overlap mode (or None = mode unknown).  The round-program
+    entry points rebuild them lazily if an overlap dispatch meets a
+    tuple built without them."""
+    from flow_updating_tpu.parallel import overlap as _overlap
+
     arrays = jax.tree.map(jnp.asarray, plan.arrays)
     arrays = jax.device_put(arrays, _sharding_tree(arrays, mesh))
     rep = jax.sharding.NamedSharding(mesh, P())
-    halo = jax.device_put(jax.tree.map(jnp.asarray, plan.halo), rep)
+    halo_t = jax.device_put(jax.tree.map(jnp.asarray, plan.halo), rep)
     perm = jax.tree.map(jnp.asarray, plan.perm_tables)
     perm = jax.device_put(perm, _sharding_tree(perm, mesh))
-    return arrays, halo, perm
+    ov = (_overlap_device_tables(plan, mesh)
+          if halo is None or halo in _overlap.OVERLAP_MODES else None)
+    return arrays, halo_t, perm, ov
 
 
 def _lanes(x):
@@ -418,6 +471,23 @@ def _unlanes(m, ref):
     return m.T if ref.ndim > 1 else m[0]
 
 
+def _local_topo(pl: PlanArrays) -> TopoArrays:
+    """One shard's block as the TopoArrays view the round math consumes
+    (``dst``/``rev`` are placeholders: no local path reads dst, and
+    delivery goes through tshard/tlocal).  Shared by the serialized
+    bodies and the overlap schedule (parallel/overlap.py) so the local
+    topology convention cannot drift between them."""
+    return TopoArrays(
+        src=pl.src_local,
+        dst=pl.src_local,
+        rev=pl.tlocal,
+        out_deg=pl.out_deg,
+        row_start=pl.row_start,
+        edge_rank=pl.edge_rank,
+        delay=pl.delay,
+    )
+
+
 def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
                  perm: PermTables, cfg: RoundConfig, Eb: int, S: int,
                  offsets: tuple, halo_mode: str):
@@ -426,15 +496,7 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
     sampler; plain runs drop them (dead-code eliminated)."""
     me = jax.lax.axis_index(NODE_AXIS)
     D = cfg.delay_depth
-    ltopo = TopoArrays(
-        src=pl.src_local,
-        dst=pl.src_local,  # placeholder: no local path reads dst
-        rev=pl.tlocal,     # placeholder: delivery goes through tshard/tlocal
-        out_deg=pl.out_deg,
-        row_start=pl.row_start,
-        edge_rank=pl.edge_rank,
-        delay=pl.delay,
-    )
+    ltopo = _local_topo(pl)
     st, processed = deliver_phase(st, ltopo, cfg)
     st, msg_est, send_mask = fire_core(st, ltopo, cfg, processed)
 
@@ -596,34 +658,51 @@ def _local_round_fastpair(st: FlowUpdatingState, pl: PlanArrays,
     return st, none, none
 
 
+def _round_dispatch(s, pl, halo_t, pm, ov, cfg, Eb, S, offsets,
+                    halo_mode, num_colors):
+    """One shard-local round for any halo mode: the serialized oracles
+    ('ppermute'/'allgather') run the straight-line bodies above; the
+    overlap modes run the interior/frontier-split schedule
+    (:mod:`flow_updating_tpu.parallel.overlap`)."""
+    from flow_updating_tpu.parallel import overlap as _ovl
+
+    if halo_mode in _ovl.OVERLAP_MODES:
+        if cfg.needs_coloring:
+            return _ovl.local_round_overlap_fastpair(
+                s, pl, halo_t, pm, ov, cfg, Eb, S, offsets, halo_mode,
+                num_colors)
+        return _ovl.local_round_overlap(
+            s, pl, halo_t, pm, ov, cfg, Eb, S, offsets, halo_mode)
+    if cfg.needs_coloring:
+        return _local_round_fastpair(
+            s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode, num_colors)
+    return _local_round(s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "num_rounds", "Eb", "offsets",
                      "halo_mode", "num_colors"),
 )
-def _run_sharded(state, arrays, halo, perm, cfg, mesh, num_rounds, Eb,
+def _run_sharded(state, arrays, halo, perm, ov, cfg, mesh, num_rounds, Eb,
                  offsets, halo_mode, num_colors=0):
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
+    ov_specs = jax.tree.map(_spec, ov)
     S = mesh.devices.size
 
-    def body(st_s, pl_s, halo_t, pm_s):
+    def body(st_s, pl_s, halo_t, pm_s, ov_s):
         st = jax.tree.map(lambda x: x[0], st_s)
         pl = jax.tree.map(lambda x: x[0], pl_s)
         pm = jax.tree.map(lambda x: x[0], pm_s)
+        ovl = jax.tree.map(lambda x: x[0], ov_s)
 
         def step(s, _):
-            if cfg.needs_coloring:
-                s2, _, _ = _local_round_fastpair(
-                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
-                    num_colors,
-                )
-            else:
-                s2, _, _ = _local_round(
-                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
-                )
+            s2, _, _ = _round_dispatch(
+                s, pl, halo_t, pm, ovl, cfg, Eb, S, offsets, halo_mode,
+                num_colors)
             return s2, None
 
         st, _ = jax.lax.scan(step, st, None, length=num_rounds)
@@ -632,11 +711,12 @@ def _run_sharded(state, arrays, halo, perm, cfg, mesh, num_rounds, Eb,
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(state_specs, plan_specs, halo_specs, perm_specs),
+        in_specs=(state_specs, plan_specs, halo_specs, perm_specs,
+                  ov_specs),
         out_specs=state_specs,
         check_vma=False,
     )
-    return fn(state, arrays, halo, perm)
+    return fn(state, arrays, halo, perm, ov)
 
 
 def run_rounds_sharded(
@@ -651,39 +731,66 @@ def run_rounds_sharded(
     """Run ``num_rounds`` sharded rounds as one compiled shard_map'd scan.
 
     ``halo`` selects the cut-edge exchange: ``'ppermute'`` (point-to-point,
-    O(cut) traffic — the default and the multi-pod path) or ``'allgather'``
-    (broadcast; one collective, competitive at small S).
+    O(cut) traffic), ``'allgather'`` (broadcast; one collective,
+    competitive at small S), ``'overlap'`` (the interior/frontier-split
+    schedule — same wire as ppermute, started before the interior
+    compute so async collectives hide it; bit-exact vs ppermute), or
+    ``'overlap_pallas'`` (the split schedule with the Pallas
+    ``make_async_remote_copy`` kernel carrying the wire — the TPU-native
+    fused form, interpret-mode-tested off TPU).
     """
     fn, args, _ = round_program(state, plan, cfg, mesh, num_rounds,
                                 arrays=arrays, halo=halo)
     return fn(*args)
 
 
-def round_program(state, plan: ShardPlan, cfg: RoundConfig,
-                  mesh: jax.sharding.Mesh, num_rounds: int,
-                  arrays=None, halo: str = "ppermute"):
-    """``(jitted_fn, full_args, n_dynamic)`` for the plain sharded round
-    scan — :func:`run_rounds_sharded` calls through this, and the AOT
-    cost-attribution layer (:mod:`flow_updating_tpu.obs.profile`) lowers
-    the same split, so the profiled executable IS the plain program."""
+def _program_inputs(plan: ShardPlan, cfg: RoundConfig, mesh, arrays,
+                    halo: str, *, _internal: bool = False):
+    """Shared preamble of the program builders: validate the config/halo
+    combination, resolve the overlap schedule (plan-time fat-frontier
+    rewrite), and materialize the device array tuple.  Returns
+    ``(plan_arrays, halo_tables, perm, ov, resolved_halo)``."""
     if cfg.needs_coloring and plan.num_colors == 0:
         raise ValueError(
             "fast synchronous pairwise needs the edge coloring in the "
             "plan: build it with plan_sharding(..., coloring=True)"
         )
-    if halo not in ("ppermute", "allgather"):
-        raise ValueError(f"unknown halo mode {halo!r}")
+    _check_halo(halo, _internal=_internal)
     if cfg.contention:
         raise NotImplementedError(
             "contention is single-device (per-round link flow counts are a "
             "global reduction; fidelity runs are platform-scale)"
         )
+    from flow_updating_tpu.parallel import overlap as _ovl
+
+    halo = _ovl.resolve_mode(plan, halo)
     if arrays is None:
-        arrays = plan_device_arrays(plan, mesh)
-    plan_arrays, halo_tables, perm = arrays
+        arrays = plan_device_arrays(plan, mesh, halo=halo)
+    plan_arrays, halo_tables, perm, ov = arrays
+    if ov is None and halo in _ovl.OVERLAP_MODES:
+        ov = _overlap_device_tables(plan, mesh)
+    return plan_arrays, halo_tables, perm, ov, halo
+
+
+def round_program(state, plan: ShardPlan, cfg: RoundConfig,
+                  mesh: jax.sharding.Mesh, num_rounds: int,
+                  arrays=None, halo: str = "ppermute",
+                  _internal: bool = False):
+    """``(jitted_fn, full_args, n_dynamic)`` for the plain sharded round
+    scan — :func:`run_rounds_sharded` calls through this, and the AOT
+    cost-attribution layer (:mod:`flow_updating_tpu.obs.profile`) lowers
+    the same split, so the profiled executable IS the plain program.
+
+    ``halo='interior'`` is the overlap schedule with the exchange
+    elided — a TIMING PROBE for ``obs.profile.overlap_report``, not a
+    correct protocol mode; it (and the plan-time ``'overlap_full'``
+    resolution) is accepted only with ``_internal=True``."""
+    plan_arrays, halo_tables, perm, ov, halo = _program_inputs(
+        plan, cfg, mesh, arrays, halo, _internal=_internal)
     return (_run_sharded,
-            (state, plan_arrays, halo_tables, perm, cfg, mesh, num_rounds,
-             plan.Eb, plan.perm_offsets, halo, plan.num_colors), 4)
+            (state, plan_arrays, halo_tables, perm, ov, cfg, mesh,
+             num_rounds, plan.Eb, plan.perm_offsets, halo,
+             plan.num_colors), 5)
 
 
 def _halo_telemetry_sample(st: FlowUpdatingState, pl: PlanArrays, spec,
@@ -739,30 +846,26 @@ def _halo_telemetry_sample(st: FlowUpdatingState, pl: PlanArrays, spec,
     static_argnames=("cfg", "mesh", "num_rounds", "Eb", "Nb", "offsets",
                      "halo_mode", "num_colors", "spec"),
 )
-def _run_sharded_telemetry(state, arrays, halo, perm, mean, cfg, mesh,
+def _run_sharded_telemetry(state, arrays, halo, perm, ov, mean, cfg, mesh,
                            num_rounds, Eb, Nb, offsets, halo_mode,
                            num_colors, spec):
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
+    ov_specs = jax.tree.map(_spec, ov)
     S = mesh.devices.size
 
-    def body(st_s, pl_s, halo_t, pm_s, mean_r):
+    def body(st_s, pl_s, halo_t, pm_s, ov_s, mean_r):
         st = jax.tree.map(lambda x: x[0], st_s)
         pl = jax.tree.map(lambda x: x[0], pl_s)
         pm = jax.tree.map(lambda x: x[0], pm_s)
+        ovl = jax.tree.map(lambda x: x[0], ov_s)
 
         def step(s, _):
-            if cfg.needs_coloring:
-                s2, pr, sm = _local_round_fastpair(
-                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
-                    num_colors,
-                )
-            else:
-                s2, pr, sm = _local_round(
-                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode
-                )
+            s2, pr, sm = _round_dispatch(
+                s, pl, halo_t, pm, ovl, cfg, Eb, S, offsets, halo_mode,
+                num_colors)
             m = _halo_telemetry_sample(s2, pl, spec, mean_r, pr, sm, Nb)
             return s2, m
 
@@ -776,11 +879,12 @@ def _run_sharded_telemetry(state, arrays, halo, perm, mean, cfg, mesh,
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(state_specs, plan_specs, halo_specs, perm_specs, P()),
+        in_specs=(state_specs, plan_specs, halo_specs, perm_specs,
+                  ov_specs, P()),
         out_specs=(state_specs, P(NODE_AXIS)),
         check_vma=False,
     )
-    return fn(state, arrays, halo, perm, mean)
+    return fn(state, arrays, halo, perm, ov, mean)
 
 
 def run_rounds_sharded_telemetry(
@@ -800,25 +904,13 @@ def run_rounds_sharded_telemetry(
     if not spec.enabled:
         raise ValueError(
             "telemetry spec is disabled; run run_rounds_sharded() instead")
-    if cfg.needs_coloring and plan.num_colors == 0:
-        raise ValueError(
-            "fast synchronous pairwise needs the edge coloring in the "
-            "plan: build it with plan_sharding(..., coloring=True)"
-        )
-    if halo not in ("ppermute", "allgather"):
-        raise ValueError(f"unknown halo mode {halo!r}")
-    if cfg.contention:
-        raise NotImplementedError(
-            "contention is single-device (per-round link flow counts are a "
-            "global reduction; fidelity runs are platform-scale)"
-        )
-    if arrays is None:
-        arrays = plan_device_arrays(plan, mesh)
-    plan_arrays, halo_tables, perm = arrays
+    plan_arrays, halo_tables, perm, ov, halo = _program_inputs(
+        plan, cfg, mesh, arrays, halo)
     mean = jnp.asarray(true_mean, state.value.dtype)
     state, series = _run_sharded_telemetry(
-        state, plan_arrays, halo_tables, perm, mean, cfg, mesh, num_rounds,
-        plan.Eb, plan.Nb, plan.perm_offsets, halo, plan.num_colors, spec,
+        state, plan_arrays, halo_tables, perm, ov, mean, cfg, mesh,
+        num_rounds, plan.Eb, plan.Nb, plan.perm_offsets, halo,
+        plan.num_colors, spec,
     )
     return state, {k: v[0] for k, v in series.items()}
 
@@ -865,7 +957,7 @@ def _halo_field_sample(st: FlowUpdatingState, pl: PlanArrays, spec, mean,
     static_argnames=("cfg", "mesh", "num_rounds", "Eb", "Nb", "offsets",
                      "halo_mode", "num_colors", "spec"),
 )
-def _run_sharded_fields(state, arrays, halo, perm, mean, cfg, mesh,
+def _run_sharded_fields(state, arrays, halo, perm, ov, mean, cfg, mesh,
                         num_rounds, Eb, Nb, offsets, halo_mode,
                         num_colors, spec):
     from flow_updating_tpu.models.rounds import _pool_abs
@@ -874,22 +966,21 @@ def _run_sharded_fields(state, arrays, halo, perm, mean, cfg, mesh,
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
+    ov_specs = jax.tree.map(_spec, ov)
     S = mesh.devices.size
     stride = spec.stride
     track_conv = spec.has("node_conv_round")
 
-    def body(st_s, pl_s, halo_t, pm_s, mean_r):
+    def body(st_s, pl_s, halo_t, pm_s, ov_s, mean_r):
         st = jax.tree.map(lambda x: x[0], st_s)
         pl = jax.tree.map(lambda x: x[0], pl_s)
         pm = jax.tree.map(lambda x: x[0], pm_s)
+        ovl = jax.tree.map(lambda x: x[0], ov_s)
 
         def one_round(_, s):
-            if cfg.needs_coloring:
-                return _local_round_fastpair(
-                    s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode,
-                    num_colors)[0]
-            return _local_round(
-                s, pl, halo_t, pm, cfg, Eb, S, offsets, halo_mode)[0]
+            return _round_dispatch(
+                s, pl, halo_t, pm, ovl, cfg, Eb, S, offsets, halo_mode,
+                num_colors)[0]
 
         def chunk(carry, _):
             s, conv = carry
@@ -911,11 +1002,12 @@ def _run_sharded_fields(state, arrays, halo, perm, mean, cfg, mesh,
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(state_specs, plan_specs, halo_specs, perm_specs, P()),
+        in_specs=(state_specs, plan_specs, halo_specs, perm_specs,
+                  ov_specs, P()),
         out_specs=(state_specs, P(NODE_AXIS), P(NODE_AXIS)),
         check_vma=False,
     )
-    return fn(state, arrays, halo, perm, mean)
+    return fn(state, arrays, halo, perm, ov, mean)
 
 
 def run_rounds_sharded_fields(
@@ -941,25 +1033,13 @@ def run_rounds_sharded_fields(
         raise ValueError(
             f"num_rounds={num_rounds} must be a multiple of the field "
             f"stride {spec.stride}")
-    if cfg.needs_coloring and plan.num_colors == 0:
-        raise ValueError(
-            "fast synchronous pairwise needs the edge coloring in the "
-            "plan: build it with plan_sharding(..., coloring=True)"
-        )
-    if halo not in ("ppermute", "allgather"):
-        raise ValueError(f"unknown halo mode {halo!r}")
-    if cfg.contention:
-        raise NotImplementedError(
-            "contention is single-device (per-round link flow counts are a "
-            "global reduction; fidelity runs are platform-scale)"
-        )
-    if arrays is None:
-        arrays = plan_device_arrays(plan, mesh)
-    plan_arrays, halo_tables, perm = arrays
+    plan_arrays, halo_tables, perm, ov, halo = _program_inputs(
+        plan, cfg, mesh, arrays, halo)
     mean = jnp.asarray(true_mean, state.value.dtype)
     return _run_sharded_fields(
-        state, plan_arrays, halo_tables, perm, mean, cfg, mesh, num_rounds,
-        plan.Eb, plan.Nb, plan.perm_offsets, halo, plan.num_colors, spec,
+        state, plan_arrays, halo_tables, perm, ov, mean, cfg, mesh,
+        num_rounds, plan.Eb, plan.Nb, plan.perm_offsets, halo,
+        plan.num_colors, spec,
     )
 
 

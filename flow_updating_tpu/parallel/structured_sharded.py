@@ -65,7 +65,16 @@ class PodShardedFatTreeKernel:
     """Fast synchronous collect-all on a virtual-or-materialized fat-tree,
     sharded by pod over ``mesh``.  Requires ``S | k`` (S = mesh size)."""
 
-    def __init__(self, topo: Topology, cfg: RoundConfig, mesh):
+    def __init__(self, topo: Topology, cfg: RoundConfig, mesh,
+                 overlap: bool = False):
+        # ``overlap=True`` runs the communication-overlap round schedule:
+        # the cross-pod psum of the core partial is ISSUED first, the
+        # pod-local host/edge/agg sections (the O(N) interior) advance
+        # while it is in flight, and the replicated core section (the
+        # (k/2)^2 frontier) finishes after the all-reduce lands.  Same
+        # ops on the same values — bit-identical results — but the
+        # program order lets XLA's async collectives hide the ICI hop
+        # behind the interior compute (Engine(halo='overlap')).
         if not cfg.is_fast_sync_collectall:
             raise ValueError(
                 "the pod-sharded stencil covers exactly the fast "
@@ -87,6 +96,8 @@ class PodShardedFatTreeKernel:
         self.topo = topo
         self.cfg = cfg
         self.mesh = mesh
+        self.overlap = bool(overlap)
+        overlap = self.overlap      # captured by the jit closures below
         dt = cfg.jnp_dtype
 
         deg = topo.out_deg.astype(np.float64)
@@ -108,7 +119,8 @@ class PodShardedFatTreeKernel:
         def _run(state: PodState, value, inv_depp1, deg,
                  num_rounds: int) -> PodState:
             shmap = shard_map(
-                functools.partial(_scan_rounds, num_rounds=num_rounds),
+                functools.partial(_scan_rounds, num_rounds=num_rounds,
+                                  overlap=overlap),
                 mesh=mesh,
                 in_specs=(PodState(t=rep, S=self._specs, G=self._specs,
                                    avg_prev=self._specs,
@@ -133,7 +145,7 @@ class PodShardedFatTreeKernel:
             shmap = shard_map(
                 functools.partial(_scan_rounds_telemetry,
                                   num_rounds=num_rounds, spec=spec,
-                                  n=n_nodes),
+                                  n=n_nodes, overlap=overlap),
                 mesh=mesh,
                 in_specs=(st_specs, self._specs, self._specs, self._specs,
                           rep),
@@ -153,7 +165,7 @@ class PodShardedFatTreeKernel:
             shmap = shard_map(
                 functools.partial(_scan_rounds_fields,
                                   num_rounds=num_rounds, spec=spec,
-                                  n=n_nodes),
+                                  n=n_nodes, overlap=overlap),
                 mesh=mesh,
                 in_specs=(st_specs, self._specs, self._specs, self._specs,
                           rep),
@@ -341,10 +353,43 @@ def _round(state: PodState, value, inv_depp1, deg,
                     avg_prev=avg, A_prev=A_cur)
 
 
+def _round_overlap(state: PodState, value, inv_depp1, deg,
+                   axis_name: str) -> PodState:
+    """The overlap schedule of :func:`_round`: issue the one cross-pod
+    collective (the core column psum — the round's whole wire) FIRST,
+    advance the pod-local host/edge/agg sections (the O(N) interior)
+    while it is in flight, and finish the replicated ``(k/2)^2`` core
+    section (the stencil's boundary band) after the all-reduce lands.
+    Same formulas on the same operands — bit-identical to :func:`_round`
+    (asserted in tests/test_overlap.py) — only the program order moves
+    the wire behind the interior compute."""
+    ew = lambda f, *ts: tuple(f(*xs) for xs in zip(*ts))
+    avg = ew(lambda v, s, a, i: (v - s + a) * i,
+             value, state.S, state.A_prev, inv_depp1)
+    xh, xe, xa, xc = avg
+    a_host, a_edge, a_agg, part = FatTreeStruct.pod_local_sums(
+        xh, xe, xa, xc)
+    part_sum = jax.lax.psum(part, axis_name)      # the wire, issued early
+    # interior: every pod-local section advances without the collective
+    local_A = (a_host, a_edge, a_agg)
+    S_local = tuple(-g - ac + d * ap for g, ac, d, ap in zip(
+        state.G[:3], local_A, deg[:3], state.avg_prev[:3]))
+    G_next = ew(lambda s, d, av, ap: -s - d * av + ap,
+                state.S, deg, avg, state.A_prev)
+    # frontier: the replicated core finishes once the psum completes
+    a_core = jnp.broadcast_to(part_sum[:, None], xc.shape)
+    S_next = S_local + (-state.G[3] - a_core + deg[3] * state.avg_prev[3],)
+    A_cur = local_A + (a_core,)
+    return PodState(t=state.t + 1, S=S_next, G=G_next,
+                    avg_prev=avg, A_prev=A_cur)
+
+
 def _scan_rounds(state: PodState, value, inv_depp1, deg,
-                 num_rounds: int) -> PodState:
+                 num_rounds: int, overlap: bool = False) -> PodState:
+    step = _round_overlap if overlap else _round
+
     def body(s, _):
-        return _round(s, value, inv_depp1, deg, NODE_AXIS), None
+        return step(s, value, inv_depp1, deg, NODE_AXIS), None
 
     out, _ = jax.lax.scan(body, state, None, length=num_rounds)
     return out
@@ -426,15 +471,17 @@ def _pod_field_sample(s: PodState, value, spec, mean, n: int,
 
 
 def _scan_rounds_fields(state: PodState, value, inv_depp1, deg, mean,
-                        num_rounds: int, spec, n: int):
+                        num_rounds: int, spec, n: int,
+                        overlap: bool = False):
     stride = spec.stride
     track_conv = spec.has("node_conv_round")
+    step = _round_overlap if overlap else _round
 
     def chunk(carry, _):
         s, conv = carry
         s = jax.lax.fori_loop(
             0, stride,
-            lambda _, x: _round(x, value, inv_depp1, deg, NODE_AXIS), s)
+            lambda _, x: step(x, value, inv_depp1, deg, NODE_AXIS), s)
         row, err = _pod_field_sample(s, value, spec, mean, n, NODE_AXIS)
         if track_conv:
             conv = tuple(
@@ -452,9 +499,12 @@ def _scan_rounds_fields(state: PodState, value, inv_depp1, deg, mean,
 
 
 def _scan_rounds_telemetry(state: PodState, value, inv_depp1, deg, mean,
-                           num_rounds: int, spec, n: int):
+                           num_rounds: int, spec, n: int,
+                           overlap: bool = False):
+    step = _round_overlap if overlap else _round
+
     def body(s, _):
-        s2 = _round(s, value, inv_depp1, deg, NODE_AXIS)
+        s2 = step(s, value, inv_depp1, deg, NODE_AXIS)
         return s2, _pod_telemetry_sample(s2, value, spec, mean, n,
                                          NODE_AXIS)
 

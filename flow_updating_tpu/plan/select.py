@@ -36,6 +36,19 @@ from flow_updating_tpu.plan.compile import ExecutionPlan, compile_topology
 GATHER_COST = {"tpu": 2000.0, "axon": 2000.0, "cpu": 8.0}
 DEFAULT_GATHER_COST = 8.0
 
+#: per-collective launch overhead charged in wire-byte equivalents when
+#: ranking halo exchange modes (a few-microsecond collective setup at
+#: ~GB/s effective ICI bandwidth) — what makes the single-collective
+#: allgather competitive when the cut is tiny but the offset count is
+#: large, and irrelevant once real payload bytes dominate
+HALO_LATENCY_BYTES = 8192.0
+
+#: interior-to-cut work ratio at which the overlap schedule fully hides
+#: the wire: one cut-edge payload byte costs roughly this many interior
+#: edge-updates' worth of time to move, so intra/cut >= the ratio means
+#: the exchange finishes inside the interior pass
+OVERLAP_HIDE_RATIO = 4.0
+
 
 @dataclasses.dataclass(frozen=True)
 class PlanDecision:
@@ -147,6 +160,56 @@ def _aot_costs(topo, cfg, plan, candidates) -> dict:
             out[cand] = float("inf")
             out[f"{cand}#error"] = f"{type(exc).__name__}: {exc}"[:200]
     return out
+
+
+def select_halo_mode(plan, *, backend: str | None = None,
+                     dtype_bytes: int = 4) -> dict:
+    """Rank the halo kernel's cut-edge exchange modes for a built
+    :class:`~flow_updating_tpu.parallel.sharded.ShardPlan`, using the
+    measured cut-edge bytes already in the halo plan report
+    (``plan.collective_bytes_per_round``).
+
+    The model charges each mode its wire bytes plus a per-collective
+    launch overhead, and credits the overlap schedule with the fraction
+    of the wire the interior compute can hide (saturating once the
+    intra-shard edge count exceeds :data:`OVERLAP_HIDE_RATIO` x the cut
+    count).  Ties break toward the simpler serialized mode.  Returns a
+    manifest-ready dict with the chosen ``halo`` and the evidence —
+    ``Engine(halo='auto')`` resolves through this and records it."""
+    backend = _backend_name(backend)
+    rep = plan.collective_bytes_per_round(dtype_bytes)
+    cut = rep["cut_edges"]
+    intra = plan.topo.num_edges - cut
+    n_off = max(rep["num_offsets"], 1)
+    if cut == 0:
+        return {"halo": "ppermute", "backend": backend,
+                "cut_edges": 0, "intra_edges": intra,
+                "predicted_effective_bytes": {},
+                "reason": "no cut edges: nothing on the wire, the "
+                          "point-to-point path compiles to no collective"}
+    hide = float(min(1.0, intra / (cut * OVERLAP_HIDE_RATIO)))
+    predicted = {
+        "allgather": rep["allgather_bytes"] + 3 * HALO_LATENCY_BYTES,
+        "ppermute": rep["ppermute_bytes"] + n_off * HALO_LATENCY_BYTES,
+        "overlap": (rep["ppermute_bytes"] * (1.0 - hide)
+                    + n_off * HALO_LATENCY_BYTES),
+    }
+    order = ("allgather", "ppermute", "overlap")  # ties -> simpler mode
+    best = min(order, key=lambda k: predicted[k])
+    return {
+        "halo": best,
+        "backend": backend,
+        "cut_edges": cut,
+        "intra_edges": intra,
+        "hide_fraction": round(hide, 3),
+        "predicted_effective_bytes": {k: round(v, 1)
+                                      for k, v in predicted.items()},
+        "reason": (f"{best} cheapest: cut={cut} edge payloads "
+                   f"({rep['ppermute_bytes']} B point-to-point, "
+                   f"{rep['allgather_bytes']} B broadcast) over "
+                   f"{n_off} offset(s); interior {intra} edges hides "
+                   f"{100 * hide:.0f}% of the wire under overlap"),
+    }
 
 
 def select_plan(topo, cfg, *, backend: str | None = None,

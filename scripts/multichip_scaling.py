@@ -1,29 +1,44 @@
 #!/usr/bin/env python
-"""Multi-chip scaling curves on a virtual CPU mesh (VERDICT r3 item 5).
+"""Multi-chip scaling curves on a virtual CPU mesh (VERDICT r3 item 5;
+weak-scaling ladder + overlap schedule added in PR 8).
 
-For S in {1, 2, 4, 8} this records, per distributed execution path and
-topology: rounds/s (R-vs-2R scan difference — launch overhead cancels)
-and the program's collective traffic. Two independent byte numbers are
-reported:
+Two ladder shapes share the harness:
+
+* the **standard (strong) ladder** — fixed topologies, S in {1,2,4,8}:
+  per distributed execution path, rounds/s (R-vs-2R scan difference —
+  launch overhead cancels) and the program's collective traffic;
+* the **weak-scaling ladder** (``--weak N``) — fixed nodes PER SHARD:
+  an Erdős–Rényi graph of ``N*S`` nodes per S, so the ideal curve is a
+  FLAT rounds/s line and ``per_chip_efficiency = rate_S / rate_1`` is
+  written onto every multi-shard row.  Halo rows cover all three
+  exchange schedules (``ppermute`` / ``allgather`` / ``overlap``), and
+  overlap rows also record ``overlap_ratio`` — the fraction of the
+  exchange hidden behind interior compute, from the same timing
+  harness via the interior-elided probe program.
+
+Two independent byte numbers are reported:
 
 * ``hlo_collective_bytes``: parsed from the XLA-optimized HLO of the
-  compiled round program — every all-gather / all-reduce /
-  collective-permute / reduce-scatter / all-to-all op's output bytes.
-  This is what the compiler actually scheduled (GSPMD paths have no
-  hand-written collectives to introspect; SURVEY §2c-2).
+  compiled round program (``obs.profile.hlo_collective_bytes``) —
+  per-round, per-shard bytes the compiler actually scheduled;
 * ``planned_bytes`` (halo paths only): the shard plan's own accounting
-  (`ShardPlan.collective_bytes_per_round`).
+  (`ShardPlan.collective_bytes_per_round`); the two are pinned against
+  each other in ``tests/test_parallel.py``.
 
 CPU-mesh wall-clock is NOT a TPU perf prediction — the value of the
 curve is the *shape* (how rounds/s and bytes move with S) and that the
 sharded programs execute correctly at every S. The driver-level
-correctness gate is `__graft_entry__.dryrun_multichip`.
+correctness gate is `__graft_entry__.dryrun_multichip`; the per-chip
+efficiency rows are gated in CI by ``regress`` against the banked
+``MULTICHIP_SCALING_*`` history (``--smoke`` is the CI entry: a
+2-shard weak ladder with the overlap-vs-ppermute bit-parity asserted
+in-child).
 
 Each S needs its own interpreter (`xla_force_host_platform_device_count`
 is fixed at backend init), so the parent re-execs per S with the proven
 CPU-pinned env (`flow_updating_tpu.utils.backend.cpu_subprocess_env`).
 
-Output: MULTICHIP_SCALING_r4.json at the repo root.
+Output: MULTICHIP_SCALING_r6.json at the repo root.
 """
 
 from __future__ import annotations
@@ -32,65 +47,36 @@ import argparse
 import dataclasses
 import json
 import os
-import re
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
-    "s64": 8, "u64": 8, "s32": 4, "u32": 4,
-    "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
-}
-_COLLECTIVES = ("all-gather", "all-reduce", "collective-permute",
-                "reduce-scatter", "all-to-all")
-# `f32[8,522]{1,0} all-gather(...)`; tuple-shaped collectives list every
-# element shape: `(f32[522]{0}, f32[522]{0}) all-reduce(...)`
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-
 
 def hlo_collective_bytes(hlo_text: str) -> dict:
-    """Sum output bytes of collective ops in optimized HLO, by op kind.
+    """Shared implementation lives with the other program-cost tooling
+    in :mod:`flow_updating_tpu.obs.profile` (import deferred: the
+    parent process never initializes jax)."""
+    from flow_updating_tpu.obs.profile import hlo_collective_bytes as f
 
-    A `lax.scan` body appears once in HLO but executes every round, so
-    on a round-scan program this is per-round traffic (plus any one-time
-    prologue collectives, which are negligible and included)."""
-    per_kind: dict = {k: 0 for k in _COLLECTIVES}
-    count = 0
-    for line in hlo_text.splitlines():
-        s = line.strip()
-        # match ` = <shape> <kind>(`; skip -start/-done pairs' duplicates
-        m = re.search(r"= (.+?) (" + "|".join(_COLLECTIVES) + r")\(", s)
-        if not m or m.group(2) + "-done" in s:
-            continue
-        shapes, kind = m.group(1), m.group(2)
-        nbytes = 0
-        for dt, dims in _SHAPE_RE.findall(shapes):
-            if dt not in _DTYPE_BYTES:
-                continue
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            nbytes += n * _DTYPE_BYTES[dt]
-        per_kind[kind] += nbytes
-        count += 1
-    return {"total": sum(per_kind.values()), "ops": count,
-            **{k: v for k, v in per_kind.items() if v}}
+    return f(hlo_text)
 
 
 def _time_scan(run, state, r: int):
     """Seconds/round via the R-vs-2R difference (overhead cancels).
 
-    Returns ``(sec_per_round, noisy)``: the median of 5 difference
-    measurements, growing R when the spread is noise-dominated (short
-    CPU-mesh scans can time *negative* otherwise — seen on the S=4 halo
-    path at R=8).  ``noisy=True`` marks a measurement that never met the
-    spread gate (shared-host CPU load): the median is still the best
-    available estimate, but the row must say so — and must never
-    displace a clean banked row (see _merge_keep_best)."""
+    Returns ``(sec_per_round, noisy, timing)``: the median of 5
+    difference measurements, growing R when the spread is
+    noise-dominated (short CPU-mesh scans can time *negative* otherwise
+    — seen on the S=4 halo path at R=8).  ``noisy=True`` marks a
+    measurement that never met the spread gate (shared-host CPU load):
+    the median is still the best available estimate, but the row must
+    say so — and must never displace a clean banked row (see
+    _merge_keep_best).  ``timing`` records what was ACTUALLY measured
+    (final round count, repeats, max-min spread in bench.py's
+    convention) so downstream baseline banking never has to invent
+    quality metadata."""
     import jax
 
     med = None
@@ -108,13 +94,19 @@ def _time_scan(run, state, r: int):
         diffs.sort()
         med = diffs[len(diffs) // 2]
         if med > 0 and diffs[1] > 0.25 * med:
-            return med, False
+            return med, False, _timing_info(r, diffs, med)
         r *= 4
     if med is None or med <= 0:
         raise RuntimeError(f"timing unusable (last diffs {diffs})")
     print(f"WARNING: noisy timing, using median {med:.3g} s/round "
           f"(diffs {diffs})", file=sys.stderr, flush=True)
-    return med, True
+    return med, True, _timing_info(r // 4, diffs, med)
+
+
+def _timing_info(rounds: int, diffs, med: float) -> dict:
+    return {"rounds": int(rounds), "repeats": len(diffs),
+            "spread_pct": round(100.0 * (max(diffs) - min(diffs))
+                                / abs(med), 1)}
 
 
 def _topologies():
@@ -154,7 +146,7 @@ def child(n_devices: int) -> None:
         # -- GSPMD node kernel ------------------------------------------
         kern = sync.NodeKernel(topo, cfg, mesh=mesh)
         st = kern.init_state()
-        spr, noisy = _time_scan(kern.run, st, 64)
+        spr, noisy, tinfo = _time_scan(kern.run, st, 64)
         hlo = (jax.jit(lambda s: kern.run(s, 64))
                .lower(st).compile().as_text())
         est = kern.estimates(kern.run(st, 8))
@@ -162,6 +154,7 @@ def child(n_devices: int) -> None:
         results.append({
             "path": "gspmd_node", "topology": tname, "shards": S,
             "rounds_per_sec": round(1.0 / spr, 2),
+            "timing": tinfo,
             "hlo_collective_bytes": hlo_collective_bytes(hlo),
             **({"noisy": True} if noisy else {}),
         })
@@ -171,7 +164,7 @@ def child(n_devices: int) -> None:
             scfg = dataclasses.replace(cfg, spmv="structured")
             ks = sync.NodeKernel(topo, scfg, mesh=mesh)
             st = ks.init_state()
-            spr, noisy = _time_scan(ks.run, st, 64)
+            spr, noisy, tinfo = _time_scan(ks.run, st, 64)
             hlo = (jax.jit(lambda s: ks.run(s, 64))
                    .lower(st).compile().as_text())
             est = ks.estimates(ks.run(st, 8))
@@ -179,6 +172,7 @@ def child(n_devices: int) -> None:
             results.append({
                 "path": "gspmd_structured", "topology": tname, "shards": S,
                 "rounds_per_sec": round(1.0 / spr, 2),
+                "timing": tinfo,
                 "hlo_collective_bytes": hlo_collective_bytes(hlo),
                 **({"noisy": True} if noisy else {}),
             })
@@ -195,7 +189,7 @@ def child(n_devices: int) -> None:
             kp = PodShardedFatTreeKernel(
                 topo, dataclasses.replace(cfg, spmv="structured"), mesh)
             st = kp.init_state()
-            spr, noisy = _time_scan(kp.run, st, 64)
+            spr, noisy, tinfo = _time_scan(kp.run, st, 64)
             hlo = (jax.jit(lambda s: kp.run(s, 64))
                    .lower(st).compile().as_text())
             est = kp.estimates(kp.run(st, 8))
@@ -203,6 +197,7 @@ def child(n_devices: int) -> None:
             results.append({
                 "path": "pod_structured", "topology": tname, "shards": S,
                 "rounds_per_sec": round(1.0 / spr, 2),
+                "timing": tinfo,
                 "hlo_collective_bytes": hlo_collective_bytes(hlo),
                 **({"noisy": True} if noisy else {}),
             })
@@ -212,7 +207,7 @@ def child(n_devices: int) -> None:
             kb = ShardedNodeKernel(
                 topo, dataclasses.replace(cfg, spmv="benes_fused"), mesh)
             st = kb.init_state()
-            spr, noisy = _time_scan(kb.run, st, 16)
+            spr, noisy, tinfo = _time_scan(kb.run, st, 16)
             hlo = (jax.jit(lambda s: kb.run(s, 16))
                    .lower(st).compile().as_text())
             est = kb.estimates(kb.run(st, 8))
@@ -220,6 +215,7 @@ def child(n_devices: int) -> None:
             results.append({
                 "path": "sharded_fused", "topology": tname, "shards": S,
                 "rounds_per_sec": round(1.0 / spr, 2),
+                "timing": tinfo,
                 "hlo_collective_bytes": hlo_collective_bytes(hlo),
                 **({"noisy": True} if noisy else {}),
             })
@@ -242,14 +238,26 @@ def child(n_devices: int) -> None:
                     topo, S, partition="bfs",
                     coloring=pcfg.needs_coloring)
                 planned = plan.collective_bytes_per_round()
-                for halo in ("ppermute", "allgather"):
+                spr_by_mode = {}
+                for halo in ("ppermute", "allgather", "overlap",
+                             "interior"):
                     st = sharded.init_plan_state(plan, pcfg, mesh)
 
                     def run(s, n, _h=halo, _c=pcfg, _p=plan):
+                        if _h == "interior":
+                            fn, args, _ = sharded.round_program(
+                                s, _p, _c, mesh, n, halo=_h,
+                                _internal=True)
+                            return fn(*args)
                         return sharded.run_rounds_sharded(
                             s, _p, _c, mesh, n, halo=_h)
 
-                    spr, noisy = _time_scan(run, st, 8)
+                    spr, noisy, tinfo = _time_scan(run, st, 8)
+                    spr_by_mode[halo] = spr
+                    if halo == "interior":
+                        # timing-only probe (exchange elided): feeds the
+                        # overlap row's ratio, never a row of its own
+                        continue
                     hlo = (jax.jit(lambda s: run(s, 8))
                            .lower(st).compile().as_text())
                     est = sharded.gather_estimates(run(st, 4), plan)
@@ -258,6 +266,7 @@ def child(n_devices: int) -> None:
                         "path": f"halo_{halo}{pname}", "topology": tname,
                         "shards": S,
                         "rounds_per_sec": round(1.0 / spr, 2),
+                        "timing": tinfo,
                         "hlo_collective_bytes": hlo_collective_bytes(hlo),
                         "planned_bytes": {
                             "per_round": planned[f"{halo}_bytes"],
@@ -265,6 +274,137 @@ def child(n_devices: int) -> None:
                         },
                         **({"noisy": True} if noisy else {}),
                     })
+                _attach_overlap_ratio(results, spr_by_mode, tname, S,
+                                      pname)
+
+    print("RESULTS " + json.dumps(results))
+
+
+def _attach_overlap_ratio(results, spr_by_mode, tname, S, pname="") -> None:
+    """Write ``overlap_ratio`` onto the just-recorded overlap row:
+    (t_ppermute - t_overlap) / (t_ppermute - t_interior), clamped to
+    [0, 1] — the fraction of the serialized exchange the split schedule
+    hid.  None when the wire cost is inside timing noise."""
+    from flow_updating_tpu.obs.profile import overlap_ratio_from_times
+
+    pp = spr_by_mode.get("ppermute")
+    ov = spr_by_mode.get("overlap")
+    it = spr_by_mode.get("interior")
+    if pp is None or ov is None or it is None:
+        return
+    exchange, _hidden, ratio = overlap_ratio_from_times(pp, ov, it)
+    for r in reversed(results):
+        if r["path"] == f"halo_overlap{pname}" and r["topology"] == tname \
+                and r["shards"] == S:
+            r["overlap_ratio"] = (round(ratio, 3)
+                                  if ratio is not None else None)
+            r["exchange_sec_per_round"] = round(exchange, 6)
+            return
+
+
+def child_weak(S: int, per_shard: int, smoke: bool = False) -> None:
+    """One weak-scaling rung: an ER graph of ``per_shard * S`` nodes
+    (degree 8), so the ideal rounds/s curve is FLAT across S.  Rows
+    carry ``ladder: 'weak'``; the parent attaches
+    ``per_chip_efficiency = rate_S / rate_1`` after merging.  At
+    ``S >= 2`` the halo rows cover all three exchange schedules and the
+    overlap row records its overlap ratio; ``smoke`` additionally
+    asserts the overlap schedule is BIT-identical to ppermute (the CI
+    parity gate) and trims the round counts."""
+    import numpy as np
+
+    import jax
+
+    from flow_updating_tpu.models import sync
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.parallel import sharded
+    from flow_updating_tpu.parallel.mesh import make_mesh
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    cfg = RoundConfig.fast(variant="collectall")
+    topo = erdos_renyi(per_shard * S, avg_degree=8.0, seed=0)
+    tname = f"er_weak{per_shard}"
+    base = {"topology": tname, "shards": S, "ladder": "weak",
+            "nodes": topo.num_nodes, "directed_edges": topo.num_edges,
+            "per_shard_nodes": per_shard}
+    results = []
+    r0 = 8 if smoke else 16
+
+    # single-device edge-kernel reference for correctness at this scale
+    k1 = sync.NodeKernel(topo, cfg)
+    ref_est = k1.estimates(k1.run(k1.init_state(), 8))
+
+    # GSPMD node kernel (mesh only when sharded)
+    kern = sync.NodeKernel(topo, cfg, mesh=make_mesh(S) if S > 1 else None)
+    st = kern.init_state()
+    spr, noisy, tinfo = _time_scan(kern.run, st, 4 * r0)
+    hlo = (jax.jit(lambda s: kern.run(s, 16)).lower(st).compile()
+           .as_text())
+    np.testing.assert_allclose(kern.estimates(kern.run(st, 8)), ref_est,
+                               atol=1e-5)
+    results.append({
+        "path": "gspmd_node", **base,
+        "rounds_per_sec": round(1.0 / spr, 2),
+        "timing": tinfo,
+        "hlo_collective_bytes": hlo_collective_bytes(hlo),
+        **({"noisy": True} if noisy else {}),
+    })
+
+    # halo kernel, all exchange schedules (S=1 runs on a 1-device mesh:
+    # the same program with no wire — the weak ladder's baseline)
+    mesh = make_mesh(S)
+    plan = sharded.plan_sharding(topo, S, partition="bfs")
+    planned = plan.collective_bytes_per_round()
+    eref = sharded.gather_estimates(
+        sharded.run_rounds_sharded(
+            sharded.init_plan_state(plan, cfg, mesh), plan, cfg, mesh, 4),
+        plan)
+    np.testing.assert_allclose(
+        eref, np.asarray(k1.estimates(k1.run(k1.init_state(), 4))),
+        atol=1e-5)
+    spr_by_mode = {}
+    states = {}
+    for halo in ("ppermute", "allgather", "overlap", "interior"):
+        st = sharded.init_plan_state(plan, cfg, mesh)
+
+        def run(s, n, _h=halo):
+            if _h == "interior":
+                fn, args, _ = sharded.round_program(
+                    s, plan, cfg, mesh, n, halo=_h, _internal=True)
+                return fn(*args)
+            return sharded.run_rounds_sharded(
+                s, plan, cfg, mesh, n, halo=_h)
+
+        spr, noisy, tinfo = _time_scan(run, st, r0)
+        spr_by_mode[halo] = spr
+        if halo == "interior":
+            continue
+        hlo = (jax.jit(lambda s, _r=run: _r(s, 8)).lower(st).compile()
+               .as_text())
+        out = run(st, 4)
+        states[halo] = out
+        np.testing.assert_allclose(
+            sharded.gather_estimates(out, plan), eref, atol=1e-5)
+        results.append({
+            "path": f"halo_{halo}", **base,
+            "rounds_per_sec": round(1.0 / spr, 2),
+            "timing": tinfo,
+            "hlo_collective_bytes": hlo_collective_bytes(hlo),
+            "planned_bytes": {
+                "per_round": planned[f"{halo}_bytes"],
+                "cut_fraction": planned["cut_fraction"],
+            },
+            **({"noisy": True} if noisy else {}),
+        })
+    _attach_overlap_ratio(results, spr_by_mode, tname, S)
+
+    if smoke and S > 1:
+        # the CI parity gate: the overlap schedule's final state is
+        # BIT-identical to the serialized ppermute oracle's
+        for a, b in zip(jax.tree_util.tree_leaves(states["ppermute"]),
+                        jax.tree_util.tree_leaves(states["overlap"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        print("SMOKE overlap==ppermute bit-parity OK", file=sys.stderr)
 
     print("RESULTS " + json.dumps(results))
 
@@ -311,7 +451,7 @@ def child_mega(S: int, k: int) -> None:
              sync.NodeKernel(topo, cfg, mesh=mesh))]
     for path, kern in runs:
         st = kern.init_state()
-        spr, noisy = _time_scan(kern.run, st, 8)
+        spr, noisy, tinfo = _time_scan(kern.run, st, 8)
         hlo = (jax.jit(lambda s, _k=kern: _k.run(s, 8))
                .lower(st).compile().as_text())
         est = kern.estimates(kern.run(st, 8))
@@ -324,6 +464,7 @@ def child_mega(S: int, k: int) -> None:
             "path": path, "topology": tname, "shards": S,
             "nodes": topo.num_nodes,
             "rounds_per_sec": round(1.0 / spr, 2),
+            "timing": tinfo,
             "hlo_collective_bytes": hlo_collective_bytes(hlo),
             **({"noisy": True} if noisy else {}),
         })
@@ -362,10 +503,46 @@ def _merge_keep_best(out_path: str, fresh: list) -> list:
                   key=lambda r: (r["topology"], r["path"], r["shards"]))
 
 
+def _attach_weak_efficiency(rows) -> None:
+    """``per_chip_efficiency`` for every multi-shard weak-ladder row:
+    rate_S / rate_1 of the same (path, topology) — weak scaling's ideal
+    is a flat rounds/s curve, so 1.0 is perfect.  A noisy S=1 row is a
+    degraded denominator and never anchors the ratio (the same
+    quarantine the regress gate applies to the rows themselves); any
+    stale efficiency from a previous merge is dropped with it."""
+    base = {}
+    for r in rows:
+        if r.get("ladder") == "weak" and r.get("shards") == 1 \
+                and not r.get("noisy"):
+            base[(r["path"], r["topology"])] = r["rounds_per_sec"]
+    for r in rows:
+        if r.get("ladder") != "weak" or r.get("shards", 1) <= 1:
+            continue
+        b = base.get((r["path"], r["topology"]))
+        if b:
+            r["per_chip_efficiency"] = round(r["rounds_per_sec"] / b, 4)
+        else:
+            r.pop("per_chip_efficiency", None)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", type=int, default=0)
     ap.add_argument("--shards", default="1,2,4,8")
+    ap.add_argument("--weak", type=int, default=0, metavar="N",
+                    help="run the weak-scaling ladder at N nodes PER "
+                         "SHARD (ER degree 8; topology grows with S so "
+                         "the ideal rounds/s curve is flat) — rows gain "
+                         "per_chip_efficiency and the overlap rows an "
+                         "overlap_ratio")
+    ap.add_argument("--weak-only", action="store_true",
+                    help="skip the standard fixed-topology ladder; run "
+                         "only the --weak rungs")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: 2-shard weak ladder (2048 nodes/"
+                         "shard unless --weak overrides), overlap-vs-"
+                         "ppermute BIT-parity asserted in-child; "
+                         "implies --weak-only --shards 1,2")
     ap.add_argument("--mega-k", type=int, default=0,
                     help="also run the mega-scale virtual fat-tree "
                          "section (pod/gspmd structured only) at this "
@@ -374,12 +551,19 @@ def main(argv=None) -> int:
     ap.add_argument("--mega-only", action="store_true",
                     help="skip the standard S-ladder; run only --mega-k")
     ap.add_argument("--out", default=os.path.join(
-        REPO, "MULTICHIP_SCALING_r5.json"))
+        REPO, "MULTICHIP_SCALING_r6.json"))
     args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.weak = args.weak or 2048
+        args.weak_only = True
+        args.shards = "1,2"
 
     if args.child:
         if args.mega_k:
             child_mega(args.child, args.mega_k)
+        elif args.weak:
+            child_weak(args.child, args.weak, smoke=args.smoke)
         else:
             child(args.child)
         return 0
@@ -388,7 +572,14 @@ def main(argv=None) -> int:
     from flow_updating_tpu.utils.backend import cpu_subprocess_env
 
     shard_list = [int(s) for s in args.shards.split(",")]
-    jobs = [] if args.mega_only else [(S, []) for S in shard_list]
+    jobs = []
+    if not (args.mega_only or args.weak_only):
+        jobs += [(S, []) for S in shard_list]
+    if args.weak:
+        weak_flags = ["--weak", str(args.weak)]
+        if args.smoke:
+            weak_flags.append("--smoke")
+        jobs += [(S, list(weak_flags)) for S in shard_list]
     if args.mega_k:
         jobs.append((max(shard_list), ["--mega-k", str(args.mega_k)]))
 
@@ -416,6 +607,7 @@ def main(argv=None) -> int:
         print(f"S={S}: done ({len(all_results)} rows total)")
 
     all_results = _merge_keep_best(args.out, all_results)
+    _attach_weak_efficiency(all_results)
     out = {
         "meta": {
             "harness": "virtual CPU mesh (xla_force_host_platform_device_"
@@ -425,7 +617,15 @@ def main(argv=None) -> int:
                       "'noisy': true never met the spread gate and never "
                       "displace a banked clean row)",
             "correctness": "every row's estimates checked against the "
-                           "single-device kernel (atol 1e-5)",
+                           "single-device kernel (atol 1e-5); --smoke "
+                           "additionally asserts overlap==ppermute "
+                           "BIT-parity in-child",
+            "efficiency": "weak-ladder rows (ladder: weak) carry "
+                          "per_chip_efficiency = rate_S / rate_1 (ideal "
+                          "weak scaling is flat); overlap rows carry "
+                          "overlap_ratio = hidden/serialized exchange "
+                          "time; both gated by `regress` against the "
+                          "MULTICHIP_SCALING_* history",
         },
         "results": all_results,
     }
@@ -433,11 +633,15 @@ def main(argv=None) -> int:
         json.dump(out, f, indent=1)
     # human-readable table
     print(f"\n{'path':<16}{'topology':<14}{'S':>3}{'rounds/s':>12}"
-          f"{'hlo coll. B':>14}")
+          f"{'hlo coll. B':>14}{'eff':>7}{'ovl':>6}")
     for r in all_results:
+        eff = r.get("per_chip_efficiency")
+        ovl = r.get("overlap_ratio")
         print(f"{r['path']:<16}{r['topology']:<14}{r['shards']:>3}"
               f"{r['rounds_per_sec']:>12}"
-              f"{r['hlo_collective_bytes']['total']:>14}")
+              f"{r['hlo_collective_bytes']['total']:>14}"
+              f"{(f'{eff:.2f}' if eff is not None else '-'):>7}"
+              f"{(f'{ovl:.2f}' if ovl is not None else '-'):>6}")
     print(f"\nwrote {args.out}")
     return 0
 

@@ -61,6 +61,11 @@ SLOW_TESTS = {
         "test_sharded_fast_pairwise_matches_single_device",
     },
     "test_engine.py": {"test_engine_multichip_halo_mode"},
+    "test_overlap.py": {
+        "test_overlap_bitwise_full_matrix",
+        "test_overlap_pallas_vector_and_fastpair",
+        "test_frontier_core_full_matrix",
+    },
     "test_multihost.py": {"test_two_process_cpu_run"},
     "test_spmv_sharded.py": {
         "test_sharded_checkpoint_roundtrip",

@@ -1,0 +1,401 @@
+"""Overlap halo schedule: interior/frontier decomposition guarantees.
+
+The contract (ISSUE 8): ``halo='overlap'`` reorders the round so the
+cut-edge exchange starts before the interior compute — and changes
+NOTHING else.  Pinned here:
+
+* the split schedule's state evolution is BIT-identical to the
+  serialized ``ppermute`` oracle for every partition mode, scalar and
+  vector payloads, drop>0, and both protocol families;
+* the compact frontier pass reproduces the unsplit round's values at
+  the frontier rows exactly (interior ∪ frontier == the whole round);
+* the Pallas remote-DMA kernel (interpret mode executes the real
+  ``make_async_remote_copy`` semantics on the CPU mesh) matches too;
+* the pod stencil's overlap schedule (early psum, core last) is
+  bit-identical to the plain round;
+* telemetry riding the overlap scan equals the ppermute series, and a
+  disabled spec runs the plain overlap program (pure-observer parity);
+* the halo auto-planner ranks modes from the plan's measured cut-edge
+  bytes, and the doctor/regress layers judge scaling ladders.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import deliver_phase, fire_core
+from flow_updating_tpu.parallel import overlap, sharded
+from flow_updating_tpu.parallel.mesh import make_mesh
+from flow_updating_tpu.topology.generators import erdos_renyi, fat_tree
+from flow_updating_tpu.topology.graph import TopoArrays
+
+
+def _run(topo, cfg, halo, partition="contiguous", values=None, rounds=24):
+    plan = sharded.plan_sharding(topo, 8, partition=partition,
+                                 coloring=cfg.needs_coloring)
+    st = sharded.init_plan_state(plan, cfg, make_mesh(8), values=values)
+    out = sharded.run_rounds_sharded(st, plan, cfg, make_mesh(8), rounds,
+                                     halo=halo)
+    return out, plan
+
+
+def _assert_state_bitwise(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(jax.device_get(a)),
+                      jax.tree_util.tree_leaves(jax.device_get(b))):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+CASES = {
+    "fast-collectall": (RoundConfig.fast(variant="collectall",
+                                         dtype="float64"), None),
+    "ref-collectall-drop": (dataclasses.replace(
+        RoundConfig.reference(variant="collectall", delay_depth=2,
+                              dtype="float64"), drop_rate=0.2), None),
+    "ref-pairwise": (RoundConfig.reference(variant="pairwise",
+                                           delay_depth=2,
+                                           dtype="float64"), None),
+    "fast-pairwise": (RoundConfig.fast(variant="pairwise",
+                                       dtype="float64"), None),
+    "vector-d3": (RoundConfig.fast(variant="collectall", dtype="float64"),
+                  "vector"),
+}
+
+
+def _assert_overlap_bitwise(partition, case, n=257, rounds=16):
+    topo = erdos_renyi(n, avg_degree=6.0, seed=7)
+    cfg, vals = CASES[case]
+    values = (np.random.default_rng(0).normal(size=(n, 3))
+              if vals else None)
+    o1, plan = _run(topo, cfg, "ppermute", partition, values, rounds)
+    o2, _ = _run(topo, cfg, "overlap", partition, values, rounds)
+    _assert_state_bitwise(o1, o2)
+    np.testing.assert_array_equal(sharded.gather_estimates(o1, plan),
+                                  sharded.gather_estimates(o2, plan))
+
+
+@pytest.mark.parametrize("partition,case", [
+    ("contiguous", "fast-collectall"),
+    ("bfs", "ref-collectall-drop"),
+])
+def test_overlap_bitwise_vs_ppermute(partition, case):
+    """halo='overlap' is the SAME computation as halo='ppermute' —
+    every state leaf bit-equal after a multi-round scan (cut payloads,
+    drop realizations, delivery merge order all preserved)."""
+    _assert_overlap_bitwise(partition, case)
+
+
+@pytest.mark.parametrize("partition,case", [
+    ("contiguous", "ref-pairwise"),
+    ("bfs", "fast-pairwise"),
+    ("contiguous", "vector-d3"),
+    ("bfs", "fast-collectall"),
+])
+def test_overlap_bitwise_full_matrix(partition, case):
+    """The remaining (partition x protocol x payload) cells — slow tail
+    of :func:`test_overlap_bitwise_vs_ppermute` (conftest SLOW_TESTS)."""
+    _assert_overlap_bitwise(partition, case)
+
+
+def test_overlap_pallas_interpret_bitwise():
+    """The Pallas remote-DMA wire (interpret mode on the CPU mesh runs
+    the real make_async_remote_copy semantics, so the shipped kernel is
+    the tested kernel) produces the identical state."""
+    topo = erdos_renyi(96, avg_degree=5.0, seed=3)
+    cfg, _ = CASES["fast-collectall"]
+    o1, _ = _run(topo, cfg, "ppermute", rounds=8)
+    o2, _ = _run(topo, cfg, "overlap_pallas", rounds=8)
+    _assert_state_bitwise(o1, o2)
+
+
+@pytest.mark.parametrize("case", ["vector-d3", "fast-pairwise"])
+def test_overlap_pallas_vector_and_fastpair(case):
+    """Pallas wire with vector payload lanes and the fastpair direct
+    exchange — slow tail (conftest SLOW_TESTS)."""
+    topo = erdos_renyi(96, avg_degree=5.0, seed=3)
+    cfg, vals = CASES[case]
+    values = (np.random.default_rng(1).normal(size=(96, 3))
+              if vals else None)
+    o1, _ = _run(topo, cfg, "ppermute", values=values, rounds=8)
+    o2, _ = _run(topo, cfg, "overlap_pallas", values=values, rounds=8)
+    _assert_state_bitwise(o1, o2)
+
+
+def test_frontier_interior_row_coverage():
+    """The decomposition's row partition: frontier rows are exactly the
+    cut-edge sources, interior the rest; disjoint and exhaustive over
+    every row that owns a real edge."""
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    plan = sharded.plan_sharding(topo, 8, partition="bfs")
+    frontier, interior = overlap.frontier_interior_rows(plan)
+    assert not (frontier & interior).any()
+    a = plan.arrays
+    own = np.arange(8).reshape(8, 1)
+    real = np.asarray(a.tlocal) < plan.Eb
+    is_cut = (np.asarray(a.tshard) != own) & real
+    for s in range(8):
+        rows_with_edges = np.unique(np.asarray(a.src_local)[s][real[s]])
+        covered = np.where(frontier[s] | interior[s])[0]
+        np.testing.assert_array_equal(covered, rows_with_edges)
+        # every cut edge's source row is frontier; interior rows own none
+        assert frontier[s][np.asarray(a.src_local)[s][is_cut[s]]].all()
+        assert not is_cut[s][interior[s][np.asarray(a.src_local)[s]]
+                             & real[s]].any()
+    # the split tables index real slots only
+    ov = overlap.build_overlap(plan)
+    fe = np.asarray(ov.f_edges)
+    assert ((fe == plan.Eb) | real[np.arange(8)[:, None],
+                                   np.minimum(fe, plan.Eb - 1)]).all()
+
+
+@pytest.mark.parametrize("case", ["ref-collectall-drop"])
+def test_frontier_core_reproduces_full_pass(case):
+    """Interior ∪ frontier == the unsplit round: the compact frontier
+    pass's post-fire flow / message estimate / send mask are BIT-equal
+    to the full-width deliver+fire at the frontier slots (so the wire
+    payloads cannot diverge from the oracle), including the positional
+    drop draw."""
+    _assert_frontier_core(case)
+
+
+@pytest.mark.parametrize("case", ["fast-collectall", "vector-d3"])
+def test_frontier_core_full_matrix(case):
+    """Remaining payload cells of the decomposition parity — slow tail
+    (conftest SLOW_TESTS)."""
+    _assert_frontier_core(case)
+
+
+def _assert_frontier_core(case):
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    cfg, vals = CASES[case]
+    values = (np.random.default_rng(2).normal(size=(257, 3))
+              if vals else None)
+    plan = sharded.plan_sharding(topo, 8, partition="bfs")
+    mesh = make_mesh(8)
+    # a mid-run state so buffers and pending queues are populated
+    st = sharded.init_plan_state(plan, cfg, mesh, values=values)
+    st = sharded.run_rounds_sharded(st, plan, cfg, mesh, 6)
+    host = jax.device_get(st)
+    arrays = jax.tree.map(np.asarray, plan.arrays)
+    ov_all = overlap.build_overlap(plan)
+    import jax.numpy as jnp
+
+    for s in range(plan.num_shards):
+        sst = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[s]), host)
+        pl = jax.tree.map(lambda x: jnp.asarray(x[s]), arrays)
+        ov = jax.tree.map(lambda x: np.asarray(x)[s], ov_all)
+        flow_f, est_f, send_f = overlap.frontier_core(
+            sst, ov, cfg, plan.Eb)
+        ltopo = TopoArrays(src=pl.src_local, dst=pl.src_local,
+                           rev=pl.tlocal, out_deg=pl.out_deg,
+                           row_start=pl.row_start,
+                           edge_rank=pl.edge_rank, delay=pl.delay)
+        full, processed = deliver_phase(sst, ltopo, cfg)
+        full, msg_est, send_mask = fire_core(full, ltopo, cfg, processed)
+        fe = np.asarray(ov.f_edges)
+        realf = fe < plan.Eb
+        idx = fe[realf]
+        np.testing.assert_array_equal(
+            np.asarray(flow_f)[realf], np.asarray(full.flow)[idx])
+        np.testing.assert_array_equal(
+            np.asarray(est_f)[realf], np.asarray(msg_est)[idx])
+        np.testing.assert_array_equal(
+            np.asarray(send_f)[realf], np.asarray(send_mask)[idx])
+
+
+def test_pod_overlap_bitwise():
+    """The pod stencil's overlap schedule (psum issued first, core
+    section finished last) is the same math: bit-identical state."""
+    from flow_updating_tpu.parallel.structured_sharded import (
+        PodShardedFatTreeKernel,
+    )
+
+    topo = fat_tree(8)
+    cfg = RoundConfig.fast(variant="collectall", kernel="node",
+                           spmv="structured", dtype="float64")
+    mesh = make_mesh(8)
+    k1 = PodShardedFatTreeKernel(topo, cfg, mesh, overlap=False)
+    k2 = PodShardedFatTreeKernel(topo, cfg, mesh, overlap=True)
+    _assert_state_bitwise(k1.run(k1.init_state(), 20),
+                          k2.run(k2.init_state(), 20))
+    np.testing.assert_array_equal(
+        k1.estimates(k1.run(k1.init_state(), 20)),
+        k2.estimates(k2.run(k2.init_state(), 20)))
+
+
+def test_overlap_telemetry_and_fields_parity():
+    """Observability is mode-transparent: the telemetry series riding
+    the overlap scan equals the ppermute series, and a disabled spec
+    runs the plain overlap program (same final state)."""
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+    topo = erdos_renyi(96, avg_degree=5.0, seed=3)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    mesh = make_mesh(8)
+
+    def tel(halo, spec):
+        e = Engine(config=cfg, mesh=mesh, multichip="halo", halo=halo)
+        e.set_topology(topo).build()
+        series = e.run_telemetry(16, spec)
+        return series, e.estimates()
+
+    s1, e1 = tel("ppermute", TelemetrySpec.full())
+    s2, e2 = tel("overlap", TelemetrySpec.full())
+    np.testing.assert_array_equal(e1, e2)
+    for m in s1.metrics:
+        np.testing.assert_array_equal(np.asarray(s1[m]),
+                                      np.asarray(s2[m]))
+    # disabled spec -> the plain program (pure-observer contract)
+    _, e3 = tel("overlap", TelemetrySpec.parse("off"))
+    np.testing.assert_array_equal(e2, e3)
+
+
+def test_select_halo_mode_ranks_from_cut_bytes():
+    """The auto-planner reads the plan's measured cut-edge bytes: a
+    well-partitioned graph (big interior) picks overlap; a plan with no
+    cut edges needs no collective at all."""
+    from flow_updating_tpu.plan.select import select_halo_mode
+
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    d = select_halo_mode(sharded.plan_sharding(topo, 8, partition="bfs"))
+    assert d["halo"] in ("overlap", "ppermute", "allgather")
+    assert d["cut_edges"] > 0 and "reason" in d
+    assert set(d["predicted_effective_bytes"]) == {
+        "allgather", "ppermute", "overlap"}
+    # locality partition of a grid: interior dominates -> overlap hides
+    # (hide_fraction saturates), so overlap must be chosen
+    from flow_updating_tpu.topology.generators import grid2d
+
+    g = sharded.plan_sharding(grid2d(32, 32, seed=0), 8, partition="bfs")
+    dg = select_halo_mode(g)
+    assert dg["halo"] == "overlap" and dg["hide_fraction"] == 1.0
+    # a single-shard plan has nothing on the wire
+    d1 = select_halo_mode(sharded.plan_sharding(topo, 1))
+    assert d1["halo"] == "ppermute" and d1["cut_edges"] == 0
+
+
+def test_engine_halo_auto_records_decision():
+    from flow_updating_tpu.engine import Engine
+
+    topo = erdos_renyi(96, avg_degree=5.0, seed=3)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    e = Engine(config=cfg, mesh=make_mesh(8), multichip="halo",
+               halo="auto")
+    e.set_topology(topo).build().run_rounds(8)
+    rep = e.halo_report()
+    assert rep["requested"] == "auto"
+    assert rep["resolved"] in ("overlap", "ppermute", "allgather")
+    assert rep["decision"]["halo"] == rep["resolved"]
+    # the resolved mode matches the serialized oracle's estimates
+    e2 = Engine(config=cfg, mesh=make_mesh(8), multichip="halo",
+                halo="ppermute")
+    e2.set_topology(topo).build().run_rounds(8)
+    np.testing.assert_array_equal(e.estimates(), e2.estimates())
+
+
+def test_engine_rejects_bad_halo_and_interior_probe():
+    from flow_updating_tpu.engine import Engine
+
+    with pytest.raises(ValueError, match="unknown halo mode"):
+        Engine(multichip="halo", halo="bogus")
+    with pytest.raises(ValueError, match="unknown halo mode"):
+        Engine(multichip="halo", halo="interior")  # probe is internal
+
+
+def test_library_entry_points_reject_internal_modes():
+    # the public sharded API is as strict as the Engine: the timing
+    # probe and the plan-time 'overlap_full' rewrite are reachable only
+    # through round_program(_internal=True) (obs.profile's path)
+    topo = erdos_renyi(64, avg_degree=4.0, seed=0)
+    cfg = RoundConfig.fast(variant="collectall")
+    mesh = make_mesh(2)
+    plan = sharded.plan_sharding(topo, 2)
+    st = sharded.init_plan_state(plan, cfg, mesh)
+    for bad in ("interior", "overlap_full"):
+        with pytest.raises(ValueError, match="internal-only"):
+            sharded.run_rounds_sharded(st, plan, cfg, mesh, 2, halo=bad)
+    fn, args, _ = sharded.round_program(st, plan, cfg, mesh, 2,
+                                        halo="interior", _internal=True)
+    fn(*args)  # the probe program still builds and runs internally
+
+
+def test_halo_report_records_executed_schedule():
+    from flow_updating_tpu.engine import Engine
+
+    cfg = RoundConfig.fast(variant="collectall")
+    e = Engine(config=cfg, mesh=make_mesh(2), multichip="halo",
+               halo="overlap")
+    e.set_topology(erdos_renyi(64, avg_degree=4.0, seed=1)).build()
+    rep = e.halo_report()
+    assert rep["resolved"] == "overlap"
+    # 'schedule' is the program the run actually dispatches — the plan-
+    # time fat-frontier resolution, not just the requested mode
+    assert rep["schedule"] == overlap.resolve_mode(e._halo_plan,
+                                                   "overlap")
+    assert rep["schedule"] in ("overlap", "overlap_full")
+
+
+# ---- scaling-ladder observability (doctor + regress) --------------------
+
+def _ladder_doc(eff_overlap=0.9, eff_allgather=0.4, noisy_overlap=False):
+    rows = [
+        {"path": p, "topology": "er_weak2048", "shards": 1,
+         "rounds_per_sec": 100.0, "ladder": "weak"}
+        for p in ("halo_overlap", "halo_allgather")
+    ]
+    rows.append({"path": "halo_overlap", "topology": "er_weak2048",
+                 "shards": 2, "rounds_per_sec": 100.0 * eff_overlap,
+                 "ladder": "weak",
+                 "per_chip_efficiency": eff_overlap,
+                 **({"noisy": True} if noisy_overlap else {})})
+    rows.append({"path": "halo_allgather", "topology": "er_weak2048",
+                 "shards": 2, "rounds_per_sec": 100.0 * eff_allgather,
+                 "ladder": "weak",
+                 "per_chip_efficiency": eff_allgather})
+    return {"meta": {}, "results": rows}
+
+
+def test_doctor_scaling_efficiency_check():
+    from flow_updating_tpu.obs import health
+
+    ok = health.check_scaling_efficiency(_ladder_doc(0.9, 0.8))
+    assert ok.status == health.PASS
+    warn = health.check_scaling_efficiency(_ladder_doc(0.9, 0.4))
+    assert warn.status == health.WARN
+    v = warn.evidence["violations"]
+    assert v[0]["path"] == "halo_allgather" and v[0]["shards"] == 2
+    # noisy rows are quarantined, never judged
+    q = health.check_scaling_efficiency(_ladder_doc(0.1, 0.8,
+                                                    noisy_overlap=True))
+    assert q.status == health.PASS
+    assert q.evidence["noisy_quarantined"] == 1
+    # manifest-level dispatch picks the check up
+    names = [c.name for c in health.diagnose_manifest(_ladder_doc())]
+    assert "scaling_efficiency" in names
+
+
+def test_regress_gates_scaling_efficiency():
+    from flow_updating_tpu.obs import health, regress
+
+    history = [("MULTICHIP_SCALING_hist.json", _ladder_doc(0.9, 0.5))]
+    # within spread: pass
+    checks = regress.compare_scaling(_ladder_doc(0.85, 0.5), history)
+    assert all(c.status == health.PASS for c in checks
+               if c.name == "scaling_regression" and c.status != "skip")
+    # a real efficiency collapse fails like any perf regression
+    checks = regress.compare_scaling(_ladder_doc(0.45, 0.5), history)
+    key = [c for c in checks
+           if c.evidence.get("key") == ["halo_overlap", "er_weak2048", 2]]
+    assert key and key[0].status == health.FAIL
+    # noisy fresh rows are quarantined out of the gate
+    checks = regress.compare_scaling(
+        _ladder_doc(0.1, 0.5, noisy_overlap=True), history)
+    assert not any(c.status == health.FAIL for c in checks)
+    # gate() dispatches on the ladder shape
+    checks = regress.gate(_ladder_doc(0.85, 0.5),
+                          history_pattern="/nonexistent/NOPE_*.json")
+    assert all(c.status == health.SKIP for c in checks)

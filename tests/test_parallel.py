@@ -169,6 +169,60 @@ def test_plan_cut_fraction_and_padding():
     assert (a.halo_idx < plan.Eb).sum() == n_cut
 
 
+def test_halo_collective_bytes_match_plan_budget():
+    """Planned vs actual traffic can never silently diverge again
+    (ISSUE 8 satellite): the compiled round program's HLO collective
+    output bytes (per shard, per round) times S must match the plan's
+    own per-round accounting for every exchange mode.  The allgather
+    budget IS the S^2 broadcast — full-width gather is the mode's
+    definition (the single-collective oracle; the row-subset paths are
+    ppermute/overlap) — so a byte blow-up beyond the plan is a bug, not
+    a mode property."""
+    from flow_updating_tpu.obs.profile import hlo_collective_bytes
+
+    topo = erdos_renyi(257, avg_degree=6.0, seed=7)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    mesh = make_mesh(8)
+    plan = sharded.plan_sharding(topo, 8, partition="bfs")
+    planned = plan.collective_bytes_per_round(dtype_bytes=8)
+    st = sharded.init_plan_state(plan, cfg, mesh)
+    for halo in ("ppermute", "allgather", "overlap"):
+        fn, args, _ = sharded.round_program(st, plan, cfg, mesh, 8,
+                                            halo=halo)
+        text = fn.lower(*args).compile().as_text()
+        measured = hlo_collective_bytes(text)["total"] * plan.num_shards
+        budget = planned["allgather_bytes" if halo == "allgather"
+                         else "ppermute_bytes"]
+        # one-time prologue collectives are the only slack tolerated
+        assert budget * 0.95 - 4096 <= measured <= budget * 1.05 + 4096, (
+            halo, measured, budget)
+
+
+def test_hlo_collective_bytes_counts_async_pairs():
+    """Async collective lowering (-start/-done pairs — the TPU form,
+    and exactly the scheduling the overlap mode relies on) is counted
+    ONCE per op, at the -done whose output is the result shape alone;
+    sync ops count as before."""
+    from flow_updating_tpu.obs.profile import hlo_collective_bytes
+
+    sync_hlo = "  x = f32[100]{0} collective-permute(p), channel_id=1"
+    async_hlo = "\n".join([
+        "  s = (f32[100]{0}, f32[100]{0}, u32[]{:S(2)}, u32[]{:S(2)}) "
+        "collective-permute-start(p), channel_id=1",
+        "  x = f32[100]{0} collective-permute-done(s)",
+        "  g = (f32[50]{0}, f32[400]{0}) all-gather-start(q), "
+        "channel_id=2",
+        "  y = f32[400]{0} all-gather-done(g)",
+    ])
+    assert hlo_collective_bytes(sync_hlo) == {
+        "total": 400, "ops": 1, "collective-permute": 400}
+    out = hlo_collective_bytes(async_hlo)
+    assert out["ops"] == 2
+    assert out["collective-permute"] == 400   # the -done result, once
+    assert out["all-gather"] == 1600
+    assert out["total"] == 2000
+
+
 def test_graft_entry_dryrun():
     """The driver's multi-chip dry run must pass on the CPU mesh.
 
