@@ -566,6 +566,147 @@ def measure_sweep(topo, batch: int, rounds: int,
     }
 
 
+def measure_scenario(name: str, lanes: int, rounds: int) -> dict:
+    """Scenario row: aggregate instance-rounds/s of one registered
+    adversarial scenario's seed grid as ONE vmapped sweep bucket
+    (adversary mask leaves riding per lane), vs the SAME grid with the
+    adversary withdrawn — the honest comparator at identical shapes.
+    The ratio is the device-side cost of the injection + robust mode
+    (statically absent faults compile to the plain program, so an
+    adversary-free scenario measures ~1.0)."""
+    import jax
+    import numpy as np
+
+    from flow_updating_tpu.scenarios.registry import get_scenario
+    from flow_updating_tpu.sweep import SweepInstance, pack_instances
+    from flow_updating_tpu.sweep.batch import run_bucket
+
+    scn = get_scenario(name)
+    cfg = scn.round_config()
+    cases = [scn.build(s) for s in range(lanes)]
+
+    def one_bucket(with_adv: bool):
+        insts = [SweepInstance(
+            topo=c.topo, seed=i,
+            adversary=(c.adversary or None) if with_adv else None)
+            for i, c in enumerate(cases)]
+        buckets = pack_instances(insts, cfg)
+        assert len(buckets) == 1, \
+            "one scenario's seed grid must share a bucket"
+        return buckets[0]
+
+    adv_bucket = one_bucket(True)
+    plain_bucket = one_bucket(False)
+
+    def run(bucket, r):
+        out = run_bucket(bucket, cfg, r)
+        jax.block_until_ready(out.flow)
+        np.asarray(out.flow[:1, :1])
+        return out
+
+    # first calls compile (timed separately, before any warm cache)
+    t0 = time.perf_counter()
+    run(adv_bucket, rounds)
+    compile_adv_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(plain_bucket, rounds)
+    compile_plain_s = time.perf_counter() - t0
+
+    while True:
+        run(adv_bucket, rounds)
+        run(plain_bucket, rounds)
+        t0 = time.perf_counter()
+        run(adv_bucket, rounds)
+        t_a = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(plain_bucket, rounds)
+        t_p = time.perf_counter() - t0
+        if t_a > 0.2 or rounds >= 65536 or t_a * 4 > MAX_LAUNCH_S:
+            break
+        rounds *= 4
+    ta, tp = [t_a], [t_p]
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run(adv_bucket, rounds)
+        ta.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run(plain_bucket, rounds)
+        tp.append(time.perf_counter() - t0)
+    rate_a = [lanes * rounds / t for t in ta]
+    rate_p = [lanes * rounds / t for t in tp]
+    agg_a = sum(rate_a) / len(rate_a)
+    agg_p = sum(rate_p) / len(rate_p)
+    topo = cases[0].topo
+    return {
+        "scenario": name,
+        "lanes": lanes,
+        "rounds": rounds,
+        "repeats": len(ta),
+        "nodes": topo.num_nodes,
+        "directed_edges": topo.num_edges,
+        "config": dict(scn.config),
+        "aggregate_instance_rounds_per_sec": agg_a,
+        "spread_pct": round(100 * (max(rate_a) - min(rate_a)) / agg_a, 1),
+        "honest_aggregate_rounds_per_sec": agg_p,
+        "honest_spread_pct": round(
+            100 * (max(rate_p) - min(rate_p)) / agg_p, 1),
+        "adversary_overhead": (agg_p / agg_a) if agg_a else None,
+        "compile_adversarial_s": compile_adv_s,
+        "compile_honest_s": compile_plain_s,
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def run_scenario_bench(args) -> dict:
+    """The ``--scenario`` measurement body (child-side, settled
+    backend).  Baseline keys are ``scn_<name>`` — fully disjoint from
+    the bare ``k<N>`` DES records, the sweep/service/scaling keys and
+    every other family, so a scenario row can never shadow (or be
+    shadowed by) an existing record."""
+    sc = measure_scenario(args.scenario, args.scenario_lanes, args.rounds)
+    base_key = f"scn_{args.scenario}"
+    if args.scenario_lanes != 8:
+        base_key += f"_b{args.scenario_lanes}"
+
+    from flow_updating_tpu.scenarios.registry import get_scenario
+
+    topo = get_scenario(args.scenario).build(0).topo
+    honest = {
+        "rounds_per_sec": sc["honest_aggregate_rounds_per_sec"],
+        "ticks": sc["rounds"],
+        "repeats": sc["repeats"],
+        "spread_pct": sc["honest_spread_pct"],
+        "note": ("honest same-shape sweep comparator (aggregate "
+                 "instance-rounds/s; not a DES measurement)"),
+    }
+    record_baseline(base_key, baseline_entry(topo, honest))
+    base_rps = recorded_baseline(base_key)
+    base_src = "recorded" if base_rps is not None else "measured"
+    if base_rps is None:
+        base_rps = honest["rounds_per_sec"]
+
+    return {
+        "metric": (f"aggregate instance-rounds/sec, scenario "
+                   f"{args.scenario} x{sc['lanes']} seeds "
+                   f"({sc['nodes']} nodes/instance, adversarial sweep "
+                   "bucket)"),
+        "value": round(sc["aggregate_instance_rounds_per_sec"], 2),
+        "unit": "instance-rounds/sec",
+        "backend": {"axon": "tpu"}.get(sc["platform"], sc["platform"]),
+        "vs_baseline": (round(sc["aggregate_instance_rounds_per_sec"]
+                              / base_rps, 2) if base_rps else None),
+        "extra": {
+            "scenario": {k: (round(v, 4) if isinstance(v, float) else v)
+                         for k, v in sc.items()},
+            "baseline_rounds_per_sec": (round(base_rps, 4)
+                                        if base_rps else None),
+            "baseline_source": base_src,
+            "baseline_key": _baseline_key(base_key),
+        },
+    }
+
+
 def profile_attribution(topo, args, tpu_row: dict, rounds: int = 64) -> dict:
     """AOT cost attribution (obs/profile.py) of the HEADLINE config's
     round program.  The runner comes from :func:`make_runner` — the
@@ -1087,6 +1228,17 @@ def parse_args(argv=None):
                     help="with --sweep: instances per bucket (the "
                          "baseline key carries this, so sweep rows "
                          "never shadow single-instance records)")
+    ap.add_argument("--scenario", metavar="NAME", default=None,
+                    help="scenario row: aggregate instance-rounds/s of "
+                         "one registered adversarial scenario's seed "
+                         "grid as a vmapped sweep bucket vs the honest "
+                         "same-shape comparator (baseline keys "
+                         "scn_<name>, disjoint from every other "
+                         "family; flow_updating_tpu.scenarios)")
+    ap.add_argument("--scenario-lanes", type=int, default=8,
+                    help="with --scenario: seed-grid lanes per bucket "
+                         "(non-default widths get their own _b<N> "
+                         "baseline key)")
     ap.add_argument("--service", action="store_true",
                     help="service-mode row: segment throughput of the "
                          "streaming engine under sustained join/leave/"
@@ -1139,6 +1291,17 @@ def parse_args(argv=None):
                          or args.profile):
         ap.error("--service is its own row: it cannot combine with "
                  "--sweep/--generator/--features/--profile")
+    if args.scenario and (args.sweep or args.service or args.generator
+                          or args.features or args.profile
+                          or args.scaling):
+        ap.error("--scenario is its own row: it cannot combine with "
+                 "--sweep/--service/--generator/--features/--profile/"
+                 "--scaling")
+    if args.scenario and args.scenario_lanes < 1:
+        # the NAME is validated child-side (importing the registry pulls
+        # jax, which the parent must not initialize before the backend
+        # settles — same discipline as --generator specs)
+        ap.error("--scenario-lanes must be >= 1")
     if args.scaling and (args.sweep or args.service or args.generator
                          or args.features or args.profile):
         ap.error("--scaling is its own row: it cannot combine with "
@@ -1193,6 +1356,8 @@ def parse_args(argv=None):
 
 def run_bench(args) -> dict:
     """The measurement body (runs in a child with a settled backend)."""
+    if args.scenario:
+        return run_scenario_bench(args)
     if args.sweep:
         return run_sweep_bench(args)
     if args.service:

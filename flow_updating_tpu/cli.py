@@ -1000,10 +1000,7 @@ def cmd_profile(args) -> int:
     return 0
 
 
-def _load_field_series(path: str):
-    """A manifest's fields block as a FieldSeries, with mix-ups named."""
-    from flow_updating_tpu.obs.fields import FieldSeries
-
+def _load_inspect_manifest(path: str) -> dict:
     try:
         with open(path) as f:
             manifest = json.load(f)
@@ -1014,6 +1011,13 @@ def _load_field_series(path: str):
             f"inspect: {path} is not a manifest (expected a JSON object "
             "with a 'fields' block — write one with `inspect --report` "
             "or `run`'s field flags)")
+    return manifest
+
+
+def _field_series_from(manifest: dict, path: str):
+    """A manifest's fields block as a FieldSeries, with mix-ups named."""
+    from flow_updating_tpu.obs.fields import FieldSeries
+
     block = manifest.get("fields")
     if not isinstance(block, dict):
         schema = manifest.get("schema", "unknown schema")
@@ -1023,6 +1027,10 @@ def _load_field_series(path: str):
             "--fields ... --report PATH` (global-telemetry manifests "
             "are judged by `doctor`)")
     return FieldSeries.from_jsonable(block)
+
+
+def _load_field_series(path: str):
+    return _field_series_from(_load_inspect_manifest(path), path)
 
 
 def _emit_json(doc: dict, output: str | None) -> None:
@@ -1095,15 +1103,33 @@ def cmd_inspect(args) -> int:
                 topo=engine.topology, fields=series, report=report,
                 timings={"run_s": round(run_s, 6)}))
         targets.append((args.report or "<live>", series))
+    sweep_targets = []
     for path in args.reports:
-        targets.append((path, _load_field_series(path)))
-    if not targets:
+        doc = _load_inspect_manifest(path)
+        if (isinstance(doc.get("instances"), list)
+                and not isinstance(doc.get("fields"), dict)):
+            # a sweep manifest: blame ranks the worst instances and
+            # cites each lane's recorded worst nodes as stragglers
+            if not args.blame:
+                raise SystemExit(
+                    f"inspect: {path} is a sweep manifest — pass "
+                    "--blame to rank its worst instances (field-level "
+                    "views need a field manifest)")
+            sweep_targets.append((path, doc))
+        else:
+            targets.append((path, _field_series_from(doc, path)))
+    if not targets and not sweep_targets:
         raise SystemExit(
             "inspect: nothing to inspect — pass saved field-manifest "
             "paths, --diff A B, or a topology (--generator/"
             "--deployment) for a live field recording")
 
     if args.heatmap:
+        if sweep_targets:
+            raise SystemExit(
+                "inspect: --heatmap renders per-node fields; sweep "
+                "manifests carry per-instance records only (use "
+                "--blame)")
         # human view: the rendered grid(s), not JSON
         for path, series in targets:
             if args.heatmap not in series:
@@ -1140,6 +1166,12 @@ def cmd_inspect(args) -> int:
             entry["blame"] = _inspect.blame(
                 series, threshold=args.rmse_threshold)
         out.append(entry)
+    for path, doc in sweep_targets:
+        try:
+            verdict = _inspect.blame_sweep(doc)
+        except ValueError as err:
+            raise SystemExit(f"inspect: {path}: {err}")
+        out.append({"source": path, "sweep_blame": verdict})
     _emit_json(out[0] if len(out) == 1 else {"inspected": out},
                args.output)
     return 0
@@ -1202,6 +1234,73 @@ def cmd_plan(args) -> int:
         doc["report_path"] = args.report
     print(json.dumps(doc))
     return 0
+
+
+def cmd_scenarios(args) -> int:
+    """``scenarios``: the adversarial conformance suite
+    (flow_updating_tpu.scenarios) — run registered scenarios (each a
+    seed grid under the sweep engine plus one field-recorded blame run),
+    write the ``flow-updating-scenario-report/v1`` manifest, and judge
+    every scenario's declared signature in-process.  Exit 1 on any
+    failing clause — the same CI contract as ``doctor`` on the saved
+    manifest."""
+    from flow_updating_tpu.scenarios.registry import (
+        REGISTRY,
+        get_scenario,
+    )
+
+    if args.list:
+        print(json.dumps({
+            name: {
+                "summary": scn.summary,
+                "rounds": scn.rounds,
+                "rmse_threshold": scn.rmse_threshold,
+                "config": dict(scn.config),
+                "signature": [dict(c) for c in scn.signature],
+            } for name, scn in REGISTRY.items()}))
+        return 0
+    names = list(args.names) or None
+    if names:
+        for n in names:
+            try:
+                get_scenario(n)
+            except ValueError as err:
+                raise SystemExit(f"scenarios: {err}")
+    _select_backend(args.backend)
+    from flow_updating_tpu.obs import health
+    from flow_updating_tpu.scenarios.run import (
+        run_scenarios,
+        scenario_manifest,
+    )
+
+    seeds = [args.seed + i for i in range(max(1, args.seeds))]
+    try:
+        records, summary = run_scenarios(
+            names, seeds=seeds, perturb=args.perturb,
+            max_batch=args.max_batch or None)
+    except ValueError as err:
+        raise SystemExit(f"scenarios: {err}")
+    manifest = scenario_manifest(records, summary,
+                                 argv=getattr(args, "_argv", None))
+    if args.report:
+        from flow_updating_tpu.obs.report import write_report
+
+        write_report(args.report, manifest)
+    checks = health.check_scenario_conformance(manifest)
+    out = {
+        "overall": health.overall(checks),
+        "scenarios": summary["scenarios"],
+        "seeds": summary["seeds"],
+        "sweep_compiles": summary["sweep_compiles"],
+        "wall_s": summary["wall_s"],
+        "checks": [c.to_jsonable() for c in checks],
+    }
+    if args.perturb:
+        out["perturb"] = args.perturb
+    if args.report:
+        out["report_path"] = args.report
+    print(json.dumps(out))
+    return health.exit_code(checks, strict=args.strict)
 
 
 def cmd_doctor(args) -> int:
@@ -1709,6 +1808,43 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the flow-updating-plan-report/v1 "
                          "manifest to PATH")
     pl.set_defaults(fn=cmd_plan)
+
+    sc = sub.add_parser(
+        "scenarios",
+        help="adversarial conformance suite: run registered scenarios "
+             "(conductance-bottleneck bridges, Byzantine nodes, "
+             "correlated failures) under the sweep engine, blame the "
+             "planted adversary, and assert each declared signature — "
+             "flow-updating-scenario-report/v1 manifests "
+             "(flow_updating_tpu.scenarios)")
+    sc.add_argument("names", nargs="*", metavar="SCENARIO",
+                    help="registered scenario names (default: the whole "
+                         "registry; see --list)")
+    sc.add_argument("--list", action="store_true",
+                    help="print the registry (name, summary, config, "
+                         "declared signature) and exit")
+    sc.add_argument("--seeds", type=int, default=2, metavar="K",
+                    help="seeds per scenario (the sweep grid width)")
+    sc.add_argument("--seed", type=int, default=0,
+                    help="base seed (seeds are seed..seed+K-1)")
+    sc.add_argument("--perturb",
+                    choices=("remove_adversary", "no_heal"),
+                    help="negative control: withdraw the planted fault "
+                         "(or never heal the partition) — signatures "
+                         "are EXPECTED to fail")
+    sc.add_argument("--max-batch", type=int, default=0, metavar="B",
+                    help="cap sweep lanes per compiled bucket (0 = "
+                         "unbounded)")
+    sc.add_argument("--report", metavar="PATH",
+                    help="write the flow-updating-scenario-report/v1 "
+                         "manifest to PATH")
+    sc.add_argument("--strict", action="store_true",
+                    help="exit 1 on warnings too")
+    sc.add_argument("--backend", default="auto",
+                    choices=("auto", "cpu", "jax_tpu"),
+                    help="JAX backend pin (cpu deregisters TPU "
+                         "factories)")
+    sc.set_defaults(fn=cmd_scenarios)
 
     dr = sub.add_parser(
         "doctor",

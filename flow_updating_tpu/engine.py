@@ -92,7 +92,7 @@ class Engine:
                  mesh=None, multichip: str = "auto",
                  halo: str = "ppermute", partition: str = "bfs",
                  host_actors: bool = False, event_log=None,
-                 plan="off"):
+                 plan="off", adversary=None):
         # argv passthrough mirrors ``Engine(sys.argv)``; recognized flags are
         # consumed by the CLI layer (flow_updating_tpu.cli) — the Engine
         # accepts a ready RoundConfig here.  ``mesh`` (a jax.sharding.Mesh
@@ -151,6 +151,13 @@ class Engine:
                 raise TypeError(
                     f"plan= takes 'off', 'auto', an ExecutionPlan or a "
                     f"PlanDecision; got {type(plan).__name__}")
+        # ``adversary`` (a flow_updating_tpu.scenarios Adversary, or any
+        # object with device_leaves()/describe()) plants device-side
+        # Byzantine faults on the message wire: value lies, flow
+        # corruption, silent drops, scheduled correlated link failure
+        # (models/rounds.py).  Single-device edge kernel only — the
+        # injection lives in the per-edge fire/send path.
+        self.adversary = adversary or None
         self.argv = list(argv) if argv else []
         self.config = config or RoundConfig.fast()
         self.config = self._apply_argv_cfg(self.config)
@@ -438,6 +445,25 @@ class Engine:
 
     def _prepare_arrays(self, latency_scale: float = 0.0) -> None:
         """Device arrays for the configured kernel (no fresh state)."""
+        if self.adversary is not None:
+            if (self.mesh is not None or self.host_actors
+                    or self._custom_actor is not None):
+                raise ValueError(
+                    "adversary= injects faults into the single-device "
+                    "edge kernel's wire; multi-chip / host-actor / "
+                    "custom-actor dispatch is not covered — drop mesh=/"
+                    "host_actors=, or run the scenario under the sweep "
+                    "engine (SweepInstance.adversary)")
+            if self.config.kernel != "edge":
+                raise ValueError(
+                    "adversary= corrupts per-edge wire state; the node-"
+                    "collapsed kernel has no wire — use kernel='edge'")
+            if self.config.needs_coloring:
+                raise ValueError(
+                    "adversary= targets the message-based protocols; the "
+                    "fast synchronous pairwise mode exchanges estimates "
+                    "directly on-chip (no wire to attack) — use "
+                    "variant='collectall' or fire_policy='reference'")
         if self._custom_actor is not None:
             from flow_updating_tpu.models.actor import ActorKernel
 
@@ -619,6 +645,14 @@ class Engine:
                 segment_benes=self.config.segment_benes_mode,
                 delivery_benes=self.config.delivery_benes_mode,
             )
+            if self.adversary is not None:
+                # plant the device-side fault masks (pytree structure:
+                # an absent family stays None and the compiled program
+                # is the plain one)
+                self._topo_arrays = self._topo_arrays.replace(
+                    **self.adversary.device_leaves(
+                        self.topology.num_nodes, self.topology.num_edges,
+                        self.config.jnp_dtype))
 
     def _apply_plan(self) -> None:
         """Resolve ``plan=`` into a concrete kernel/spmv choice (the

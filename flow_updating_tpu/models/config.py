@@ -220,6 +220,46 @@ class RoundConfig:
     #                                    remainder for ARBITRARY graphs,
     #                                    flow_updating_tpu.plan — RCM
     #                                    reorder handled by the kernel)
+    robust: str = "off"                # robust-aggregation variant of the
+    #                                    collect-all fire/average step
+    #                                    (Byzantine tolerance, scenarios/):
+    #                                    'off' (the historical average —
+    #                                    statically off, the compiled
+    #                                    program is bit-identical to
+    #                                    before the knob existed) |
+    #                                    'trim' (trimmed mean: each node
+    #                                    with degree >= 3 whose
+    #                                    neighborhood spread exceeds
+    #                                    robust_tol drops its single
+    #                                    highest and single lowest
+    #                                    neighbor estimate — one edge
+    #                                    each, rank-tie-broken — before
+    #                                    averaging, and freezes those
+    #                                    edges out of the exchange: one
+    #                                    extreme liar per neighborhood is
+    #                                    excluded outright) | 'clip'
+    #                                    (clipped flows: the per-edge
+    #                                    flow LEDGER is clamped to
+    #                                    +-robust_clip at every write —
+    #                                    fire deltas and receive-side
+    #                                    antisymmetry writes alike — so
+    #                                    no neighbor, honest or
+    #                                    Byzantine, can claim more than
+    #                                    robust_clip of standing mass
+    #                                    displacement through any edge;
+    #                                    pick robust_clip above the
+    #                                    honest equilibrium |flow| or
+    #                                    convergence itself is clipped)
+    robust_clip: float = 0.0           # ledger clamp magnitude for
+    #                                    robust='clip'
+    robust_tol: float = 0.0            # trim arming threshold: a node
+    #                                    only trims while its neighbor-
+    #                                    estimate spread (max - min)
+    #                                    exceeds this, so near-consensus
+    #                                    neighborhoods fall back to the
+    #                                    plain average instead of
+    #                                    freezing their extremes forever
+    #                                    (0.0 = any nonzero spread arms)
     segment_impl: str = "auto"         # edge-kernel per-node reductions:
     #                                    'segment' (jax.ops segment_* —
     #                                    scatter-based lowering) | 'ell'
@@ -292,6 +332,33 @@ class RoundConfig:
                 "contention_backlog adds in-flight load to the shared-link "
                 "bandwidth split; it needs contention=True"
             )
+        if self.robust not in ("off", "trim", "clip"):
+            raise ValueError(f"unknown robust mode {self.robust!r} "
+                             "(use 'off', 'trim' or 'clip')")
+        if self.robust != "off" and self.variant != COLLECTALL:
+            raise ValueError(
+                "robust aggregation modifies the collect-all fire/average "
+                "step; the pairwise 2-party exchange has nothing to trim "
+                "or clip (variant='collectall')")
+        if self.robust != "off" and self.kernel != "edge":
+            raise ValueError(
+                "robust aggregation is implemented in the edge kernel's "
+                "fire phase; the node-collapsed SpMV recurrence has no "
+                "per-edge ledgers to clip (kernel='edge')")
+        if self.robust == "clip" and not self.robust_clip > 0.0:
+            raise ValueError(
+                "robust='clip' needs robust_clip > 0 (the flow-ledger "
+                "clamp magnitude)")
+        if self.robust != "clip" and self.robust_clip != 0.0:
+            raise ValueError(
+                "robust_clip is the ledger clamp magnitude of "
+                "robust='clip'; set robust='clip' to use it")
+        if self.robust_tol < 0.0:
+            raise ValueError("robust_tol must be >= 0")
+        if self.robust != "trim" and self.robust_tol != 0.0:
+            raise ValueError(
+                "robust_tol is the trim arming threshold of "
+                "robust='trim'; set robust='trim' to use it")
         if self.kernel == "node" and not self.is_fast_sync_collectall:
             raise ValueError(
                 "kernel='node' covers exactly the fast synchronous "
@@ -307,7 +374,8 @@ class RoundConfig:
                 and self.fire_policy == "every_round"
                 and self.delay_depth == 1
                 and self.drain == 0
-                and self.drop_rate == 0.0)
+                and self.drop_rate == 0.0
+                and self.robust == "off")
 
     @property
     def jnp_dtype(self):

@@ -206,7 +206,14 @@ def deliver_phase(state: FlowUpdatingState, topo, cfg: RoundConfig):
             process = process | pick
             remaining = remaining & ~pick
 
-    flow = jnp.where(_ex(process, state.flow), -pending_flow[0], state.flow)
+    recv_flow = pending_flow[0]
+    if cfg.robust == "clip":
+        # the receive-side half of the flow-ledger clamp (see fire_core):
+        # the antisymmetry write honors the same +-robust_clip bound, so
+        # a corrupted wire flow cannot install an oversized ledger entry
+        clamp = jnp.asarray(cfg.robust_clip, recv_flow.dtype)
+        recv_flow = jnp.clip(recv_flow, -clamp, clamp)
+    flow = jnp.where(_ex(process, state.flow), -recv_flow, state.flow)
     est = jnp.where(_ex(process, state.est), pending_est[0], state.est)
     recv = state.recv | process
 
@@ -329,8 +336,54 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
         # avg over self + ALL neighbors' last-known estimates (unheard
         # neighbors contribute their defaultdict 0.0, as in the reference,
         # ``collectall.py:109-113``).
-        avg = (estimate + est_sum) / _ex((topo.out_deg + 1).astype(dt),
-                                         estimate)
+        trim_edge = None
+        if cfg.robust == "trim":
+            # trimmed-mean fire (robust aggregation, scenarios/): a node
+            # with degree >= 3 whose neighbor-estimate spread exceeds
+            # cfg.robust_tol marks its single highest and single lowest
+            # neighbor estimate (one edge each — ties broken by edge
+            # rank, so the mark is deterministic) and EXCLUDES those
+            # edges outright — from the average AND from the flow
+            # exchange (no ledger delta, no message).  Merely trimming
+            # the average while still pumping flow += avg - est along
+            # extreme edges is unstable (the extreme pair oscillates
+            # with growing amplitude); freezing the edge is what
+            # isolates a liar: its pinned-extreme estimate never moves
+            # mass again.  Once a neighborhood's spread falls inside
+            # robust_tol trimming disarms and the plain fire applies, so
+            # honest regions converge to the historical fixed point
+            # instead of freezing their extremes forever.
+            if vec:
+                raise ValueError(
+                    "robust='trim' marks per-edge extreme ESTIMATES, a "
+                    "control-plane (feature-free) decision; vector "
+                    "payloads would need per-feature firing — use "
+                    "robust='clip' for (N, D) payloads")
+            est_hi = _seg_max(state.est, topo, N,
+                              jnp.asarray(jnp.finfo(dt).min, dt))
+            est_lo = _seg_min(state.est, topo, N,
+                              jnp.asarray(jnp.finfo(dt).max, dt))
+            tol = jnp.asarray(cfg.robust_tol, dt)
+            can = (topo.out_deg >= 3) & (est_hi - est_lo > tol)
+            can_e = _bcast(can, topo)
+            # one edge per extreme: among the edges attaining the
+            # neighborhood max (resp. min), keep the lowest edge rank
+            at_hi = can_e & (state.est >= _bcast(est_hi, topo))
+            at_lo = can_e & (state.est <= _bcast(est_lo, topo))
+            pick = lambda at: at & (topo.edge_rank == _bcast(_seg_min(
+                jnp.where(at, topo.edge_rank, _I32_MAX), topo, N,
+                _I32_MAX), topo))
+            trim_edge = pick(at_hi) | pick(at_lo)
+            t_sum = _seg_sum(
+                jnp.where(trim_edge, jnp.asarray(0, dt), state.est),
+                topo, N)
+            t_cnt = topo.out_deg - _seg_sum(
+                trim_edge.astype(jnp.int32), topo, N)
+            avg = (estimate + t_sum) / _ex((t_cnt + 1).astype(dt),
+                                           estimate)
+        else:
+            avg = (estimate + est_sum) / _ex((topo.out_deg + 1).astype(dt),
+                                             estimate)
         if topo.seg_plan is not None and not vec:
             from flow_updating_tpu.ops.seg_benes import broadcast_multi
 
@@ -340,11 +393,36 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
         else:
             fire_e = _bcast(fire_n, topo)
             avg_e = _bcast(avg, topo)
-        fire_ex = _ex(fire_e, state.flow)
-        new_flow = jnp.where(fire_ex, state.flow + avg_e - state.est,
-                             state.flow)
-        new_est = jnp.where(fire_ex, avg_e, state.est)
-        msg_est = avg_e
+        # under trim, excluded edges apply no ledger delta (no mass moves
+        # toward the extreme, and the last-heard extreme entry survives
+        # for next round's spread detection) — but they still SEND the
+        # unchanged ledger + fresh average below: silencing them too
+        # deadlocks honest pairs (each side's stale view of the other
+        # stays extreme, so both keep trimming forever)
+        act_e = fire_e if trim_edge is None else fire_e & ~trim_edge
+        fire_ex = _ex(act_e, state.flow)
+        if cfg.robust == "clip":
+            # clipped flows (robust aggregation, scenarios/): the flow
+            # LEDGER is clamped to +-robust_clip, so no edge can hold
+            # more than robust_clip of standing mass displacement.  The
+            # fire applies only the delta the clamp admits and the
+            # est/wire updates shrink with it, keeping ledger and
+            # message consistent; the matching receive-side clamp lives
+            # in deliver_phase, so a Byzantine wire gain cannot pump the
+            # pair into a runaway amplifier (an unclamped pair with wire
+            # gain g multiplies its ledger by g every round trip).
+            clamp = jnp.asarray(cfg.robust_clip, dt)
+            delta = jnp.clip(state.flow + (avg_e - state.est),
+                             -clamp, clamp) - state.flow
+            clipped = state.est + delta
+            new_flow = jnp.where(fire_ex, state.flow + delta, state.flow)
+            new_est = jnp.where(fire_ex, clipped, state.est)
+            msg_est = clipped
+        else:
+            new_flow = jnp.where(fire_ex, state.flow + avg_e - state.est,
+                                 state.flow)
+            new_est = jnp.where(fire_ex, avg_e, state.est)
+            msg_est = avg_e
         send_mask = fire_e
         ticks = jnp.where(fire_n, 0, ticks)
         recv = recv & ~fire_e
@@ -438,6 +516,38 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
             last_avg = jnp.where(_ex(fire_any, final_est), final_est,
                                  last_avg)
             fired_ctr = fired_ctr + fire_any.astype(jnp.int32)
+
+    # --- device-side Byzantine wire injection (scenarios/adversary.py).
+    # Each branch keys on pytree STRUCTURE (a None leaf is statically
+    # absent), so adversary-free runs compile the exact plain program.
+    # The honest ledgers are never touched — only what goes on the wire.
+    if (topo.adv_lie_mask is not None or topo.adv_silent_mask is not None
+            or topo.adv_down_mask is not None):
+        if cfg.needs_coloring:
+            raise ValueError(
+                "Byzantine/fault injection targets the message-based "
+                "protocols; fast synchronous pairwise exchanges estimates "
+                "directly on-chip (no wire to attack) — use "
+                "variant='collectall' or fire_policy='reference'")
+    if topo.adv_lie_mask is not None:
+        # value lies: every message a lying node sends reports
+        # adv_lie_value as its estimate (its own state stays honest)
+        lie_e = _bcast(topo.adv_lie_mask, topo)
+        msg_est = jnp.where(_ex(lie_e, msg_est),
+                            jnp.asarray(topo.adv_lie_value, dt), msg_est)
+    if topo.adv_silent_mask is not None:
+        # silent drops: the node's sends vanish on the wire while its
+        # ledger updates regardless — exactly a lost put_async
+        send_mask = send_mask & ~_bcast(topo.adv_silent_mask, topo)
+    if topo.adv_down_mask is not None:
+        # scheduled correlated link failure (partition-then-heal): the
+        # masked edges lose every send during rounds [from, until) —
+        # cutting a subtree's bridge edges in both directions isolates
+        # it without touching node state, and the first post-heal
+        # exchange restores the pair ledgers (self-healing)
+        down = (topo.adv_down_mask
+                & (t >= topo.adv_down_from) & (t < topo.adv_down_until))
+        send_mask = send_mask & ~down
 
     # link-failure mask: a dead link loses every message put on it; the
     # sender's ledger is still updated, exactly like per-message loss
@@ -623,6 +733,24 @@ def send_messages(
                 if cfg.contention_backlog else None)
     delay = edge_delays(topo, cfg, send_mask, inflight=inflight,
                         params=params)
+    # device-side Byzantine flow corruption (scenarios/adversary.py): the
+    # WIRE copy of the flow ledger is scaled on corrupted edges, so the
+    # receiver's antisymmetry write no longer cancels the sender's honest
+    # ledger.  adv_corrupt_mask=None (the default) is pytree structure:
+    # wire_flow IS state.flow and the program is the plain one.
+    wire_flow = state.flow
+    if topo.adv_corrupt_mask is not None:
+        if cfg.needs_coloring:
+            raise ValueError(
+                "Byzantine flow corruption targets the message wire; the "
+                "fast synchronous pairwise mode exchanges directly "
+                "on-chip — use variant='collectall' or "
+                "fire_policy='reference'")
+        wire_flow = jnp.where(
+            _ex(topo.adv_corrupt_mask, wire_flow),
+            wire_flow * jnp.asarray(topo.adv_corrupt_gain,
+                                    wire_flow.dtype),
+            wire_flow)
     if cfg.delivery in ("gather", "benes", "benes_fused"):
         if cfg.delivery != "gather":
             # same receiver-pull formulation, but the rev permutation runs
@@ -650,7 +778,7 @@ def send_messages(
             nf = _feat(state.flow)
             as_lanes = (lambda x: x.T.astype(lane_dt) if x.ndim > 1
                         else x.astype(lane_dt)[None])
-            lanes = [as_lanes(state.flow), as_lanes(msg_est),
+            lanes = [as_lanes(wire_flow), as_lanes(msg_est),
                      send_mask.astype(lane_dt)[None]]
             if cfg.contention:
                 lanes.append(delay.astype(lane_dt)[None])
@@ -668,7 +796,7 @@ def send_messages(
         else:
             rf = topo.rev
             sending = send_mask[rf]
-            pay_flow = state.flow[rf]
+            pay_flow = wire_flow[rf]
             pay_est = msg_est[rf]
             slot_r = (t + delay[rf]) % D
         hit = sending[None, :] & (
@@ -681,7 +809,7 @@ def send_messages(
     else:
         slot_idx = (t + delay) % D
         tgt = jnp.where(send_mask, topo.rev, E)
-        buf_flow = state.buf_flow.at[slot_idx, tgt].set(state.flow, mode="drop")
+        buf_flow = state.buf_flow.at[slot_idx, tgt].set(wire_flow, mode="drop")
         buf_est = state.buf_est.at[slot_idx, tgt].set(msg_est, mode="drop")
         buf_valid = state.buf_valid.at[slot_idx, tgt].set(True, mode="drop")
     return state.replace(
@@ -870,6 +998,8 @@ def field_sample(state, topo, spec, mean):
         row["node_fired"] = state.fired
     if spec.has("edge_flow"):
         row["edge_flow"] = _pool_sum(state.flow)
+    if spec.has("edge_est"):
+        row["edge_est"] = _pool_sum(state.est)
     if spec.has("edge_stale"):
         row["edge_stale"] = state.t - state.stamp
     return row, err

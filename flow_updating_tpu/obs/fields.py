@@ -28,6 +28,13 @@ callbacks, one bulk transfer — down to per-node and per-edge resolution:
                             summed), ``(R, E)``.  Pairing it through
                             ``rev`` localizes mass leaks: a healthy pair
                             has ``flow[e] + flow[rev[e]] ~ 0``.
+* ``edge_est``            — the per-edge estimate ledger (features
+                            summed), ``(R, E)``: what ``src`` last heard
+                            ``dst`` claim.  The Byzantine tell — a value
+                            liar's in-view entries sit pinned at the lie
+                            and a silent node's never leave 0 while the
+                            consensus moves (``inspect`` blame,
+                            scenarios/).
 * ``edge_stale``          — rounds since the edge last averaged
                             (``t - stamp``; meaningful for the pairwise
                             variant, monotone for collect-all).
@@ -66,6 +73,8 @@ NODE_FIELDS = (
 #: Per-edge fields (edge-ledger kernels only).
 EDGE_FIELDS = (
     "edge_flow",           # (R, E) signed flow ledger (features summed)
+    "edge_est",            # (R, E) estimate ledger: src's last-heard
+    #                        view of dst (features summed)
     "edge_stale",          # (R, E) int32 rounds since last avg on edge
 )
 

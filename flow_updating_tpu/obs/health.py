@@ -686,6 +686,265 @@ def check_report(report: dict | None, *, dtype: str | None = None
 
 # ---- bundles -------------------------------------------------------------
 
+# ---- scenario conformance (flow_updating_tpu.scenarios) ------------------
+
+def _scn_instances(rec) -> list:
+    return [i for i in (rec.get("instances") or []) if isinstance(i, dict)]
+
+
+def _scn_conv(inst) -> dict:
+    return inst.get("convergence") or {}
+
+
+def _scn_seed(inst):
+    return (inst.get("tag") or {}).get("seed", inst.get("seed"))
+
+
+def _scn_series(inst, name):
+    s = (inst.get("series") or {}).get(name)
+    return None if s is None else np.asarray(s, np.float64)
+
+
+def _blame_symptom(rec, symptom: str):
+    """The blame bundle list a clause's symptom refers to (the
+    ``straggler`` alias names the stall ranking)."""
+    bundle = rec.get("blame") or {}
+    key = {"straggler": "stall"}.get(symptom, symptom)
+    return bundle.get(key), key
+
+
+def _eval_scenario_clause(rec: dict, clause: dict, by_name: dict,
+                          idx: int) -> CheckResult:
+    """Judge ONE declared signature clause against a scenario record.
+    Every verdict cites the measured per-seed numbers (or blamed
+    entries) it was judged on."""
+    scn = rec.get("name", "?")
+    kind = clause.get("check")
+    name = f"scn:{scn}:{kind}#{idx}"
+    insts = _scn_instances(rec)
+    if not insts:
+        return CheckResult(name, FAIL,
+                           f"{scn}: no sweep instances recorded",
+                           {"clause": clause})
+
+    if kind == "converges":
+        within = int(clause["within"])
+        rounds = {f"seed{_scn_seed(i)}":
+                  int(_scn_conv(i).get("converged_round", -1))
+                  for i in insts}
+        bad = {k: r for k, r in rounds.items() if r < 0 or r > within}
+        status = PASS if not bad else FAIL
+        return CheckResult(
+            name, status,
+            f"{scn}: every seed converges within {within} rounds"
+            if not bad else
+            f"{scn}: {len(bad)}/{len(rounds)} seeds missed the "
+            f"{within}-round convergence deadline",
+            {"clause": clause, "converged_round": rounds,
+             "rmse_threshold": rec.get("rmse_threshold")})
+
+    if kind in ("final_rmse_below", "final_rmse_above"):
+        bound = float(clause["value"])
+        finals = {f"seed{_scn_seed(i)}": _scn_conv(i).get("final_rmse")
+                  for i in insts}
+        vals = [v for v in finals.values() if v is not None]
+        if not vals:
+            return CheckResult(name, SKIP,
+                               f"{scn}: no final rmse recorded",
+                               {"clause": clause})
+        below = kind == "final_rmse_below"
+        ok = all((v <= bound) if below else (v > bound) for v in vals)
+        word = "<=" if below else ">"
+        return CheckResult(
+            name, PASS if ok else FAIL,
+            f"{scn}: final rmse {word} {bound:g} on every seed"
+            + ("" if ok else " VIOLATED"),
+            {"clause": clause, "final_rmse": finals})
+
+    if kind == "rmse_at_least":
+        r = int(clause["round"])
+        bound = float(clause["value"])
+        vals = {}
+        for i in insts:
+            s = _scn_series(i, "rmse")
+            if s is None or r >= s.shape[0]:
+                return CheckResult(
+                    name, SKIP,
+                    f"{scn}: no per-round rmse series covering round "
+                    f"{r} (re-run the scenario sweep with series)",
+                    {"clause": clause})
+            vals[f"seed{_scn_seed(i)}"] = float(s[r])
+        ok = all(v >= bound for v in vals.values())
+        return CheckResult(
+            name, PASS if ok else FAIL,
+            f"{scn}: rmse at round {r} >= {bound:g} on every seed "
+            "(the fault visibly disrupts the run)" if ok else
+            f"{scn}: rmse at round {r} under {bound:g} — the planted "
+            "fault left no observable disruption",
+            {"clause": clause, "rmse_at_round": vals})
+
+    if kind == "mass_bounded":
+        bound = float(clause["value"])
+        start = clause.get("from_round")
+        worst = {}
+        for i in insts:
+            s = _scn_series(i, "mass_residual")
+            if s is None:
+                return CheckResult(
+                    name, SKIP,
+                    f"{scn}: no mass_residual series recorded",
+                    {"clause": clause})
+            mag = np.abs(s) if s.ndim == 1 else np.max(
+                np.abs(s), axis=tuple(range(1, s.ndim)))
+            window = mag[int(start):] if start is not None else mag[-1:]
+            if window.size == 0:
+                return CheckResult(
+                    name, SKIP,
+                    f"{scn}: mass_residual series ends before round "
+                    f"{int(start)} (re-run the scenario sweep with a "
+                    "full-length series)",
+                    {"clause": clause, "series_rounds": int(mag.shape[0])})
+            worst[f"seed{_scn_seed(i)}"] = float(window.max())
+        ok = all(v <= bound for v in worst.values())
+        span = (f"from round {int(start)} on" if start is not None
+                else "at the final round")
+        return CheckResult(
+            name, PASS if ok else FAIL,
+            f"{scn}: |mass residual| {span} <= {bound:g} on every seed"
+            + ("" if ok else " VIOLATED"),
+            {"clause": clause, "worst_abs_mass_residual": worst})
+
+    if kind == "relative_rounds":
+        other_name = clause["of"]
+        other = by_name.get(other_name)
+        if other is None:
+            return CheckResult(
+                name, SKIP,
+                f"{scn}: comparison scenario {other_name!r} not in this "
+                "manifest — run both in one `scenarios` invocation",
+                {"clause": clause})
+
+        def _median_rounds(r):
+            rounds = [int(_scn_conv(i).get("converged_round", -1))
+                      for i in _scn_instances(r)]
+            return None if any(x < 0 for x in rounds) or not rounds \
+                else float(np.median(rounds))
+
+        mine, theirs = _median_rounds(rec), _median_rounds(other)
+        if mine is None or theirs is None or theirs <= 0:
+            return CheckResult(
+                name, FAIL,
+                f"{scn}: convergence rounds unavailable for the "
+                f"{other_name!r} comparison (unconverged seeds)",
+                {"clause": clause, "median_rounds": mine,
+                 "other_median_rounds": theirs})
+        ratio = mine / theirs
+        lo = float(clause.get("min_factor", 0.0))
+        hi = float(clause.get("max_factor", math.inf))
+        ok = lo <= ratio <= hi
+        return CheckResult(
+            name, PASS if ok else FAIL,
+            f"{scn}: converges in {ratio:.2f}x the rounds of "
+            f"{other_name} (declared [{lo:g}, {hi:g}]x)"
+            + ("" if ok else " VIOLATED"),
+            {"clause": clause, "median_rounds": mine,
+             "other_median_rounds": theirs, "ratio": round(ratio, 4)})
+
+    if kind == "blame":
+        symptom = clause.get("symptom", "?")
+        ranked, key = _blame_symptom(rec, symptom)
+        gt = rec.get("ground_truth") or {}
+        if clause.get("block") is not None:
+            part = (rec.get("blame") or {}).get("partition")
+            want = int(clause["block"])
+            ok = isinstance(part, dict) and part.get("block") == want
+            return CheckResult(
+                name, PASS if ok else FAIL,
+                f"{scn}: partition blame names block {want} from the "
+                "cut-edge residuals" if ok else
+                f"{scn}: partition blame did not localize block {want} "
+                f"(got {part})",
+                {"clause": clause, "partition": part,
+                 "cut": (rec.get("blame") or {}).get("cut")})
+        if not ranked:
+            return CheckResult(
+                name, FAIL,
+                f"{scn}: blame ranked no {symptom!r} culprit (field "
+                f"bundle key {key!r} empty)",
+                {"clause": clause, "blame": ranked})
+        if "nodes" in clause:
+            want = [int(n) for n in clause["nodes"]]
+            got = [e.get("node") for e in ranked[:len(want)]]
+            ok = set(got) == set(want)
+            return CheckResult(
+                name, PASS if ok else FAIL,
+                f"{scn}: {symptom} blame names node(s) {want} at rank 1"
+                if ok else
+                f"{scn}: {symptom} blame ranked {got}, expected {want}",
+                {"clause": clause, "ranked": ranked[:3]})
+        if "edge_of" in clause:
+            fam = gt.get(clause["edge_of"]) or {}
+            want = {int(e) for e in fam.get("edges", ())}
+            top = ranked[0]
+            got = {top.get("edge"), top.get("rev")}
+            ok = bool(want & got)
+            return CheckResult(
+                name, PASS if ok else FAIL,
+                f"{scn}: {symptom} blame names planted edge pair "
+                f"{sorted(got)} at rank 1" if ok else
+                f"{scn}: {symptom} blame ranked pair {sorted(got)}, "
+                f"expected one of {sorted(want)}",
+                {"clause": clause, "ranked": ranked[:3],
+                 "planted_edges": sorted(want)})
+        return CheckResult(name, SKIP,
+                           f"{scn}: blame clause declares no "
+                           "expectation (nodes/edge_of/block)",
+                           {"clause": clause})
+
+    return CheckResult(name, SKIP,
+                       f"{scn}: unknown signature clause {kind!r}",
+                       {"clause": clause})
+
+
+def check_scenario_conformance(manifest: dict) -> list:
+    """Judge a ``flow-updating-scenario-report/v1`` manifest: every
+    registered scenario's declared signature clause becomes one check
+    with field-cited evidence (per-seed convergence rounds, series
+    values at the declared rounds, ranked blame entries vs the planted
+    ground truth).  Scenario series are judged ONLY against their own
+    declared signature — a Byzantine run failing the healthy-run mass
+    rule is the scenario working, not a defect."""
+    records = [r for r in (manifest.get("scenarios") or [])
+               if isinstance(r, dict)]
+    if not records:
+        return [CheckResult(
+            "scenario_conformance", SKIP,
+            "manifest has no scenario records — run "
+            "`flow_updating_tpu scenarios --report PATH`")]
+    by_name = {r.get("name"): r for r in records}
+    checks = []
+    for rec in records:
+        clauses = rec.get("signature") or []
+        if not clauses:
+            checks.append(CheckResult(
+                f"scn:{rec.get('name', '?')}", WARN,
+                f"scenario {rec.get('name', '?')!r} declares no "
+                "signature — nothing to conform to"))
+        for idx, clause in enumerate(clauses):
+            if not isinstance(clause, dict):
+                continue
+            checks.append(_eval_scenario_clause(rec, clause, by_name,
+                                                idx))
+        if rec.get("perturb"):
+            checks.append(CheckResult(
+                f"scn:{rec.get('name', '?')}:perturbed", WARN,
+                f"scenario {rec.get('name', '?')!r} ran PERTURBED "
+                f"({rec['perturb']}) — this manifest is a negative "
+                "control, not a conformance record",
+                {"perturb": rec["perturb"]}))
+    return checks
+
+
 def diagnose_series(series, *, threshold: float = 1e-6,
                     dtype: str | None = None) -> list:
     """The full series rule set (live doctor / manifest telemetry)."""
@@ -751,6 +1010,12 @@ def diagnose_manifest(manifest: dict) -> list:
     checks = [check_environment(manifest.get("environment"),
                                 config=config if isinstance(config, dict)
                                 else None)]
+    if isinstance(manifest.get("scenarios"), list):
+        # scenario manifests are judged against their DECLARED
+        # signatures only; the healthy-run series rules would flag the
+        # planted faults as defects (they are the point)
+        checks.extend(check_scenario_conformance(manifest))
+        return checks
     report = manifest.get("report")
     if isinstance(report, dict):
         checks.append(check_report(report, dtype=dtype))

@@ -134,6 +134,192 @@ def blame_leak(fields, *, tail: int = 4, rtol: float | None = None,
     return out
 
 
+def _mad_dev(x: np.ndarray) -> np.ndarray:
+    """|x - median| in median-absolute-deviation units (robust z-score;
+    the scale a planted anomaly cannot poison the way it poisons a
+    mean/std)."""
+    med = np.median(x)
+    mad = np.median(np.abs(x - med))
+    return np.abs(x - med) / (mad + 1e-12)
+
+
+def blame_liar(fields, *, significance: float = 30.0, top: int = 5) -> list:
+    """Byzantine value-liars: nodes whose mass anomaly — own
+    MAD-normalized ``node_mass`` deviation plus the mean deviation of
+    their neighborhood (one diffusion hop) — stands out.
+
+    A liar's poison concentrates: every neighbor counts the lie in its
+    average, so the deviation field peaks ON the liar and its ring; the
+    one-hop diffusion makes the common center rank first whether the
+    extreme mass sits on the liar itself (unprotected) or on its
+    neighbors (clipped flows).  Honest runs measure a diffused score
+    < ~3; a planted liar measures hundreds (the ``significance`` gate
+    keeps honest runs silent).  Needs ``node_mass`` + the edge arrays;
+    returns ``[{"node", "score", "mass"}, ...]`` ranked."""
+    s = _as_series(fields)
+    if "node_mass" not in s.node or s.edges is None or len(s) == 0 \
+            or s.topk_idx is not None:
+        return []
+    mass = np.asarray(s.node["node_mass"], np.float64)[-1]
+    if mass.ndim > 1:        # vector payloads: features summed, like mass
+        mass = mass.sum(axis=tuple(range(1, mass.ndim)))
+    dev = _mad_dev(mass)
+    src = np.asarray(s.edges["src"], np.int64)
+    dst = np.asarray(s.edges["dst"], np.int64)
+    n = mass.shape[0]
+    nsum = np.zeros(n)
+    ncnt = np.zeros(n)
+    np.add.at(nsum, src, dev[dst])
+    np.add.at(ncnt, src, 1.0)
+    score = dev + nsum / np.maximum(ncnt, 1.0)
+    order = np.argsort(-score, kind="stable")[:top]
+    return [{"node": int(i), "score": float(score[i]),
+             "mass": float(mass[i])}
+            for i in order if score[i] >= significance]
+
+
+def blame_pinned(fields, *, significance: float = 50.0,
+                 top: int = 5) -> list:
+    """Frozen-out extremes: nodes whose in-view ``edge_est`` entries
+    (what some neighbor last heard them claim) sit wildly off the
+    consensus in MAD units.
+
+    Under ``robust='trim'`` an excluded liar's entry is never
+    overwritten by the owner's fire — the lie stays pinned at full
+    magnitude while every kept entry tracks the tightening consensus
+    (honest runs measure ~1; a planted liar measures > 10^6).  Returns
+    ``[{"node", "score", "pinned_value"}, ...]`` ranked."""
+    s = _as_series(fields)
+    if "edge_est" not in s.edge or s.edges is None or len(s) == 0:
+        return []
+    est = np.asarray(s.edge["edge_est"], np.float64)[-1]
+    dev = _mad_dev(est)
+    dst = np.asarray(s.edges["dst"], np.int64)
+    n = int(dst.max()) + 1 if dst.size else 0
+    score = np.zeros(n)
+    value = np.zeros(n)
+    np.maximum.at(score, dst, dev)
+    if dst.size:
+        # each node's pinned value = est at its max-dev in-view entry;
+        # reversed fancy assignment makes the lowest edge id win ties,
+        # matching argmax-first semantics, in one vectorized pass
+        at_max = np.flatnonzero(dev >= score[dst])[::-1]
+        value[dst[at_max]] = est[at_max]
+    order = np.argsort(-score, kind="stable")[:top]
+    return [{"node": int(i), "score": float(score[i]),
+             "pinned_value": float(value[i])}
+            for i in order if score[i] >= significance]
+
+
+def blame_cut(fields, *, gate: float = 0.2, factor: float = 3.0,
+              top: int = 5) -> list:
+    """Cut/partitioned links: edge pairs whose antisymmetry residual
+    AFTER the initial mixing transient dwarfs the population.
+
+    When a link dies mid-run the sender's ledger keeps moving while the
+    receiver's mirror is frozen — the pair residual grows to the full
+    standing displacement across the dead link, an order above the
+    population's in-flight noise.  The transient gate (first recorded
+    row where the mean node error fell to ``gate``× its initial value)
+    keeps the early mixing burst — where EVERY pair is transiently
+    unbalanced — out of the ranking.  A pair is blamed when its
+    residual exceeds ``factor`` × the population's 90th percentile.
+    Needs ``edge_flow`` + ``node_err``; returns ``[{"edge", "rev",
+    "src", "dst", "residual"}, ...]``."""
+    s = _as_series(fields)
+    if ("edge_flow" not in s.edge or "node_err" not in s.node
+            or s.edges is None or len(s) < 2 or s.topk_idx is not None):
+        return []
+    mean_err = s.pooled("node_err").mean(axis=1)
+    past = np.flatnonzero(mean_err <= gate * max(mean_err[0], 1e-30))
+    t0 = int(past[0]) if past.size else 0
+    flow = np.asarray(s.edge["edge_flow"], np.float64)[t0:]
+    if flow.shape[0] == 0:
+        return []
+    rev = np.asarray(s.edges["rev"], np.int64)
+    resid = np.abs(flow + flow[:, rev]).max(axis=0)
+    primary = np.arange(resid.shape[0]) <= rev
+    pop = resid[primary]
+    thr = factor * max(float(np.percentile(pop, 90.0)) if pop.size
+                       else 0.0, 1e-30)
+    pr = np.where(primary, resid, -np.inf)
+    order = np.argsort(-pr, kind="stable")[:top]
+    src = np.asarray(s.edges["src"], np.int64)
+    dst = np.asarray(s.edges["dst"], np.int64)
+    return [{"edge": int(e), "rev": int(rev[e]), "src": int(src[e]),
+             "dst": int(dst[e]), "residual": float(resid[e])}
+            for e in order if pr[e] > thr]
+
+
+def blame_partition(fields, membership, bridge_edges, *,
+                    gate: float = 0.2, factor: float = 3.0) -> dict | None:
+    """Localize a partitioned community: the block ALL of whose bridge
+    edges are blamed by :func:`blame_cut` (with planted-partition
+    metadata from the ``community`` generator, nothing is re-derived).
+    Returns ``{"block", "edges", "residual"}`` for the smallest fully
+    cut block, or None."""
+    s = _as_series(fields)
+    cut = blame_cut(s, gate=gate, factor=factor,
+                    top=max(16, 2 * len(bridge_edges)))
+    if not cut or s.edges is None:
+        return None
+    memb = np.asarray(membership, np.int64)
+    src = np.asarray(s.edges["src"], np.int64)
+    dst = np.asarray(s.edges["dst"], np.int64)
+    blamed = set()
+    for c in cut:
+        blamed.add(c["edge"])
+        blamed.add(c["rev"])
+    candidates = []
+    for b in np.unique(memb):
+        bridges = {int(e) for e in bridge_edges
+                   if memb[src[e]] == b or memb[dst[e]] == b}
+        if bridges and bridges <= blamed:
+            candidates.append((len(bridges), int(b), sorted(bridges)))
+    if not candidates:
+        return None
+    nb, block, edges = sorted(candidates)[0]
+    resid = max(c["residual"] for c in cut
+                if c["edge"] in edges or c["rev"] in edges)
+    return {"block": block, "edges": edges, "residual": float(resid)}
+
+
+def blame_sweep(manifest: dict, *, top: int = 3) -> dict:
+    """Blame over a ``flow-updating-sweep-report/v1`` manifest: rank
+    instances by how badly they ended (diverged/non-converged first,
+    then final RMSE) and cite each lane's recorded worst nodes as its
+    stragglers.  Returns ``{"worst_instance", "instances": [...]}`` —
+    the per-lane verdict ``inspect --blame`` prints for sweeps."""
+    instances = manifest.get("instances")
+    if not isinstance(instances, list) or not instances:
+        raise ValueError(
+            "sweep manifest has no instance records to blame (was the "
+            "sweep written with `sweep --report PATH`?)")
+
+    def _key(rec):
+        conv = rec.get("convergence") or {}
+        final = conv.get("final_rmse")
+        final = float("inf") if final is None or not np.isfinite(final) \
+            else float(final)
+        return (bool(conv.get("converged")), -final)
+
+    ranked = sorted(instances, key=_key)
+    out = []
+    for rec in ranked[:top]:
+        conv = rec.get("convergence") or {}
+        out.append({
+            "instance": rec.get("instance"),
+            "tag": rec.get("tag"),
+            "converged": bool(conv.get("converged")),
+            "converged_round": conv.get("converged_round"),
+            "final_rmse": conv.get("final_rmse"),
+            "stragglers": rec.get("worst_nodes") or [],
+        })
+    return {"worst_instance": out[0] if out else None,
+            "instances": out,
+            "ranked_of": len(instances)}
+
+
 def blame_divergence(fields) -> dict | None:
     """Origin of the first non-finite value: the earliest recorded row
     any per-node field goes NaN/Inf, and the node ids carrying it.
@@ -164,10 +350,18 @@ def blame_divergence(fields) -> dict | None:
     }
 
 
-def blame(fields, *, threshold: float = 1e-6, top: int = 5) -> dict:
+def blame(fields, *, threshold: float = 1e-6, top: int = 5,
+          membership=None, bridge_edges=None) -> dict:
     """The full localization bundle: one ranked culprit list per
     symptom.  Symptoms whose prerequisite fields were not recorded come
-    back as ``None`` with a ``skipped`` note."""
+    back as ``None`` with a ``skipped`` note.
+
+    Beyond the stall/leak/divergence triple, the adversarial symptoms of
+    the scenario registry (flow_updating_tpu.scenarios) are ranked when
+    their fields are present: ``liar`` (Byzantine mass anomaly),
+    ``pinned`` (trimmed-out extreme claims), ``cut`` (dead-link pair
+    residuals) and — when planted-partition ``membership`` +
+    ``bridge_edges`` metadata is supplied — ``partition``."""
     s = _as_series(fields)
     out: dict = {}
     div = blame_divergence(s)
@@ -184,6 +378,28 @@ def blame(fields, *, threshold: float = 1e-6, top: int = 5) -> dict:
         out["leak"] = None
         out.setdefault("skipped", []).append(
             "leak blame needs the edge_flow field (edge-ledger kernels)")
+    if "node_mass" in s.node and s.edges is not None \
+            and s.topk_idx is None:
+        out["liar"] = blame_liar(s, top=top)
+    else:
+        out["liar"] = None
+        out.setdefault("skipped", []).append(
+            "liar blame needs full node_mass rows + the edge arrays")
+    if "edge_est" in s.edge and s.edges is not None:
+        out["pinned"] = blame_pinned(s, top=top)
+    else:
+        out["pinned"] = None
+        out.setdefault("skipped", []).append(
+            "pinned blame needs the edge_est field")
+    if ("edge_flow" in s.edge and "node_err" in s.node
+            and s.edges is not None and s.topk_idx is None):
+        out["cut"] = blame_cut(s, top=top)
+        if membership is not None and bridge_edges is not None:
+            out["partition"] = blame_partition(s, membership, bridge_edges)
+    else:
+        out["cut"] = None
+        out.setdefault("skipped", []).append(
+            "cut blame needs full edge_flow + node_err rows")
     return out
 
 

@@ -32,6 +32,7 @@ PROFILE_SCHEMA = "flow-updating-profile-report/v1"
 FIELD_SCHEMA = "flow-updating-field-report/v1"
 PLAN_SCHEMA = "flow-updating-plan-report/v1"
 SERVICE_SCHEMA = "flow-updating-service-report/v1"
+SCENARIO_SCHEMA = "flow-updating-scenario-report/v1"
 
 
 def environment_info() -> dict:
@@ -265,6 +266,32 @@ def build_service_manifest(*, argv=None, config=None, topo=None,
             "derived_from": "segment_boundaries",
             "series": {k: list(v) for k, v in series.items()},
         }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_scenario_manifest(*, argv=None, scenarios=None, summary=None,
+                            timings=None, extra=None) -> dict:
+    """Assemble the scenario-conformance v1 manifest: the standard
+    argv/environment binding around one record per executed scenario
+    (``scenarios``: each carrying the registered declaration, the planted
+    ground truth, per-seed sweep instance records with series, the
+    representative run's field block and blame bundle —
+    :func:`flow_updating_tpu.scenarios.run.run_scenarios` output).  The
+    doctor judges each record against its declared signature
+    (``obs.health.check_scenario_conformance``); per-scenario series
+    live INSIDE the records, so the healthy-run series rules are never
+    applied to an intentionally hostile run."""
+    manifest = {
+        "schema": SCENARIO_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "environment": environment_info(),
+        "summary": dict(summary) if summary else None,
+        "timings": dict(timings) if timings else None,
+        "scenarios": list(scenarios) if scenarios is not None else [],
+    }
     if extra:
         manifest.update(extra)
     return manifest

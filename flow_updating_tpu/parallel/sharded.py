@@ -947,6 +947,8 @@ def _halo_field_sample(st: FlowUpdatingState, pl: PlanArrays, spec, mean,
         row["node_fired"] = st.fired
     if spec.has("edge_flow"):
         row["edge_flow"] = _pool_sum(st.flow)
+    if spec.has("edge_est"):
+        row["edge_est"] = _pool_sum(st.est)
     if spec.has("edge_stale"):
         row["edge_stale"] = st.t - st.stamp
     return row, err

@@ -1,0 +1,36 @@
+"""Scenario conformance suite: adversarial and poorly-connected cases.
+
+The observability stack watches healthy runs everywhere else in the
+repo; this package turns it into an active conformance suite.  A
+:class:`~flow_updating_tpu.scenarios.registry.Scenario` bundles a
+deterministic hostile construction (conductance-bottleneck bridges,
+Byzantine nodes injected device-side on the message wire, correlated
+link failure), a config (including the robust-aggregation fire modes),
+and a declared expected observable signature that ``doctor`` asserts
+against the scenario's manifest — with ``inspect --blame`` required to
+localize the planted adversary at rank 1.
+
+Entry points: the ``scenarios`` CLI subcommand,
+:func:`~flow_updating_tpu.scenarios.run.run_scenarios`, and
+``bench.py --scenario`` (isolated ``scn_<name>`` baseline keys).
+"""
+
+from flow_updating_tpu.scenarios.adversary import Adversary
+from flow_updating_tpu.scenarios.registry import (
+    REGISTRY,
+    Scenario,
+    ScenarioCase,
+    get_scenario,
+    scenario_names,
+)
+from flow_updating_tpu.scenarios.run import (
+    run_scenario,
+    run_scenarios,
+    scenario_manifest,
+)
+
+__all__ = [
+    "Adversary", "REGISTRY", "Scenario", "ScenarioCase", "get_scenario",
+    "run_scenario", "run_scenarios", "scenario_manifest",
+    "scenario_names",
+]

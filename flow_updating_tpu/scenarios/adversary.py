@@ -1,0 +1,131 @@
+"""Device-side fault/adversary specs for the scenario registry.
+
+An :class:`Adversary` is a *host-side plan* of which nodes lie, which
+edges corrupt their wire flow, which senders drop silently and which
+link set suffers a scheduled correlated failure.  It lowers to the
+``adv_*`` leaves of :class:`~flow_updating_tpu.topology.graph.TopoArrays`
+(:meth:`Adversary.device_leaves`), where the round kernel injects the
+faults **on the wire** — the honest per-edge ledgers are never touched,
+so the observability stack sees exactly what a real deployment would:
+honest state, corrupted messages (models/rounds.py ``fire_core`` /
+``send_messages``).
+
+Absence is pytree STRUCTURE: every leaf defaults to ``None`` and an
+adversary-free topology compiles the bit-identical plain program.  Under
+the sweep engine the leaves vmap per lane, so one compiled bucket serves
+a whole scenario x seed grid — but only lanes with the same
+:meth:`structure_key` may share a bucket (a ``None`` mask would split
+the vmapped treedef), which is why the packing layer folds the key into
+its bucket grouping (sweep/pack.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["Adversary"]
+
+
+def _ids(x) -> tuple:
+    return tuple(int(i) for i in np.atleast_1d(np.asarray(x, np.int64)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Adversary:
+    """One scenario's planted faults, by original node/edge id.
+
+    * ``lie_nodes`` / ``lie_value`` — value lies: every message a lying
+      node sends reports ``lie_value`` as its estimate.
+    * ``corrupt_edges`` / ``corrupt_gain`` — flow corruption: the WIRE
+      copy of the flow ledger is scaled by ``corrupt_gain`` on these
+      directed edges (the receiver's antisymmetry write then no longer
+      cancels the sender's honest ledger).
+    * ``silent_nodes`` — silent drops: every send from these nodes is
+      lost on the wire while the sender's ledger updates regardless.
+    * ``down_edges`` / ``down_from`` / ``down_until`` — scheduled
+      correlated link failure: the edges lose every send during rounds
+      ``[down_from, down_until)`` (partition a subtree, then heal).
+    """
+
+    lie_nodes: tuple = ()
+    lie_value: float = 0.0
+    corrupt_edges: tuple = ()
+    corrupt_gain: float = 1.0
+    silent_nodes: tuple = ()
+    down_edges: tuple = ()
+    down_from: int = 0
+    down_until: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "lie_nodes", _ids(self.lie_nodes))
+        object.__setattr__(self, "corrupt_edges", _ids(self.corrupt_edges))
+        object.__setattr__(self, "silent_nodes", _ids(self.silent_nodes))
+        object.__setattr__(self, "down_edges", _ids(self.down_edges))
+        if self.down_edges and not self.down_until > self.down_from >= 0:
+            raise ValueError(
+                f"down window [{self.down_from}, {self.down_until}) is "
+                "empty; schedule at least one dead round (or drop the "
+                "down_edges)")
+
+    def __bool__(self) -> bool:
+        return bool(self.lie_nodes or self.corrupt_edges
+                    or self.silent_nodes or self.down_edges)
+
+    def structure_key(self) -> tuple:
+        """Which leaf families are statically present — the part of the
+        compiled program's identity this adversary contributes.  Lanes
+        may share a vmapped sweep bucket iff their keys agree."""
+        return (bool(self.lie_nodes), bool(self.corrupt_edges),
+                bool(self.silent_nodes), bool(self.down_edges))
+
+    def device_leaves(self, n_pad: int, e_pad: int, dtype) -> dict:
+        """The ``TopoArrays.replace`` kwargs: masks padded to the bucket
+        shape (ghost slots never lie/corrupt/drop), values as ()-shaped
+        device scalars.  Only present families emit leaves — absence
+        stays ``None`` (pytree structure)."""
+        import jax.numpy as jnp
+
+        def mask(ids, size):
+            m = np.zeros(size, bool)
+            ids = np.asarray(ids, np.int64)
+            if ids.size and (ids.min() < 0 or ids.max() >= size):
+                raise ValueError(
+                    f"adversary id(s) {ids[(ids < 0) | (ids >= size)]} "
+                    f"outside [0, {size})")
+            m[ids] = True
+            return jnp.asarray(m)
+
+        out: dict = {}
+        if self.lie_nodes:
+            out["adv_lie_mask"] = mask(self.lie_nodes, n_pad)
+            out["adv_lie_value"] = jnp.asarray(self.lie_value, dtype)
+        if self.corrupt_edges:
+            out["adv_corrupt_mask"] = mask(self.corrupt_edges, e_pad)
+            out["adv_corrupt_gain"] = jnp.asarray(self.corrupt_gain, dtype)
+        if self.silent_nodes:
+            out["adv_silent_mask"] = mask(self.silent_nodes, n_pad)
+        if self.down_edges:
+            out["adv_down_mask"] = mask(self.down_edges, e_pad)
+            out["adv_down_from"] = jnp.asarray(self.down_from, jnp.int32)
+            out["adv_down_until"] = jnp.asarray(self.down_until, jnp.int32)
+        return out
+
+    def describe(self) -> dict:
+        """Manifest-grade ground truth: the planted culprits a
+        conformance check verifies blame against."""
+        out: dict = {}
+        if self.lie_nodes:
+            out["lie"] = {"nodes": list(self.lie_nodes),
+                          "value": float(self.lie_value)}
+        if self.corrupt_edges:
+            out["corrupt"] = {"edges": list(self.corrupt_edges),
+                              "gain": float(self.corrupt_gain)}
+        if self.silent_nodes:
+            out["silent"] = {"nodes": list(self.silent_nodes)}
+        if self.down_edges:
+            out["down"] = {"edges": list(self.down_edges),
+                           "from": int(self.down_from),
+                           "until": int(self.down_until)}
+        return out

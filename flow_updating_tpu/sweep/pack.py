@@ -70,7 +70,16 @@ class SweepInstance:
     instance only (they become the lane's traced :class:`RoundParams`);
     ``None`` inherits the config value.  ``values`` optionally replaces
     the topology's node values (``(N,)`` or ``(N, D)``); ``tag`` is
-    free-form grid metadata echoed into the sweep manifest record."""
+    free-form grid metadata echoed into the sweep manifest record.
+
+    ``adversary`` (optional) is a device-side Byzantine fault spec (an
+    :class:`~flow_updating_tpu.scenarios.adversary.Adversary`, or any
+    object with ``device_leaves(n_pad, e_pad, dtype)`` /
+    ``structure_key()``): its mask leaves are padded to the bucket shape
+    and stacked per lane, so one compiled bucket program serves
+    adversarial and honest lanes alike — but only lanes whose adversary
+    STRUCTURE matches share a bucket (a None-mask lane would otherwise
+    split the vmapped treedef)."""
 
     topo: Topology
     seed: int = 0
@@ -79,6 +88,7 @@ class SweepInstance:
     latency_scale: float | None = None
     contention_scale: float | None = None
     values: object | None = None
+    adversary: object | None = None
     tag: dict = dataclasses.field(default_factory=dict)
 
     def params(self, cfg: RoundConfig) -> RoundParams:
@@ -166,6 +176,11 @@ def pack_instance(inst: SweepInstance, cfg: RoundConfig,
         arrays = arrays.replace(
             num_colors=0,
             num_colors_arr=jnp.asarray(arrays.num_colors, jnp.int32))
+    if inst.adversary is not None:
+        # Byzantine mask leaves, padded to the bucket shape (ghost slots
+        # never lie/corrupt/drop — they are dead and edge-failed anyway)
+        arrays = arrays.replace(**inst.adversary.device_leaves(
+            n_pad, e_pad, cfg.jnp_dtype))
     values = None
     if inst.values is not None:
         vals = np.asarray(inst.values, np.float64)
@@ -207,7 +222,16 @@ def pack_instances(instances, cfg: RoundConfig,
     for idx, inst in enumerate(instances):
         feat = (() if inst.values is None
                 else np.asarray(inst.values).shape[1:])
-        key = bucket_shape(inst.topo, n_min=n_min, e_min=e_min) + feat
+        # the adversary's structure key is part of the bucket identity:
+        # its mask leaves are pytree STRUCTURE, so a lie-mask lane and a
+        # mask-free lane cannot stack into one vmapped treedef (and would
+        # not share a compile anyway); an all-empty adversary emits zero
+        # leaves, so it merges with the adversary-free lanes (truthiness,
+        # matching Adversary.__bool__)
+        adv = (inst.adversary.structure_key()
+               if inst.adversary else None)
+        shape = bucket_shape(inst.topo, n_min=n_min, e_min=e_min) + feat
+        key = (shape, adv)
         if key not in groups:
             groups[key] = []
             order.append(key)
@@ -216,7 +240,8 @@ def pack_instances(instances, cfg: RoundConfig,
     buckets = []
     for key in order:
         members = groups[key]
-        n_pad, e_pad = key[0], key[1]
+        shape = key[0]
+        n_pad, e_pad = shape[0], shape[1]
         step = max_batch or len(members)
         for lo in range(0, len(members), step):
             chunk = members[lo: lo + step]
@@ -253,7 +278,7 @@ def pack_instances(instances, cfg: RoundConfig,
                     rec["tag"] = dict(inst.tag)
                 meta.append(rec)
             buckets.append(SweepBucket(
-                shape=key,
+                shape=shape,
                 states=states,
                 arrays=arrays,
                 params=params,

@@ -152,11 +152,15 @@ def run_sweep(instances, cfg: RoundConfig, rounds: int, spec=None,
     # a compile is keyed by the full traced structure, not just the
     # bucket shape: lane count and row width (both visible in
     # sweep_edge_rows' (B, N_pad, W) shape), payload feature shape
-    # (means), and the statically-absent drop leaf all split the cache
+    # (means), the statically-absent drop leaf, and which adversary mask
+    # families are present (scenarios/) all split the cache
     compile_keys = {
         (np.shape(np.asarray(b.arrays.sweep_edge_rows)),
          np.shape(np.asarray(b.means)),
-         b.params.drop_rate is None)
+         b.params.drop_rate is None,
+         tuple(getattr(b.arrays, leaf) is not None
+               for leaf in ("adv_lie_mask", "adv_corrupt_mask",
+                            "adv_silent_mask", "adv_down_mask")))
         for b in buckets}
     bucket_rows = []
     for i, b in enumerate(buckets):

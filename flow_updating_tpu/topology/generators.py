@@ -200,7 +200,17 @@ def community(n: int, c: int = 8, k_in: float = 8.0, k_out: float = 0.5,
         pairs.append(np.stack([chain_u, chain_v], axis=1))
     all_pairs = (np.concatenate(pairs) if pairs
                  else np.empty((0, 2), np.int64))
-    return _finish(n, all_pairs, seed, values)
+    topo = _finish(n, all_pairs, seed, values)
+    # planted-partition ground truth rides the topology: block membership
+    # per node and the directed edge ids crossing blocks — scenarios,
+    # membership-aware heatmaps and partition blame consume these instead
+    # of re-deriving the partition from the edge list
+    membership = (np.searchsorted(bounds, np.arange(n), side="right") - 1
+                  ).astype(np.int32)
+    bridge = np.flatnonzero(
+        membership[topo.src] != membership[topo.dst]).astype(np.int64)
+    return dataclasses.replace(topo, membership=membership,
+                               bridge_edges=bridge)
 
 
 def fat_tree(k: int, seed: int = 0, values=None, hosts_only_values: bool = True,

@@ -87,6 +87,15 @@ class Topology:
     #                                          original edge id, so a planned
     #                                          drop>0 run replays the exact
     #                                          original loss realization
+    membership: np.ndarray | None = None     # (N,) int32 planted-partition
+    #                                          block id — the community
+    #                                          generator exposes its ground
+    #                                          truth so scenarios, heatmaps
+    #                                          and blame never re-derive it
+    bridge_edges: np.ndarray | None = None   # (B,) int64 directed edge ids
+    #                                          crossing community blocks
+    #                                          (membership[src] !=
+    #                                          membership[dst])
 
     @property
     def num_edges(self) -> int:
@@ -464,6 +473,31 @@ class TopoArrays:
     seg_extract_masks: tuple = ()    # row-end -> node Beneš masks
     seg_place_masks: tuple = ()      # node -> row-head Beneš masks
     seg_plan: object = struct.field(pytree_node=False, default=None)
+    # device-side Byzantine fault injection (flow_updating_tpu.scenarios):
+    # the round kernel corrupts the WIRE, never the honest ledgers.  None
+    # (the default everywhere) is pytree STRUCTURE — the injection is
+    # statically absent and the compiled program is bit-identical to the
+    # plain one.  Masks vmap per-lane under the sweep engine.
+    adv_lie_mask: object = None      # (N,) bool — value-lying nodes: every
+    #                                  message they send reports
+    #                                  adv_lie_value as the estimate
+    adv_lie_value: object = None     # () payload dtype — the reported lie
+    adv_corrupt_mask: object = None  # (E,) bool — edges whose outgoing wire
+    #                                  flow is scaled by adv_corrupt_gain
+    #                                  (the receiver's antisymmetry write
+    #                                  then no longer cancels the sender's)
+    adv_corrupt_gain: object = None  # () — wire-flow multiplier
+    adv_silent_mask: object = None   # (N,) bool — silently dropping
+    #                                  senders: every send is lost on the
+    #                                  wire, the sender's ledger updates
+    #                                  regardless (exactly a lost put)
+    adv_down_mask: object = None     # (E,) bool — scheduled correlated
+    #                                  link failure: the masked edges
+    #                                  lose every send during rounds
+    #                                  [adv_down_from, adv_down_until)
+    #                                  (partition a subtree, then heal)
+    adv_down_from: object = None     # () int32 — first dead round
+    adv_down_until: object = None    # () int32 — first healed round
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -573,6 +607,12 @@ def reorder_topology(topo: Topology, order: np.ndarray) -> Topology:
         adopted=None,
         edge_links=pick_e(topo.edge_links),
         lat_rounds=pick_e(topo.lat_rounds),
+        # planted-partition ground truth follows the renumbering: block
+        # ids travel with their nodes, bridge edge ids with their edges
+        membership=(None if topo.membership is None
+                    else topo.membership[order].astype(np.int32)),
+        bridge_edges=(None if topo.bridge_edges is None
+                      else np.sort(e_pos[topo.bridge_edges])),
         # a structure descriptor indexes sections by the GENERATOR's node
         # layout; after renumbering it would compute silently wrong
         # stencil sums (same reasoning as pad_topology)

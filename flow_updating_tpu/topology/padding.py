@@ -130,6 +130,10 @@ def pad_topology_to(topo: Topology, n_pad: int, e_pad: int,
         lat_rounds=None,
         # a structure descriptor indexes the UNpadded node layout
         structure=None,
+        # planted-partition ground truth is sized to the UNpadded arrays;
+        # scenario/blame consumers read it from the original topology
+        membership=None,
+        bridge_edges=None,
     )
     # carry a computed coloring through (extended with -1 on pad
     # self-loops) so the padded instance runs the SAME matching sequence;

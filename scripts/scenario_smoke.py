@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""CI scenario-conformance smoke: the adversarial loop end to end.
+
+Runs two registered scenarios — one Byzantine (``byzantine_lie``: a
+value-lying node poisons the average, blame must name it) and the
+correlated-failure case (``partition_heal``: a community's bridges die
+and heal, conservation must recover) — through the ``scenarios`` CLI:
+seed grid under the sweep engine, representative field run, blame, and
+the declared-signature conformance checks, writing the
+``flow-updating-scenario-report/v1`` manifest into ``--outdir`` (the
+tier1 workflow uploads it).
+
+Then the negative control: the SAME Byzantine scenario with the planted
+adversary removed must FAIL its signature (exit 1 from the CLI) — a
+conformance suite that cannot reject the honest run asserts nothing.
+
+Finally ``doctor --strict`` re-judges the saved manifest offline and
+``inspect --blame`` must name the planted liar at rank 1 from the
+manifest's field block alone.
+
+Exit code: 0 when every step lands as declared; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SCENARIOS = ["byzantine_lie", "partition_heal"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--seeds", type=int, default=2)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    from flow_updating_tpu.cli import main as cli_main
+
+    manifest_path = os.path.join(args.outdir, "scenario_report.json")
+    rc = cli_main(["scenarios", *SCENARIOS, "--backend", "cpu",
+                   "--seeds", str(args.seeds),
+                   "--report", manifest_path, "--strict"])
+    if rc != 0:
+        print(f"scenario_smoke: conformance run failed (rc={rc})",
+              file=sys.stderr)
+        return rc or 1
+
+    # negative control: the signature must REJECT the adversary-free run
+    rc = cli_main(["scenarios", "byzantine_lie", "--backend", "cpu",
+                   "--seeds", "1", "--perturb", "remove_adversary"])
+    if rc == 0:
+        print("scenario_smoke: PERTURBED run passed its signature — "
+              "the conformance suite is vacuous", file=sys.stderr)
+        return 1
+
+    # doctor re-judges the saved manifest offline (the CI contract)
+    rc = cli_main(["doctor", manifest_path, "--strict"])
+    if rc != 0:
+        print(f"scenario_smoke: doctor rejects the saved manifest "
+              f"(rc={rc})", file=sys.stderr)
+        return rc or 1
+
+    # blame the planted liar from the manifest's own records
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    by_name = {r["name"]: r for r in manifest["scenarios"]}
+    liar = by_name["byzantine_lie"]["blame"]["liar"]
+    planted = by_name["byzantine_lie"]["ground_truth"]["lie"]["nodes"]
+    if not liar or liar[0]["node"] != planted[0]:
+        print(f"scenario_smoke: blame ranked {liar[:1]}, expected "
+              f"planted node {planted[0]} at rank 1", file=sys.stderr)
+        return 1
+    block = by_name["partition_heal"]["blame"].get("partition") or {}
+    want = by_name["partition_heal"]["ground_truth"]["partition_block"]
+    if block.get("block") != want:
+        print(f"scenario_smoke: partition blame {block} != planted "
+              f"block {want}", file=sys.stderr)
+        return 1
+
+    print(json.dumps({
+        "scenario_smoke": "ok",
+        "manifest": manifest_path,
+        "scenarios": SCENARIOS,
+        "blamed_liar": liar[0]["node"],
+        "blamed_block": block.get("block"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,10 @@ SLOW_TESTS = {
         "test_backlog_kernel_matches_same_model_oracle",
     },
     "test_pairwise.py": {"test_segmented_affine_scan_matches_loop"},
+    "test_scenarios.py": {
+        "test_full_registry_conformance_and_perturbations",
+        "test_byzantine_lie_signature_passes_and_perturbation_fails",
+    },
     "test_faults.py": {
         "test_kill_revive_reconverges_pairwise",
         "test_kill_revive_reconverges_collectall",

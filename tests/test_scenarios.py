@@ -1,0 +1,395 @@
+"""Scenario conformance suite tests (flow_updating_tpu.scenarios).
+
+Pins the conformance LOOP, both directions: every registered scenario's
+declared signature passes the doctor on its own run, and FAILS on a
+perturbed run (planted adversary removed / healing disabled).  Plus the
+static guarantees: robust-aggregation modes off leave the lowered round
+program identical to the plain one, adversary-free scenario plumbing is
+bit-exact with the ordinary engine path, adversary structure splits
+sweep buckets, and the community generator's planted-partition metadata
+rides topology transforms.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs import health
+from flow_updating_tpu.obs import inspect as obs_inspect
+from flow_updating_tpu.scenarios import (
+    Adversary,
+    get_scenario,
+    run_scenario,
+    run_scenarios,
+    scenario_manifest,
+)
+from flow_updating_tpu.topology.generators import community
+
+
+# ---- adversary spec ------------------------------------------------------
+
+def test_adversary_defaults_are_absent():
+    adv = Adversary()
+    assert not adv
+    assert adv.device_leaves(8, 16, np.float32) == {}
+    assert adv.structure_key() == (False, False, False, False)
+
+
+def test_adversary_empty_down_window_rejected():
+    with pytest.raises(ValueError, match="down window"):
+        Adversary(down_edges=(1,), down_from=5, down_until=5)
+
+
+def test_adversary_out_of_range_ids_rejected():
+    adv = Adversary(lie_nodes=(9,), lie_value=1.0)
+    with pytest.raises(ValueError, match="outside"):
+        adv.device_leaves(8, 16, np.float32)
+
+
+def test_adversary_structure_key_families():
+    adv = Adversary(lie_nodes=(1,), lie_value=2.0, silent_nodes=(3,))
+    assert adv.structure_key() == (True, False, True, False)
+    leaves = adv.device_leaves(8, 16, np.float32)
+    assert set(leaves) == {"adv_lie_mask", "adv_lie_value",
+                           "adv_silent_mask"}
+    assert bool(np.asarray(leaves["adv_lie_mask"])[1])
+    # describe() is the manifest-grade ground truth
+    assert adv.describe() == {
+        "lie": {"nodes": [1], "value": 2.0},
+        "silent": {"nodes": [3]},
+    }
+
+
+# ---- robust-aggregation config ------------------------------------------
+
+def test_robust_mode_validation():
+    with pytest.raises(ValueError, match="unknown robust"):
+        RoundConfig.fast(robust="median")
+    with pytest.raises(ValueError, match="collectall"):
+        RoundConfig.fast(variant="pairwise", robust="clip",
+                         robust_clip=1.0)
+    with pytest.raises(ValueError, match="robust_clip > 0"):
+        RoundConfig.fast(robust="clip")
+    with pytest.raises(ValueError, match="set robust='clip'"):
+        RoundConfig.fast(robust_clip=1.0)
+    with pytest.raises(ValueError, match="set robust='trim'"):
+        RoundConfig.fast(robust_tol=1.0)
+    with pytest.raises(ValueError, match="kernel='edge'"):
+        RoundConfig.fast(kernel="node", robust="clip", robust_clip=1.0)
+
+
+def _lowered_text(topo, cfg, adversary=None, rounds=4):
+    arrays = topo.device_arrays()
+    if adversary is not None:
+        arrays = arrays.replace(**adversary.device_leaves(
+            topo.num_nodes, topo.num_edges, cfg.jnp_dtype))
+    state = init_state(topo, cfg, seed=0)
+    return jax.jit(run_rounds, static_argnames=(
+        "cfg", "num_rounds")).lower(
+            state, arrays, cfg, rounds).as_text()
+
+
+def test_robust_off_and_empty_adversary_compile_the_plain_program():
+    """The static-off guarantee: robust='off' + an absent adversary is
+    byte-for-byte the plain lowered program, while each robust mode and
+    each planted mask family changes it (the knobs are real)."""
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+    cfg = RoundConfig.fast()
+    plain = _lowered_text(topo, cfg)
+    assert _lowered_text(topo, cfg, adversary=None) == plain
+    # an EMPTY adversary contributes no leaves: identical program
+    assert Adversary().device_leaves(
+        topo.num_nodes, topo.num_edges, cfg.jnp_dtype) == {}
+    clip = dataclasses.replace(cfg, robust="clip", robust_clip=1.0)
+    trim = dataclasses.replace(cfg, robust="trim", robust_tol=0.5)
+    assert _lowered_text(topo, clip) != plain
+    assert _lowered_text(topo, trim) != plain
+    lie = Adversary(lie_nodes=(1,), lie_value=9.0)
+    assert _lowered_text(topo, cfg, adversary=lie) != plain
+
+
+def test_engine_adversary_none_is_bit_exact():
+    """Engine(adversary=None) and the plain engine produce bit-identical
+    estimates; a planted liar changes them."""
+    from flow_updating_tpu.engine import Engine
+
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+
+    def run(adv):
+        eng = Engine(config=RoundConfig.fast(), adversary=adv)
+        eng.set_topology(topo)
+        eng.build(seed=0)
+        eng.run_rounds(32)
+        return np.asarray(eng.estimates())
+
+    honest = run(None)
+    assert np.array_equal(run(Adversary()), honest)
+    lied = run(Adversary(lie_nodes=(1,), lie_value=50.0))
+    assert not np.array_equal(lied, honest)
+
+
+def test_engine_adversary_validation():
+    from flow_updating_tpu.engine import Engine
+
+    topo = community(16, c=2, k_in=4.0, k_out=0.0, seed=0)
+    adv = Adversary(lie_nodes=(1,), lie_value=9.0)
+    eng = Engine(config=RoundConfig.fast(kernel="node"), adversary=adv)
+    eng.set_topology(topo)
+    with pytest.raises(ValueError, match="kernel='edge'"):
+        eng.build()
+    eng = Engine(config=RoundConfig.fast(variant="pairwise"),
+                 adversary=adv)
+    eng.set_topology(topo)
+    with pytest.raises(ValueError, match="no wire to attack"):
+        eng.build()
+
+
+def test_trim_and_clip_do_not_break_honest_convergence():
+    """Robust modes on an HONEST run still converge (trim disarms once
+    spread is inside tol; clip above equilibrium |flow| never binds)."""
+    topo = community(48, c=2, k_in=6.0, k_out=0.0, seed=0)
+    rng = np.random.default_rng(5)
+    topo = topo.with_values(rng.uniform(0.0, 1.0, 48))
+    arrays = topo.device_arrays()
+    for cfg in (RoundConfig.fast(robust="clip", robust_clip=8.0),
+                RoundConfig.fast(robust="trim", robust_tol=2.0)):
+        state = init_state(topo, cfg, seed=0)
+        state = run_rounds(state, arrays, cfg, 200)
+        est = np.asarray(node_estimates(state, arrays))
+        assert np.max(np.abs(est - topo.true_mean)) < 1e-3, cfg.robust
+
+
+# ---- community metadata (satellite: planted-partition ground truth) -----
+
+def test_community_metadata_membership_and_bridges():
+    topo = community(96, c=3, k_in=8.0, k_out=0.5, seed=1)
+    memb = topo.membership
+    assert memb is not None and memb.shape == (96,)
+    assert set(np.unique(memb)) == {0, 1, 2}
+    bridge = topo.bridge_edges
+    assert bridge is not None and bridge.size > 0
+    src, dst = np.asarray(topo.src), np.asarray(topo.dst)
+    # exactly the directed edges crossing blocks, no more, no fewer
+    crossing = np.flatnonzero(memb[src] != memb[dst])
+    assert np.array_equal(np.sort(bridge), crossing)
+
+
+def test_community_metadata_survives_reorder():
+    from flow_updating_tpu.topology.graph import reorder_topology
+
+    topo = community(48, c=2, k_in=6.0, k_out=0.5, seed=3)
+    order = np.random.default_rng(0).permutation(48)
+    re = reorder_topology(topo, order)
+    # block ids travel with their nodes...
+    assert np.array_equal(re.membership, topo.membership[order])
+    # ...and the bridge set still marks exactly the crossing edges
+    src, dst = np.asarray(re.src), np.asarray(re.dst)
+    crossing = np.flatnonzero(re.membership[src] != re.membership[dst])
+    assert np.array_equal(np.sort(re.bridge_edges), crossing)
+
+
+def test_community_metadata_cleared_by_padding():
+    from flow_updating_tpu.topology.padding import pad_topology_to
+
+    topo = community(48, c=2, k_in=6.0, k_out=0.5, seed=3)
+    padded = pad_topology_to(topo, 64, 1024, spread="even")
+    assert padded.membership is None and padded.bridge_edges is None
+
+
+# ---- sweep packing with adversaries -------------------------------------
+
+def test_sweep_buckets_split_by_adversary_structure():
+    from flow_updating_tpu.sweep import SweepInstance, pack_instances
+
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+    cfg = RoundConfig.fast()
+    lie = Adversary(lie_nodes=(1,), lie_value=9.0)
+    lie2 = Adversary(lie_nodes=(2,), lie_value=5.0)
+    silent = Adversary(silent_nodes=(3,))
+    insts = [
+        SweepInstance(topo=topo, seed=0),                  # honest
+        SweepInstance(topo=topo, seed=1, adversary=lie),   # lie family
+        SweepInstance(topo=topo, seed=2, adversary=lie2),  # same family
+        SweepInstance(topo=topo, seed=3, adversary=silent),
+        SweepInstance(topo=topo, seed=4),                  # honest again
+    ]
+    buckets = pack_instances(insts, cfg)
+    # same shape, three adversary STRUCTURES -> three buckets; the two
+    # lie lanes (same structure, different masks) share one
+    assert len(buckets) == 3
+    sizes = sorted(b.size for b in buckets)
+    assert sizes == [1, 2, 2]
+    # input order is preserved through the instance index
+    got = sorted(m["instance"] for b in buckets for m in b.meta)
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_sweep_adversarial_lane_matches_single_device():
+    """A lie lane under the vmapped sweep bucket reproduces the single-
+    device adversarial run bit-for-bit (the injection vmaps, the honest
+    lanes stay honest)."""
+    from flow_updating_tpu.sweep import SweepInstance, pack_instances
+    from flow_updating_tpu.sweep.batch import run_bucket
+
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+    cfg = RoundConfig.fast()
+    lie = Adversary(lie_nodes=(1,), lie_value=9.0)
+    insts = [SweepInstance(topo=topo, seed=0, adversary=lie),
+             SweepInstance(topo=topo, seed=1, adversary=lie)]
+    bucket = pack_instances(insts, cfg)[0]
+    out = run_bucket(bucket, cfg, 40)
+
+    arrays = topo.device_arrays().replace(**lie.device_leaves(
+        topo.num_nodes, topo.num_edges, cfg.jnp_dtype))
+    ref = run_rounds(init_state(topo, cfg, seed=0), arrays, cfg, 40)
+    lane0 = jax.tree.map(lambda x: x[0], out)
+    be = np.asarray(node_estimates(
+        lane0, jax.tree.map(lambda x: x[0], bucket.arrays)))
+    se = np.asarray(node_estimates(ref, arrays))
+    assert np.array_equal(be[: topo.num_nodes], se)
+
+
+# ---- the conformance loop (fast representatives) ------------------------
+
+def _conformance(records, summary):
+    man = scenario_manifest(records, summary)
+    return man, health.check_scenario_conformance(man)
+
+
+def test_byzantine_lie_signature_passes_and_perturbation_fails():
+    scn = get_scenario("byzantine_lie")
+    rec = run_scenario(scn, seeds=(0,))
+    man, checks = _conformance([rec], {})
+    assert health.overall(checks) == "pass", \
+        [c.summary for c in checks if c.status != "pass"]
+    # doctor end-to-end on the manifest (the CI contract)
+    assert health.overall(health.diagnose_manifest(man)) == "pass"
+    # negative control: adversary withdrawn -> the signature FAILS
+    rec_p = run_scenario(scn, seeds=(0,), perturb="remove_adversary")
+    _, checks_p = _conformance([rec_p], {})
+    assert health.overall(checks_p) == "fail"
+    assert health.exit_code(checks_p) == 1
+    failing = {c.name.split(":")[2].split("#")[0]
+               for c in checks_p if c.status == "fail"}
+    # both the attack-effect clause and the blame clause collapse
+    assert "final_rmse_above" in failing
+    assert "blame" in failing
+
+
+def test_silent_node_blame_rank1_deterministic():
+    scn = get_scenario("silent_node")
+    rec = run_scenario(scn, seeds=(0,))
+    ranked = rec["blame"]["stall"]
+    assert ranked and ranked[0]["node"] == 7
+    # rank 1 is deterministic: a second identical run ranks identically
+    rec2 = run_scenario(scn, seeds=(0,))
+    assert [e["node"] for e in rec2["blame"]["stall"]] == \
+        [e["node"] for e in ranked]
+
+
+def test_conformance_checker_rejects_tampered_blame():
+    """The checker itself discriminates: the same manifest with the
+    planted culprit edited out of the blame ranking fails the blame
+    clause (no re-run needed — this pins the judgment, not the run)."""
+    scn = get_scenario("byzantine_lie")
+    rec = run_scenario(scn, seeds=(0,))
+    _, checks = _conformance([rec], {})
+    assert health.overall(checks) == "pass"
+    tampered = json.loads(json.dumps(rec))
+    tampered["blame"]["liar"] = [
+        {"node": 9, "score": 1e6, "mass": 0.0}]
+    _, checks_t = _conformance([tampered], {})
+    bad = [c for c in checks_t if c.status == "fail"]
+    assert len(bad) == 1 and "blame" in bad[0].name
+
+
+def test_scenario_manifest_schema_and_doctor_dispatch():
+    scn = get_scenario("expander_relief")
+    rec = run_scenario(scn, seeds=(0,))
+    man = scenario_manifest([rec], {"scenarios": ["expander_relief"]})
+    assert man["schema"] == "flow-updating-scenario-report/v1"
+    checks = health.diagnose_manifest(man)
+    names = {c.name for c in checks}
+    # scenario manifests get environment + conformance ONLY — the
+    # healthy-run series rules never judge a planted fault
+    assert any(n.startswith("scn:") for n in names)
+    assert not any(n in ("rmse_stall", "mass_conservation")
+                   for n in names)
+    # per-instance series ride the record (the clause evidence source)
+    inst = rec["instances"][0]
+    assert "rmse" in inst["series"] and "mass_residual" in inst["series"]
+
+
+def test_unknown_scenario_names_registry():
+    with pytest.raises(ValueError, match="registered:"):
+        get_scenario("no_such_scenario")
+    with pytest.raises(ValueError, match="did you mean"):
+        get_scenario("byzantine_lei")
+
+
+def test_perturb_no_heal_requires_down_window():
+    with pytest.raises(ValueError, match="no link-down window"):
+        run_scenario(get_scenario("byzantine_lie"), seeds=(0,),
+                     perturb="no_heal")
+
+
+# ---- blame over sweep manifests (satellite) -----------------------------
+
+def test_blame_sweep_ranks_worst_instance():
+    manifest = {
+        "schema": "flow-updating-sweep-report/v1",
+        "instances": [
+            {"instance": 0, "tag": {"topology": "a", "seed": 0},
+             "convergence": {"converged": True, "converged_round": 30,
+                             "final_rmse": 1e-7},
+             "worst_nodes": [{"node": 3, "abs_err": 1e-7}]},
+            {"instance": 1, "tag": {"topology": "b", "seed": 0},
+             "convergence": {"converged": False, "converged_round": -1,
+                             "final_rmse": 0.25},
+             "worst_nodes": [{"node": 9, "abs_err": 0.4}]},
+        ],
+    }
+    out = obs_inspect.blame_sweep(manifest)
+    assert out["worst_instance"]["instance"] == 1
+    assert out["worst_instance"]["stragglers"][0]["node"] == 9
+    assert out["ranked_of"] == 2
+
+
+def test_blame_sweep_rejects_recordless_manifest():
+    with pytest.raises(ValueError, match="no instance records"):
+        obs_inspect.blame_sweep({"instances": []})
+
+
+# ---- full registry (slow: the acceptance criterion end-to-end) ----------
+
+def test_full_registry_conformance_and_perturbations():
+    """Every registered scenario: signature passes doctor --strict on
+    its own run; every adversarial scenario FAILS when the adversary is
+    removed; the partition scenario FAILS when healing is disabled."""
+    records, summary = run_scenarios(seeds=(0, 1))
+    man = scenario_manifest(records, summary)
+    checks = health.diagnose_manifest(man)
+    assert health.exit_code(checks, strict=True) == 0, \
+        [c.summary for c in checks if c.status not in ("pass", "skip")]
+    # one compiled program per shape x adversary-structure bucket
+    assert summary["sweep_compiles"] == len(records)
+
+    for rec in records:
+        if not rec.get("ground_truth", {}).keys() & \
+                {"lie", "corrupt", "silent", "down"}:
+            continue
+        name = rec["name"]
+        perturb = ("no_heal" if name == "partition_heal"
+                   else "remove_adversary")
+        rec_p = run_scenario(get_scenario(name), seeds=(0,),
+                             perturb=perturb)
+        _, checks_p = _conformance([rec_p], {})
+        assert health.overall(checks_p) == "fail", \
+            f"{name}: perturbed ({perturb}) run still passes — the " \
+            "signature is vacuous"
