@@ -515,11 +515,15 @@ def cmd_train(args) -> int:
     if args.samples_per_node < 1:
         raise SystemExit("--samples-per-node must be >= 1")
     topo = _build_topology(args)
-    ds = make_dataset(
-        topo.num_nodes, args.features,
-        samples_per_node=args.samples_per_node, task=args.task,
-        noise=args.noise, heterogeneity=args.heterogeneity, seed=args.seed,
-    )
+    try:
+        ds = make_dataset(
+            topo.num_nodes, args.features,
+            samples_per_node=args.samples_per_node, task=args.task,
+            noise=args.noise, heterogeneity=args.heterogeneity,
+            dirichlet_alpha=args.dirichlet_alpha, seed=args.seed,
+        )
+    except ValueError as err:
+        raise SystemExit(f"invalid dataset flags: {err}")
     maker = (RoundConfig.reference if args.fire_policy == "reference"
              else RoundConfig.fast)
     try:
@@ -529,7 +533,10 @@ def cmd_train(args) -> int:
             global_avg_every=args.global_avg_every,
         )
         rcfg = maker(variant=args.variant, dtype=args.dtype)
-        trainer = GossipSGDTrainer(topo, ds, gcfg, round_cfg=rcfg)
+        trainer = GossipSGDTrainer(
+            topo, ds, gcfg, round_cfg=rcfg, chunk=args.chunk,
+            feature_shards=args.feature_shards,
+            rounds_per_visit=args.rounds_per_visit or None)
     except ValueError as err:
         raise SystemExit(f"invalid flag combination: {err}")
     churn = _parse_churn(args.churn_kill, args.churn_revive,
@@ -1496,6 +1503,22 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--heterogeneity", type=float, default=0.0,
                     help="per-node feature-distribution shift (non-IID "
                          "shards; 0 = IID)")
+    tr.add_argument("--dirichlet-alpha", type=float, default=None,
+                    help="Dirichlet non-IID shard synthesis: node "
+                         "cluster mixtures ~ Dir(alpha) over latent "
+                         "feature clusters (small alpha = strongly "
+                         "non-IID; omit for none)")
+    tr.add_argument("--chunk", type=int, default=0,
+                    help="pipelined chunked gossip: stream the D-feature"
+                         " payload through edges in c-lane slices "
+                         "(a divisor of D; 0 = monolithic)")
+    tr.add_argument("--rounds-per-visit", type=int, default=0,
+                    help="with --chunk: rounds each chunk advances per "
+                         "schedule visit (0 = the config's canonical "
+                         "visit length)")
+    tr.add_argument("--feature-shards", type=int, default=0,
+                    help="shard the payload feature axis over this many "
+                         "devices (model parallelism; 0 = off)")
     tr.add_argument("--lr", type=float, default=0.2)
     tr.add_argument("--local-steps", type=int, default=1,
                     help="gradient steps per outer step")

@@ -718,10 +718,31 @@ class Engine:
     def plan_report(self) -> dict | None:
         """JSON-ready record of the plan decision (None when planning
         was off or fell back) — the ``plan`` block of run and plan
-        manifests (``flow-updating-plan-report/v1``)."""
+        manifests (``flow-updating-plan-report/v1``).  Vector-payload
+        engines additionally carry the payload-schedule ranking (the
+        chunked-vs-monolithic payload-bytes term of plan='auto',
+        plan/select.select_payload_schedule) so manifests record how
+        the DFL schedules would rank on this topology/backend."""
         if self.plan_decision is None:
             return None
-        return self.plan_decision.describe()
+        out = self.plan_decision.describe()
+        vals = self.topology.values if self.topology is not None else None
+        if vals is not None and getattr(vals, "ndim", 1) > 1:
+            from flow_updating_tpu.plan.select import (
+                select_payload_schedule,
+            )
+
+            feats = int(vals.size // vals.shape[0])
+            try:
+                import jax.numpy as _jnp
+
+                out["payload_schedule"] = select_payload_schedule(
+                    self.topology, features=feats,
+                    dtype_bytes=_jnp.dtype(
+                        self.config.jnp_dtype).itemsize)
+            except ValueError as exc:
+                out["payload_schedule"] = {"error": str(exc)}
+        return out
 
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
         """Resolve deployment(+platform) into topology + fresh state."""

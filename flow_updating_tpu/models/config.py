@@ -221,8 +221,17 @@ class RoundConfig:
     #                                    flow_updating_tpu.plan — RCM
     #                                    reorder handled by the kernel)
     robust: str = "off"                # robust-aggregation variant of the
-    #                                    collect-all fire/average step
-    #                                    (Byzantine tolerance, scenarios/):
+    #                                    fire/average step, BOTH protocol
+    #                                    families (Byzantine tolerance,
+    #                                    scenarios/).  Collect-all trims/
+    #                                    clips the neighborhood average;
+    #                                    pairwise applies the same ledger
+    #                                    clamp to the 2-party exchange
+    #                                    ('clip') or refuses to match /
+    #                                    fire along its single highest-
+    #                                    and lowest-estimate edges while
+    #                                    the neighborhood spread exceeds
+    #                                    robust_tol ('trim'):
     #                                    'off' (the historical average —
     #                                    statically off, the compiled
     #                                    program is bit-identical to
@@ -335,11 +344,6 @@ class RoundConfig:
         if self.robust not in ("off", "trim", "clip"):
             raise ValueError(f"unknown robust mode {self.robust!r} "
                              "(use 'off', 'trim' or 'clip')")
-        if self.robust != "off" and self.variant != COLLECTALL:
-            raise ValueError(
-                "robust aggregation modifies the collect-all fire/average "
-                "step; the pairwise 2-party exchange has nothing to trim "
-                "or clip (variant='collectall')")
         if self.robust != "off" and self.kernel != "edge":
             raise ValueError(
                 "robust aggregation is implemented in the edge kernel's "

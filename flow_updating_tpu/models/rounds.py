@@ -40,6 +40,7 @@ from flow_updating_tpu.models.config import (
     RoundParams,
 )
 from flow_updating_tpu.models.state import FlowUpdatingState, _ex, _feat
+from flow_updating_tpu.utils import struct
 from flow_updating_tpu.ops.segment import (
     ell_segment_all,
     ell_segment_max,
@@ -268,6 +269,42 @@ def _align_drop(keep, topo):
     return keep[topo.drop_perm]
 
 
+def _trim_extreme_edges(state: FlowUpdatingState, topo, cfg: RoundConfig,
+                        N: int, dt):
+    """The trimmed-mean mark (robust='trim', both protocol families): a
+    node with degree >= 3 whose neighbor-estimate spread exceeds
+    ``cfg.robust_tol`` marks its single highest and single lowest
+    neighbor-estimate edge (one edge each — ties broken by edge rank, so
+    the mark is deterministic).  Returns the ``(E,)`` marked-edge mask;
+    the caller decides what exclusion means for its family (collect-all
+    freezes the edge out of the average and the flow exchange, pairwise
+    refuses to match / fire along it)."""
+    est_hi = _seg_max(state.est, topo, N,
+                      jnp.asarray(jnp.finfo(dt).min, dt))
+    est_lo = _seg_min(state.est, topo, N,
+                      jnp.asarray(jnp.finfo(dt).max, dt))
+    tol = jnp.asarray(cfg.robust_tol, dt)
+    can = (topo.out_deg >= 3) & (est_hi - est_lo > tol)
+    can_e = _bcast(can, topo)
+    # one edge per extreme: among the edges attaining the neighborhood
+    # max (resp. min), keep the lowest edge rank
+    at_hi = can_e & (state.est >= _bcast(est_hi, topo))
+    at_lo = can_e & (state.est <= _bcast(est_lo, topo))
+    pick = lambda at: at & (topo.edge_rank == _bcast(_seg_min(
+        jnp.where(at, topo.edge_rank, _I32_MAX), topo, N,
+        _I32_MAX), topo))
+    return pick(at_hi) | pick(at_lo)
+
+
+def _reject_vec_trim(vec: bool) -> None:
+    if vec:
+        raise ValueError(
+            "robust='trim' marks per-edge extreme ESTIMATES, a "
+            "control-plane (feature-free) decision; vector "
+            "payloads would need per-feature firing — use "
+            "robust='clip' for (N, D) payloads")
+
+
 def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
               params: RoundParams | None = None):
     """Tick + averaging + ledger update; outgoing messages are *computed*
@@ -353,27 +390,8 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
             # robust_tol trimming disarms and the plain fire applies, so
             # honest regions converge to the historical fixed point
             # instead of freezing their extremes forever.
-            if vec:
-                raise ValueError(
-                    "robust='trim' marks per-edge extreme ESTIMATES, a "
-                    "control-plane (feature-free) decision; vector "
-                    "payloads would need per-feature firing — use "
-                    "robust='clip' for (N, D) payloads")
-            est_hi = _seg_max(state.est, topo, N,
-                              jnp.asarray(jnp.finfo(dt).min, dt))
-            est_lo = _seg_min(state.est, topo, N,
-                              jnp.asarray(jnp.finfo(dt).max, dt))
-            tol = jnp.asarray(cfg.robust_tol, dt)
-            can = (topo.out_deg >= 3) & (est_hi - est_lo > tol)
-            can_e = _bcast(can, topo)
-            # one edge per extreme: among the edges attaining the
-            # neighborhood max (resp. min), keep the lowest edge rank
-            at_hi = can_e & (state.est >= _bcast(est_hi, topo))
-            at_lo = can_e & (state.est <= _bcast(est_lo, topo))
-            pick = lambda at: at & (topo.edge_rank == _bcast(_seg_min(
-                jnp.where(at, topo.edge_rank, _I32_MAX), topo, N,
-                _I32_MAX), topo))
-            trim_edge = pick(at_hi) | pick(at_lo)
+            _reject_vec_trim(vec)
+            trim_edge = _trim_extreme_edges(state, topo, cfg, N, dt)
             t_sum = _seg_sum(
                 jnp.where(trim_edge, jnp.asarray(0, dt), state.est),
                 topo, N)
@@ -459,13 +477,37 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
                 & state.edge_ok
                 & state.edge_ok[topo.rev]
             )
+            if cfg.robust == "trim":
+                # pairwise trimmed matching: an armed node refuses to
+                # match along its extreme-estimate edges.  Standing down
+                # is symmetric by construction (the direct exchange needs
+                # both ends), so antisymmetry — and mass — are untouched;
+                # a pinned-extreme liar simply never moves mass again
+                # until the neighborhood spread falls inside robust_tol.
+                _reject_vec_trim(vec)
+                trim_edge = _trim_extreme_edges(state, topo, cfg, N, dt)
+                matched = matched & ~trim_edge & ~trim_edge[topo.rev]
             x_u = estimate[src]
             x_v = estimate[topo.dst]
-            avg_e = (x_u + x_v) * half
-            m_ex = _ex(matched, state.flow)
-            new_flow = jnp.where(
-                m_ex, state.flow + (x_u - x_v) * half, state.flow
-            )
+            if cfg.robust == "clip":
+                # the pairwise form of the clipped-flow ledger clamp: the
+                # 2-party exchange admits only the delta the +-robust_clip
+                # bound allows.  clip is odd and fast-pairwise flow is
+                # antisymmetric by construction, so delta[rev] == -delta
+                # and mass is conserved exactly; each end's estimate moves
+                # by exactly the admitted delta.
+                clamp = jnp.asarray(cfg.robust_clip, dt)
+                delta = jnp.clip(state.flow + (x_u - x_v) * half,
+                                 -clamp, clamp) - state.flow
+                avg_e = x_u - delta
+                m_ex = _ex(matched, state.flow)
+                new_flow = jnp.where(m_ex, state.flow + delta, state.flow)
+            else:
+                avg_e = (x_u + x_v) * half
+                m_ex = _ex(matched, state.flow)
+                new_flow = jnp.where(
+                    m_ex, state.flow + (x_u - x_v) * half, state.flow
+                )
             new_est = jnp.where(m_ex, avg_e, state.est)
             msg_est = avg_e
             send_mask = jnp.zeros_like(matched)  # direct exchange, no messages
@@ -480,6 +522,15 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
             # Faithful message-based dynamics.
             stale = stamp < (t - timeout)
             fire_e = (trigger | stale) & _bcast(state.alive, topo)
+            if cfg.robust == "trim":
+                # faithful-pairwise trim: an armed node's extreme-estimate
+                # edges do not fire at all — no flow delta, no message (the
+                # staleness trigger keeps re-arming, and trim keeps
+                # standing the edge down while the spread exceeds
+                # robust_tol, so a pinned-extreme liar is frozen out).
+                _reject_vec_trim(vec)
+                fire_e = fire_e & ~_trim_extreme_edges(state, topo, cfg,
+                                                       N, dt)
             # Sequential-within-tick semantics: each firing out-edge applies
             # x -> (x + est)/2 to the node's running estimate, in edge order
             # (the reference's for-loop over stale neighbors,
@@ -494,10 +545,27 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
             run_est = _ex(A, B) * _bcast(estimate, topo) + B  # est after edge e
             avg_e = run_est                  # == the 2-party average at firing e
             f_ex = _ex(fire_e, state.flow)
-            new_flow = jnp.where(f_ex, state.flow + avg_e - state.est,
-                                 state.flow)
-            new_est = jnp.where(f_ex, avg_e, state.est)
-            msg_est = avg_e
+            if cfg.robust == "clip":
+                # clipped flows for the message-based pairwise family: the
+                # sequential affine scan keeps computing the UNclipped
+                # 2-party targets (the clamp is not affine), and the
+                # ledger write admits only the delta within +-robust_clip
+                # — ledger, estimate entry and wire message all move by
+                # the same admitted delta, and the matching receive-side
+                # clamp in deliver_phase bounds what the reply can
+                # install, so no edge pair can pump past the bound.
+                clamp = jnp.asarray(cfg.robust_clip, dt)
+                delta = jnp.clip(state.flow + (avg_e - state.est),
+                                 -clamp, clamp) - state.flow
+                clipped = state.est + delta
+                new_flow = jnp.where(f_ex, state.flow + delta, state.flow)
+                new_est = jnp.where(f_ex, clipped, state.est)
+                msg_est = clipped
+            else:
+                new_flow = jnp.where(f_ex, state.flow + avg_e - state.est,
+                                     state.flow)
+                new_est = jnp.where(f_ex, avg_e, state.est)
+                msg_est = avg_e
             send_mask = fire_e
             stamp = jnp.where(fire_e, t, stamp)
             # last_avg per node = average at its last firing edge == its
@@ -867,6 +935,340 @@ def run_rounds(
 
     state, _ = jax.lax.scan(body, state, None, length=num_rounds)
     return state
+
+
+# ---- pipelined chunked gossip (deep payloads, arXiv:1504.03277) ----------
+#
+# A deep (N, D) payload need not ride every edge monolithically: the
+# chunked schedule time-multiplexes D/c INDEPENDENT protocol instances,
+# one per contiguous c-feature chunk, each carrying its OWN wire state
+# (ring-buffer + pending-mailbox slots).  One "visit" advances one
+# chunk's instance by ``rounds_per_visit`` ordinary rounds — the
+# unmodified :func:`round_step` on that chunk's (E, c) slice — and a
+# pass visits every chunk once, so a full model streams through every
+# edge over D/c visits while per-visit edge traffic is E*c payload
+# lanes, not E*D.
+#
+# Layout is chunk-major — every payload leaf grows a LEADING
+# ``n_chunks`` axis — and the pass runs as ``lax.scan`` over that axis
+# with the chunk leaves as xs/ys: the scan machinery's per-iteration
+# slice/stack is the in-place update pattern XLA handles on every
+# backend (a cursor formulation with ``dynamic_update_slice`` on big
+# scan carries measures ~30x slower on XLA:CPU, which falls back to
+# full-ledger copies when several cross-coupled carries are updated).
+#
+# Guarantees (tests/test_dfl_scale.py):
+# * each chunk's instance IS the plain protocol on its feature block:
+#   a chunked run is bit-identical PER CHUNK to the monolithic run on
+#   that block — for every fire policy, drop > 0 included (each chunk
+#   carries its own round counter, tick/stamp clocks and PRNG key, so
+#   its trajectory cannot depend on the visit schedule or on the other
+#   chunks; every chunk starts from the same seed key, mirroring the
+#   vector-payload rule that one drop draw serves all lanes);
+# * ``c = D`` is ONE chunk and the pass scan degenerates to the plain
+#   round scan — bit-identical vs :func:`run_rounds`;
+# * per-feature mass conservation under drop > 0 and churn for all c:
+#   each chunk owns its wire slots, so a message is always delivered
+#   into the ledger slice it was computed from, and the self-healing
+#   antisymmetry-write argument applies per chunk unchanged.  Churn is
+#   the one SHARED control input (``state.alive`` / ``state.edge_ok``):
+#   killing a node kills it for every in-flight chunk at once.
+
+
+@struct.dataclass
+class ChunkedState:
+    """Chunk-major state of the pipelined schedule.
+
+    ``state`` is a one-chunk working window: its ``alive`` / ``edge_ok``
+    masks are the SHARED control inputs (churn applies to every chunk),
+    every other window leaf is per-visit scratch.  The chunk-major
+    leaves hold each instance's complete protocol state — payload
+    ledgers, wire slots AND per-instance control (round counter,
+    tick/stamp clocks, PRNG key) — so each chunk evolves exactly as a
+    standalone run on its feature block (leading axis = chunk index
+    over contiguous c-feature blocks)."""
+
+    state: FlowUpdatingState   # shared churn masks + (E, c) scratch window
+    flow: jnp.ndarray          # (n_chunks, E, c) standing ledgers
+    est: jnp.ndarray           # (n_chunks, E, c)
+    value: jnp.ndarray         # (n_chunks, N, c)
+    last_avg: jnp.ndarray      # (n_chunks, N, c)
+    pending_flow: jnp.ndarray  # (n_chunks, Q, E, c) per-instance mailbox
+    pending_est: jnp.ndarray   # (n_chunks, Q, E, c)
+    pending_valid: jnp.ndarray   # (n_chunks, Q, E)
+    pending_stamp: jnp.ndarray   # (n_chunks, Q, E)
+    buf_flow: jnp.ndarray      # (n_chunks, Dd, E, c) per-instance ring
+    buf_est: jnp.ndarray       # (n_chunks, Dd, E, c)
+    buf_valid: jnp.ndarray     # (n_chunks, Dd, E)
+    t: jnp.ndarray             # (n_chunks,) per-instance round counters
+    recv: jnp.ndarray          # (n_chunks, E) heard-since-last-avg
+    ticks: jnp.ndarray         # (n_chunks, N) collect-all tick clocks
+    stamp: jnp.ndarray         # (n_chunks, E) pairwise last-avg rounds
+    fired: jnp.ndarray         # (n_chunks, N) averaging-event counters
+    key: jnp.ndarray           # (n_chunks, ...) per-instance PRNG keys
+
+    @property
+    def n_chunks(self) -> int:
+        return self.flow.shape[0]
+
+    @property
+    def chunk(self) -> int:
+        return self.flow.shape[-1]
+
+    @property
+    def features(self) -> int:
+        return self.n_chunks * self.chunk
+
+
+#: ChunkedState leaf name == the FlowUpdatingState leaf it windows.
+#: Everything here is PER-INSTANCE state riding the pass scan as xs/ys;
+#: what is NOT here (alive, edge_ok) is shared control read from the
+#: window each visit.
+_CHUNK_LEAVES = ("flow", "est", "value", "last_avg", "pending_flow",
+                 "pending_est", "pending_valid", "pending_stamp",
+                 "buf_flow", "buf_est", "buf_valid",
+                 "t", "recv", "ticks", "stamp", "fired", "key")
+
+
+def chunk_count(features: int, chunk: int) -> int:
+    """Number of chunks ``D / c`` (validates divisibility)."""
+    if chunk <= 0 or features % chunk:
+        raise ValueError(
+            f"chunk={chunk} must be a positive divisor of the payload "
+            f"feature count D={features} (pad D up to a multiple)")
+    return features // chunk
+
+
+def check_chunked_config(cfg: RoundConfig, features: int,
+                         chunk: int) -> None:
+    """Domain of validity of the chunked schedule: any edge-kernel
+    dynamics (each chunk runs the unmodified round kernel), minus the
+    modes that are scalar-only or read cross-round wire occupancy."""
+    chunk_count(features, chunk)
+    if cfg.kernel != "edge":
+        raise ValueError(
+            "chunked gossip streams the edge kernel's payload ledgers "
+            "(kernel='edge')")
+    if cfg.robust == "trim":
+        _reject_vec_trim(True)
+    if cfg.contention_backlog:
+        raise ValueError(
+            "contention_backlog reads the ring buffer's standing "
+            "occupancy across rounds; under the chunked schedule each "
+            "chunk's ring advances only on its own visits, so the "
+            "backlog term would alias across instances")
+
+
+def _chunk_major(x, n_chunks: int):
+    """(..., D) -> (n_chunks, ..., c): contiguous feature blocks to the
+    leading axis."""
+    D = x.shape[-1]
+    c = D // n_chunks
+    split = x.reshape(x.shape[:-1] + (n_chunks, c))
+    return jnp.moveaxis(split, -2, 0)
+
+
+def _chunk_flat(x):
+    """(n_chunks, ..., c) -> (..., D): inverse of :func:`_chunk_major`."""
+    merged = jnp.moveaxis(x, 0, -2)
+    return merged.reshape(merged.shape[:-2] + (-1,))
+
+
+def init_chunked_state(topo, cfg: RoundConfig, chunk: int, values,
+                       seed: int = 0) -> ChunkedState:
+    """Fresh chunk-major state: ``values`` is the full ``(N, D)``
+    payload; every instance starts with the usual empty ledgers."""
+    values = jnp.asarray(values, cfg.jnp_dtype)
+    if values.ndim != 2:
+        raise ValueError(
+            "chunked gossip streams a vector payload; pass values of "
+            f"shape (N, D) — got {values.shape}")
+    n = chunk_count(int(values.shape[1]), chunk)
+    check_chunked_config(cfg, int(values.shape[1]), chunk)
+    from flow_updating_tpu.models.state import init_state as _init
+
+    window = _init(topo, cfg, seed=seed,
+                   values=values[:, :chunk])
+    E, Q, Dd = topo.num_edges, cfg.pending_depth, cfg.delay_depth
+    dt = cfg.jnp_dtype
+    rep = lambda x: jnp.broadcast_to(x, (n,) + x.shape)
+    return ChunkedState(
+        state=window,
+        flow=jnp.zeros((n, E, chunk), dt),
+        est=jnp.zeros((n, E, chunk), dt),
+        value=_chunk_major(values, n),
+        last_avg=jnp.zeros((n, topo.num_nodes, chunk), dt),
+        pending_flow=jnp.zeros((n, Q, E, chunk), dt),
+        pending_est=jnp.zeros((n, Q, E, chunk), dt),
+        pending_valid=rep(window.pending_valid),
+        pending_stamp=rep(window.pending_stamp),
+        buf_flow=jnp.zeros((n, Dd, E, chunk), dt),
+        buf_est=jnp.zeros((n, Dd, E, chunk), dt),
+        buf_valid=rep(window.buf_valid),
+        t=rep(window.t),
+        recv=rep(window.recv),
+        ticks=rep(window.ticks),
+        stamp=rep(window.stamp),
+        fired=rep(window.fired),
+        # every instance starts from the SAME seed key — the chunk-major
+        # form of the vector-payload rule that one drop draw serves all
+        # lanes, and what makes c = D degenerate bit-exactly to the
+        # plain run
+        key=rep(window.key),
+    )
+
+
+def chunked_values(cs: ChunkedState) -> jnp.ndarray:
+    """The full ``(N, D)`` input payload, original feature order."""
+    return _chunk_flat(cs.value)
+
+
+def chunked_node_estimates(cs: ChunkedState, topo) -> jnp.ndarray:
+    """Per-node ``(N, D)`` estimates over every chunk (readback)."""
+    N = topo.out_deg.shape[0]
+    flow = _chunk_flat(cs.flow)
+    return _chunk_flat(cs.value) - _seg_sum(flow, topo, N)
+
+
+def _run_chunk_pass(cs: ChunkedState, topo, cfg: RoundConfig,
+                    rounds_per_visit: int,
+                    params: RoundParams | None = None) -> ChunkedState:
+    """One pass: visit every chunk once, advancing its instance by
+    ``rounds_per_visit`` unmodified rounds.  The per-instance leaves
+    ride the scan as xs/ys; the carry window contributes the shared
+    churn masks (``alive``/``edge_ok``) and absorbs per-visit scratch."""
+
+    def visit(ctrl: FlowUpdatingState, xs):
+        s = ctrl.replace(**dict(zip(_CHUNK_LEAVES, xs)))
+        s = jax.lax.fori_loop(
+            0, rounds_per_visit,
+            lambda _, x: round_step(x, topo, cfg, params=params), s)
+        return s, tuple(getattr(s, f) for f in _CHUNK_LEAVES)
+
+    ctrl, ys = jax.lax.scan(
+        visit, cs.state, tuple(getattr(cs, f) for f in _CHUNK_LEAVES))
+    return cs.replace(state=ctrl, **dict(zip(_CHUNK_LEAVES, ys)))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_rounds", "rounds_per_visit"))
+def run_rounds_chunked(
+    cs: ChunkedState, topo, cfg: RoundConfig, num_rounds: int,
+    rounds_per_visit: int = 1, params: RoundParams | None = None,
+) -> ChunkedState:
+    """Run ``num_rounds`` underlying rounds of the chunked schedule as
+    one compiled scan-of-passes.
+
+    ``num_rounds`` counts GLOBAL underlying rounds (visits x
+    ``rounds_per_visit``, summed over chunks) and must cover whole
+    passes: ``num_rounds % (n_chunks * rounds_per_visit) == 0``.  Each
+    chunk advances ``num_rounds / n_chunks`` of its OWN rounds (each
+    instance carries its own round counter/clocks/key, so its
+    trajectory is schedule-independent — bit-exact vs the monolithic
+    run on its block whatever ``rounds_per_visit``); larger
+    ``rounds_per_visit`` amortizes the per-visit chunk-rotation cost at
+    the price of coarser pipelining (see
+    :func:`chunked_rounds_per_visit` and plan/select.py's
+    payload-bytes model)."""
+    check_chunked_config(cfg, cs.features, cs.chunk)
+    per_pass = cs.n_chunks * rounds_per_visit
+    if num_rounds % per_pass:
+        raise ValueError(
+            f"num_rounds={num_rounds} must be a multiple of the pass "
+            f"length n_chunks*rounds_per_visit = {per_pass}")
+
+    def one_pass(c, _):
+        return _run_chunk_pass(c, topo, cfg, rounds_per_visit,
+                               params=params), None
+
+    cs, _ = jax.lax.scan(one_pass, cs, None,
+                         length=num_rounds // per_pass)
+    return cs
+
+
+def chunked_rounds_per_visit(topo, cfg: RoundConfig) -> int:
+    """The canonical visit length: 1 round, except fast pairwise where
+    a visit is one full color sweep — not for correctness (each chunk's
+    own round counter cycles its colors whatever the visit length) but
+    for delivery latency: a full sweep per visit lets every edge of the
+    chunk fire before the schedule rotates on, so a chunk's 2-party
+    exchanges complete within one visit instead of straddling passes."""
+    if cfg.needs_coloring:
+        # TopoArrays carries num_colors as an int (0 = no coloring
+        # built), Topology as Optional — reject both absent forms
+        if not topo.num_colors:
+            raise ValueError(
+                "fast pairwise chunking needs the static edge coloring "
+                "(device_arrays(coloring=True))")
+        return int(topo.num_colors)
+    return 1
+
+
+def chunked_telemetry_sample(cs: ChunkedState, topo, spec, mean) -> dict:
+    """One per-PASS metric row over every chunk (device-side).  Reduces
+    the chunk-major ledgers directly, so a disabled-feature chunk
+    between visits still reports its standing state — the resolution a
+    convergence-vs-bytes curve needs (one sample per full model
+    stream)."""
+    est = chunked_node_estimates(cs, topo)
+    alive = cs.state.alive
+    # per-instance round counters agree at pass boundaries; max = the
+    # per-chunk round count this row samples at
+    out = {"t": jnp.max(cs.t)}
+    a_ex = _ex(alive, est)
+    err = jnp.where(a_ex, est - mean, 0)
+    if spec.has("rmse"):
+        cnt = (jnp.maximum(jnp.sum(alive), 1)
+               * _feat(est)).astype(est.dtype)
+        out["rmse"] = jnp.sqrt(jnp.sum(err * err) / cnt)
+    if spec.has("max_abs_err"):
+        out["max_abs_err"] = jnp.max(jnp.abs(err))
+    if spec.has("mass") or spec.has("mass_residual"):
+        mass = jnp.sum(jnp.where(a_ex, est, 0), axis=0)      # (D,)
+        if spec.has("mass"):
+            out["mass"] = mass
+        if spec.has("mass_residual"):
+            value = _chunk_flat(cs.value)
+            out["mass_residual"] = mass - jnp.sum(
+                jnp.where(_ex(alive, value), value, 0), axis=0)
+    if spec.has("antisymmetry"):
+        out["antisymmetry"] = jnp.max(
+            jnp.abs(cs.flow + cs.flow[:, topo.rev]))
+    if spec.has("active"):
+        out["active"] = jnp.sum(alive.astype(jnp.int32))
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "num_rounds", "rounds_per_visit", "spec"))
+def run_rounds_chunked_telemetry(
+    cs: ChunkedState, topo, cfg: RoundConfig, num_rounds: int, spec,
+    true_mean, rounds_per_visit: int = 1,
+    params: RoundParams | None = None,
+):
+    """Chunked scan with one telemetry row PER PASS riding as ys (each
+    row covers all D features).  Returns ``(cs, series)``."""
+    if not spec.enabled:
+        raise ValueError(
+            "telemetry spec is disabled; run run_rounds_chunked() "
+            "instead")
+    check_chunked_config(cfg, cs.features, cs.chunk)
+    per_pass = cs.n_chunks * rounds_per_visit
+    if num_rounds % per_pass:
+        raise ValueError(
+            f"num_rounds={num_rounds} must be a multiple of the pass "
+            f"length n_chunks*rounds_per_visit = {per_pass}")
+    mean = jnp.asarray(true_mean, cs.value.dtype)
+
+    def one_pass(c, _):
+        c = _run_chunk_pass(c, topo, cfg, rounds_per_visit,
+                            params=params)
+        return c, chunked_telemetry_sample(c, topo, spec, mean)
+
+    cs, series = jax.lax.scan(one_pass, cs, None,
+                              length=num_rounds // per_pass)
+    return cs, series
 
 
 def _fired_acc():

@@ -370,3 +370,68 @@ def per_round(record: dict, rounds: int) -> dict:
         v = cost.get(key)
         out[key] = (v / r) if isinstance(v, (int, float)) else None
     return out
+
+
+# ---- payload-bytes attribution (DFL model scale, arXiv:2506.10607) ------
+
+
+def payload_bytes_per_round(num_edges: int, features: int, *,
+                            chunk: int | None = None,
+                            feature_shards: int = 1,
+                            dtype_bytes: int = 4) -> dict:
+    """Edge-payload wire bytes of one underlying gossip round — the
+    denominator of the DFL rounds/s-per-byte efficiency metric and the
+    x-axis increment of convergence-vs-bytes curves.
+
+    One round moves one ledger entry (flow + estimate, but the estimate
+    rides the same message, so ONE payload word per lane per directed
+    edge... the accounting convention is LANES: ``E * width`` payload
+    words) across every directed edge:
+
+    * monolithic: ``width = D`` — the whole model on every wire, every
+      round;
+    * chunked (``chunk=c``): ``width = c`` — each underlying round moves
+      one ``(E, c)`` slice; a full model stream costs ``D/c`` rounds and
+      the same TOTAL bytes as one monolithic round (chunking re-times
+      the traffic, it never inflates it);
+    * feature sharding divides the PER-DEVICE share by ``S_f`` without
+      changing the global total (lanes move between device pairs of
+      their own shard).
+
+    Returns a dict with the global and per-device figures plus the
+    full-model-stream cost, so bench rows and manifests can cite one
+    accounting."""
+    if features <= 0:
+        raise ValueError("features must be >= 1 for payload accounting")
+    width = int(chunk) if chunk else int(features)
+    if chunk and (chunk <= 0 or features % chunk):
+        raise ValueError(
+            f"chunk={chunk} must be a positive divisor of D={features}")
+    if feature_shards < 1:
+        raise ValueError("feature_shards must be >= 1")
+    per_round = num_edges * width * dtype_bytes
+    return {
+        "features": int(features),
+        "chunk": int(chunk) if chunk else None,
+        "width": width,
+        "dtype_bytes": int(dtype_bytes),
+        "bytes_per_round": per_round,
+        "bytes_per_round_per_device": per_round // feature_shards,
+        "rounds_per_model_stream": (int(features) // width),
+        "bytes_per_model_stream": num_edges * features * dtype_bytes,
+    }
+
+
+def dfl_efficiency(rate: float, bytes_per_round: float,
+                   anchor_rate: float, anchor_bytes_per_round: float
+                   ) -> float | None:
+    """The DFL bytes-efficiency ratio: rounds/s per wire byte, relative
+    to an anchor row (the D=64 monolithic record).  1.0 means a round
+    that moves the same bytes as the anchor's costs the same wall-clock;
+    the chunked schedule's whole point is keeping this near 1.0 while D
+    grows by orders of magnitude (each chunked round does a D=64-sized
+    unit of work)."""
+    if not rate or not anchor_rate or not bytes_per_round \
+            or not anchor_bytes_per_round:
+        return None
+    return (rate / bytes_per_round) / (anchor_rate / anchor_bytes_per_round)

@@ -60,7 +60,9 @@ def load_history(pattern: str) -> list:
     ``pattern``, oldest first (glob order is lexicographic, which the
     ``BENCH_r<N>`` naming makes chronological).  Driver-wrapped
     artifacts (the repo's ``BENCH_r*.json``: ``{n, cmd, rc, parsed}``)
-    are unwrapped to their ``parsed`` result line."""
+    are unwrapped to their ``parsed`` result line, and multi-row
+    artifacts (``BENCH_DFL_r*.json``: a dict of named result lines) to
+    one history entry per row."""
     out = []
     for path in sorted(_glob.glob(pattern)):
         try:
@@ -74,6 +76,10 @@ def load_history(pattern: str) -> list:
             doc = doc["parsed"]
         if "metric" in doc:
             out.append((path, doc))
+            continue
+        for key, row in doc.items():
+            if isinstance(row, dict) and "metric" in row:
+                out.append((f"{path}#{key}", row))
     return out
 
 

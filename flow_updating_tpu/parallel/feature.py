@@ -1,0 +1,258 @@
+"""Feature-axis model parallelism: shard the D payload lanes across
+devices.
+
+A D-feature run is exactly D independent scalar protocol instances
+sharing ONE control plane — firing decisions, delivery masks, drop
+draws and liveness are feature-free (models/state.py, pinned
+bit-for-bit by tests/test_vector_payload.py).  That makes the feature
+dimension the perfect model-parallel axis: shard every payload leaf's
+trailing feature axis over the mesh's ``'feature'`` axis, REPLICATE the
+control plane, and each device runs the unmodified round kernel on its
+``D / S_f`` feature slice.  No collective ever crosses the feature
+axis during gossip — per-device edge traffic drops to ``E * D/S_f``
+payload lanes and the shard outputs concatenate to the single-device
+run bit-for-bit (drop draws are control state, so even lossy runs
+agree positionally).
+
+Collectives appear in exactly two places, both outside the round scan:
+
+* the trainer's logits ``z = sum_d X[..., d] w[..., d]`` reduce over
+  features — one ``psum`` over ``'feature'`` per local step
+  (:func:`feature_logits`);
+* Gossip-PGA's periodic global average reduces over nodes — one
+  ``psum`` over ``'nodes'`` per sync (:func:`global_average_feature`),
+  the psum-native form of arXiv:2105.09080's H-step sync (no host
+  round-trip, composes with the 2-D ``('nodes', 'feature')`` mesh).
+
+The chunked pipelined schedule (models/rounds.py) composes by sharding
+the leading chunk axis instead: each device streams its OWN contiguous
+chunks, so chunking x feature-sharding multiplies the per-device wire
+reduction (``E * c`` lanes per visit, ``n_chunks / S_f`` visits per
+pass per device).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flow_updating_tpu.models.config import RoundConfig, RoundParams
+from flow_updating_tpu.models.rounds import (
+    ChunkedState,
+    _CHUNK_LEAVES,
+    check_chunked_config,
+    node_estimates,
+    run_rounds,
+    run_rounds_chunked,
+)
+from flow_updating_tpu.models.state import FlowUpdatingState, _ex
+from flow_updating_tpu.parallel.mesh import (
+    FEATURE_AXIS,
+    NODE_AXIS,
+    make_mesh2d,
+    shard_map,
+)
+
+#: FlowUpdatingState leaves that carry a trailing feature axis in vector
+#: mode — the shardable payload planes.  Everything else is the
+#: replicated control plane (masks, counters, PRNG key): the protocol's
+#: decisions are payload-independent, which is WHY feature sharding
+#: needs no round-time collectives.
+PAYLOAD_LEAVES = ("value", "flow", "est", "last_avg",
+                  "pending_flow", "pending_est", "buf_flow", "buf_est")
+
+
+def check_feature_mesh(mesh) -> int:
+    """Validate that ``mesh`` carries the feature axis; returns S_f."""
+    if FEATURE_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} lack {FEATURE_AXIS!r}; build "
+            "one with parallel.mesh.make_mesh2d(graph, feature)")
+    return int(mesh.shape[FEATURE_AXIS])
+
+
+def _check_features(D: int, sf: int, what: str) -> None:
+    if D % sf:
+        raise ValueError(
+            f"{what}={D} must divide evenly over {sf} feature shards")
+
+
+def state_feature_specs(state: FlowUpdatingState):
+    """Per-leaf PartitionSpecs: payload leaves shard their LAST axis over
+    the feature mesh axis, control leaves replicate.  The state must be
+    in vector mode (payload leaves carry the trailing feature axis)."""
+    if state.value.ndim != 2:
+        raise ValueError(
+            "feature sharding needs a vector payload: init the state "
+            f"with (N, D) values (got value shape {state.value.shape})")
+    specs = {}
+    for f in state.__dataclass_fields__:
+        x = getattr(state, f)
+        if f in PAYLOAD_LEAVES:
+            specs[f] = P(*([None] * (x.ndim - 1)), FEATURE_AXIS)
+        else:
+            specs[f] = P()
+    return state.replace(**specs)
+
+
+def chunked_feature_specs(cs: ChunkedState):
+    """ChunkedState specs: the chunk-major leaves shard their LEADING
+    chunk axis (each device streams its own contiguous chunks); the
+    control window replicates.  The window's payload planes are scratch
+    (overwritten every visit) — :func:`run_chunked_feature` zeroes them
+    on exit so the returned state is deterministic and replicated."""
+    window = jax.tree.map(lambda x: P(), cs.state)
+    specs = {f: P(FEATURE_AXIS) for f in _CHUNK_LEAVES}
+    return cs.replace(state=window, **specs)
+
+
+def place_feature_state(state: FlowUpdatingState, mesh) -> FlowUpdatingState:
+    """Device-place a (host or single-device) vector state onto the
+    feature mesh according to :func:`state_feature_specs`."""
+    specs = state_feature_specs(state)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, jax.sharding.NamedSharding(mesh, s)),
+        state, specs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds", "mesh"))
+def run_rounds_feature(
+    state: FlowUpdatingState, topo, cfg: RoundConfig, num_rounds: int,
+    mesh, params: RoundParams | None = None,
+) -> FlowUpdatingState:
+    """``num_rounds`` rounds with the payload feature axis sharded over
+    ``mesh``'s ``'feature'`` axis — bit-exact vs the single-device
+    vector run (lane independence), drop>0 and churn included (the drop
+    draws are replicated control state: every shard realizes the same
+    per-edge loss pattern, exactly like the single-device run where one
+    draw serves all D lanes)."""
+    sf = check_feature_mesh(mesh)
+    _check_features(state.value.shape[-1], sf, "payload features D")
+    if cfg.kernel != "edge":
+        raise ValueError("feature sharding runs the edge kernel "
+                         "(kernel='edge')")
+    if cfg.robust == "trim":
+        raise ValueError(
+            "robust='trim' is scalar-only (control-plane estimate marks); "
+            "vector payloads use robust='clip'")
+    specs = state_feature_specs(state)
+    arrays_specs = jax.tree.map(lambda x: P(), topo)
+
+    def body(st, ta):
+        return run_rounds(st, ta, cfg, num_rounds, params=params)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(specs, arrays_specs),
+                   out_specs=specs, check_vma=False)
+    return fn(state, topo)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "num_rounds", "rounds_per_visit", "mesh"))
+def run_chunked_feature(
+    cs: ChunkedState, topo, cfg: RoundConfig, num_rounds: int, mesh,
+    rounds_per_visit: int = 1, params: RoundParams | None = None,
+) -> ChunkedState:
+    """The pipelined chunked schedule with the CHUNK axis sharded over
+    the feature mesh axis: each device streams its own ``n_chunks/S_f``
+    contiguous chunks, ``num_rounds`` counts each shard's underlying
+    rounds (so one call advances every chunk's instance by
+    ``num_rounds / n_chunks * S_f`` rounds... i.e. the same per-chunk
+    progress as the single-device call with the same ``num_rounds``
+    PER PASS accounting — pass ``num_rounds`` as multiples of the LOCAL
+    pass length ``(n_chunks / S_f) * rounds_per_visit``).
+
+    Bit-exact per chunk vs the single-device chunked schedule for
+    EVERY config, drop>0 included: each chunk's instance carries its
+    own round counter, clocks and PRNG key in the chunk-major leaves,
+    so its trajectory cannot depend on which device visits it or in
+    what order.  The control window is per-visit scratch (plus the
+    shared churn masks); the scratch leaves are returned zeroed so the
+    declared-replicated output is deterministic."""
+    sf = check_feature_mesh(mesh)
+    check_chunked_config(cfg, cs.features, cs.chunk)
+    _check_features(cs.n_chunks, sf, "n_chunks")
+    local_pass = (cs.n_chunks // sf) * rounds_per_visit
+    if num_rounds % local_pass:
+        raise ValueError(
+            f"num_rounds={num_rounds} must be a multiple of the LOCAL "
+            f"pass length (n_chunks/S_f)*rounds_per_visit = {local_pass}")
+    specs = chunked_feature_specs(cs)
+    arrays_specs = jax.tree.map(lambda x: P(), topo)
+
+    def body(c, ta):
+        out = run_rounds_chunked(c, ta, cfg, num_rounds,
+                                 rounds_per_visit=rounds_per_visit,
+                                 params=params)
+        # the working window holds whichever chunk this shard visited
+        # last — shard-divergent scratch.  Zero every windowed leaf
+        # (the shared churn masks stay) so the declared-replicated
+        # output is really replicated.
+        win = out.state.replace(**{
+            f: jnp.zeros_like(getattr(out.state, f))
+            for f in _CHUNK_LEAVES})
+        return out.replace(state=win)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(specs, arrays_specs),
+                   out_specs=specs, check_vma=False)
+    return fn(cs, topo)
+
+
+# ---- the trainer's two cross-shard reductions ---------------------------
+
+
+def feature_logits(X, w):
+    """Per-node logits under feature sharding: the local partial
+    ``sum_d X[n, m, d] w[n, d]`` psum-reduced over the feature axis —
+    the ONE cross-feature collective of the gossip-SGD local step.
+    Call inside a feature shard_map with X, w feature-sharded."""
+    z = jnp.einsum("nmd,nd->nm", X, w)
+    return jax.lax.psum(z, FEATURE_AXIS)
+
+
+def _pga_rebase(state: FlowUpdatingState, topo, node_axis: bool):
+    """The PGA value rebase on one shard: estimates to the alive-mean,
+    sums psum-reduced over the node axis when it is real."""
+    est = node_estimates(state, topo)
+    alive = state.alive
+    a = _ex(alive, est)
+    cnt = jnp.sum(alive)
+    tot = jnp.sum(jnp.where(a, est, 0), axis=0)      # (f_local,)
+    if node_axis:
+        cnt = jax.lax.psum(cnt, NODE_AXIS)
+        tot = jax.lax.psum(tot, NODE_AXIS)
+    mean = tot / jnp.maximum(cnt, 1).astype(est.dtype)
+    value = jnp.where(a, state.value - est + mean, state.value)
+    return state.replace(value=value)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def global_average_feature(state: FlowUpdatingState, topo,
+                           mesh) -> FlowUpdatingState:
+    """Gossip-PGA's periodic global average as a native collective
+    (arXiv:2105.09080): every alive node's estimate is rebased to the
+    exact alive-mean via the mass-preserving ``value <- value - est +
+    mean(est)`` — computed entirely device-side under the 2-D mesh.
+    The node-sum rides ``psum('nodes')`` (identity when the graph axis
+    is trivial); the feature axis needs NO collective (each shard owns
+    its features' mean outright) — the whole sync is one psum instead
+    of a host gather/scatter round-trip."""
+    check_feature_mesh(mesh)
+    specs = state_feature_specs(state)
+    arrays_specs = jax.tree.map(lambda x: P(), topo)
+    node_axis = (NODE_AXIS in mesh.axis_names
+                 and int(mesh.shape[NODE_AXIS]) > 1)
+
+    fn = shard_map(lambda st, ta: _pga_rebase(st, ta, node_axis),
+                   mesh=mesh, in_specs=(specs, arrays_specs),
+                   out_specs=specs, check_vma=False)
+    return fn(state, topo)
+
+
+def feature_mesh(feature_shards: int, graph_shards: int = 1):
+    """Convenience: the ``('nodes', 'feature')`` mesh for S_f payload
+    shards (re-exported so workloads never import mesh internals)."""
+    return make_mesh2d(graph_shards, feature_shards)

@@ -1,10 +1,19 @@
 """Device mesh helpers.
 
-The framework's one parallel axis is the *node axis* — the analogue of the
-reference's "thousands of simulated actors" (SURVEY.md §2c): nodes and their
-out-edge ledgers are sharded over devices; cross-shard edges ride XLA
-collectives over ICI (the TPU-native replacement for the mailbox rendezvous
-that SimGrid's kernel performs in shared memory)."""
+The framework's primary parallel axis is the *node axis* (named
+``'nodes'``, the "graph" axis of the 2-D mesh) — the analogue of the
+reference's "thousands of simulated actors" (SURVEY.md §2c): nodes and
+their out-edge ledgers are sharded over devices; cross-shard edges ride
+XLA collectives over ICI (the TPU-native replacement for the mailbox
+rendezvous that SimGrid's kernel performs in shared memory).
+
+Vector payloads add an orthogonal *feature axis* (``'feature'``): the D
+payload lanes of an ``(N, D)`` run are D independent protocol instances
+sharing one message schedule (models/state.py), so the feature dimension
+shards across devices with NO cross-shard protocol traffic at all — the
+model-parallel axis of the DFL workloads (:mod:`flow_updating_tpu
+.parallel.feature`).  :func:`make_mesh2d` builds the combined
+``('nodes', 'feature')`` mesh; either axis may be 1."""
 
 from __future__ import annotations
 
@@ -12,6 +21,7 @@ import jax
 import numpy as np
 
 NODE_AXIS = "nodes"
+FEATURE_AXIS = "feature"
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -39,3 +49,20 @@ def make_mesh(n_devices: int | None = None, axis: str = NODE_AXIS) -> jax.shardi
             )
         devices = devices[:n_devices]
     return jax.sharding.Mesh(np.array(devices), (axis,))
+
+
+def make_mesh2d(graph_shards: int = 1,
+                feature_shards: int = 1) -> jax.sharding.Mesh:
+    """The ``('nodes', 'feature')`` 2-D mesh: ``graph_shards`` halo
+    shards x ``feature_shards`` payload-model shards.  Either axis may
+    be 1 (a 1-axis mesh with the other axis present-but-trivial keeps
+    every sharding spec valid, so single-axis and 2-D programs share
+    code paths)."""
+    need = graph_shards * feature_shards
+    devices = jax.devices()
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {graph_shards}x{feature_shards} needs {need} devices, "
+            f"only {len(devices)} visible")
+    grid = np.array(devices[:need]).reshape(graph_shards, feature_shards)
+    return jax.sharding.Mesh(grid, (NODE_AXIS, FEATURE_AXIS))

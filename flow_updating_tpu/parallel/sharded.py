@@ -359,6 +359,42 @@ def _spec(x) -> P:
     return P(NODE_AXIS, *([None] * (np.ndim(x) - 1)))
 
 
+def _feature_shards(mesh) -> int:
+    """Size of the mesh's feature axis (1 when absent): the 2-D
+    ``('nodes', 'feature')`` mesh composes halo sharding with payload
+    model-parallelism (parallel/feature.py)."""
+    from flow_updating_tpu.parallel.mesh import FEATURE_AXIS
+
+    if FEATURE_AXIS in getattr(mesh, "axis_names", ()):
+        return int(mesh.shape[FEATURE_AXIS])
+    return 1
+
+
+def _state_specs(state, mesh):
+    """Halo state specs.  Under a 2-D ``('nodes', 'feature')`` mesh a
+    VECTOR state's payload leaves additionally shard their trailing
+    feature axis — the D lanes are independent protocol instances, so
+    each (node-shard, feature-shard) device runs the unmodified local
+    round on its ``(Nb, D/S_f)`` block and the node-axis collectives
+    move ``D/S_f`` lanes per cut edge.  Control leaves (and every leaf
+    of a scalar state) keep the 1-D node spec."""
+    from flow_updating_tpu.parallel.mesh import FEATURE_AXIS
+
+    if _feature_shards(mesh) == 1 or state.value.ndim != 3:
+        return jax.tree.map(_spec, state)
+    from flow_updating_tpu.parallel.feature import PAYLOAD_LEAVES
+
+    specs = {}
+    for f in state.__dataclass_fields__:
+        x = getattr(state, f)
+        if f in PAYLOAD_LEAVES:
+            specs[f] = P(NODE_AXIS, *([None] * (np.ndim(x) - 2)),
+                         FEATURE_AXIS)
+        else:
+            specs[f] = _spec(x)
+    return state.replace(**specs)
+
+
 def _sharding_tree(tree, mesh):
     return jax.tree.map(
         lambda x: jax.sharding.NamedSharding(mesh, _spec(x)), tree
@@ -423,6 +459,16 @@ def init_plan_state(
         buf_valid=jnp.zeros((S, D, Eb), bool),
         key=keys,
     )
+    if _feature_shards(mesh) > 1 and F:
+        if F[0] % _feature_shards(mesh):
+            raise ValueError(
+                f"payload features D={F[0]} must divide evenly over "
+                f"{_feature_shards(mesh)} feature shards")
+        specs = _state_specs(state, mesh)
+        shardings = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(state, shardings)
     return jax.device_put(state, _sharding_tree(state, mesh))
 
 
@@ -686,12 +732,12 @@ def _round_dispatch(s, pl, halo_t, pm, ov, cfg, Eb, S, offsets,
 )
 def _run_sharded(state, arrays, halo, perm, ov, cfg, mesh, num_rounds, Eb,
                  offsets, halo_mode, num_colors=0):
-    state_specs = jax.tree.map(_spec, state)
+    state_specs = _state_specs(state, mesh)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
     ov_specs = jax.tree.map(_spec, ov)
-    S = mesh.devices.size
+    S = int(mesh.shape[NODE_AXIS])  # node-axis size (2-D mesh aware)
 
     def body(st_s, pl_s, halo_t, pm_s, ov_s):
         st = jax.tree.map(lambda x: x[0], st_s)
@@ -849,12 +895,18 @@ def _halo_telemetry_sample(st: FlowUpdatingState, pl: PlanArrays, spec,
 def _run_sharded_telemetry(state, arrays, halo, perm, ov, mean, cfg, mesh,
                            num_rounds, Eb, Nb, offsets, halo_mode,
                            num_colors, spec):
+    if _feature_shards(mesh) > 1:
+        raise NotImplementedError(
+            "telemetry series on the 2-D (nodes, feature) mesh are not "
+            "wired (the metric reductions would need a feature-axis "
+            "psum); run telemetry on a 1-D node mesh or use the "
+            "chunked-schedule telemetry (models/rounds.py)")
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
     ov_specs = jax.tree.map(_spec, ov)
-    S = mesh.devices.size
+    S = int(mesh.shape[NODE_AXIS])  # node-axis size (2-D mesh aware)
 
     def body(st_s, pl_s, halo_t, pm_s, ov_s, mean_r):
         st = jax.tree.map(lambda x: x[0], st_s)
@@ -964,12 +1016,17 @@ def _run_sharded_fields(state, arrays, halo, perm, ov, mean, cfg, mesh,
                         num_colors, spec):
     from flow_updating_tpu.models.rounds import _pool_abs
 
+    if _feature_shards(mesh) > 1:
+        raise NotImplementedError(
+            "field series on the 2-D (nodes, feature) mesh are not "
+            "wired (per-entity reductions would need a feature-axis "
+            "psum); run fields on a 1-D node mesh")
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
     halo_specs = jax.tree.map(lambda x: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
     ov_specs = jax.tree.map(_spec, ov)
-    S = mesh.devices.size
+    S = int(mesh.shape[NODE_AXIS])  # node-axis size (2-D mesh aware)
     stride = spec.stride
     track_conv = spec.has("node_conv_round")
 

@@ -212,6 +212,151 @@ def select_halo_mode(plan, *, backend: str | None = None,
     }
 
 
+#: per-round control-plane cost of one edge-kernel round, in streamed
+#: edge-element-pass units — the payload-INDEPENDENT work (firing masks,
+#: delivery selects, segment folds on the scalar control arrays) that
+#: every underlying round pays whatever its payload width.  Measured on
+#: the CPU proxy: a D=64 round costs ~2x a D=1 round, so the control
+#: plane weighs about as much as ~60-80 payload lanes' streaming.
+CONTROL_LANES_EQUIV = 64.0
+
+#: per-visit overhead of the chunked schedule's scan machinery (slice +
+#: stack of the chunk-major wire-state leaves), in payload-lane-pass
+#: units per visit — amortized by rounds_per_visit
+CHUNK_VISIT_LANES_EQUIV = 192.0
+
+
+def select_payload_schedule(topo, *, features: int,
+                            backend: str | None = None,
+                            dtype_bytes: int = 4,
+                            chunk: int | None = None,
+                            rounds_per_visit: int | None = None,
+                            anchor_features: int = 64,
+                            max_round_bytes: float | None = None) -> dict:
+    """The payload-bytes term of plan='auto' for deep-payload (DFL)
+    runs: rank the chunked pipelined schedule against the monolithic
+    one from the measured edge count and payload bytes
+    (:func:`flow_updating_tpu.obs.profile.payload_bytes_per_round`),
+    and pick the chunk width / visit length that maximizes predicted
+    PER-LANE THROUGHPUT — i.e. wall-clock per full model stream, the
+    quantity a training loop feels.  (This is deliberately NOT the
+    bench's ``dfl_efficiency`` metric: that one normalizes the round
+    rate by per-round bytes at a FIXED anchor width, so it compares
+    schedules that move anchor-sized rounds; the two agree only at
+    ``w == anchor``.  A planner optimizing rate-per-round-byte would
+    always shrink chunks without bound — lane throughput is the
+    decision-relevant objective.)
+
+    The model: one underlying round of payload width ``w`` costs
+    ``E * (w + CONTROL_LANES_EQUIV)`` streamed lane-passes, plus the
+    chunked schedule's per-visit scan overhead amortized over
+    ``rounds_per_visit``.  Its lane throughput relative to the anchor
+    width ``a`` (the D=64 record) is
+
+        lane_throughput(w, rpv) = (cost(a) / a) / (cost(w, rpv) / w)
+
+    — monotonically better with larger ``w`` (control amortizes) and
+    larger ``rpv`` (scan slicing amortizes).  What caps ``w`` is the
+    PER-ROUND wire window ``max_round_bytes`` (per-message size limits,
+    per-device HBM wire-state budget, latency-to-first-progress — the
+    pipelining rationale of arXiv:1504.03277): schedules whose
+    ``E * w * dtype_bytes`` exceeds it are excluded, which is exactly
+    when the chunked schedule earns its keep.  With no window (the CPU
+    proxy default) the monolithic schedule's fully-amortized control
+    plane wins, and the decision records WHY.  Explicit ``chunk`` /
+    ``rounds_per_visit`` pin those knobs; 'auto' searches the divisor
+    grid and reports the ranking."""
+    backend = _backend_name(backend)
+    from flow_updating_tpu.obs.profile import payload_bytes_per_round
+
+    E = float(topo.num_edges)
+    a = float(anchor_features)
+
+    def visit_cost(w, rpv):
+        # per-underlying-round lane-passes: payload + control + amortized
+        # per-visit scan slice/stack of the chunk wire state
+        return E * (w + CONTROL_LANES_EQUIV
+                    + CHUNK_VISIT_LANES_EQUIV / max(rpv, 1))
+
+    anchor_cost = E * (a + CONTROL_LANES_EQUIV)
+
+    def lane_throughput(w, rpv, chunked):
+        cost = visit_cost(w, rpv) if chunked else E * (w
+                                                       + CONTROL_LANES_EQUIV)
+        return (anchor_cost / a) / (cost / w)
+
+    if features <= anchor_features:
+        return {
+            "schedule": "monolithic", "chunk": None,
+            "rounds_per_visit": None, "backend": backend,
+            "predicted_lane_throughput": {"monolithic": round(
+                lane_throughput(features, 1, False), 3)},
+            "bytes": payload_bytes_per_round(
+                topo.num_edges, features, dtype_bytes=dtype_bytes),
+            "reason": (f"D={features} <= anchor {anchor_features}: "
+                       "nothing to pipeline"),
+        }
+    rpv_grid = ([int(rounds_per_visit)] if rounds_per_visit
+                else [1, 4, 8, 16])
+    if chunk:
+        c_grid = [int(chunk)]
+    else:
+        c_grid = [c for c in (64, 128, 256, 512)
+                  if c < features and features % c == 0]
+    fits = lambda w: (max_round_bytes is None
+                      or E * w * dtype_bytes <= max_round_bytes)
+    predicted = {"monolithic": lane_throughput(features, 1, False)}
+    if chunk:
+        # an explicit chunk pins the schedule: the ranking still reports
+        # the monolithic prediction, but only chunked candidates compete
+        best_key, best_eff, best = None, -1.0, None
+    elif fits(features):
+        best_key, best_eff, best = ("monolithic",
+                                    predicted["monolithic"], None)
+    else:
+        predicted["monolithic#excluded"] = (
+            f"{int(E * features * dtype_bytes)} B/round exceeds the "
+            f"{int(max_round_bytes)} B wire window")
+        best_key, best_eff, best = None, -1.0, None
+    for c in c_grid:
+        if not fits(c):
+            predicted[f"chunked_c{c}#excluded"] = "over wire window"
+            continue
+        for rpv in rpv_grid:
+            eff = lane_throughput(c, rpv, True)
+            key = f"chunked_c{c}_rpv{rpv}"
+            predicted[key] = eff
+            if eff > best_eff:
+                best_key, best_eff, best = key, eff, (c, rpv)
+    if best_key is None:
+        raise ValueError(
+            f"no payload schedule fits max_round_bytes="
+            f"{max_round_bytes} (smallest candidate chunk moves "
+            f"{int(E * min(c_grid or [features]) * dtype_bytes)} B)")
+    chosen_chunk, chosen_rpv = best if best else (None, None)
+    bytes_rep = payload_bytes_per_round(
+        topo.num_edges, features, chunk=chosen_chunk,
+        dtype_bytes=dtype_bytes)
+    return {
+        "schedule": "chunked" if best else "monolithic",
+        "chunk": chosen_chunk,
+        "rounds_per_visit": chosen_rpv,
+        "backend": backend,
+        "predicted_lane_throughput": {k: (round(v, 3)
+                                          if isinstance(v, float) else v)
+                                      for k, v in predicted.items()},
+        "bytes": bytes_rep,
+        "reason": (
+            f"{best_key} maximizes predicted per-lane throughput "
+            f"({best_eff:.2f}x the D={anchor_features} anchor): each "
+            f"underlying round moves {bytes_rep['bytes_per_round']} B "
+            f"over {topo.num_edges} directed edges instead of "
+            f"{topo.num_edges * features * dtype_bytes} B monolithic; "
+            f"control plane ~{CONTROL_LANES_EQUIV:.0f} lane-equivalents "
+            "amortized per visit"),
+    }
+
+
 def select_plan(topo, cfg, *, backend: str | None = None,
                 features: int = 0, probe: str = "analytic",
                 max_lanes: int = 96, min_fill: float | None = None,

@@ -397,8 +397,10 @@ class Topology:
 
     def with_values(self, values: np.ndarray) -> "Topology":
         values = np.asarray(values, dtype=np.float64)
-        if values.shape != (self.num_nodes,):
-            raise ValueError(f"values must have shape ({self.num_nodes},)")
+        if values.ndim not in (1, 2) or values.shape[0] != self.num_nodes:
+            raise ValueError(
+                f"values must have shape ({self.num_nodes},) or "
+                f"({self.num_nodes}, D) — got {values.shape}")
         return dataclasses.replace(self, values=values)
 
 

@@ -28,4 +28,5 @@ from flow_updating_tpu.workloads.gossip_sgd import (  # noqa: F401
     GossipSGDConfig,
     GossipSGDTrainer,
     per_feature_mass_residual,
+    train_grid,
 )

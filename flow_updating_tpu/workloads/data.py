@@ -42,19 +42,50 @@ def make_dataset(
     task: str = "linear",
     noise: float = 0.1,
     heterogeneity: float = 0.0,
+    dirichlet_alpha: float | None = None,
+    dirichlet_components: int = 8,
     seed: int = 0,
 ) -> NodeDataset:
     """One global problem, sharded across nodes.
 
-    ``heterogeneity`` > 0 shifts each node's feature distribution by a
-    node-specific mean of that magnitude (non-IID shards); 0 = IID.
+    Two non-IID knobs, composable:
+
+    * ``heterogeneity`` > 0 shifts each node's feature distribution by a
+      node-specific mean of that magnitude; 0 = IID.
+    * ``dirichlet_alpha`` is the standard federated Dirichlet shard
+      synthesis (the non-IID axis of the DFL sweeps, arXiv:2506.10607
+      §II): ``dirichlet_components`` latent feature clusters with
+      distinct means, and node ``n`` draws each sample's cluster from
+      its own mixture ``pi_n ~ Dir(alpha * 1_K)``.  Small ``alpha``
+      concentrates every node on a few clusters (strongly non-IID);
+      ``alpha -> inf`` recovers the uniform mixture.  Fully determined
+      by ``seed`` (one `default_rng` stream).
     """
     if task not in TASKS:
         raise ValueError(f"unknown task {task!r} (have {TASKS})")
+    if dirichlet_alpha is not None and dirichlet_alpha <= 0:
+        raise ValueError(
+            f"dirichlet_alpha must be > 0 (got {dirichlet_alpha}); "
+            "omit it (None) for IID shards")
     rng = np.random.default_rng(seed)
     w_true = rng.normal(size=features) / np.sqrt(features)
     shift = heterogeneity * rng.normal(size=(num_nodes, 1, features))
     X = rng.normal(size=(num_nodes, samples_per_node, features)) + shift
+    if dirichlet_alpha is not None:
+        K = int(dirichlet_components)
+        if K < 2:
+            raise ValueError("dirichlet_components must be >= 2")
+        # latent cluster means on the unit-ish sphere; pi_n ~ Dir(alpha)
+        # per node; each sample joins cluster c_nm ~ Cat(pi_n) and is
+        # shifted by that cluster's mean
+        centers = rng.normal(size=(K, features)) / np.sqrt(features)
+        pi = rng.dirichlet(dirichlet_alpha * np.ones(K), size=num_nodes)
+        cdf = np.cumsum(pi, axis=1)
+        cdf[:, -1] = 1.0   # float cumsum can land at 1 - eps; a uniform
+        #                    draw above it would index past cluster K-1
+        u = rng.uniform(size=(num_nodes, samples_per_node, 1))
+        comp = (u > cdf[:, None, :]).sum(axis=2)  # (N, m) cluster ids
+        X = X + centers[comp]
     logits = np.einsum("nmd,d->nm", X, w_true)
     if task == "linear":
         y = logits + noise * rng.normal(size=logits.shape)

@@ -39,7 +39,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from flow_updating_tpu.models.config import RoundConfig
-from flow_updating_tpu.models.rounds import node_estimates, round_step
+from flow_updating_tpu.models.rounds import (
+    ChunkedState,
+    chunk_count,
+    chunked_node_estimates,
+    chunked_rounds_per_visit,
+    init_chunked_state,
+    node_estimates,
+    round_step,
+    run_rounds_chunked,
+    _chunk_major,
+    _chunk_flat,
+)
 from flow_updating_tpu.models.state import FlowUpdatingState, init_state
 from flow_updating_tpu.workloads.data import NodeDataset, pooled_loss
 
@@ -113,6 +124,212 @@ def _outer_step(state, arrays, X, y, rcfg: RoundConfig,
     return state
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("rcfg", "gcfg", "task", "do_global", "mesh"))
+def _outer_step_feature(state, arrays, X, y, rcfg: RoundConfig,
+                        gcfg: GossipSGDConfig, task: str,
+                        do_global: bool, mesh):
+    """One outer step under feature-axis model parallelism: the WHOLE
+    step — local gradients, comm rounds, optional PGA sync — runs inside
+    one ``shard_map`` over the ``('nodes', 'feature')`` mesh, so the
+    only cross-device traffic is (a) one ``psum('feature')`` per local
+    step for the logits and (b) Gossip-PGA's ``psum('nodes')`` node-mean
+    when the sync fires — no host round-trips, no GSPMD resharding
+    between phases (parallel/feature.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from flow_updating_tpu.parallel import feature as _F
+    from flow_updating_tpu.parallel.mesh import NODE_AXIS, shard_map
+
+    specs = _F.state_feature_specs(state)
+    aspec = jax.tree.map(lambda x: P(), arrays)
+    xspec = P(None, None, _F.FEATURE_AXIS)
+    node_axis = (NODE_AXIS in mesh.axis_names
+                 and int(mesh.shape[NODE_AXIS]) > 1)
+
+    def body(st, ta, Xs, ys):
+        for _ in range(gcfg.local_steps):
+            w = node_estimates(st, ta)
+            z = _F.feature_logits(Xs, w)          # psum over 'feature'
+            r = (z - ys) if task == "linear" else (jax.nn.sigmoid(z) - ys)
+            g = jnp.einsum("nmd,nm->nd", Xs, r) / Xs.shape[1]
+            g = jnp.where(st.alive[:, None], g, 0)
+            st = st.replace(
+                value=st.value - jnp.asarray(gcfg.lr, w.dtype) * g)
+        st = jax.lax.fori_loop(
+            0, gcfg.comm_rounds, lambda _, s: round_step(s, ta, rcfg), st)
+        if do_global:
+            st = _F._pga_rebase(st, ta, node_axis)  # psum over 'nodes'
+        return st
+
+    fn = shard_map(body, mesh=mesh, in_specs=(specs, aspec, xspec, P()),
+                   out_specs=specs, check_vma=False)
+    return fn(state, arrays, X, y)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rcfg", "gcfg", "task", "do_global", "rpv", "mesh"))
+def _outer_step_chunked_feature(cs: ChunkedState, arrays, X, y,
+                                rcfg: RoundConfig, gcfg: GossipSGDConfig,
+                                task: str, do_global: bool, rpv: int,
+                                mesh):
+    """Chunked schedule x feature sharding: the comm phase streams each
+    device's OWN chunks through the explicit shard_map path
+    (parallel/feature.run_chunked_feature — per-device wire is E*c lanes
+    per visit); local compute and the PGA rebase run as sharded-array
+    ops (the chunk axis is the partitioned dimension, so the gradient's
+    cross-chunk reads resolve to the feature-axis collectives GSPMD
+    inserts — one gather per local step, outside the round scan)."""
+    from flow_updating_tpu.parallel import feature as _F
+
+    for _ in range(gcfg.local_steps):
+        w = chunked_node_estimates(cs, arrays)
+        g = _grad(w, X, y, task)
+        g = jnp.where(cs.state.alive[:, None], g, 0)
+        lr = jnp.asarray(gcfg.lr, w.dtype)
+        cs = cs.replace(value=cs.value - _chunk_major(lr * g, cs.n_chunks))
+    if gcfg.comm_rounds:
+        sf = int(mesh.shape[_F.FEATURE_AXIS])
+        cs = _F.run_chunked_feature(
+            cs, arrays, rcfg,
+            num_rounds=(cs.n_chunks // sf) * gcfg.comm_rounds,
+            mesh=mesh, rounds_per_visit=rpv)
+    if do_global:
+        cs = _global_average_chunked(cs, arrays)
+    return cs
+
+
+def _global_average_chunked(cs: ChunkedState, arrays) -> ChunkedState:
+    """The PGA rebase on chunk-major state: identical math to
+    :func:`_global_average`, applied per contiguous feature block."""
+    est = chunked_node_estimates(cs, arrays)          # (N, D)
+    alive = cs.state.alive
+    a = alive[:, None]
+    cnt = jnp.maximum(jnp.sum(alive), 1).astype(est.dtype)
+    mean = jnp.sum(jnp.where(a, est, 0), axis=0) / cnt
+    value = _chunk_flat(cs.value)
+    value = jnp.where(a, value - est + mean, value)
+    return cs.replace(value=_chunk_major(value, cs.n_chunks))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rcfg", "gcfg", "task", "do_global", "rpv"))
+def _outer_step_chunked(cs: ChunkedState, arrays, X, y, rcfg: RoundConfig,
+                        gcfg: GossipSGDConfig, task: str, do_global: bool,
+                        rpv: int):
+    """One outer step over the pipelined chunked schedule: local compute
+    touches the chunk-major values directly; the comm phase advances
+    EVERY chunk's instance by ``gcfg.comm_rounds`` rounds
+    (``comm_rounds / rpv`` full passes)."""
+    for _ in range(gcfg.local_steps):
+        w = chunked_node_estimates(cs, arrays)
+        g = _grad(w, X, y, task)
+        g = jnp.where(cs.state.alive[:, None], g, 0)
+        lr = jnp.asarray(gcfg.lr, w.dtype)
+        cs = cs.replace(value=cs.value - _chunk_major(lr * g, cs.n_chunks))
+    if gcfg.comm_rounds:
+        cs = run_rounds_chunked(
+            cs, arrays, rcfg,
+            num_rounds=cs.n_chunks * gcfg.comm_rounds,
+            rounds_per_visit=rpv)
+    if do_global:
+        cs = _global_average_chunked(cs, arrays)
+    return cs
+
+
+@functools.partial(jax.jit, static_argnames=("rcfg", "gcfg", "task"))
+def _grid_step(states, arrays, X, y, H, k, rcfg: RoundConfig,
+               gcfg: GossipSGDConfig, task: str):
+    """One vmapped outer step over B trainer lanes sharing ONE topology
+    shape (the sweep discipline): per-lane datasets (the non-IID axis)
+    and per-lane PGA periods ``H`` (TRACED int32, so every period in the
+    grid rides the same compiled program — 0 means never)."""
+
+    def one(st, Xs, ys, h):
+        for _ in range(gcfg.local_steps):
+            w = node_estimates(st, arrays)
+            g = _grad(w, Xs, ys, task)
+            g = jnp.where(st.alive[:, None], g, 0)
+            st = st.replace(
+                value=st.value - jnp.asarray(gcfg.lr, w.dtype) * g)
+        st = jax.lax.fori_loop(
+            0, gcfg.comm_rounds,
+            lambda _, s: round_step(s, arrays, rcfg), st)
+        do = (h > 0) & (((k + 1) % jnp.maximum(h, 1)) == 0)
+        ga = _global_average(st, arrays)
+        return st.replace(value=jnp.where(do, ga.value, st.value))
+
+    return jax.vmap(one, in_axes=(0, 0, 0, 0))(states, X, y, H)
+
+
+def train_grid(topo, datasets, periods, cfg: GossipSGDConfig,
+               round_cfg: RoundConfig | None = None,
+               w0: np.ndarray | None = None) -> list[dict]:
+    """The DFL sweep: a (non-IID shard) x (PGA period) grid trained as
+    ONE vmapped program — ``B = len(datasets) * len(periods)`` lanes,
+    one compile for the whole grid (same-shape topologies share the jit
+    cache entry across calls, the sweep engine's shape-bucket
+    discipline; build ``datasets`` with ``make_dataset(dirichlet_alpha=
+    ...)`` for the Dirichlet non-IID axis).
+
+    Returns one report dict per lane (row-major over datasets x
+    periods), each tagged with its lane coordinates."""
+    if round_cfg is None:
+        round_cfg = RoundConfig.fast(dtype="float64")
+    if round_cfg.kernel != "edge":
+        raise ValueError("train_grid drives the edge kernel "
+                         "(kernel='edge')")
+    tasks = {d.task for d in datasets}
+    feats = {d.features for d in datasets}
+    if len(tasks) != 1 or len(feats) != 1:
+        raise ValueError("grid datasets must share task and feature "
+                         f"count (got tasks={tasks}, D={feats})")
+    arrays = topo.device_arrays(
+        coloring=round_cfg.needs_coloring,
+        segment_ell=round_cfg.use_segment_ell,
+        segment_benes=round_cfg.segment_benes_mode,
+        delivery_benes=round_cfg.delivery_benes_mode)
+    dt = round_cfg.jnp_dtype
+    D = feats.pop()
+    task = tasks.pop()
+    if w0 is None:
+        w0 = np.zeros((topo.num_nodes, D))
+    lanes = [(d, h) for d in datasets for h in periods]
+    states = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[init_state(topo, round_cfg, values=w0) for _ in lanes])
+    X = jnp.stack([jnp.asarray(d.X, dt) for d, _ in lanes])
+    y = jnp.stack([jnp.asarray(d.y, dt) for d, _ in lanes])
+    H = jnp.asarray([h for _, h in lanes], jnp.int32)
+    for k in range(cfg.outer_steps):
+        states = _grid_step(states, arrays, X, y, H,
+                            jnp.asarray(k, jnp.int32), round_cfg, cfg,
+                            task)
+    reports = []
+    for i, (d, h) in enumerate(lanes):
+        st = jax.tree.map(lambda x: x[i], states)
+        w = np.asarray(node_estimates(st, arrays))
+        alive = np.asarray(st.alive)
+        w_mean = w[alive].mean(axis=0) if alive.any() else w.mean(axis=0)
+        res = np.asarray(jnp.sum(node_estimates(st, arrays), axis=0)
+                         - jnp.sum(st.value, axis=0))
+        wa = w[alive] if alive.any() else w
+        reports.append({
+            "lane": i,
+            "global_avg_every": int(h),
+            "outer_steps": cfg.outer_steps,
+            "pooled_loss": pooled_loss(d, w_mean),
+            "consensus_dispersion": (
+                float(np.abs(wa - wa.mean(axis=0)).max()) if len(wa)
+                else 0.0),
+            "max_mass_residual": float(np.abs(res).max()),
+        })
+    return reports
+
+
 class GossipSGDTrainer:
     """Decentralized gossip-SGD over one topology + dataset.
 
@@ -127,7 +344,10 @@ class GossipSGDTrainer:
     def __init__(self, topo, data: NodeDataset,
                  cfg: GossipSGDConfig = GossipSGDConfig(),
                  round_cfg: RoundConfig | None = None,
-                 w0: np.ndarray | None = None):
+                 w0: np.ndarray | None = None,
+                 chunk: int = 0,
+                 feature_shards: int = 0,
+                 rounds_per_visit: int | None = None):
         if data.num_nodes != topo.num_nodes:
             raise ValueError(
                 f"dataset covers {data.num_nodes} nodes, topology has "
@@ -151,20 +371,104 @@ class GossipSGDTrainer:
         dt = round_cfg.jnp_dtype
         if w0 is None:
             w0 = np.zeros((topo.num_nodes, data.features))
-        self.state = init_state(topo, round_cfg, values=w0)
+
+        # -- model-scale axes (docs/WORKLOADS.md "model scale") ----------
+        self.cstate = None
+        self._state = None
+        self.chunk = int(chunk)
+        self.feature_shards = int(feature_shards)
+        self._mesh = None
+        if self.chunk:
+            chunk_count(data.features, self.chunk)  # divisibility
+            self._rpv = (int(rounds_per_visit) if rounds_per_visit
+                         else chunked_rounds_per_visit(self.arrays,
+                                                       round_cfg))
+            if cfg.comm_rounds % max(self._rpv, 1):
+                raise ValueError(
+                    f"comm_rounds={cfg.comm_rounds} must be a multiple "
+                    f"of rounds_per_visit={self._rpv} (whole chunk "
+                    "passes per outer step)")
+        else:
+            self._rpv = None
+            if rounds_per_visit:
+                raise ValueError("rounds_per_visit is a chunked-schedule "
+                                 "knob; pass chunk=c to enable it")
+        if self.feature_shards:
+            from flow_updating_tpu.parallel import feature as _F
+
+            self._mesh = _F.feature_mesh(self.feature_shards)
+            if self.chunk:
+                n = data.features // self.chunk
+                if n % self.feature_shards:
+                    raise ValueError(
+                        f"n_chunks={n} must divide evenly over "
+                        f"{self.feature_shards} feature shards")
+            elif data.features % self.feature_shards:
+                raise ValueError(
+                    f"features D={data.features} must divide evenly "
+                    f"over {self.feature_shards} feature shards")
+        if self.chunk:
+            self.cstate = init_chunked_state(topo, round_cfg, self.chunk,
+                                             w0)
+            if self._mesh is not None:
+                from flow_updating_tpu.parallel import feature as _F
+
+                specs = _F.chunked_feature_specs(self.cstate)
+                self.cstate = jax.tree.map(
+                    lambda x, s: jax.device_put(
+                        x, jax.sharding.NamedSharding(self._mesh, s)),
+                    self.cstate, specs)
+        else:
+            self.state = init_state(topo, round_cfg, values=w0)
+            if self._mesh is not None:
+                from flow_updating_tpu.parallel import feature as _F
+
+                self.state = _F.place_feature_state(self.state,
+                                                    self._mesh)
         self._X = jnp.asarray(data.X, dt)
         self._y = jnp.asarray(data.y, dt)
+        if self._mesh is not None and not self.chunk:
+            from flow_updating_tpu.parallel.mesh import FEATURE_AXIS
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._X = jax.device_put(self._X, NamedSharding(
+                self._mesh, P(None, None, FEATURE_AXIS)))
         self.outer_done = 0
 
     # -- payload views ---------------------------------------------------
     def params(self) -> np.ndarray:
         """(N, D) current per-node models (the Flow-Updating estimates)."""
+        if self.cstate is not None:
+            return np.asarray(chunked_node_estimates(self.cstate,
+                                                     self.arrays))
         return np.asarray(node_estimates(self.state, self.arrays))
+
+    @property
+    def state(self) -> FlowUpdatingState:
+        """The protocol state.  In chunked mode the state of record
+        lives in ``cstate`` (chunk-major leaves + shared churn masks);
+        reading ``.state`` always reflects it and assigning through
+        ``.state`` updates the chunked window, so the long-standing
+        attribute can never go stale behind ``cstate`` mutations."""
+        return self.cstate.state if self.cstate is not None else self._state
+
+    @state.setter
+    def state(self, value: FlowUpdatingState) -> None:
+        if self.cstate is not None:
+            self.cstate = self.cstate.replace(state=value)
+        else:
+            self._state = value
+
+    @property
+    def control(self) -> FlowUpdatingState:
+        """The control-plane state (liveness, round counter) — an alias
+        of :attr:`state` (which tracks ``cstate`` in chunked mode)."""
+        return self.state
 
     def consensus_dispersion(self) -> float:
         """max_i ||w_i - mean(w)||_inf over alive nodes."""
         w = self.params()
-        alive = np.asarray(self.state.alive)
+        alive = np.asarray(self.control.alive)
         wa = w[alive]
         return float(np.abs(wa - wa.mean(axis=0)).max()) if len(wa) else 0.0
 
@@ -175,7 +479,7 @@ class GossipSGDTrainer:
         tests) reports the same thing.  Dead nodes are excluded: their
         params froze at death and don't represent the survivors."""
         w_opt = np.asarray(w_opt)
-        alive = np.asarray(self.state.alive)
+        alive = np.asarray(self.control.alive)
         w = self.params()
         if alive.any():
             w = w[alive]
@@ -183,6 +487,11 @@ class GossipSGDTrainer:
         return float(np.linalg.norm(w - w_opt, axis=1).max() / denom)
 
     def mass_residual(self) -> np.ndarray:
+        if self.cstate is not None:
+            est = chunked_node_estimates(self.cstate, self.arrays)
+            value = _chunk_flat(self.cstate.value)
+            return np.asarray(jnp.sum(est, axis=0)
+                              - jnp.sum(value, axis=0))
         return per_feature_mass_residual(self.state, self.arrays)
 
     # -- fault injection -------------------------------------------------
@@ -192,6 +501,7 @@ class GossipSGDTrainer:
     def kill_nodes(self, nodes) -> None:
         from flow_updating_tpu.service import membership
 
+        # the .state property routes the edit into cstate in chunked mode
         self.state = membership.set_alive(self.state, nodes, False)
 
     def revive_nodes(self, nodes) -> None:
@@ -204,9 +514,24 @@ class GossipSGDTrainer:
         """One outer step (local compute + gossip + optional PGA sync)."""
         H = self.cfg.global_avg_every
         do_global = bool(H) and (self.outer_done + 1) % H == 0
-        self.state = _outer_step(
-            self.state, self.arrays, self._X, self._y, self.round_cfg,
-            self.cfg, self.data.task, do_global)
+        if self.cstate is not None:
+            step_fn = _outer_step_chunked
+            extra = ()
+            if self._mesh is not None:
+                step_fn, extra = _outer_step_chunked_feature, (self._mesh,)
+            self.cstate = step_fn(
+                self.cstate, self.arrays, self._X, self._y,
+                self.round_cfg, self.cfg, self.data.task, do_global,
+                self._rpv, *extra)
+        elif self._mesh is not None:
+            self.state = _outer_step_feature(
+                self.state, self.arrays, self._X, self._y,
+                self.round_cfg, self.cfg, self.data.task, do_global,
+                self._mesh)
+        else:
+            self.state = _outer_step(
+                self.state, self.arrays, self._X, self._y, self.round_cfg,
+                self.cfg, self.data.task, do_global)
         self.outer_done += 1
 
     def train(self, churn: dict | None = None, sample_every: int = 0,
@@ -231,7 +556,7 @@ class GossipSGDTrainer:
 
     def report(self) -> dict:
         w = self.params()
-        alive = np.asarray(self.state.alive)
+        alive = np.asarray(self.control.alive)
         w_mean = w[alive].mean(axis=0) if alive.any() else w.mean(axis=0)
         res = self.mass_residual()
         return {
@@ -244,4 +569,25 @@ class GossipSGDTrainer:
             "pooled_loss": pooled_loss(self.data, w_mean),
             "consensus_dispersion": self.consensus_dispersion(),
             "max_mass_residual": float(np.abs(res).max()),
+            "chunk": self.chunk or None,
+            "rounds_per_visit": self._rpv,
+            "feature_shards": self.feature_shards or None,
+            "comm_bytes_total": self.comm_bytes_total(),
         }
+
+    def comm_bytes_total(self) -> int:
+        """Total payload bytes the comm phases have moved over edges so
+        far — the x-axis of the convergence-vs-bytes methodology
+        (arXiv:2506.10607).  Every schedule moves the same bytes per
+        underlying round x lane: chunking/sharding change WHO moves them
+        and how many per device, not the total."""
+        from flow_updating_tpu.obs.profile import payload_bytes_per_round
+
+        per = payload_bytes_per_round(
+            self.topo.num_edges, self.data.features,
+            chunk=self.chunk or None,
+            dtype_bytes=jnp.dtype(self.round_cfg.jnp_dtype).itemsize)
+        rounds_per_outer = self.cfg.comm_rounds * (
+            1 if not self.chunk else self.data.features // self.chunk)
+        return int(self.outer_done * rounds_per_outer
+                   * per["bytes_per_round"])

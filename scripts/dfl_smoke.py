@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""CI DFL model-scale smoke: the feature-sharded, pipelined gossip
+stack end to end on the 2-device virtual CPU mesh.
+
+For each smoke topology (an ER gossip graph and the planted-partition
+community graph — one convergence-vs-bytes curve per topology lands in
+the manifest):
+
+1. **Chunked == monolithic bit-parity.**  A ``c = D`` chunked run must
+   be BIT-identical to the plain vector run (the degenerate one-chunk
+   pass), and a ``c = 64`` chunked run must be bit-identical PER CHUNK
+   to a monolithic run on that feature block — the pipelined schedule
+   re-times the traffic, it never changes a single bit of any lane.
+2. **Feature-sharded == single-device bit-parity.**  The same payload
+   run with the feature axis sharded over the 2-device mesh
+   (parallel/feature.py) must concatenate to the single-device run
+   bit-for-bit (the control plane is replicated, the lanes are
+   independent).
+3. **Per-feature mass conservation.**  After the chunked run (drop>0
+   included) the per-feature ledger-form residual must sit within the
+   float tolerance — the paper's conservation invariant, per feature,
+   per chunk.
+4. **Convergence-vs-bytes curve.**  One telemetry row per full model
+   stream (pass) of the chunked schedule — RMSE + per-feature mass
+   residual against cumulative wire bytes (the arXiv:2506.10607
+   bytes-per-accuracy measurement) — embedded in a
+   ``flow-updating-run-report/v1`` manifest under the standard
+   ``telemetry`` key, then audited by ``doctor`` (exit 1 on any
+   failing health check).
+
+Exit code: 0 when every assert and the doctor pass; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FEATURE_SHARDS = 2
+D = 256
+CHUNK = 64
+
+# the 2-device mesh must exist before jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        f"{_flags} --xla_force_host_platform_device_count="
+        f"{FEATURE_SHARDS}").strip()
+
+
+def _fail(msg: str) -> int:
+    print(f"dfl_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--rounds", type=int, default=48,
+                    help="underlying rounds per chunk for the parity "
+                         "runs (the curve runs 8 passes)")
+    args = ap.parse_args()
+    os.makedirs(args.outdir, exist_ok=True)
+
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < FEATURE_SHARDS:
+        return _fail(f"need {FEATURE_SHARDS} devices, have "
+                     f"{len(jax.devices())} (jax initialized before the "
+                     "device-count flag?)")
+
+    from flow_updating_tpu.models import rounds as R
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.state import init_state
+    from flow_updating_tpu.obs.profile import payload_bytes_per_round
+    from flow_updating_tpu.obs.report import build_manifest, write_report
+    from flow_updating_tpu.obs.telemetry import (
+        TelemetrySeries,
+        TelemetrySpec,
+    )
+    from flow_updating_tpu.parallel import feature as F
+    from flow_updating_tpu.topology.generators import community, erdos_renyi
+
+    topologies = {
+        "er": erdos_renyi(96, avg_degree=6.0, seed=0),
+        "community": community(96, c=4, seed=0),
+    }
+    cfg = RoundConfig.fast(variant="collectall", kernel="edge")
+    # short timeout so drop-orphaned edges refire (and heal) quickly
+    cfg_drop = RoundConfig.reference(variant="collectall", kernel="edge",
+                                     drop_rate=0.2, timeout=8)
+    cfg_heal = RoundConfig.reference(variant="collectall", kernel="edge",
+                                     timeout=8)
+    mesh = F.feature_mesh(FEATURE_SHARDS)
+    rng = np.random.default_rng(0)
+    curves = {}
+    n_chunks = D // CHUNK
+
+    for name, topo in topologies.items():
+        ta = topo.device_arrays()
+        vals = rng.normal(size=(topo.num_nodes, D))
+        rounds = args.rounds
+
+        # 1a: c = D degenerates to the plain vector run, bit-for-bit
+        ref = R.run_rounds(init_state(topo, cfg, values=vals), ta, cfg,
+                           num_rounds=rounds)
+        cs1 = R.run_rounds_chunked(
+            R.init_chunked_state(topo, cfg, D, vals), ta, cfg,
+            num_rounds=rounds)
+        if not np.array_equal(np.asarray(R._chunk_flat(cs1.flow)),
+                              np.asarray(ref.flow)):
+            return _fail(f"{name}: c=D chunked run != monolithic run")
+
+        # 1b: c = 64 is bit-identical per chunk to the per-block runs
+        csc = R.run_rounds_chunked(
+            R.init_chunked_state(topo, cfg, CHUNK, vals), ta, cfg,
+            num_rounds=rounds * n_chunks)
+        for b in range(n_chunks):
+            blk = R.run_rounds(
+                init_state(topo, cfg,
+                           values=vals[:, b * CHUNK:(b + 1) * CHUNK]),
+                ta, cfg, num_rounds=rounds)
+            if not np.array_equal(np.asarray(csc.flow[b]),
+                                  np.asarray(blk.flow)):
+                return _fail(f"{name}: chunk {b} != its monolithic "
+                             "block run")
+
+        # 2: feature-sharded == single-device, bit-for-bit
+        st = F.place_feature_state(init_state(topo, cfg, values=vals),
+                                   mesh)
+        out = F.run_rounds_feature(st, ta, cfg, rounds, mesh)
+        if not np.array_equal(np.asarray(out.flow), np.asarray(ref.flow)):
+            return _fail(f"{name}: feature-sharded run != single-device")
+
+        # 3: per-feature mass conservation under drop>0 — the paper's
+        # self-healing story under the doctor's accounting: the faithful
+        # asynchronous dynamics never fully quiesce (there are ALWAYS
+        # sent-but-undelivered messages carrying mass), so the residual
+        # is judged against the standard in-flight allowance — factor x
+        # worst per-node error x active nodes (obs/health.py, "mid-run
+        # in-flight mass is NOT a leak") — after a drop-free healing
+        # tail shrinks that error.
+        csd = R.run_rounds_chunked(
+            R.init_chunked_state(topo, cfg_drop, CHUNK, vals, seed=3),
+            ta, cfg_drop, num_rounds=rounds * n_chunks)
+        heal = R.run_rounds_chunked(csd, ta, cfg_heal,
+                                    num_rounds=4 * rounds * n_chunks)
+        est = np.asarray(R.chunked_node_estimates(heal, ta))
+        mean_d = np.asarray(vals).mean(axis=0)
+        max_abs_err = float(np.abs(est - mean_d).max())
+        residual = np.abs(est.sum(axis=0) - np.asarray(vals).sum(axis=0))
+        allowance = 2.0 * max_abs_err * topo.num_nodes \
+            + 64 * np.finfo(np.float32).eps * float(
+                np.abs(vals).sum(axis=0).max())
+        if residual.max() > allowance:
+            return _fail(f"{name}: per-feature mass residual "
+                         f"{residual.max():.3e} exceeds the in-flight "
+                         f"allowance {allowance:.3e}")
+        # and the healing must actually shrink the error (self-healing,
+        # not divergence): the healed per-node error must be far inside
+        # the payload scale
+        if max_abs_err > 0.5:
+            return _fail(f"{name}: healed per-node error {max_abs_err} "
+                         "did not contract (self-healing broken?)")
+
+        # 4: convergence-vs-bytes curve — one telemetry row per pass
+        # 'active' feeds the doctor's in-flight mass allowance (factor x
+        # worst error x active nodes) — without it a mid-stream residual
+        # reads as a leak
+        spec = TelemetrySpec.parse("rmse,max_abs_err,mass_residual,active")
+        cs0 = R.init_chunked_state(topo, cfg, CHUNK, vals)
+        mean = np.asarray(vals).mean(axis=0)
+        _, series = R.run_rounds_chunked_telemetry(
+            cs0, ta, cfg, num_rounds=8 * n_chunks, spec=spec,
+            true_mean=mean)
+        series = {k: np.asarray(v) for k, v in series.items()}
+        bytes_per_pass = payload_bytes_per_round(
+            topo.num_edges, D, chunk=CHUNK,
+            dtype_bytes=4)["bytes_per_model_stream"]
+        curves[name] = {
+            "topology": name,
+            "nodes": topo.num_nodes,
+            "directed_edges": topo.num_edges,
+            "features": D,
+            "chunk": CHUNK,
+            "bytes_per_pass": bytes_per_pass,
+            "cumulative_bytes": [bytes_per_pass * (i + 1)
+                                 for i in range(len(series["rmse"]))],
+            "rmse": [float(x) for x in series["rmse"]],
+            "max_mass_residual": [
+                float(np.abs(x).max())
+                for x in series["mass_residual"]],
+        }
+        tser = TelemetrySeries(
+            {"t": series["t"], "rmse": series["rmse"],
+             "max_abs_err": series["max_abs_err"],
+             "mass_residual": series["mass_residual"],
+             "active": series["active"]})
+        manifest = build_manifest(
+            argv=sys.argv[1:], config=cfg, topo=topo,
+            report={
+                "mode": "dfl_smoke",
+                "features": D, "chunk": CHUNK,
+                "feature_shards": FEATURE_SHARDS,
+                "rounds": int(series["t"][-1]),
+                "convergence_vs_bytes": curves[name],
+                "final_rmse": curves[name]["rmse"][-1],
+                "true_mean_mean": float(mean.mean()),
+            },
+            telemetry=tser)
+        path = os.path.join(args.outdir, f"dfl_{name}_report.json")
+        write_report(path, manifest)
+        print(f"dfl_smoke: {name}: parity OK, residual "
+              f"{residual.max():.3e}, final rmse "
+              f"{curves[name]['rmse'][-1]:.3e} after "
+              f"{curves[name]['cumulative_bytes'][-1]} B -> {path}")
+
+        # doctor-audit the manifest (any failing check fails the smoke)
+        from flow_updating_tpu.cli import main as cli_main
+
+        rc = cli_main(["doctor", path])
+        if rc != 0:
+            return _fail(f"{name}: doctor rejected {path} (rc={rc})")
+
+    # 5: the bytes-efficiency regression gate, cross-machine stable
+    # because it is a SAME-machine rate ratio (the scaling smoke's
+    # per-chip-efficiency discipline): a D=256 payload streamed in
+    # anchor-width chunks must keep >= 30% of the D=64 monolithic round
+    # rate.  The recorded CPU-proxy figure is ~90% (dfl_d4096,
+    # BASELINE_MEASURED.json); 30% is the collapse detector — the
+    # pre-redesign chunk rotation (full-ledger copies per visit) sat at
+    # ~3%, an order below the floor.
+    import time
+
+    topo = topologies["er"]
+    ta = topo.device_arrays()
+    vals = rng.normal(size=(topo.num_nodes, D))
+    ref_state = init_state(topo, cfg, values=vals[:, :CHUNK])
+    cs_perf = R.init_chunked_state(topo, cfg, CHUNK, vals)
+    rpv = 16
+    per_pass = n_chunks * rpv
+
+    def rate(fn, r):
+        fn(r)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn(r)
+            best = max(best, r / (time.perf_counter() - t0))
+        return best
+
+    r_anchor = rate(lambda r: jax.block_until_ready(
+        R.run_rounds(ref_state, ta, cfg, num_rounds=r).flow), 512)
+    r_chunk = rate(lambda r: jax.block_until_ready(
+        R.run_rounds_chunked(cs_perf, ta, cfg, num_rounds=r,
+                             rounds_per_visit=rpv).flow), 4 * per_pass)
+    eff = r_chunk / r_anchor
+    print(f"dfl_smoke: efficiency gate: chunked {r_chunk:.1f} r/s vs "
+          f"anchor {r_anchor:.1f} r/s -> {100 * eff:.1f}%")
+    if eff < 0.30:
+        return _fail(f"bytes-efficiency {100 * eff:.1f}% below the 30% "
+                     "collapse floor (chunk rotation regressed?)")
+
+    print(json.dumps({"ok": True,
+                      "topologies": list(topologies),
+                      "features": D, "chunk": CHUNK,
+                      "feature_shards": FEATURE_SHARDS,
+                      "efficiency_vs_anchor": round(eff, 3)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,454 @@
+"""DFL model scale: feature-axis sharding + pipelined chunked gossip.
+
+The two big-payload axes (ROADMAP item 4) against their ground truth:
+
+* each chunk of the pipelined schedule IS the plain protocol on its
+  feature block — bit-identical per chunk to the monolithic run on that
+  block for every fire policy, drop>0 included (each instance carries
+  its own round counter/clocks/PRNG key, so its trajectory cannot
+  depend on the visit schedule), and ``c = D`` degenerates bit-exactly
+  to :func:`run_rounds`;
+* feature sharding concatenates to the single-device vector run
+  bit-for-bit (replicated control plane, independent lanes), drop and
+  churn included, and composes with chunking;
+* per-feature mass is conserved under drop + churn for all c (the
+  in-flight-allowance accounting of obs/health.py);
+* the trainer's new knobs, the Dirichlet non-IID synthesis, the
+  payload-bytes planner term and the dfl_* baseline-key isolation.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models import rounds as R
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.parallel import feature as F
+from flow_updating_tpu.topology.generators import erdos_renyi
+from flow_updating_tpu.workloads.data import make_dataset
+from flow_updating_tpu.workloads.gossip_sgd import (
+    GossipSGDConfig,
+    GossipSGDTrainer,
+    train_grid,
+)
+
+
+@pytest.fixture(scope="module")
+def topo():
+    return erdos_renyi(48, avg_degree=5.0, seed=0)
+
+
+@pytest.fixture(scope="module")
+def arrays(topo):
+    return topo.device_arrays(coloring=True)
+
+
+@pytest.fixture(scope="module")
+def vals(topo):
+    return np.random.default_rng(0).normal(size=(topo.num_nodes, 8))
+
+
+CFGS = [
+    RoundConfig.fast(variant="collectall", kernel="edge"),
+    RoundConfig.reference(variant="collectall", kernel="edge",
+                          drop_rate=0.3, timeout=8),
+    RoundConfig.fast(variant="pairwise"),
+    RoundConfig.reference(variant="pairwise", drop_rate=0.2),
+]
+CFG_IDS = ["fast-ca", "ref-ca-drop", "fast-pw", "ref-pw-drop"]
+
+
+# ---- chunked schedule: bit-exactness ------------------------------------
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=CFG_IDS)
+def test_chunk_c_eq_D_degenerates_to_plain_run(topo, arrays, vals, cfg):
+    ref = R.run_rounds(init_state(topo, cfg, values=vals), arrays, cfg,
+                       num_rounds=12)
+    cs = R.run_rounds_chunked(
+        R.init_chunked_state(topo, cfg, 8, vals), arrays, cfg,
+        num_rounds=12)
+    np.testing.assert_array_equal(np.asarray(R._chunk_flat(cs.flow)),
+                                  np.asarray(ref.flow))
+    np.testing.assert_array_equal(np.asarray(R._chunk_flat(cs.est)),
+                                  np.asarray(ref.est))
+    np.testing.assert_array_equal(np.asarray(cs.t),
+                                  np.asarray(ref.t)[None])
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=CFG_IDS)
+def test_per_chunk_parity_vs_monolithic_block(topo, arrays, vals, cfg):
+    """Every chunk's trajectory == the plain run on its feature block,
+    bit-for-bit — drop draws included (per-instance PRNG keys)."""
+    c, D = 2, 8
+    cs = R.run_rounds_chunked(
+        R.init_chunked_state(topo, cfg, c, vals), arrays, cfg,
+        num_rounds=12 * (D // c))
+    for b in range(D // c):
+        blk = R.run_rounds(
+            init_state(topo, cfg, values=vals[:, b * c:(b + 1) * c]),
+            arrays, cfg, num_rounds=12)
+        np.testing.assert_array_equal(np.asarray(cs.flow[b]),
+                                      np.asarray(blk.flow))
+
+
+def test_rounds_per_visit_never_changes_trajectories(topo, arrays, vals):
+    """The visit length is a pure scheduling knob: per-instance clocks
+    make chunk trajectories independent of how rounds batch into
+    visits."""
+    cfg = CFGS[1]
+    a = R.run_rounds_chunked(R.init_chunked_state(topo, cfg, 2, vals),
+                             arrays, cfg, num_rounds=24,
+                             rounds_per_visit=1)
+    b = R.run_rounds_chunked(R.init_chunked_state(topo, cfg, 2, vals),
+                             arrays, cfg, num_rounds=24,
+                             rounds_per_visit=3)
+    np.testing.assert_array_equal(np.asarray(a.flow), np.asarray(b.flow))
+
+
+def test_chunked_validation(topo, arrays, vals):
+    cfg = RoundConfig.fast(variant="collectall", kernel="edge")
+    with pytest.raises(ValueError, match="divisor"):
+        R.init_chunked_state(topo, cfg, 3, vals)
+    with pytest.raises(ValueError, match="kernel='edge'"):
+        R.init_chunked_state(topo, dataclasses.replace(cfg, kernel="node"),
+                             2, vals)
+    cs = R.init_chunked_state(topo, cfg, 2, vals)
+    with pytest.raises(ValueError, match="multiple of the pass"):
+        R.run_rounds_chunked(cs, arrays, cfg, num_rounds=7)
+    with pytest.raises(ValueError, match="vector payload"):
+        R.init_chunked_state(topo, cfg, 2, vals[:, 0])
+
+
+def test_chunked_mass_conserved_under_drop_and_churn(topo, arrays, vals):
+    """Per-feature mass under drop>0 + mid-run churn, judged with the
+    doctor's in-flight allowance (factor x worst error x active)."""
+    from flow_updating_tpu.service import membership
+
+    cfg = CFGS[1]
+    cs = R.init_chunked_state(topo, cfg, 2, vals, seed=3)
+    cs = R.run_rounds_chunked(cs, arrays, cfg, num_rounds=32)
+    # kill 4 nodes for a while, then revive (the shared churn masks)
+    cs = cs.replace(state=membership.set_alive(cs.state, [1, 5, 9, 13],
+                                               False))
+    cs = R.run_rounds_chunked(cs, arrays, cfg, num_rounds=32)
+    cs = cs.replace(state=membership.set_alive(cs.state, [1, 5, 9, 13],
+                                               True))
+    heal = dataclasses.replace(cfg, drop_rate=0.0)
+    cs = R.run_rounds_chunked(cs, arrays, heal, num_rounds=160)
+    est = np.asarray(R.chunked_node_estimates(cs, arrays))
+    mean = vals.mean(axis=0)
+    residual = np.abs(est.sum(axis=0) - vals.sum(axis=0))
+    allowance = 2.0 * np.abs(est - mean).max() * topo.num_nodes + 1e-9
+    assert residual.max() <= allowance
+
+
+# ---- feature sharding ----------------------------------------------------
+
+
+def test_feature_sharded_bit_exact_with_drop_and_churn(topo, arrays, vals):
+    """Monolithic feature-sharded run == single device, bit-for-bit:
+    the drop draws are replicated control state and churn masks are
+    shared, so even lossy churning runs agree positionally."""
+    from flow_updating_tpu.service import membership
+
+    cfg = CFGS[1]
+    mesh = F.feature_mesh(4)
+
+    ref = init_state(topo, cfg, values=vals)
+    st = F.place_feature_state(init_state(topo, cfg, values=vals), mesh)
+    ref = R.run_rounds(ref, arrays, cfg, num_rounds=10)
+    st = F.run_rounds_feature(st, arrays, cfg, 10, mesh)
+    ref = membership.set_alive(ref, [2, 7], False)
+    st = membership.set_alive(st, [2, 7], False)
+    ref = R.run_rounds(ref, arrays, cfg, num_rounds=10)
+    st = F.run_rounds_feature(st, arrays, cfg, 10, mesh)
+    np.testing.assert_array_equal(np.asarray(st.flow),
+                                  np.asarray(ref.flow))
+    np.testing.assert_array_equal(np.asarray(st.est), np.asarray(ref.est))
+    np.testing.assert_array_equal(np.asarray(st.key), np.asarray(ref.key))
+
+
+def test_chunked_feature_sharded_bit_exact(topo, arrays, vals):
+    """Chunked x feature-sharded == chunked single-device, drop
+    included (per-instance keys travel with their chunks)."""
+    cfg = CFGS[1]
+    mesh = F.feature_mesh(2)
+    cs0 = R.init_chunked_state(topo, cfg, 2, vals)   # 4 chunks
+    ref = R.run_rounds_chunked(cs0, arrays, cfg, num_rounds=24)
+    out = F.run_chunked_feature(cs0, arrays, cfg, 12, mesh)
+    np.testing.assert_array_equal(np.asarray(out.flow),
+                                  np.asarray(ref.flow))
+    np.testing.assert_array_equal(np.asarray(out.key),
+                                  np.asarray(ref.key))
+
+
+def test_feature_shard_validation(topo, arrays, vals):
+    cfg = RoundConfig.fast(variant="collectall", kernel="edge")
+    mesh = F.feature_mesh(4)
+    st = init_state(topo, cfg, values=vals[:, :6])   # 6 % 4 != 0
+    with pytest.raises(ValueError, match="divide evenly"):
+        F.run_rounds_feature(st, arrays, cfg, 4, mesh)
+    with pytest.raises(ValueError, match="vector payload"):
+        F.state_feature_specs(init_state(topo, cfg))
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="feature"):
+        F.check_feature_mesh(make_mesh(2))
+
+
+def test_pga_psum_native_matches_host_rebase(topo, arrays, vals):
+    """global_average_feature (the psum-native Gossip-PGA sync) ==
+    the trainer's host-side rebase, bit-for-bit (same op order on each
+    feature shard)."""
+    from flow_updating_tpu.workloads.gossip_sgd import _global_average
+
+    cfg = RoundConfig.fast(variant="collectall", kernel="edge")
+    mesh = F.feature_mesh(4)
+    st = F.place_feature_state(init_state(topo, cfg, values=vals), mesh)
+    st = F.run_rounds_feature(st, arrays, cfg, 6, mesh)
+    ga = F.global_average_feature(st, arrays, mesh)
+    ga_ref = _global_average(jax.device_get(st), arrays)
+    np.testing.assert_array_equal(np.asarray(ga.value),
+                                  np.asarray(ga_ref.value))
+
+
+def test_halo_2d_mesh_matches_1d(topo, vals):
+    """The 2-D (nodes, feature) halo mesh == the 1-D halo run: payload
+    leaves shard their feature axis orthogonally to the node blocks."""
+    from flow_updating_tpu.parallel import sharded as SH
+    from flow_updating_tpu.parallel.mesh import make_mesh, make_mesh2d
+
+    cfg = RoundConfig.reference(variant="collectall", kernel="edge",
+                                drop_rate=0.2)
+    plan = SH.plan_sharding(topo, 2)
+    m1, m2 = make_mesh(2), make_mesh2d(2, 2)
+    s1 = SH.init_plan_state(plan, cfg, m1, values=vals)
+    s2 = SH.init_plan_state(plan, cfg, m2, values=vals)
+    o1 = SH.run_rounds_sharded(s1, plan, cfg, m1, 10)
+    o2 = SH.run_rounds_sharded(s2, plan, cfg, m2, 10)
+    np.testing.assert_array_equal(np.asarray(o2.flow), np.asarray(o1.flow))
+    np.testing.assert_array_equal(np.asarray(o2.est), np.asarray(o1.est))
+
+
+# ---- trainer knobs -------------------------------------------------------
+
+
+def test_trainer_chunk_eq_D_matches_plain():
+    topo = erdos_renyi(16, avg_degree=4.0, seed=1)
+    ds = make_dataset(16, 4, task="linear", seed=0)
+    gc = GossipSGDConfig(outer_steps=8, comm_rounds=2, global_avg_every=4)
+    t0 = GossipSGDTrainer(topo, ds, gc)
+    t0.train()
+    t1 = GossipSGDTrainer(topo, ds, gc, chunk=4)
+    t1.train()
+    np.testing.assert_array_equal(t1.params(), t0.params())
+
+
+def test_trainer_chunked_and_sharded_converge():
+    topo = erdos_renyi(16, avg_degree=4.0, seed=1)
+    ds = make_dataset(16, 4, task="linear", seed=0)
+    gc = GossipSGDConfig(outer_steps=20, comm_rounds=2,
+                         global_avg_every=5)
+    base = GossipSGDTrainer(topo, ds, gc).train()
+    for kw in ({"chunk": 2}, {"feature_shards": 2},
+               {"chunk": 2, "feature_shards": 2}):
+        rep = GossipSGDTrainer(topo, ds, gc, **kw).train()
+        assert rep["pooled_loss"] == pytest.approx(base["pooled_loss"],
+                                                   rel=1e-3), kw
+        # the residual is in-flight mass (comm messages pending at the
+        # sample point) — the schedule must carry the SAME in-flight
+        # mass as the plain trainer, not magically less
+        assert rep["max_mass_residual"] == pytest.approx(
+            base["max_mass_residual"], rel=1e-6, abs=1e-9), kw
+        assert rep["comm_bytes_total"] > 0, kw
+
+
+def test_trainer_knob_validation():
+    topo = erdos_renyi(16, avg_degree=4.0, seed=1)
+    ds = make_dataset(16, 6, task="linear", seed=0)
+    gc = GossipSGDConfig(outer_steps=2)
+    with pytest.raises(ValueError, match="divisor"):
+        GossipSGDTrainer(topo, ds, gc, chunk=4)
+    with pytest.raises(ValueError, match="divide evenly"):
+        GossipSGDTrainer(topo, ds, gc, feature_shards=4)
+    with pytest.raises(ValueError, match="chunked-schedule knob"):
+        GossipSGDTrainer(topo, ds, gc, rounds_per_visit=4)
+    with pytest.raises(ValueError, match="multiple"):
+        GossipSGDTrainer(topo, ds,
+                         GossipSGDConfig(outer_steps=2, comm_rounds=3),
+                         chunk=2, rounds_per_visit=2)
+
+
+def test_train_grid_one_compile_per_shape():
+    """The period x non-IID grid rides ONE vmapped program: a second
+    grid with different lane VALUES (periods, datasets) must hit the
+    same jit cache entry."""
+    topo = erdos_renyi(16, avg_degree=4.0, seed=1)
+    gc = GossipSGDConfig(outer_steps=3, comm_rounds=1)
+    from flow_updating_tpu.workloads.gossip_sgd import _grid_step
+
+    before = _grid_step._cache_size()
+    ds_a = [make_dataset(16, 4, dirichlet_alpha=a, seed=3)
+            for a in (0.1, 10.0)]
+    reps = train_grid(topo, ds_a, [0, 2], gc)
+    assert len(reps) == 4
+    assert {r["global_avg_every"] for r in reps} == {0, 2}
+    mid = _grid_step._cache_size()
+    # a second grid with DIFFERENT lane values but the same lane count
+    # must hit the compiled program (shapes are the jit key; periods
+    # are traced)
+    ds_b = [make_dataset(16, 4, dirichlet_alpha=0.5, seed=9),
+            make_dataset(16, 4, dirichlet_alpha=2.0, seed=11)]
+    train_grid(topo, ds_b, [3, 7], gc)
+    assert _grid_step._cache_size() == mid  # same shapes -> same program
+    assert mid == before + 1
+    for r in reps:
+        # 3 outer steps x 1 comm round leave substantial in-flight mass
+        # at the sample point — finite and bounded is the meaningful
+        # assert here (quiescent residuals are pinned by
+        # test_trainer_chunked_and_sharded_converge)
+        assert np.isfinite(r["max_mass_residual"])
+        assert np.isfinite(r["pooled_loss"])
+
+
+# ---- Dirichlet non-IID shards -------------------------------------------
+
+
+def test_dirichlet_deterministic_and_seed_sensitive():
+    a = make_dataset(24, 6, dirichlet_alpha=0.3, seed=5)
+    b = make_dataset(24, 6, dirichlet_alpha=0.3, seed=5)
+    c = make_dataset(24, 6, dirichlet_alpha=0.3, seed=6)
+    np.testing.assert_array_equal(a.X, b.X)
+    np.testing.assert_array_equal(a.y, b.y)
+    assert not np.array_equal(a.X, c.X)
+
+
+def test_dirichlet_alpha_controls_heterogeneity():
+    """Small alpha concentrates each node on few clusters -> per-node
+    feature means spread far more than near-IID large alpha."""
+    spread = {}
+    for a in (0.05, 100.0):
+        # enough samples that per-node sampling noise doesn't mask the
+        # mixture concentration (the large-alpha baseline shrinks as
+        # 1/sqrt(m), the small-alpha cluster shift doesn't)
+        ds = make_dataset(64, 8, samples_per_node=256, dirichlet_alpha=a,
+                          seed=2)
+        node_means = ds.X.mean(axis=1)           # (N, D)
+        spread[a] = float(np.linalg.norm(node_means - node_means.mean(0),
+                                         axis=1).mean())
+    assert spread[0.05] > 2.0 * spread[100.0]
+
+
+def test_dirichlet_validation():
+    with pytest.raises(ValueError, match="dirichlet_alpha"):
+        make_dataset(8, 4, dirichlet_alpha=0.0)
+    with pytest.raises(ValueError, match="dirichlet_components"):
+        make_dataset(8, 4, dirichlet_alpha=1.0, dirichlet_components=1)
+
+
+# ---- planner term + bytes accounting ------------------------------------
+
+
+def test_payload_bytes_accounting():
+    from flow_updating_tpu.obs.profile import (
+        dfl_efficiency,
+        payload_bytes_per_round,
+    )
+
+    rep = payload_bytes_per_round(100, 256, chunk=64, feature_shards=2)
+    assert rep["bytes_per_round"] == 100 * 64 * 4
+    assert rep["bytes_per_round_per_device"] == 100 * 64 * 4 // 2
+    assert rep["rounds_per_model_stream"] == 4
+    assert rep["bytes_per_model_stream"] == 100 * 256 * 4
+    mono = payload_bytes_per_round(100, 256)
+    assert mono["width"] == 256 and mono["rounds_per_model_stream"] == 1
+    with pytest.raises(ValueError, match="divisor"):
+        payload_bytes_per_round(100, 256, chunk=100)
+    # matched-width chunking: efficiency is a pure rate ratio
+    assert dfl_efficiency(50.0, 1000.0, 100.0, 1000.0) == \
+        pytest.approx(0.5)
+    assert dfl_efficiency(0.0, 1.0, 1.0, 1.0) is None
+
+
+def test_select_payload_schedule(topo):
+    from flow_updating_tpu.plan.select import select_payload_schedule
+
+    # absent a wire window the monolithic schedule's fully-amortized
+    # control plane wins the wall-clock ranking
+    d = select_payload_schedule(topo, features=4096, backend="cpu")
+    assert d["schedule"] == "monolithic"
+    assert "monolithic" in d["predicted_lane_throughput"]
+    # a per-round wire window is WHY chunking exists: monolithic is
+    # excluded and a fitting chunk width wins
+    w = select_payload_schedule(
+        topo, features=4096, backend="cpu",
+        max_round_bytes=topo.num_edges * 256 * 4)
+    assert w["schedule"] == "chunked"
+    assert w["chunk"] is not None and w["chunk"] <= 256
+    assert "monolithic#excluded" in w["predicted_lane_throughput"]
+    # pinning a chunk forces the chunked schedule
+    p = select_payload_schedule(topo, features=4096, backend="cpu",
+                                chunk=64, rounds_per_visit=16)
+    assert p["schedule"] == "chunked" and p["chunk"] == 64
+    # nothing to pipeline at/below the anchor width
+    s = select_payload_schedule(topo, features=64, backend="cpu")
+    assert s["schedule"] == "monolithic"
+    with pytest.raises(ValueError, match="fits"):
+        select_payload_schedule(topo, features=4096, backend="cpu",
+                                max_round_bytes=16.0)
+
+
+def test_engine_plan_report_carries_payload_schedule():
+    from flow_updating_tpu.engine import Engine
+
+    topo = erdos_renyi(32, avg_degree=4.0, seed=0)
+    vals = np.random.default_rng(0).normal(size=(32, 8))
+    eng = Engine(plan="auto").set_topology(topo.with_values(vals)).build()
+    rep = eng.plan_report()
+    assert rep is not None and "payload_schedule" in rep
+    assert rep["payload_schedule"]["schedule"] in ("monolithic", "chunked")
+
+
+# ---- baseline-key isolation ---------------------------------------------
+
+
+def test_dfl_baseline_keys_disjoint_from_every_family():
+    """dfl_d{D}[_c{c}][_fs{S}] keys can never shadow (or be shadowed
+    by) the fat-tree k-keys, vector suffixes, sweep/service/scenario/
+    planned/scaling records."""
+    import bench
+
+    keys = ["dfl_d64", "dfl_d4096", "dfl_d4096_c64",
+            "dfl_d4096_c64_fs2", "dfl_d256_c64_n256"]
+    others = ["160", "96_faithful", "96_vector_d64", "16_sweep_b32",
+              "16_service", "scn_byzantine_lie", "ba100k_planned",
+              "er_weak8192_scale_s2"]
+    seen = {bench._baseline_key(k) for k in others}
+    for k in keys:
+        bk = bench._baseline_key(k)
+        assert bk == k                      # alpha-leading: kept as-is
+        assert bk not in seen
+        assert not bk.startswith("k")       # never a fat-tree key
+        assert not bk.startswith("scn_")
+
+
+def test_dfl_efficiency_definition_matches_anchor_width():
+    """At chunk == anchor width the rounds/s-per-byte ratio IS the rate
+    ratio — the acceptance metric's definition, pinned."""
+    from flow_updating_tpu.obs.profile import (
+        dfl_efficiency,
+        payload_bytes_per_round,
+    )
+
+    E = 5058
+    anchor = payload_bytes_per_round(E, 64)
+    row = payload_bytes_per_round(E, 4096, chunk=64)
+    assert row["bytes_per_round"] == anchor["bytes_per_round"]
+    assert dfl_efficiency(380.0, row["bytes_per_round"],
+                          420.0, anchor["bytes_per_round"]) == \
+        pytest.approx(380.0 / 420.0)

@@ -71,9 +71,13 @@ def test_adversary_structure_key_families():
 def test_robust_mode_validation():
     with pytest.raises(ValueError, match="unknown robust"):
         RoundConfig.fast(robust="median")
-    with pytest.raises(ValueError, match="collectall"):
-        RoundConfig.fast(variant="pairwise", robust="clip",
-                         robust_clip=1.0)
+    # robust modes cover BOTH protocol families (the pairwise extension
+    # of the scenario suite): constructing pairwise robust configs is
+    # legal for every mode
+    for robust, kw in (("clip", {"robust_clip": 1.0}),
+                       ("trim", {"robust_tol": 1.0})):
+        RoundConfig.fast(variant="pairwise", robust=robust, **kw)
+        RoundConfig.reference(variant="pairwise", robust=robust, **kw)
     with pytest.raises(ValueError, match="robust_clip > 0"):
         RoundConfig.fast(robust="clip")
     with pytest.raises(ValueError, match="set robust='clip'"):
@@ -85,7 +89,7 @@ def test_robust_mode_validation():
 
 
 def _lowered_text(topo, cfg, adversary=None, rounds=4):
-    arrays = topo.device_arrays()
+    arrays = topo.device_arrays(coloring=cfg.needs_coloring)
     if adversary is not None:
         arrays = arrays.replace(**adversary.device_leaves(
             topo.num_nodes, topo.num_edges, cfg.jnp_dtype))
@@ -148,6 +152,122 @@ def test_engine_adversary_validation():
     eng.set_topology(topo)
     with pytest.raises(ValueError, match="no wire to attack"):
         eng.build()
+
+
+def test_pairwise_robust_off_is_statically_off():
+    """The pairwise extension keeps the static-off guarantee: for BOTH
+    pairwise families, robust='off' lowers the identical program
+    whatever the robust knob values would have been, and each robust
+    mode really changes the program (the knobs are lowered, not
+    decorative)."""
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+    for cfg in (RoundConfig.fast(variant="pairwise"),
+                RoundConfig.reference(variant="pairwise")):
+        plain = _lowered_text(topo, cfg)
+        assert _lowered_text(topo, cfg) == plain   # deterministic lower
+        clip = dataclasses.replace(cfg, robust="clip", robust_clip=1.0)
+        trim = dataclasses.replace(cfg, robust="trim", robust_tol=0.5)
+        assert _lowered_text(topo, clip) != plain, cfg.fire_policy
+        assert _lowered_text(topo, trim) != plain, cfg.fire_policy
+
+
+def test_pairwise_clip_conserves_mass_and_converges_honest():
+    """The 2-party clip clamp is odd over an antisymmetric ledger —
+    mass is conserved EXACTLY (fast pairwise) / within the in-flight
+    allowance (faithful), and an honest run whose equilibrium flows sit
+    inside the clamp converges as if unclipped."""
+    topo = community(48, c=2, k_in=6.0, k_out=0.0, seed=0)
+    rng = np.random.default_rng(5)
+    topo = topo.with_values(rng.uniform(0.0, 1.0, 48))
+    arrays = topo.device_arrays(coloring=True)
+    for cfg in (RoundConfig.fast(variant="pairwise", robust="clip",
+                                 robust_clip=8.0),
+                RoundConfig.reference(variant="pairwise", robust="clip",
+                                      robust_clip=8.0)):
+        state = init_state(topo, cfg, seed=0)
+        state = run_rounds(state, arrays, cfg, 600)
+        flow = np.asarray(state.flow)
+        assert np.abs(flow).max() <= 8.0 + 1e-12
+        est = np.asarray(node_estimates(state, arrays))
+        if cfg.fire_policy != "reference":
+            # direct exchange: antisymmetry is exact every round
+            np.testing.assert_allclose(flow, -flow[np.asarray(arrays.rev)],
+                                       atol=1e-12)
+        # the community bridge bottleneck caps the mixing rate; 1e-2
+        # after 600 pairwise rounds == the unclipped rate there
+        assert np.max(np.abs(est - topo.true_mean)) < 1e-2, \
+            cfg.fire_policy
+
+
+def test_pairwise_clip_tight_clamp_still_conserves():
+    """A clamp BELOW the equilibrium flow magnitudes slows mixing but
+    can never leak mass: the admitted delta is identical (negated) on
+    both ends of every exchange."""
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+    vals = np.zeros(32)
+    vals[0] = 32.0                  # needs |flow| ~ 31/32... per edge
+    topo = topo.with_values(vals)
+    arrays = topo.device_arrays(coloring=True)
+    cfg = RoundConfig.fast(variant="pairwise", robust="clip",
+                           robust_clip=0.05, dtype="float64")
+    state = init_state(topo, cfg, seed=0)
+    state = run_rounds(state, arrays, cfg, 64)
+    est = np.asarray(node_estimates(state, arrays))
+    assert abs(est.sum() - vals.sum()) < 1e-9
+    assert np.abs(np.asarray(state.flow)).max() <= 0.05 + 1e-12
+
+
+def test_pairwise_trim_contains_value_outlier():
+    """Pairwise trim stands down extreme-estimate edges while the
+    neighborhood spread exceeds robust_tol: an extreme value's mass
+    stops mixing once estimates reveal it (the first exchanges DO mix —
+    trim arms on observed estimates, not values), so the outlier's own
+    estimate stays far above the global mean it would fully average to
+    under robust='off'.  Mass is conserved either way (refusing to
+    match is symmetric)."""
+    topo = community(48, c=2, k_in=8.0, k_out=0.0, seed=3)
+    rng = np.random.default_rng(7)
+    vals = rng.uniform(0.0, 1.0, 48)
+    vals[0] = 500.0
+    topo = topo.with_values(vals)
+    arrays = topo.device_arrays(coloring=True)
+
+    def run(robust, **kw):
+        cfg = RoundConfig.fast(variant="pairwise", robust=robust,
+                               dtype="float64", **kw)
+        st = run_rounds(init_state(topo, cfg, seed=0), arrays, cfg, 300)
+        est = np.asarray(node_estimates(st, arrays))
+        assert abs(est.sum() - vals.sum()) < 1e-6, robust  # mass
+        return est
+
+    est_off = run("off")
+    est_trim = run("trim", robust_tol=2.0)
+    gmean = vals.mean()
+    # off: the outlier averages toward the global mean (within the
+    # bridge bottleneck's remaining transient); trim: its estimate
+    # freezes several times above it
+    assert abs(est_off[0] - gmean) < 0.5 * gmean
+    assert est_trim[0] > 2.5 * gmean
+
+
+def test_pairwise_trim_disarmed_matches_off_trajectory():
+    """With robust_tol above every neighborhood spread the trim masks
+    never arm: the trajectory is BIT-identical to robust='off' (the
+    mode only ever acts through the masks)."""
+    topo = community(32, c=2, k_in=6.0, k_out=0.0, seed=0)
+    rng = np.random.default_rng(5)
+    topo = topo.with_values(rng.uniform(0.0, 1.0, 32))
+    arrays = topo.device_arrays(coloring=True)
+    for maker in (RoundConfig.fast, RoundConfig.reference):
+        off = maker(variant="pairwise", dtype="float64")
+        trim = maker(variant="pairwise", robust="trim", robust_tol=1e6,
+                     dtype="float64")
+        a = run_rounds(init_state(topo, off, seed=0), arrays, off, 50)
+        b = run_rounds(init_state(topo, trim, seed=0), arrays, trim, 50)
+        np.testing.assert_array_equal(np.asarray(a.flow),
+                                      np.asarray(b.flow))
+        np.testing.assert_array_equal(np.asarray(a.est),
+                                      np.asarray(b.est))
 
 
 def test_trim_and_clip_do_not_break_honest_convergence():
