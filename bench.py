@@ -507,9 +507,9 @@ def measure_sweep(topo, batch: int, rounds: int,
     s_outs = run_seq(pr)
     parity = True
     for lane in range(batch):
-        lane_state = jax.tree.map(lambda x: x[lane], b_out)
+        lane_state = jax.tree.map(lambda x, lane=lane: x[lane], b_out)
         be = np.asarray(node_estimates(lane_state, jax.tree.map(
-            lambda x: x[lane], bucket.arrays)))[: topo.num_nodes]
+            lambda x, lane=lane: x[lane], bucket.arrays)))[: topo.num_nodes]
         se = np.asarray(node_estimates(s_outs[lane], arrays))
         if not np.array_equal(be, se):
             parity = False
@@ -2085,7 +2085,7 @@ def main():
         try:
             result = run_bench(args)
         except ValueError as err:
-            raise SystemExit(f"invalid flag combination: {err}")
+            raise SystemExit(f"invalid flag combination: {err}") from err
         if args.report:
             from flow_updating_tpu.obs.report import (
                 build_manifest,

@@ -1,0 +1,36 @@
+"""Static analysis: jaxpr/HLO rule engine, repo-specific lint, and the
+golden-program ledger (docs/ANALYSIS.md).
+
+Three layers, one goal — catch program-level hazards and identity drift
+at lint time instead of at benchmark-archaeology time:
+
+- :mod:`~flow_updating_tpu.analysis.rules` — structural checks over
+  round-program jaxprs (serializing scatters, fast-path gathers,
+  callbacks/collectives inside the round scan, dtype drift, PRNG key
+  reuse), run over every kernel's ``round_program`` lowering.
+- :mod:`~flow_updating_tpu.analysis.flowlint` — AST rules ruff cannot
+  express (numpy in kernels, Python ``if`` on traced values, kernel
+  ``round_program`` coverage, bare PRNGKey, bench baseline key
+  families).
+- :mod:`~flow_updating_tpu.analysis.golden` — the canonical-hashed
+  StableHLO ledger of the mode x twin matrix (``GOLDEN_PROGRAMS.json``)
+  with drift-naming audit; the safety net ROADMAP item 5's IR refactor
+  lowers against.
+
+CLI: ``python -m flow_updating_tpu lint`` and ``... audit``.
+"""
+
+from flow_updating_tpu.analysis.flowlint import lint_paths  # noqa: F401
+from flow_updating_tpu.analysis.golden import (  # noqa: F401
+    assert_same_program,
+    audit,
+    build_ledger,
+    canonical_program,
+    load_ledger,
+)
+from flow_updating_tpu.analysis.rules import (  # noqa: F401
+    Finding,
+    ProgramContext,
+    analyze_program,
+    audit_kernels,
+)

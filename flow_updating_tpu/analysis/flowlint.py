@@ -1,0 +1,447 @@
+"""Repo-specific AST lint — the rules ruff cannot express.
+
+Five rules, each encoding a contract this codebase depends on but no
+generic linter knows about:
+
+``numpy-in-kernel`` (FL001)
+    No ``np.*`` / ``numpy.*`` *calls* inside traced functions (functions
+    that are jit-decorated, passed to ``lax.scan``/``while_loop``/
+    ``cond``/``shard_map``, or nested inside one).  A numpy call on a
+    traced value either crashes at trace time or — worse — silently
+    constant-folds a value that should be data.  Attribute reads
+    (``np.float32`` dtypes) stay legal.
+
+``traced-if`` (FL002)
+    No Python ``if`` on a scan/while/cond body function's parameters:
+    those are traced values; branching on them is a
+    ``TracerBoolConversionError`` at best and a silently specialized
+    program at worst.  Use ``jnp.where`` / ``lax.cond``.
+
+``kernel-round-program`` (FL003)
+    Every ``*Kernel`` class must expose ``round_program`` — the AOT
+    cost-attribution + golden-ledger hook (obs/profile.py,
+    analysis/golden.py).  A kernel without it is invisible to the
+    profiler and the conformance ledger.
+
+``bare-prngkey`` (FL004)
+    ``jax.random.PRNGKey`` only inside the documented seeding entry
+    points (``init_state`` / ``init_plan_state``).  Anywhere else it
+    manufactures a fresh root key mid-protocol — the classic correlated
+    -randomness bug (two "independent" streams from seed 0).
+
+``baseline-key-family`` (FL005)
+    Keys handed to ``record_baseline``/``recorded_baseline`` in bench.py
+    must come from the documented key families (k-configs, ``dfl_d*``,
+    ``scn_*``, ``*_planned``, ``*_scale_s*``, ``*_sweep_b*``,
+    ``*_service``).  An undocumented ad-hoc key silently shadows or
+    forks the measurement history the regress gate judges against.
+
+Suppression: append ``# flowlint: ok(<rule>) <reason>`` to the flagged
+line (or the line above).  The reason is mandatory — a bare suppression
+is itself an error.
+
+Run via ``python -m flow_updating_tpu lint`` (which also runs the jaxpr
+rule engine, :mod:`flow_updating_tpu.analysis.rules`) or call
+:func:`lint_paths` directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+# jit / scan markers: attribute or name heads that make a callee traced
+_TRACE_CALLS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                "shard_map", "vmap", "pmap", "checkpoint", "remat",
+                "custom_vmap"}
+_JIT_NAMES = {"jit"}
+_SEEDING_FUNCS = {"init_state", "init_plan_state"}
+
+# documented baseline key families (bench.py `_baseline_key` prepends
+# "k" to bare numerics, so the numeric family is a plain integer probe).
+# Probes substitute "0" for every dynamic fragment of an f-string.
+_KEY_FAMILIES = (
+    r"\d+(_[a-z0-9]+)*",            # str(k) numeric configs + suffixes
+    r"k\d+(_[a-z0-9]+)*",           # explicit k-configs
+    r".+_planned",                  # topology-compiler rows
+    r".+_scale_s.+",                # weak-scaling ladder rows
+    r".+_sweep_b.+",                # sweep-engine rows
+    r".+_service",                  # streaming-service rows
+    r"dfl_d.+",                     # model-scale DFL rows
+    r"scn_.+",                      # scenario rows
+    r"(er|ba)\d+k?_[a-z_0-9]+",     # named generator configs
+)
+_KEY_FAMILY_RES = tuple(re.compile(p) for p in _KEY_FAMILIES)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*flowlint:\s*ok\((?P<rule>[\w-]+)\)\s*(?P<reason>\S.*)?")
+
+RULE_DOCS = {
+    "numpy-in-kernel": "no numpy calls inside traced (jit/scan) functions",
+    "traced-if": "no Python `if` on scan/cond body parameters (traced)",
+    "kernel-round-program": "every *Kernel class exposes round_program",
+    "bare-prngkey": "jax.random.PRNGKey only in seeding entry points",
+    "baseline-key-family": "bench baseline keys from documented families",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    rule: str
+    file: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+def _attr_tail(node) -> str:
+    """Last attribute/name segment of a callee expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_jit_decorator(dec) -> bool:
+    """``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit, ...)`` and
+    the ``@partial(jax.jit, static_argnames=...)`` spelling."""
+    if _attr_tail(dec) in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        if _attr_tail(dec.func) in _JIT_NAMES:
+            return True
+        if _attr_tail(dec.func) == "partial" and dec.args \
+                and _attr_tail(dec.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+class _Module:
+    """One parsed file plus the traced-function analysis shared by the
+    per-rule passes."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        # parent links (ast has none)
+        self.parent: dict = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.jit_fns: set = set()      # FunctionDef/Lambda, jit-decorated
+        self.scan_body_fns: set = set()  # passed to scan/cond/... by name
+        self._classify()
+
+    def _classify(self) -> None:
+        # name -> [FunctionDef] per enclosing scope, for resolving
+        # `lax.scan(step, ...)` references
+        defs_by_name: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                if any(_is_jit_decorator(d) for d in node.decorator_list):
+                    self.jit_fns.add(node)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _attr_tail(node.func)
+            is_trace = callee in _TRACE_CALLS
+            is_jit_call = callee in _JIT_NAMES  # jax.jit(fn, ...)
+            if not (is_trace or is_jit_call):
+                continue
+            # the body-function positions of the control-flow callees
+            if callee == "scan":
+                cands = node.args[:1]
+            elif callee == "while_loop":
+                cands = node.args[:2]          # (cond_fun, body_fun, init)
+            elif callee == "fori_loop":
+                cands = node.args[2:3]         # (lo, hi, body_fun, init)
+            else:
+                cands = node.args
+            for arg in cands:
+                target = self.jit_fns if is_jit_call else self.scan_body_fns
+                if isinstance(arg, ast.Lambda):
+                    target.add(arg)
+                elif isinstance(arg, ast.Name):
+                    for fn in defs_by_name.get(arg.id, ()):
+                        target.add(fn)
+                elif isinstance(arg, ast.Call) and \
+                        _attr_tail(arg.func) == "partial":
+                    for sub in arg.args[:1]:
+                        if isinstance(sub, ast.Name):
+                            for fn in defs_by_name.get(sub.id, ()):
+                                target.add(fn)
+
+    def traced_functions(self) -> set:
+        """Traced = jit-decorated, scan-body, or nested inside one."""
+        roots = self.jit_fns | self.scan_body_fns
+        out = set()
+        for root in roots:
+            for node in ast.walk(root):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    out.add(node)
+        return out
+
+    def suppressed(self, line: int, rule: str) -> bool | str:
+        """Suppression state for a finding at ``line``: True (valid
+        suppression), False (none), or "bare" (suppression without a
+        reason — itself a violation)."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[ln - 1])
+                if m and m.group("rule") == rule:
+                    return True if m.group("reason") else "bare"
+        return False
+
+
+def _params_of(fn) -> set:
+    args = fn.args
+    names = [a.arg for a in args.args + args.posonlyargs + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _enclosing_function(mod: _Module, node):
+    """Nearest enclosing NAMED function (lambdas are skipped: a seeding
+    entry point's helper lambda still seeds on its behalf)."""
+    cur = mod.parent.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        cur = mod.parent.get(cur)
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# the rule passes
+
+def _attr_root(node):
+    """Root Name of a dotted attribute chain (``np.linalg.norm`` ->
+    the ``np`` Name node), or None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None
+
+
+def _r_numpy_in_kernel(mod: _Module):
+    traced = mod.traced_functions()
+    for fn in traced:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                # the root of the dotted chain: catches np.asarray AND
+                # submodule calls (np.random.rand, np.linalg.norm)
+                root = _attr_root(node.func.value)
+                if root is not None and root.id in ("np", "numpy",
+                                                    "onp"):
+                    dotted = ast.unparse(node.func)
+                    yield LintFinding(
+                        "numpy-in-kernel", mod.path, node.lineno,
+                        node.col_offset,
+                        f"numpy call `{dotted}(...)` inside a traced "
+                        "function — use jnp, or hoist to trace-time "
+                        "setup")
+
+
+def _r_traced_if(mod: _Module):
+    for fn in mod.scan_body_fns:
+        if isinstance(fn, ast.Lambda):
+            continue                      # a lambda has no If statements
+        params = _params_of(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            hit = sorted({n.id for n in ast.walk(node.test)
+                          if isinstance(n, ast.Name) and n.id in params})
+            if hit:
+                yield LintFinding(
+                    "traced-if", mod.path, node.lineno, node.col_offset,
+                    f"Python `if` on traced parameter(s) {hit} of scan/"
+                    f"cond body `{fn.name}` — use jnp.where or lax.cond")
+
+
+def _r_kernel_round_program(mod: _Module):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef) or \
+                not node.name.endswith("Kernel"):
+            continue
+        if node.bases:
+            continue          # inherited hooks resolve dynamically
+        methods = {n.name for n in node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if "round_program" not in methods:
+            yield LintFinding(
+                "kernel-round-program", mod.path, node.lineno,
+                node.col_offset,
+                f"kernel class `{node.name}` does not expose "
+                "round_program — the profiler and the golden-program "
+                "ledger cannot see it")
+
+
+def _r_bare_prngkey(mod: _Module):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                _attr_tail(node.func) == "PRNGKey":
+            fn = _enclosing_function(mod, node)
+            name = getattr(fn, "name", "")
+            if name in _SEEDING_FUNCS:
+                continue
+            yield LintFinding(
+                "bare-prngkey", mod.path, node.lineno, node.col_offset,
+                f"bare jax.random.PRNGKey outside the seeding entry "
+                f"points {sorted(_SEEDING_FUNCS)} (enclosing: "
+                f"`{name or '<module>'}`) — derive keys by split/"
+                "fold_in from the run seed")
+
+
+def _probe_strings(node, assigns: dict) -> list:
+    """Render a key expression to probe strings: literal text kept,
+    every dynamic fragment replaced by ``\"0\"``.  Names resolve through
+    simple/augmented assignments; unresolvable expressions probe as
+    bare ``\"0\"`` (dynamic keys pass — the rule judges literals)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("0")
+        return ["".join(parts)]
+    if isinstance(node, ast.Name) and node.id in assigns:
+        base, suffixes = assigns[node.id]
+        out = []
+        for b in base:
+            probe = b
+            for s in suffixes:
+                probe += s
+            out.append(probe)
+        return out
+    return ["0"]
+
+
+def _r_baseline_key_family(mod: _Module):
+    if os.path.basename(mod.path) != "bench.py":
+        return
+    # name -> ([base probes], [suffix probes]) from `k = <expr>` and
+    # `k += <expr>` at any nesting depth
+    assigns: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            probes = _probe_strings(node.value, {})
+            assigns.setdefault(name, ([], []))[0].extend(probes)
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.op, ast.Add):
+            name = node.target.id
+            for p in _probe_strings(node.value, {}):
+                assigns.setdefault(name, ([], []))[1].append(p)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or _attr_tail(node.func) not in (
+                "record_baseline", "recorded_baseline"):
+            continue
+        if not node.args:
+            continue
+        for probe in _probe_strings(node.args[0], assigns):
+            if not any(r.fullmatch(probe) for r in _KEY_FAMILY_RES):
+                yield LintFinding(
+                    "baseline-key-family", mod.path, node.lineno,
+                    node.col_offset,
+                    f"baseline key shaped like {probe!r} matches no "
+                    "documented key family (docs/ANALYSIS.md) — new "
+                    "families need a doc row + a family regex here")
+
+
+_RULE_PASSES = {
+    "numpy-in-kernel": _r_numpy_in_kernel,
+    "traced-if": _r_traced_if,
+    "kernel-round-program": _r_kernel_round_program,
+    "bare-prngkey": _r_bare_prngkey,
+    "baseline-key-family": _r_baseline_key_family,
+}
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+def lint_source(src: str, path: str, rules=None) -> list:
+    """Lint one source text; returns surviving findings (suppressions
+    applied; a reason-less suppression becomes its own finding)."""
+    mod = _Module(path, src)
+    out = []
+    seen = set()
+    for name in (rules or _RULE_PASSES):
+        for f in _RULE_PASSES[name](mod):
+            # nested traced functions are walked both standalone and as
+            # part of their parent's body: keep one finding per site
+            site = (f.rule, f.line, f.col)
+            if site in seen:
+                continue
+            seen.add(site)
+            state = mod.suppressed(f.line, f.rule)
+            if state is True:
+                continue
+            if state == "bare":
+                out.append(dataclasses.replace(
+                    f, message=(
+                        "suppression without a reason — write "
+                        f"`# flowlint: ok({f.rule}) <why>` "
+                        f"(suppressing: {f.message})")))
+            else:
+                out.append(f)
+    return out
+
+
+def default_targets(repo_root: str | None = None) -> list:
+    """The repo surface ``lint`` covers: the package + bench.py."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = repo_root or os.path.dirname(here)
+    targets = []
+    for base, _dirs, files in os.walk(os.path.join(root,
+                                                   "flow_updating_tpu")):
+        if "__pycache__" in base:
+            continue
+        targets.extend(os.path.join(base, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        targets.append(bench)
+    return targets
+
+
+def lint_paths(paths=None, rules=None) -> list:
+    """Lint files (default: the whole repo surface).  Syntax errors in
+    a target surface as findings, never tracebacks."""
+    out = []
+    for path in (paths if paths is not None else default_targets()):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as err:
+            out.append(LintFinding("io", path, 0, 0, str(err)))
+            continue
+        try:
+            out.extend(lint_source(src, path, rules=rules))
+        except SyntaxError as err:
+            out.append(LintFinding("syntax", path, err.lineno or 0, 0,
+                                   str(err.msg)))
+    return out

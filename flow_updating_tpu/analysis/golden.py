@@ -1,0 +1,569 @@
+"""Golden-program ledger — the mode x twin lowering matrix as ONE table.
+
+The repo's deepest guarantees are *program identities*: telemetry/fields
+/profile off must lower the byte-identical plain program,
+``robust='none'`` and ``adversary=None`` must not perturb the lowering,
+and ROADMAP item 5's round-program IR must reproduce every existing
+lowering bit-exactly before it can land.  Until now each identity was a
+hand-written ``lower().as_text()`` comparison scattered across test
+files; this module replaces them with one canonicalizer and one
+committed ledger (``GOLDEN_PROGRAMS.json``):
+
+- every **cell** of the (dispatch mode edge/node/halo/pod) x (twin
+  plain/telemetry/fields) x robust x adversary x payload matrix names a
+  deterministic small program (fixed topology, fixed seed, CPU
+  lowering);
+- :func:`build_ledger` canonical-hashes each cell's StableHLO and
+  stores the zlib-compressed canonical text;
+- :func:`audit` re-lowers every cell and diffs against the ledger,
+  naming the exact cell and the FIRST DIVERGENT HLO LINE on drift;
+- ``audit --rebase`` regenerates the ledger after an intentional
+  lowering change (docs/ANALYSIS.md records the workflow).
+
+The ledger is keyed to the lowering environment (jax version, CPU
+backend): an audit under a different jax version reports the mismatch
+explicitly and judges nothing — program text is a compiler artifact,
+not a cross-version invariant.
+
+Tests use :func:`canonical_program` as the ONE canonicalizer for ad-hoc
+program-identity asserts (test_fields.py, test_scenarios.py,
+scripts/telemetry_overhead.py all route through it).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import json
+import re
+import zlib
+
+LEDGER_VERSION = "flow-updating-golden-programs/v1"
+DEFAULT_LEDGER = "GOLDEN_PROGRAMS.json"
+
+# number of rounds every cell lowers: programs scan, so text size is
+# round-count independent, but the count is part of the cell identity
+CELL_ROUNDS = 4
+
+
+# ---------------------------------------------------------------------------
+# canonicalization — the one place lowered text is normalized
+
+_LOC_LINE = re.compile(r"^#loc\d*\s*=.*$", re.MULTILINE)
+_LOC_ATTR = re.compile(r"\s+loc\(.*?\)")
+
+
+def canonical_text(text: str) -> str:
+    """Canonical form of a lowered module's text: location metadata
+    stripped (``#loc`` lines and ``loc(...)`` attributes carry file
+    paths and line numbers of the *caller*, not the program), trailing
+    whitespace removed, single trailing newline."""
+    text = _LOC_LINE.sub("", text)
+    text = _LOC_ATTR.sub("", text)
+    lines = [ln.rstrip() for ln in text.splitlines()]
+    while lines and not lines[-1]:
+        lines.pop()
+    return "\n".join(lines) + "\n"
+
+
+def canonical_program(fn, *args, **kwargs) -> str:
+    """Canonical lowered text of ``fn(*args, **kwargs)`` — the one
+    canonicalizer every program-identity assert routes through.  ``fn``
+    is any jit-wrapped callable; static args pass exactly as a normal
+    call."""
+    return canonical_text(fn.lower(*args, **kwargs).as_text())
+
+
+def program_digest(canonical: str) -> str:
+    return hashlib.sha256(canonical.encode()).hexdigest()
+
+
+def _pack(canonical: str) -> str:
+    return base64.b85encode(zlib.compress(canonical.encode(), 9)).decode()
+
+
+def _unpack(packed: str) -> str:
+    return zlib.decompress(base64.b85decode(packed.encode())).decode()
+
+
+def first_divergence(old: str, new: str) -> dict:
+    """First line where two canonical programs diverge: 1-based line
+    number plus both lines (missing side = None)."""
+    old_lines = old.splitlines()
+    new_lines = new.splitlines()
+    for i, (a, b) in enumerate(zip(old_lines, new_lines)):
+        if a != b:
+            return {"line": i + 1, "ledger": a, "current": b}
+    if len(old_lines) != len(new_lines):
+        i = min(len(old_lines), len(new_lines))
+        return {"line": i + 1,
+                "ledger": old_lines[i] if i < len(old_lines) else None,
+                "current": new_lines[i] if i < len(new_lines) else None}
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# the cell registry
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    """One coordinate of the conformance matrix.  ``key`` is the ledger
+    key; ``build`` returns ``(jitted_fn, args, kwargs)`` ready to
+    lower."""
+
+    key: str
+    mode: str          # edge | node | halo | pod
+    twin: str          # plain | telemetry | fields
+    build: object      # () -> (fn, args, kwargs)
+
+
+class _Fixtures:
+    """Shared deterministic inputs, built once per registry walk (cells
+    reuse topologies/configs so a full audit stays seconds, not
+    minutes)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def get(self, name: str, make):
+        if name not in self._cache:
+            self._cache[name] = make()
+        return self._cache[name]
+
+
+def _mean(topo, cfg):
+    import jax.numpy as jnp
+
+    return jnp.asarray(topo.true_mean, cfg.jnp_dtype)
+
+
+def cells() -> list:
+    """The registered matrix.  Builders are lazy: constructing the list
+    touches nothing heavy; each cell builds its inputs when lowered.
+
+    Coverage: all four dispatch modes x all three twins, plus the
+    robust/adversary/payload/variant axes on the edge kernel (where
+    those knobs live) and a vector-payload variant on halo — ≥24 cells
+    by construction (pinned in tests/test_analysis.py)."""
+    import jax.numpy as jnp
+
+    fx = _Fixtures()
+    out: list = []
+
+    def _topo_edge():
+        from flow_updating_tpu.topology.generators import community
+
+        return community(32, c=2, k_in=6.0, k_out=0.5, seed=0)
+
+    def _edge_inputs(cfg, *, adversary=None, vector=False):
+        from flow_updating_tpu.models.state import init_state
+
+        topo = fx.get("topo_edge", _topo_edge)
+        arrays = fx.get(
+            f"arrays_edge_coloring={cfg.needs_coloring}",
+            lambda: topo.device_arrays(coloring=cfg.needs_coloring))
+        if adversary is not None:
+            arrays = arrays.replace(**adversary.device_leaves(
+                topo.num_nodes, topo.num_edges, cfg.jnp_dtype))
+        values = None
+        if vector:
+            import numpy as np
+
+            values = jnp.asarray(
+                np.linspace(0.0, 1.0, topo.num_nodes * 3,
+                            dtype=np.float64).reshape(-1, 3))
+        state = init_state(topo, cfg, seed=0, values=values)
+        return topo, arrays, state
+
+    def _edge_cell(key, cfg, twin="plain", adversary=None, vector=False):
+        def build(cfg=cfg, twin=twin, adversary=adversary, vector=vector):
+            from flow_updating_tpu.models.rounds import (
+                run_rounds,
+                run_rounds_fields,
+                run_rounds_telemetry,
+            )
+
+            topo, arrays, state = _edge_inputs(cfg, adversary=adversary,
+                                               vector=vector)
+            if twin == "plain":
+                return run_rounds, (state, arrays, cfg, CELL_ROUNDS), {}
+            from flow_updating_tpu.obs.fields import FieldSpec
+            from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+            if twin == "telemetry":
+                spec = TelemetrySpec.default().for_kernel("edge")
+                return run_rounds_telemetry, (
+                    state, arrays, cfg, CELL_ROUNDS, spec,
+                    _mean(topo, cfg)), {}
+            spec = FieldSpec.default().for_kernel("edge")
+            return run_rounds_fields, (
+                state, arrays, cfg, CELL_ROUNDS, spec,
+                _mean(topo, cfg)), {}
+        out.append(Cell(key=key, mode="edge", twin=twin, build=build))
+
+    from flow_updating_tpu.models.config import RoundConfig
+
+    fast = RoundConfig.fast()
+    # -- edge x twin x payload ------------------------------------------
+    for twin in ("plain", "telemetry", "fields"):
+        _edge_cell(f"edge/{twin}/robust=none/adv=none/payload=scalar",
+                   fast, twin=twin)
+        _edge_cell(f"edge/{twin}/robust=none/adv=none/payload=vector3",
+                   fast, twin=twin, vector=True)
+    # -- edge robust modes ---------------------------------------------
+    _edge_cell("edge/plain/robust=clip/adv=none/payload=scalar",
+               RoundConfig.fast(robust="clip", robust_clip=1.0))
+    _edge_cell("edge/plain/robust=trim/adv=none/payload=scalar",
+               RoundConfig.fast(robust="trim", robust_tol=0.5))
+    # -- edge adversaries ----------------------------------------------
+
+    def _adv_lie():
+        from flow_updating_tpu.scenarios.adversary import Adversary
+
+        return Adversary(lie_nodes=(1,), lie_value=9.0)
+
+    def _adv_flow():
+        from flow_updating_tpu.scenarios.adversary import Adversary
+
+        return Adversary(corrupt_edges=(0,), corrupt_gain=1.5)
+
+    _edge_cell("edge/plain/robust=none/adv=lie/payload=scalar",
+               fast, adversary=_adv_lie())
+    _edge_cell("edge/plain/robust=clip/adv=lie/payload=scalar",
+               RoundConfig.fast(robust="clip", robust_clip=1.0),
+               adversary=_adv_lie())
+    _edge_cell("edge/plain/robust=none/adv=corrupt/payload=scalar",
+               fast, adversary=_adv_flow())
+    # -- edge protocol variants ----------------------------------------
+    _edge_cell("edge-reference/plain/robust=none/adv=none/payload=scalar",
+               RoundConfig.reference(variant="collectall"))
+    _edge_cell("edge-pairwise/plain/robust=none/adv=none/payload=scalar",
+               RoundConfig.fast(variant="pairwise"))
+    _edge_cell(
+        "edge-pairwise-faithful/plain/robust=none/adv=none/payload=scalar",
+        RoundConfig.reference(variant="pairwise"))
+    _edge_cell("edge-pairwise/plain/robust=clip/adv=none/payload=scalar",
+               RoundConfig.fast(variant="pairwise", robust="clip",
+                                robust_clip=1.0))
+
+    # -- edge chunked payload schedule ---------------------------------
+    def _build_chunked():
+        from flow_updating_tpu.models.rounds import (
+            init_chunked_state,
+            run_rounds_chunked,
+        )
+
+        topo = fx.get("topo_edge", _topo_edge)
+        arrays = fx.get("arrays_edge_coloring=False",
+                        lambda: topo.device_arrays())
+        import numpy as np
+
+        vals = jnp.asarray(
+            np.linspace(0.0, 1.0, topo.num_nodes * 4,
+                        dtype=np.float64).reshape(-1, 4))
+        cs = init_chunked_state(topo, fast, 2, vals, seed=0)
+        return run_rounds_chunked, (cs, arrays, fast, 4, 1), {}
+    out.append(Cell(
+        key="edge-chunked2/plain/robust=none/adv=none/payload=vector4",
+        mode="edge", twin="plain", build=_build_chunked))
+
+    # -- node x twin ----------------------------------------------------
+    def _node_kernel(spmv="xla"):
+        from flow_updating_tpu.models import sync
+        from flow_updating_tpu.topology.generators import erdos_renyi
+
+        topo = fx.get("topo_node",
+                      lambda: erdos_renyi(24, avg_degree=4.0, seed=3))
+        cfg = RoundConfig.fast(kernel="node", spmv=spmv)
+        return fx.get(f"node_kernel_{spmv}",
+                      lambda: sync.NodeKernel(topo, cfg)), topo, cfg
+
+    def _node_cell(key, twin, spmv="xla"):
+        def build(twin=twin, spmv=spmv):
+            from flow_updating_tpu.models import sync
+
+            kern, topo, cfg = _node_kernel(spmv)
+            state = kern.init_state()
+            if twin == "plain":
+                fn, args, _ = kern.round_program(state, CELL_ROUNDS)
+                return fn, args, {}
+            from flow_updating_tpu.obs.fields import FieldSpec
+            from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+            if twin == "telemetry":
+                spec = TelemetrySpec.default().for_kernel("node")
+                return sync.run_rounds_node_telemetry, (
+                    state, kern.arrays, cfg, CELL_ROUNDS, spec,
+                    _mean(topo, cfg)), {}
+            spec = FieldSpec.default().for_kernel("node")
+            return sync.run_rounds_node_fields, (
+                state, kern.arrays, cfg, CELL_ROUNDS, spec,
+                _mean(topo, cfg)), {}
+        out.append(Cell(key=key, mode="node", twin=twin, build=build))
+
+    for twin in ("plain", "telemetry", "fields"):
+        _node_cell(f"node/{twin}/robust=none/adv=none/payload=scalar",
+                   twin)
+    _node_cell("node-benes/plain/robust=none/adv=none/payload=scalar",
+               "plain", spmv="benes")
+
+    # -- halo x twin (2-shard virtual mesh) -----------------------------
+    def _halo_parts(vector=False):
+        from flow_updating_tpu.parallel import sharded
+        from flow_updating_tpu.parallel.mesh import make_mesh
+        from flow_updating_tpu.topology.generators import erdos_renyi
+
+        topo = fx.get("topo_node",
+                      lambda: erdos_renyi(24, avg_degree=4.0, seed=3))
+        mesh = fx.get("mesh2", lambda: make_mesh(2))
+        cfg = RoundConfig.fast()
+        plan = fx.get("halo_plan",
+                      lambda: sharded.plan_sharding(topo, 2))
+        values = None
+        if vector:
+            import numpy as np
+
+            values = np.linspace(
+                0.0, 1.0, topo.num_nodes * 3).reshape(-1, 3)
+        state = sharded.init_plan_state(plan, cfg, mesh, seed=0,
+                                        values=values)
+        return sharded, topo, mesh, cfg, plan, state
+
+    def _halo_cell(key, twin, vector=False):
+        def build(twin=twin, vector=vector):
+            sharded, topo, mesh, cfg, plan, state = _halo_parts(vector)
+            if twin == "plain":
+                fn, args, _ = sharded.round_program(
+                    state, plan, cfg, mesh, CELL_ROUNDS)
+                return fn, args, {}
+            from flow_updating_tpu.obs.fields import FieldSpec
+            from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+            # mirror the public wrappers' preamble
+            # (run_rounds_sharded_telemetry/_fields), which call the
+            # jitted twins with the plan arrays resolved
+            plan_arrays, halo_tables, perm, ov, halo = \
+                sharded._program_inputs(plan, cfg, mesh, None, "ppermute")
+            mean = _mean(topo, cfg)
+            if twin == "telemetry":
+                spec = TelemetrySpec.default().for_kernel("halo")
+                return sharded._run_sharded_telemetry, (
+                    state, plan_arrays, halo_tables, perm, ov, mean,
+                    cfg, mesh, CELL_ROUNDS, plan.Eb, plan.Nb,
+                    plan.perm_offsets, halo, plan.num_colors, spec), {}
+            spec = FieldSpec.default().for_kernel("halo")
+            return sharded._run_sharded_fields, (
+                state, plan_arrays, halo_tables, perm, ov, mean,
+                cfg, mesh, CELL_ROUNDS, plan.Eb, plan.Nb,
+                plan.perm_offsets, halo, plan.num_colors, spec), {}
+        out.append(Cell(key=key, mode="halo", twin=twin, build=build))
+
+    for twin in ("plain", "telemetry", "fields"):
+        _halo_cell(f"halo-s2/{twin}/robust=none/adv=none/payload=scalar",
+                   twin)
+    _halo_cell("halo-s2/plain/robust=none/adv=none/payload=vector3",
+               "plain", vector=True)
+
+    # -- pod x twin (fat-tree stencil, 2-shard mesh) --------------------
+    def _pod_kernel():
+        from flow_updating_tpu.parallel import structured_sharded
+        from flow_updating_tpu.parallel.mesh import make_mesh
+        from flow_updating_tpu.topology.generators import fat_tree
+
+        topo = fx.get("topo_pod", lambda: fat_tree(4, seed=0))
+        mesh = fx.get("mesh2_pod", lambda: make_mesh(2))
+        cfg = RoundConfig.fast(kernel="node", spmv="structured")
+        kern = fx.get(
+            "pod_kernel",
+            lambda: structured_sharded.PodShardedFatTreeKernel(
+                topo, cfg, mesh))
+        return kern, topo, cfg
+
+    def _pod_cell(key, twin):
+        def build(twin=twin):
+            kern, topo, cfg = _pod_kernel()
+            state = kern.init_state()
+            if twin == "plain":
+                fn, args, _ = kern.round_program(state, CELL_ROUNDS)
+                return fn, args, {}
+            from flow_updating_tpu.obs.fields import FieldSpec
+            from flow_updating_tpu.obs.telemetry import TelemetrySpec
+
+            mean = _mean(topo, cfg)
+            if twin == "telemetry":
+                spec = TelemetrySpec.default().for_kernel("pod")
+                return kern._run_tel_jit, (
+                    state, kern.value, kern.inv_depp1, kern.deg, mean), \
+                    {"num_rounds": CELL_ROUNDS, "spec": spec}
+            spec = FieldSpec.default().for_kernel("pod")
+            return kern._run_fields_jit, (
+                state, kern.value, kern.inv_depp1, kern.deg, mean), \
+                {"num_rounds": CELL_ROUNDS, "spec": spec}
+        out.append(Cell(key=key, mode="pod", twin=twin, build=build))
+
+    for twin in ("plain", "telemetry", "fields"):
+        _pod_cell(f"pod-s2/{twin}/robust=none/adv=none/payload=scalar",
+                  twin)
+
+    return out
+
+
+def cell_index() -> dict:
+    return {c.key: c for c in cells()}
+
+
+# ---------------------------------------------------------------------------
+# build / audit
+
+def _environment() -> dict:
+    import jax
+
+    return {"jax": jax.__version__,
+            "backend": jax.devices()[0].platform,
+            "x64": bool(jax.config.jax_enable_x64),
+            "device_count": len(jax.devices())}
+
+
+def lower_cell(cell: Cell) -> str:
+    """Canonical lowered text of one cell's program."""
+    fn, args, kwargs = cell.build()
+    return canonical_program(fn, *args, **kwargs)
+
+
+def build_ledger(keys=None) -> dict:
+    """Lower every registered cell (or the ``keys`` subset) and return
+    the ledger document."""
+    index = cell_index()
+    keys = list(keys) if keys is not None else list(index)
+    entries = {}
+    for key in keys:
+        canonical = lower_cell(index[key])
+        entries[key] = {
+            "sha256": program_digest(canonical),
+            "lines": canonical.count("\n"),
+            "text_z": _pack(canonical),
+        }
+    return {"version": LEDGER_VERSION,
+            "rounds": CELL_ROUNDS,
+            "environment": _environment(),
+            "cells": entries}
+
+
+def load_ledger(path: str = DEFAULT_LEDGER) -> dict:
+    with open(path) as f:
+        ledger = json.load(f)
+    if ledger.get("version") != LEDGER_VERSION:
+        raise ValueError(
+            f"{path} is not a {LEDGER_VERSION} ledger "
+            f"(version={ledger.get('version')!r})")
+    return ledger
+
+
+def save_ledger(ledger: dict, path: str = DEFAULT_LEDGER) -> None:
+    with open(path, "w") as f:
+        json.dump(ledger, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def environment_mismatch(ledger: dict) -> str | None:
+    """Why this environment cannot judge the ledger (None = it can).
+    Lowered text is a compiler artifact: a different jax version or
+    backend legitimately changes it, so the audit refuses to call that
+    drift."""
+    env = _environment()
+    want = ledger.get("environment", {})
+    for field in ("jax", "backend", "x64"):
+        if field in want and want[field] != env[field]:
+            return (f"ledger lowered under {field}={want[field]!r}, "
+                    f"running {field}={env[field]!r} — regenerate with "
+                    "`audit --rebase` in the pinned environment "
+                    "(the audit CLI pins cpu + x64, matching the test "
+                    "suite)")
+    if want.get("device_count", 0) > env["device_count"]:
+        # halo/pod cells build a >=2-device mesh; auditing from a
+        # process with fewer devices must read as an environment
+        # problem, not as program drift
+        return (f"ledger lowered with {want['device_count']} devices, "
+                f"only {env['device_count']} visible — run the audit "
+                "CLI (it pins 8 virtual CPU devices), or set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    return None
+
+
+def audit(ledger: dict, keys=None) -> dict:
+    """Re-lower every ledger cell and diff.  Returns the audit report:
+    ``overall`` is ``pass`` | ``drift`` | ``env-mismatch``; each cell
+    record is ``match`` / ``drift`` (with the first divergent HLO line)
+    / ``missing`` (registered but not in the ledger) / ``unknown``
+    (in the ledger but no longer registered) / ``error``."""
+    mismatch = environment_mismatch(ledger)
+    if mismatch:
+        return {"overall": "env-mismatch", "reason": mismatch,
+                "environment": _environment(), "cells": []}
+    index = cell_index()
+    want = ledger.get("cells", {})
+    keys = list(keys) if keys is not None else sorted(
+        set(index) | set(want))
+    results = []
+    for key in keys:
+        if key not in want:
+            results.append({"cell": key, "status": "missing",
+                            "detail": "registered cell not in ledger — "
+                                      "run `audit --rebase`"})
+            continue
+        if key not in index:
+            results.append({"cell": key, "status": "unknown",
+                            "detail": "ledger cell no longer registered "
+                                      "— run `audit --rebase`"})
+            continue
+        try:
+            current = lower_cell(index[key])
+        except Exception as exc:  # a cell failing to lower IS a finding
+            results.append({"cell": key, "status": "error",
+                            "detail": f"{type(exc).__name__}: {exc}"})
+            continue
+        if program_digest(current) == want[key]["sha256"]:
+            results.append({"cell": key, "status": "match"})
+            continue
+        old = _unpack(want[key]["text_z"])
+        div = first_divergence(old, current)
+        if not div:
+            # digest mismatch but stored text == current text: the
+            # ledger's own digest is inconsistent (hand-edited file)
+            results.append({
+                "cell": key, "status": "drift",
+                "first_divergence": div,
+                "detail": "ledger digest does not match the ledger's "
+                          "own stored text (corrupted entry?) — "
+                          "regenerate with `audit --rebase`"})
+            continue
+        results.append({
+            "cell": key, "status": "drift",
+            "first_divergence": div,
+            "detail": (
+                f"lowering drifted at HLO line {div['line']}: "
+                f"ledger {div.get('ledger')!r} vs current "
+                f"{div.get('current')!r}"),
+        })
+    bad = [r for r in results if r["status"] != "match"]
+    return {"overall": "pass" if not bad else "drift",
+            "environment": _environment(),
+            "drifted": [r["cell"] for r in bad],
+            "cells": results}
+
+
+def assert_same_program(fn_a, args_a, fn_b, args_b, *, label: str = "",
+                        kwargs_a=None, kwargs_b=None) -> None:
+    """Assert two jitted calls lower to the identical canonical program
+    — the migrated form of the hand-rolled ``lower().as_text()``
+    comparisons.  On mismatch the AssertionError names the first
+    divergent HLO line."""
+    a = canonical_program(fn_a, *args_a, **(kwargs_a or {}))
+    b = canonical_program(fn_b, *args_b, **(kwargs_b or {}))
+    if a != b:
+        div = first_divergence(a, b)
+        raise AssertionError(
+            f"programs differ{' (' + label + ')' if label else ''} at "
+            f"HLO line {div.get('line', '?')}: {div.get('ledger')!r} vs "
+            f"{div.get('current')!r}")

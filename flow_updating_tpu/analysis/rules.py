@@ -1,0 +1,578 @@
+"""Structural rules over round-program jaxprs — hazards caught at lint
+time instead of at 100x-slowdown time.
+
+Every rule here mechanizes a hazard this repo has already paid for once
+by benchmark archaeology or debugging session:
+
+``serializing-scatter``
+    A *batched* ``scatter-add`` (non-empty ``update_window_dims`` /
+    ``operand_batching_dims`` — the shape vmap produces) inside the
+    round scan on a CPU-hot path.  XLA:CPU serializes batched scatters;
+    the sweep engine's first vmapped build ran ~100x slow before the
+    row-fold rewrite (PR 3, the dense-hardware recipe of
+    arXiv:1906.11786 applied in reverse).
+
+``gather-fast-path``
+    A ``gather`` inside the round scan of a program claiming the
+    TPU fast path.  ``plan/select.py`` models this penalty at ~2000x on
+    TPU; the Benes/structured paths exist precisely to avoid it, so a
+    gather showing up there is a silent fast-path regression.
+
+``callback-in-scan``
+    Any ``*_callback`` primitive inside a scan/while body: a host
+    round-trip per round, the exact failure mode the device-resident
+    telemetry layer (PR 2) was built to prevent.
+
+``dtype-drift``
+    A non-scalar float width change (``convert_element_type`` f32<->f64)
+    inside the round scan: an fp32 ledger silently widening (2x HBM +
+    wire) or narrowing (silent precision loss) mid-round.  Scalars are
+    exempt — weak-type literal promotion is idiomatic and free.
+
+``key-reuse``
+    The same PRNG key consumed by two independent random draws/splits
+    (jaxpr dataflow, not name matching).  Correlated "independent" drop
+    draws corrupt loss realizations silently.  ``fold_in`` derivations
+    are treated as fresh streams (the documented per-edge/per-shard key
+    family pattern); ``cond`` branches count as alternatives, not
+    repetitions.
+
+``scan-collective``
+    Collectives inside the round scan over axes the program declared it
+    would not touch.  Feature-mesh runs must have ZERO round-scan
+    collectives (PR 10's bit-exactness argument rests on it); halo/pod
+    programs allow exactly the node axis.
+
+A rule runs over a traced jaxpr under a :class:`ProgramContext` (what
+the program claims about itself: hot backend, fast-path claim, allowed
+scan collectives) and returns :class:`Finding` records citing the
+primitive path
+(``pjit/scan/scatter-add``).  Nothing compiles or executes — rules run
+on ``jax.make_jaxpr`` output only, so the whole kernel matrix audits in
+seconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+
+from flow_updating_tpu.analysis import walk
+
+# ---------------------------------------------------------------------------
+# findings + context
+
+ERROR = "error"
+WARN = "warn"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, citable: rule id, program label, primitive
+    path, and the message naming the hazard."""
+
+    rule: str
+    message: str
+    where: str = ""
+    program: str = ""
+    severity: str = ERROR
+
+    def format(self) -> str:
+        loc = f" at {self.where}" if self.where else ""
+        prog = f"[{self.program}] " if self.program else ""
+        return f"{prog}{self.rule}{loc}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramContext:
+    """What the program under analysis claims about itself — rules are
+    conditional on these claims, not on guesses.
+
+    ``backend`` — where the program is hot ('cpu' or 'tpu').
+    ``tpu_fast_path`` — the program claims the gather-free TPU fast
+    path (Benes / structured / banded spmv, Benes delivery).
+    ``allowed_scan_collective_axes`` — mesh axes whose collectives are
+    expected inside the round scan (halo/pod: the node axis; feature
+    -sharded payload programs: none at all).
+    """
+
+    backend: str = "cpu"
+    tpu_fast_path: bool = False
+    allowed_scan_collective_axes: frozenset = frozenset({"nodes"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    fn: Callable
+
+    def run(self, closed_jaxpr, ctx: ProgramContext) -> list:
+        return list(self.fn(closed_jaxpr, ctx))
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _rule(name: str, doc: str):
+    def deco(fn):
+        RULES[name] = Rule(name=name, doc=doc, fn=fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# scatter / gather / callback / dtype / collective rules
+
+# combining scatters only: the serialization hazard is the REDUCTION
+# form (segment sums).  A plain overwrite `scatter` (delay-line row
+# writes via .at[i].set) is a contiguous window update, not the hazard.
+_SCATTER_PRIMS = ("scatter-add", "scatter-mul", "scatter-min",
+                  "scatter-max")
+_GATHER_PRIMS = ("gather",)
+_COLLECTIVE_PRIMS = ("psum", "psum2", "ppermute", "pmax", "pmin",
+                     "pgather", "all_gather", "all_to_all",
+                     "reduce_scatter", "collective_permute")
+
+
+def _is_batched_scatter(eqn) -> bool:
+    """The vmap-produced shape: a combining scatter whose operand keeps
+    a window (batch) axis BEFORE the scattered axis, so every scatter
+    index touches a strided slab — the form XLA:CPU serializes.  A
+    payload scatter-add (window axis AFTER the scattered axis:
+    contiguous row adds, ``(N, D)`` ledgers) is the fast form and does
+    not fire."""
+    dn = eqn.params.get("dimension_numbers")
+    if dn is None:
+        return False
+    if getattr(dn, "operand_batching_dims", ()):
+        return True
+    if not getattr(dn, "update_window_dims", ()):
+        return False
+    operand = walk.aval_of(eqn.invars[0])
+    rank = len(getattr(operand, "shape", ()) or ())
+    excluded = set(getattr(dn, "inserted_window_dims", ())) \
+        | set(getattr(dn, "operand_batching_dims", ()))
+    window_dims = [d for d in range(rank) if d not in excluded]
+    scattered = getattr(dn, "scatter_dims_to_operand_dims", ())
+    return bool(window_dims and scattered
+                and min(window_dims) < max(scattered))
+
+
+@_rule(
+    "serializing-scatter",
+    "batched scatter-add inside the round scan on a CPU-hot path: "
+    "XLA:CPU serializes it (the PR-3 ~100x sweep slowdown); use the "
+    "custom_vmap flat-offset rule or a row-matrix fold instead",
+)
+def _r_serializing_scatter(jx, ctx):
+    if ctx.backend != "cpu":
+        return
+    for site in walk.iter_sites(jx):
+        if (site.prim in _SCATTER_PRIMS and site.loop_depth >= 1
+                and _is_batched_scatter(site.eqn)):
+            op = walk.aval_of(site.eqn.invars[0])
+            yield Finding(
+                rule="serializing-scatter",
+                where=site.where,
+                message=(
+                    f"batched {site.prim} on operand "
+                    f"{walk.fmt_aval(op)} inside the round scan — "
+                    "XLA:CPU executes batched scatters serially"),
+            )
+
+
+@_rule(
+    "gather-fast-path",
+    "gather inside the round scan of a program claiming the gather-free "
+    "TPU fast path (plan/select.py models ~2000x penalty on TPU)",
+)
+def _r_gather_fast_path(jx, ctx):
+    if not ctx.tpu_fast_path:
+        return
+    for site in walk.iter_sites(jx):
+        if site.prim in _GATHER_PRIMS and site.loop_depth >= 1:
+            op = walk.aval_of(site.eqn.invars[0])
+            yield Finding(
+                rule="gather-fast-path",
+                where=site.where,
+                message=(
+                    f"gather on {walk.fmt_aval(op)} inside the round "
+                    "scan of a claimed gather-free fast path"),
+            )
+
+
+@_rule(
+    "callback-in-scan",
+    "host callback inside a scan/while body: a host round-trip per "
+    "round (telemetry/fields ride the scan as ys exactly to avoid this)",
+)
+def _r_callback_in_scan(jx, ctx):
+    del ctx
+    for site in walk.iter_sites(jx):
+        if "callback" in site.prim and site.loop_depth >= 1:
+            yield Finding(
+                rule="callback-in-scan",
+                where=site.where,
+                message=f"{site.prim} inside the round scan",
+            )
+
+
+def _float_width(dtype) -> int | None:
+    import numpy as np
+
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        return None
+    return dt.itemsize if dt.kind == "f" else None
+
+
+@_rule(
+    "dtype-drift",
+    "non-scalar float width change inside the round scan: an fp32 "
+    "ledger silently widening (2x HBM + wire bytes) or narrowing "
+    "(precision loss) mid-round",
+)
+def _r_dtype_drift(jx, ctx):
+    del ctx
+    for site in walk.iter_sites(jx):
+        if site.prim != "convert_element_type" or site.loop_depth < 1:
+            continue
+        src = walk.aval_of(site.eqn.invars[0])
+        if src is None or not getattr(src, "shape", None):
+            continue                       # scalars: weak-type idiom, free
+        w_in = _float_width(getattr(src, "dtype", None))
+        w_out = _float_width(site.eqn.params.get("new_dtype"))
+        if w_in and w_out and w_in != w_out:
+            yield Finding(
+                rule="dtype-drift",
+                where=site.where,
+                message=(
+                    f"{walk.fmt_aval(src)} converts to "
+                    f"{site.eqn.params['new_dtype']} inside the round "
+                    "scan (non-scalar float width change)"),
+            )
+
+
+def _collective_axes(eqn) -> tuple:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+@_rule(
+    "scan-collective",
+    "collective inside the round scan over an axis the program declared "
+    "collective-free (feature-mesh runs must have ZERO round-scan "
+    "collectives — PR 10's bit-exactness guarantee)",
+)
+def _r_scan_collective(jx, ctx):
+    allowed = ctx.allowed_scan_collective_axes
+    for site in walk.iter_sites(jx):
+        if site.prim not in _COLLECTIVE_PRIMS or site.loop_depth < 1:
+            continue
+        bad = [a for a in _collective_axes(site.eqn) if a not in allowed]
+        if bad:
+            yield Finding(
+                rule="scan-collective",
+                where=site.where,
+                message=(
+                    f"{site.prim} over axis {bad} inside the round scan "
+                    f"(allowed axes: {sorted(allowed) or 'none'})"),
+            )
+
+
+# ---------------------------------------------------------------------------
+# key-reuse: dataflow over the PRNG primitives
+
+# consume the key they are given (each key must be consumed at most once)
+_KEY_CONSUMERS = ("random_bits", "random_split", "threefry2x32",
+                  "random_gamma")
+# derive a FRESH stream from data (the documented key-family pattern)
+_KEY_DERIVERS = ("random_fold_in",)
+# pure repackaging: output carries the same logical key as operand 0
+_KEY_PASSTHROUGH = ("random_wrap", "random_unwrap", "convert_element_type",
+                    "squeeze", "reshape", "broadcast_in_dim", "transpose",
+                    "copy", "device_put")
+_CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat", "remat2",
+               "custom_jvp_call", "custom_vjp_call", "custom_vmap_call",
+               "shard_map", "xla_call")
+
+
+def _key_flow(jaxpr, env: dict, sites: dict, path: tuple) -> None:
+    """Walk ``jaxpr`` propagating value tokens through key-shaped
+    dataflow; record each consuming equation against its key's root
+    token in ``sites`` (token -> list of locations)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+
+    def tok(atom):
+        return env.get(id(atom))
+
+    def fresh(var, label):
+        env[id(var)] = (label, id(var))
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        here = path + (name,)
+        if name in _KEY_CONSUMERS:
+            # threefry2x32 consumes (k1, k2, c1, c2): the key is the
+            # first two operands; typed-key prims consume operand 0
+            n_key_ops = 2 if name == "threefry2x32" else 1
+            hit = set()
+            for atom in eqn.invars[:n_key_ops]:
+                t = tok(atom)
+                if t is not None and t not in hit:
+                    hit.add(t)
+                    sites.setdefault(t, []).append("/".join(here))
+            for ov in eqn.outvars:
+                fresh(ov, name)
+            continue
+        if name in _KEY_DERIVERS:
+            for ov in eqn.outvars:
+                fresh(ov, name)
+            continue
+        if name in _KEY_PASSTHROUGH and eqn.invars:
+            t = tok(eqn.invars[0])
+            for ov in eqn.outvars:
+                if t is not None:
+                    env[id(ov)] = t
+                else:
+                    fresh(ov, name)
+            continue
+        if name == "slice" and eqn.invars:
+            # slices of a key batch select DISTINCT children (the split
+            # output pattern): refine the token by the slice window
+            t = tok(eqn.invars[0])
+            start = tuple(eqn.params.get("start_indices", ()))
+            for ov in eqn.outvars:
+                if t is not None:
+                    env[id(ov)] = (t, ("slice", start))
+                else:
+                    fresh(ov, name)
+            continue
+        inner = walk.subjaxprs(eqn)
+        if inner and name in walk.BRANCH_PRIMS:
+            # branches are alternatives: merge consumption counts by MAX
+            ops = eqn.invars[1:]        # invars[0] is the branch index
+            merged: dict = {}
+            for sub in inner:
+                sub_sites: dict = {}
+                sub_env = dict(env)
+                _bind(sub, ops, sub_env)
+                _key_flow(sub, sub_env, sub_sites, here)
+                for t, locs in sub_sites.items():
+                    if len(locs) > len(merged.get(t, ())):
+                        merged[t] = locs
+            for t, locs in merged.items():
+                sites.setdefault(t, []).extend(locs)
+        elif inner and name in walk.LOOP_PRIMS:
+            # loop bodies re-execute: a carried key that is CONSUMED in
+            # the body yet returned unchanged on the carry leg is drawn
+            # from with the same value every iteration — the canonical
+            # per-round reuse.  Record the body's consumptions, then add
+            # a synthetic second site per consumed-and-passed-through
+            # carry token.
+            for sub in inner:
+                sub_env = dict(env)
+                _bind(sub, eqn.invars, sub_env)
+                before = {t: len(locs) for t, locs in sites.items()}
+                _key_flow(sub, sub_env, sites, here)
+                sub_jaxpr = getattr(sub, "jaxpr", sub)
+                for cin, cout in _loop_carry_pairs(eqn, sub_jaxpr):
+                    t_in = sub_env.get(id(cin))
+                    t_out = sub_env.get(id(cout))
+                    if t_in is None or t_in != t_out:
+                        continue
+                    if len(sites.get(t_in, ())) > before.get(t_in, 0):
+                        sites.setdefault(t_in, []).append(
+                            "/".join(here) + "[carry-passthrough]")
+        elif inner and (name in _CALL_PRIMS
+                        or name == "custom_vmap_call_jvp"):
+            for sub in inner:
+                sub_env = dict(env)
+                _bind(sub, eqn.invars, sub_env)
+                _key_flow(sub, sub_env, sites, here)
+        for ov in eqn.outvars:
+            if id(ov) not in env:
+                fresh(ov, name)
+
+
+def _loop_carry_pairs(eqn, body_jaxpr):
+    """(invar, outvar) carry-leg pairs of a scan/while body jaxpr.
+    scan: invars = consts + carry + xs, outvars = carry + ys (counts in
+    params).  while: only the params['body_jaxpr'] sub-jaxpr carries
+    (the cond jaxpr returns a boolean and yields no pairs)."""
+    name = eqn.primitive.name
+    invars, outvars = list(body_jaxpr.invars), list(body_jaxpr.outvars)
+    if name == "scan":
+        nc = eqn.params.get("num_consts", 0)
+        nk = eqn.params.get("num_carry", 0)
+        return list(zip(invars[nc:nc + nk], outvars[:nk]))
+    if name == "while":
+        body = eqn.params.get("body_jaxpr")
+        if body_jaxpr is not getattr(body, "jaxpr", body):
+            return []
+        nk = len(outvars)
+        return list(zip(invars[len(invars) - nk:], outvars))
+    return []
+
+
+def _bind(jaxpr, outer_atoms, env: dict) -> None:
+    """Bind an inner jaxpr's invars to the outer operands' tokens
+    (positional; extra/missing positions get fresh tokens)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    invars = list(jaxpr.invars)
+    # align from the END: call conventions prepend consts to invars
+    outer = list(outer_atoms)[-len(invars):] if invars else []
+    offset = len(invars) - len(outer)
+    for k, iv in enumerate(invars):
+        src = outer[k - offset] if k >= offset else None
+        t = env.get(id(src)) if src is not None else None
+        env[id(iv)] = t if t is not None else ("arg", id(iv))
+
+
+@_rule(
+    "key-reuse",
+    "the same PRNG key consumed by two independent draws/splits "
+    "(dataflow, not name matching): correlated 'independent' randomness",
+)
+def _r_key_reuse(jx, ctx):
+    del ctx
+    jaxpr = getattr(jx, "jaxpr", jx)
+    env: dict = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        env[id(v)] = ("arg", id(v))
+    sites: dict = {}
+    _key_flow(jaxpr, env, sites, ())
+    for t, locs in sites.items():
+        if len(locs) >= 2:
+            yield Finding(
+                rule="key-reuse",
+                where=locs[1],
+                message=(
+                    f"one PRNG key reaches {len(locs)} draws/splits "
+                    f"(first at {locs[0]}) — split the key, or fold_in "
+                    "distinct data per stream"),
+            )
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+def analyze_jaxpr(closed_jaxpr, ctx: ProgramContext | None = None,
+                  rules=None, program: str = "") -> list:
+    """Run ``rules`` (default: all) over one traced jaxpr.  Findings
+    are deduplicated: ``custom_vmap``-style equations carry BOTH the
+    primal and the batching-rule jaxpr in their params, so the same
+    site would otherwise report twice."""
+    ctx = ctx or ProgramContext()
+    out = []
+    for name in (rules or RULES):
+        for f in RULES[name].run(closed_jaxpr, ctx):
+            out.append(dataclasses.replace(f, program=program))
+    return list(dict.fromkeys(out))
+
+
+def analyze_program(fn, args, n_dynamic: int | None = None,
+                    ctx: ProgramContext | None = None, rules=None,
+                    program: str = "") -> list:
+    """Trace a round_program-convention callable and analyze it."""
+    jx = walk.jaxpr_program(fn, args, n_dynamic)
+    return analyze_jaxpr(jx, ctx, rules=rules, program=program)
+
+
+def kernel_programs() -> list:
+    """The standard audit matrix ``lint`` runs the rule engine over:
+    one small program per dispatch mode plus the fast-path and
+    feature-mesh claims.  Returns ``(label, fn, args, n_dynamic, ctx)``
+    tuples; building them traces nothing yet."""
+    import jax.numpy as jnp
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.models.rounds import run_rounds
+    from flow_updating_tpu.models.state import init_state
+    from flow_updating_tpu.topology.generators import (
+        erdos_renyi,
+        fat_tree,
+        ring,
+    )
+
+    progs = []
+    topo = ring(16, k=2, seed=1)
+    cfg = RoundConfig.fast()
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg, seed=0)
+    progs.append(("edge/collectall", run_rounds,
+                  (state, arrays, cfg, 4), 2, ProgramContext()))
+    ref = RoundConfig.reference(variant="collectall")
+    progs.append(("edge/reference", run_rounds,
+                  (init_state(topo, ref, seed=0), arrays, ref, 4), 2,
+                  ProgramContext()))
+
+    from flow_updating_tpu.models import sync
+
+    ntopo = erdos_renyi(24, avg_degree=4.0, seed=3)
+    ncfg = RoundConfig.fast(kernel="node")
+    nk = sync.NodeKernel(ntopo, ncfg)
+    fn, args, nd = nk.round_program(nk.init_state(), 4)
+    progs.append(("node/xla", fn, args, nd, ProgramContext()))
+    bcfg = RoundConfig.fast(kernel="node", spmv="benes")
+    bk = sync.NodeKernel(ntopo, bcfg)
+    fn, args, nd = bk.round_program(bk.init_state(), 4)
+    progs.append(("node/benes", fn, args, nd,
+                  ProgramContext(backend="tpu", tpu_fast_path=True)))
+
+    import jax
+
+    if len(jax.devices()) >= 2:
+        from flow_updating_tpu.parallel import sharded
+        from flow_updating_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(2)
+        ecfg = RoundConfig.fast()
+        plan = sharded.plan_sharding(ntopo, 2)
+        hstate = sharded.init_plan_state(plan, ecfg, mesh)
+        fn, args, nd = sharded.round_program(
+            hstate, plan, ecfg, mesh, 4)
+        progs.append(("halo/ppermute", fn, args, nd,
+                      ProgramContext(
+                          allowed_scan_collective_axes=frozenset(
+                              {"nodes"}))))
+
+        from flow_updating_tpu.parallel import structured_sharded
+
+        ft = fat_tree(4, seed=0)
+        pcfg = RoundConfig.fast(kernel="node", spmv="structured")
+        pk = structured_sharded.PodShardedFatTreeKernel(ft, pcfg, mesh)
+        fn, args, nd = pk.round_program(pk.init_state(), 4)
+        progs.append(("pod/structured", fn, args, nd,
+                      ProgramContext(
+                          backend="tpu", tpu_fast_path=True,
+                          allowed_scan_collective_axes=frozenset(
+                              {"nodes"}))))
+
+        from flow_updating_tpu.parallel import feature
+        from flow_updating_tpu.parallel.mesh import make_mesh2d
+
+        fmesh = make_mesh2d(1, 2)
+        vals = jnp.tile(jnp.asarray(ntopo.values)[:, None], (1, 4))
+        fcfg = RoundConfig.fast()
+        fstate = init_state(ntopo, fcfg, values=vals)
+        farrays = ntopo.device_arrays()
+        progs.append(("feature/sharded", feature.run_rounds_feature,
+                      (fstate, farrays, fcfg, 4, fmesh), 2,
+                      ProgramContext(
+                          allowed_scan_collective_axes=frozenset())))
+    return progs
+
+
+def audit_kernels(rules=None) -> list:
+    """Trace + analyze the whole standard matrix; the jaxpr half of the
+    ``lint`` CLI.  Returns all findings (empty = clean)."""
+    findings = []
+    for label, fn, args, nd, ctx in kernel_programs():
+        findings.extend(analyze_program(fn, args, nd, ctx, rules=rules,
+                                        program=label))
+    return findings

@@ -1,0 +1,129 @@
+"""Jaxpr traversal utilities — the substrate of the rule engine.
+
+Everything in :mod:`flow_updating_tpu.analysis.rules` is a pass over the
+recursive jaxpr structure jax builds for a round program: equations
+nested inside ``pjit`` / ``scan`` / ``while`` / ``cond`` / ``shard_map``
+/ ``custom_*`` bodies.  This module owns the one traversal all rules
+share, so a rule is only the predicate, never the plumbing:
+
+- :func:`iter_sites` — depth-first iteration over EVERY equation in a
+  closed jaxpr, each wrapped in an :class:`EqnSite` carrying its loop
+  depth (how many enclosing ``scan``/``while`` bodies — "inside the
+  round scan" is ``loop_depth >= 1``) and the primitive path from the
+  root (the location a finding cites).
+- :func:`jaxpr_program` — trace a ``round_program``-convention callable
+  (``(fn, full_args, n_dynamic)`` with the static args TRAILING, the
+  contract every kernel's hook follows) into the closed jaxpr the rules
+  inspect.  Tracing only: nothing compiles, nothing executes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Iterator
+
+# Control-flow primitives whose bodies execute repeatedly: an equation
+# inside one runs once per round (or per inner step), which is what the
+# "inside the round scan" rules scope to.
+LOOP_PRIMS = ("scan", "while")
+# Branch-style primitives: bodies are alternatives, not repetitions.
+BRANCH_PRIMS = ("cond",)
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnSite:
+    """One equation plus where the traversal found it."""
+
+    eqn: object                 # jax.core.JaxprEqn
+    loop_depth: int             # enclosing scan/while bodies
+    path: tuple                 # primitive names root -> here (inclusive)
+
+    @property
+    def prim(self) -> str:
+        return self.eqn.primitive.name
+
+    @property
+    def where(self) -> str:
+        """Human-citable location, e.g. ``pjit/scan/scatter-add``."""
+        return "/".join(self.path)
+
+
+def _jaxpr_types() -> tuple:
+    """(ClosedJaxpr, Jaxpr) resolved version-portably: modern jax
+    exposes them via ``jax.extend.core`` (they left the public
+    ``jax.core`` namespace); older releases only have ``jax.core``."""
+    try:
+        from jax.extend import core as jex_core
+
+        return jex_core.ClosedJaxpr, jex_core.Jaxpr
+    except (ImportError, AttributeError):
+        import jax
+
+        return jax.core.ClosedJaxpr, jax.core.Jaxpr
+
+
+def subjaxprs(eqn) -> list:
+    """The inner jaxprs of one equation (scan body, cond branches, pjit
+    call jaxpr, shard_map body, custom_* rules ...), uniformly as open
+    ``Jaxpr`` objects.  Order is the params-dict order jax builds."""
+    closed_t, open_t = _jaxpr_types()
+    found = []
+
+    def _collect(v):
+        if isinstance(v, closed_t):
+            found.append(v.jaxpr)
+        elif isinstance(v, open_t):
+            found.append(v)
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                _collect(item)
+
+    for v in eqn.params.values():
+        _collect(v)
+    return found
+
+
+def iter_sites(closed_jaxpr, *, loop_depth: int = 0,
+               path: tuple = ()) -> Iterator[EqnSite]:
+    """Depth-first over every equation of ``closed_jaxpr`` (a
+    ``ClosedJaxpr`` or open ``Jaxpr``), recursing into control-flow and
+    call bodies.  ``loop_depth`` increments under scan/while bodies."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        here = path + (name,)
+        yield EqnSite(eqn=eqn, loop_depth=loop_depth, path=here)
+        inner_depth = loop_depth + (1 if name in LOOP_PRIMS else 0)
+        for sub in subjaxprs(eqn):
+            yield from iter_sites(sub, loop_depth=inner_depth, path=here)
+
+
+def jaxpr_program(fn, args, n_dynamic: int | None = None):
+    """Trace ``fn(*args)`` to a closed jaxpr without compiling.
+
+    ``fn``/``args``/``n_dynamic`` follow the ``round_program``
+    convention (obs/profile.py): ``args`` is the full tuple with the
+    static arguments TRAILING, ``n_dynamic`` is how many leading args
+    are dynamic (default: all).  Static args are closed over so
+    hashability quirks (dataclass configs, meshes, specs) never reach
+    ``jax.make_jaxpr``."""
+    import jax
+
+    if n_dynamic is None:
+        n_dynamic = len(args)
+    dyn, static = args[:n_dynamic], args[n_dynamic:]
+    return jax.make_jaxpr(lambda *d: fn(*d, *static))(*dyn)
+
+
+def aval_of(atom):
+    """The abstract value of an invar/outvar atom (Var or Literal)."""
+    return getattr(atom, "aval", None)
+
+
+def fmt_aval(aval) -> str:
+    if aval is None:
+        return "?"
+    dtype = getattr(aval, "dtype", "?")
+    shape = getattr(aval, "shape", None)
+    return f"{dtype}[{','.join(map(str, shape))}]" if shape is not None \
+        else str(dtype)

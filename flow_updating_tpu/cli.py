@@ -184,7 +184,7 @@ def _build_topology(args):
         try:
             return topology_from_spec(args.generator, seed=args.seed)
         except ValueError as err:
-            raise SystemExit(str(err))
+            raise SystemExit(str(err)) from err
     if args.deployment:
         from flow_updating_tpu.engine import TICK_INTERVAL
 
@@ -241,7 +241,7 @@ def _make_config(args):
     try:
         return maker(**kw)
     except ValueError as err:
-        raise SystemExit(f"invalid flag combination: {err}")
+        raise SystemExit(f"invalid flag combination: {err}") from err
 
 
 def _resolve_latency_scale(args) -> None:
@@ -274,7 +274,7 @@ def cmd_run(args) -> int:
         try:
             telemetry_spec = TelemetrySpec.parse(args.telemetry)
         except ValueError as err:
-            raise SystemExit(f"--telemetry: {err}")
+            raise SystemExit(f"--telemetry: {err}") from err
         if not telemetry_spec.enabled:
             # '--telemetry off' means exactly that: the plain run paths
             # (watcher, --stream, --until-rmse) all stay available
@@ -327,7 +327,7 @@ def cmd_run(args) -> int:
         except ValueError as err:
             # covers both bad checkpoints (format/fingerprint/dtype) and
             # config-validity errors raised while rebuilding kernels
-            raise SystemExit(f"cannot resume from {args.resume}: {err}")
+            raise SystemExit(f"cannot resume from {args.resume}: {err}") from err
         if engine.config != cfg:
             logging.getLogger("flow_updating_tpu.cli").warning(
                 "--resume: checkpoint config %s overrides CLI flags %s",
@@ -339,7 +339,7 @@ def cmd_run(args) -> int:
         except (ValueError, NotImplementedError) as err:
             # NotImplementedError covers explicit unsupported-mode guards
             # (e.g. halo + contention) — a clean exit, not a traceback
-            raise SystemExit(f"invalid flag combination: {err}")
+            raise SystemExit(f"invalid flag combination: {err}") from err
     build_s = _time.perf_counter() - t_build0
 
     from flow_updating_tpu.utils.trace import trace
@@ -365,7 +365,7 @@ def cmd_run(args) -> int:
             try:
                 telemetry_series = engine.run_telemetry(n, telemetry_spec)
             except (ValueError, NotImplementedError) as err:
-                raise SystemExit(f"--telemetry: {err}")
+                raise SystemExit(f"--telemetry: {err}") from err
             if event_log and telemetry_series:
                 # the one obs emit path — same record shape as the
                 # streamed observers (contract-tested)
@@ -470,7 +470,7 @@ def _parse_churn(kill_spec, revive_spec, num_nodes: int, outer_steps: int):
         except ValueError as err:
             raise SystemExit(
                 f"--churn-{verb} {spec!r}: expected STEP:ID[,ID...] "
-                f"({err})")
+                f"({err})") from err
         if not 0 <= step < outer_steps:
             raise SystemExit(
                 f"--churn-{verb} {spec!r}: step {step} is outside the "
@@ -523,7 +523,7 @@ def cmd_train(args) -> int:
             dirichlet_alpha=args.dirichlet_alpha, seed=args.seed,
         )
     except ValueError as err:
-        raise SystemExit(f"invalid dataset flags: {err}")
+        raise SystemExit(f"invalid dataset flags: {err}") from err
     maker = (RoundConfig.reference if args.fire_policy == "reference"
              else RoundConfig.fast)
     try:
@@ -538,7 +538,7 @@ def cmd_train(args) -> int:
             feature_shards=args.feature_shards,
             rounds_per_visit=args.rounds_per_visit or None)
     except ValueError as err:
-        raise SystemExit(f"invalid flag combination: {err}")
+        raise SystemExit(f"invalid flag combination: {err}") from err
     churn = _parse_churn(args.churn_kill, args.churn_revive,
                          topo.num_nodes, args.outer_steps)
 
@@ -587,7 +587,7 @@ def _csv_list(text, cast, flag: str):
         vals = tuple(cast(v) for v in text.split(",") if v.strip())
     except ValueError:
         raise SystemExit(f"{flag} {text!r}: expected a comma list of "
-                         f"{cast.__name__} values")
+                         f"{cast.__name__} values") from None
     if not vals:
         raise SystemExit(f"{flag} {text!r}: no values")
     return vals
@@ -612,7 +612,7 @@ def cmd_sweep(args) -> int:
         try:
             topos.append((spec, topology_from_spec(spec, seed=args.seed)))
         except ValueError as err:
-            raise SystemExit(str(err))
+            raise SystemExit(str(err)) from err
 
     drop_rates = _csv_list(args.drop_rates, float, "--drop-rates")
     timeouts = _csv_list(args.timeouts, int, "--timeouts")
@@ -633,7 +633,7 @@ def cmd_sweep(args) -> int:
             need = int(np.ceil(max_d * ls_max))
             cfg = _dc.replace(cfg, delay_depth=max(cfg.delay_depth, need))
     except ValueError as err:
-        raise SystemExit(f"invalid flag combination: {err}")
+        raise SystemExit(f"invalid flag combination: {err}") from err
 
     seeds = [args.seed + i for i in range(max(1, args.seeds))]
     instances = grid_instances(topos, seeds=seeds, drop_rates=drop_rates,
@@ -644,7 +644,7 @@ def cmd_sweep(args) -> int:
         try:
             spec = TelemetrySpec.parse(args.telemetry)
         except ValueError as err:
-            raise SystemExit(f"--telemetry: {err}")
+            raise SystemExit(f"--telemetry: {err}") from err
     t0 = _time.perf_counter()
     try:
         records, summary = run_sweep(
@@ -654,7 +654,7 @@ def cmd_sweep(args) -> int:
             include_series=args.include_series,
             profile=args.profile)
     except ValueError as err:
-        raise SystemExit(f"invalid sweep configuration: {err}")
+        raise SystemExit(f"invalid sweep configuration: {err}") from err
     wall_s = _time.perf_counter() - t0
 
     out = dict(summary)
@@ -726,7 +726,7 @@ def _parse_service_events(lines):
                     "checkpoint)")
         except (IndexError, ValueError) as err:
             raise SystemExit(
-                f"events line {lineno}: cannot parse {line!r} ({err})")
+                f"events line {lineno}: cannot parse {line!r} ({err})") from err
     return out
 
 
@@ -746,7 +746,7 @@ def cmd_serve(args) -> int:
         try:
             svc = ServiceEngine.restore_checkpoint(args.resume)
         except ValueError as err:
-            raise SystemExit(f"serve: {err}")
+            raise SystemExit(f"serve: {err}") from err
         topo = None
     else:
         topo = _build_topology(args)
@@ -767,7 +767,7 @@ def cmd_serve(args) -> int:
                 config=cfg, segment_rounds=args.segment_rounds,
                 seed=args.seed)
         except ValueError as err:
-            raise SystemExit(f"invalid service configuration: {err}")
+            raise SystemExit(f"invalid service configuration: {err}") from err
 
     if args.events == "-":
         events = _parse_service_events(sys.stdin.readlines())
@@ -776,7 +776,7 @@ def cmd_serve(args) -> int:
             with open(args.events) as f:
                 events = _parse_service_events(f.readlines())
         except OSError as err:
-            raise SystemExit(f"serve: cannot read events: {err}")
+            raise SystemExit(f"serve: cannot read events: {err}") from err
     else:
         events = []
 
@@ -814,12 +814,12 @@ def cmd_serve(args) -> int:
             elif verb == "checkpoint":
                 svc.save_checkpoint(a[0])
         except (ValueError, RuntimeError) as err:
-            raise SystemExit(f"serve: events line {lineno}: {err}")
+            raise SystemExit(f"serve: events line {lineno}: {err}") from err
     if args.rounds:
         try:
             svc.run(args.rounds)
         except ValueError as err:
-            raise SystemExit(f"serve: {err}")
+            raise SystemExit(f"serve: {err}") from err
 
     report = svc.convergence_report()
     if args.checkpoint:
@@ -977,7 +977,7 @@ def _engine_from_args(args):
         engine.build(latency_scale=getattr(args, "latency_scale", 0.0),
                      seed=args.seed)
     except (ValueError, NotImplementedError) as err:
-        raise SystemExit(f"invalid flag combination: {err}")
+        raise SystemExit(f"invalid flag combination: {err}") from err
     return engine
 
 
@@ -991,7 +991,7 @@ def cmd_profile(args) -> int:
     try:
         prof = engine.profile(args.rounds, execute=not args.no_execute)
     except (ValueError, NotImplementedError) as err:
-        raise SystemExit(f"profile: {err}")
+        raise SystemExit(f"profile: {err}") from err
     if args.report:
         from flow_updating_tpu.obs.report import (
             build_profile_manifest,
@@ -1012,7 +1012,7 @@ def _load_inspect_manifest(path: str) -> dict:
         with open(path) as f:
             manifest = json.load(f)
     except (OSError, ValueError) as err:
-        raise SystemExit(f"inspect: cannot read {path}: {err}")
+        raise SystemExit(f"inspect: cannot read {path}: {err}") from err
     if not isinstance(manifest, dict):
         raise SystemExit(
             f"inspect: {path} is not a manifest (expected a JSON object "
@@ -1066,7 +1066,7 @@ def cmd_inspect(args) -> int:
         try:
             out = _inspect.diff_fields(sa, sb, atol=args.diff_atol)
         except ValueError as err:
-            raise SystemExit(f"inspect --diff: {err}")
+            raise SystemExit(f"inspect --diff: {err}") from err
         _emit_json({"a": a_path, "b": b_path, **out}, args.output)
         return 0
 
@@ -1080,7 +1080,7 @@ def cmd_inspect(args) -> int:
                 stride=args.field_stride, topk=args.field_topk,
                 tol=args.conv_tol)
         except ValueError as err:
-            raise SystemExit(f"--fields: {err}")
+            raise SystemExit(f"--fields: {err}") from err
         if not spec.enabled:
             raise SystemExit(
                 "--fields off records nothing to inspect; pick a field "
@@ -1094,7 +1094,7 @@ def cmd_inspect(args) -> int:
         try:
             series = engine.run_fields(args.rounds, spec)
         except (ValueError, NotImplementedError) as err:
-            raise SystemExit(f"inspect: {err}")
+            raise SystemExit(f"inspect: {err}") from err
         run_s = _time.perf_counter() - t0
         if args.report:
             from flow_updating_tpu.obs.report import (
@@ -1150,7 +1150,7 @@ def cmd_inspect(args) -> int:
                 except IndexError:
                     raise SystemExit(
                         f"inspect: --heatmap-round {args.heatmap_round} "
-                        f"outside the {len(series)} recorded rows")
+                        f"outside the {len(series)} recorded rows") from None
             if series.topk_idx is not None:
                 raise SystemExit(
                     "inspect: heatmaps need full field rows; this run "
@@ -1177,7 +1177,7 @@ def cmd_inspect(args) -> int:
         try:
             verdict = _inspect.blame_sweep(doc)
         except ValueError as err:
-            raise SystemExit(f"inspect: {path}: {err}")
+            raise SystemExit(f"inspect: {path}: {err}") from err
         out.append({"source": path, "sweep_blame": verdict})
     _emit_json(out[0] if len(out) == 1 else {"inspected": out},
                args.output)
@@ -1203,7 +1203,7 @@ def cmd_plan(args) -> int:
             max_lanes=args.max_lanes, min_fill=args.min_fill,
             remainder=args.remainder)
     except (ValueError, NotImplementedError) as err:
-        raise SystemExit(f"plan: {err}")
+        raise SystemExit(f"plan: {err}") from err
     doc = decision.describe()
     doc["nodes"] = topo.num_nodes
     doc["directed_edges"] = topo.num_edges
@@ -1272,7 +1272,7 @@ def cmd_scenarios(args) -> int:
             try:
                 get_scenario(n)
             except ValueError as err:
-                raise SystemExit(f"scenarios: {err}")
+                raise SystemExit(f"scenarios: {err}") from err
     _select_backend(args.backend)
     from flow_updating_tpu.obs import health
     from flow_updating_tpu.scenarios.run import (
@@ -1286,7 +1286,7 @@ def cmd_scenarios(args) -> int:
             names, seeds=seeds, perturb=args.perturb,
             max_batch=args.max_batch or None)
     except ValueError as err:
-        raise SystemExit(f"scenarios: {err}")
+        raise SystemExit(f"scenarios: {err}") from err
     manifest = scenario_manifest(records, summary,
                                  argv=getattr(args, "_argv", None))
     if args.report:
@@ -1323,7 +1323,7 @@ def cmd_doctor(args) -> int:
             with open(path) as f:
                 manifest = json.load(f)
         except (OSError, ValueError) as err:
-            raise SystemExit(f"doctor: cannot read {path}: {err}")
+            raise SystemExit(f"doctor: cannot read {path}: {err}") from err
         for c in health.diagnose_manifest(manifest):
             c.evidence.setdefault("source", path)
             checks.append(c)
@@ -1333,9 +1333,36 @@ def cmd_doctor(args) -> int:
                 data = json.load(f)
         except (OSError, ValueError) as err:
             raise SystemExit(
-                f"doctor: cannot read baselines {args.baselines}: {err}")
+                f"doctor: cannot read baselines {args.baselines}: {err}") from err
         c = health.check_baselines(data)
         c.evidence.setdefault("source", args.baselines)
+        checks.append(c)
+    # getattr: callers build Namespaces programmatically (tests, other
+    # drivers) and may predate the --golden flag
+    golden_path = getattr(args, "golden", None)
+    if golden_path is not None and (args.generator or args.deployment):
+        # the golden audit pins its own lowering environment (cpu, 8
+        # virtual devices, x64) BEFORE jax initializes — combining it
+        # with a live run would silently hijack the run's backend and
+        # numerics.  Two invocations, two environments.
+        raise SystemExit(
+            "doctor: --golden pins the cpu+x64 audit environment and "
+            "cannot share a process with a live run — run `doctor "
+            "--golden` and `doctor --generator ...` separately")
+    if golden_path is not None:
+        # program_conformance: the golden-program ledger audit as a
+        # doctor check (analysis/golden.py; same CPU pin as `audit`)
+        _pin_analysis_backend()
+        from flow_updating_tpu.analysis import golden
+
+        try:
+            ledger = golden.load_ledger(golden_path)
+        except (OSError, ValueError) as err:
+            raise SystemExit(
+                f"doctor: cannot read golden ledger {golden_path}: "
+                f"{err} — generate it with `audit --rebase`") from err
+        c = health.check_program_conformance(golden.audit(ledger))
+        c.evidence.setdefault("source", golden_path)
         checks.append(c)
     if args.generator or args.deployment:
         _select_backend(args.backend,
@@ -1348,7 +1375,7 @@ def cmd_doctor(args) -> int:
             series = engine.run_telemetry(args.rounds,
                                           TelemetrySpec.full())
         except (ValueError, NotImplementedError) as err:
-            raise SystemExit(f"doctor: {err}")
+            raise SystemExit(f"doctor: {err}") from err
         dtype = engine.config.dtype
         checks.extend(health.diagnose_series(
             series, threshold=args.rmse_threshold, dtype=dtype))
@@ -1364,8 +1391,8 @@ def cmd_doctor(args) -> int:
     if not checks:
         raise SystemExit(
             "doctor: nothing to judge — pass saved report paths, "
-            "--baselines, or a topology (--generator/--deployment) for "
-            "a live run")
+            "--baselines, --golden, or a topology (--generator/"
+            "--deployment) for a live run")
     print(json.dumps({"overall": health.overall(checks),
                       "checks": [c.to_jsonable() for c in checks]}))
     return health.exit_code(checks, strict=args.strict)
@@ -1382,7 +1409,7 @@ def cmd_regress(args) -> int:
             with open(path) as f:
                 return json.load(f)
         except (OSError, ValueError) as err:
-            raise SystemExit(f"regress: cannot read {path}: {err}")
+            raise SystemExit(f"regress: cannot read {path}: {err}") from err
 
     fresh = _load(args.fresh)
     against = _load(args.against) if args.against else None
@@ -1391,6 +1418,87 @@ def cmd_regress(args) -> int:
     print(json.dumps({"overall": health.overall(checks),
                       "checks": [c.to_jsonable() for c in checks]}))
     return health.exit_code(checks)
+
+
+def _pin_analysis_backend() -> None:
+    """lint/audit lower the kernel matrix on the CPU backend with 8
+    virtual devices and x64 enabled — the EXACT environment
+    tests/conftest.py pins, because the committed ledger is the
+    canonical-text table of that environment (x64 changes int widths in
+    the lowering, so it is part of the ledger's identity)."""
+    import jax
+
+    from flow_updating_tpu.utils.backend import pin_cpu
+
+    pin_cpu(n_virtual_devices=8)
+    jax.config.update("jax_enable_x64", True)
+
+
+def cmd_lint(args) -> int:
+    """``lint``: the repo-specific AST rules (analysis/flowlint.py) plus
+    the jaxpr rule engine over the standard kernel-program matrix
+    (analysis/rules.py).  Exit 1 on any finding, each cited as
+    ``file:line: rule: message`` / ``[program] rule at path:
+    message``."""
+    from flow_updating_tpu.analysis import flowlint
+
+    findings = []
+    paths = args.paths or None
+    ast_findings = flowlint.lint_paths(paths)
+    findings.extend(f.format() for f in ast_findings)
+    if not args.ast_only and not args.paths:
+        _pin_analysis_backend()
+        from flow_updating_tpu.analysis import rules
+
+        findings.extend(f.format() for f in rules.audit_kernels())
+    for line in findings:
+        print(line)
+    if findings:
+        print(f"lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("lint: clean")
+    return 0
+
+
+def cmd_audit(args) -> int:
+    """``audit``: re-lower every golden-program cell and diff against
+    the committed ledger (GOLDEN_PROGRAMS.json), naming the exact cell
+    and first divergent HLO line on drift; ``--rebase`` regenerates the
+    ledger after an INTENTIONAL lowering change (the diff review is the
+    sign-off).  Exit 1 on drift."""
+    from flow_updating_tpu.analysis import golden
+    from flow_updating_tpu.obs import health
+    from flow_updating_tpu.obs.report import (
+        build_audit_manifest,
+        write_report,
+    )
+
+    _pin_analysis_backend()
+    if args.rebase:
+        ledger = golden.build_ledger()
+        golden.save_ledger(ledger, args.ledger)
+        print(f"audit: rebased {len(ledger['cells'])} cells -> "
+              f"{args.ledger}")
+        if not args.report:
+            return 0
+        # --rebase --report: fall through and audit the fresh ledger so
+        # the requested manifest exists (it records the rebased state)
+    else:
+        try:
+            ledger = golden.load_ledger(args.ledger)
+        except (OSError, ValueError) as err:
+            raise SystemExit(
+                f"audit: cannot read ledger {args.ledger}: {err} — "
+                "generate it with `audit --rebase`") from err
+    report = golden.audit(ledger)
+    check = health.check_program_conformance(report)
+    if args.report:
+        write_report(args.report, build_audit_manifest(
+            argv=getattr(args, "_argv", None), audit=report,
+            ledger_path=args.ledger))
+    print(json.dumps({"overall": report["overall"],
+                      "check": check.to_jsonable()}))
+    return health.exit_code([check], strict=args.strict)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1892,6 +2000,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="audit recorded DES baselines against the "
                          "spread validity gate (default file: "
                          "BASELINE_MEASURED.json)")
+    dr.add_argument("--golden", nargs="?", const="GOLDEN_PROGRAMS.json",
+                    metavar="PATH",
+                    help="program_conformance: audit the golden-program "
+                         "ledger (default file: GOLDEN_PROGRAMS.json)")
     dr.add_argument("--strict", action="store_true",
                     help="warnings also exit 1")
     dr.set_defaults(fn=cmd_doctor)
@@ -1912,6 +2024,44 @@ def build_parser() -> argparse.ArgumentParser:
     rg.add_argument("--margin", type=float, default=None, metavar="PCT",
                     help="override the allowed drop/growth percentage")
     rg.set_defaults(fn=cmd_regress)
+
+    ln = sub.add_parser(
+        "lint",
+        help="repo-specific static analysis: AST rules ruff cannot "
+             "express (numpy in kernels, traced `if`, kernel "
+             "round_program coverage, bare PRNGKey, baseline key "
+             "families) + the jaxpr rule engine over every kernel's "
+             "round program (serializing scatters, fast-path gathers, "
+             "callbacks/collectives in the round scan, dtype drift, "
+             "PRNG key reuse); exit 1 on any finding "
+             "(flow_updating_tpu/analysis)")
+    ln.add_argument("paths", nargs="*", metavar="PATH",
+                    help="files to lint (default: the whole repo "
+                         "surface; an explicit list skips the jaxpr "
+                         "kernel matrix)")
+    ln.add_argument("--ast-only", action="store_true",
+                    help="skip the jaxpr rule engine (no jax import)")
+    ln.set_defaults(fn=cmd_lint)
+
+    au = sub.add_parser(
+        "audit",
+        help="golden-program conformance: re-lower every (mode x twin "
+             "x robust x adversary x payload) cell and diff against the "
+             "committed GOLDEN_PROGRAMS.json ledger, naming the exact "
+             "cell and first divergent HLO line on drift; exit 1 on "
+             "drift (flow_updating_tpu/analysis/golden.py)")
+    au.add_argument("--ledger", default="GOLDEN_PROGRAMS.json",
+                    metavar="PATH", help="ledger file location")
+    au.add_argument("--rebase", action="store_true",
+                    help="regenerate the ledger from the current "
+                         "lowerings (after an INTENTIONAL program "
+                         "change; review the diff)")
+    au.add_argument("--report", metavar="PATH",
+                    help="write a flow-updating-audit-report/v1 "
+                         "manifest (doctor judges it)")
+    au.add_argument("--strict", action="store_true",
+                    help="environment-mismatch warnings also exit 1")
+    au.set_defaults(fn=cmd_audit)
 
     return ap
 

@@ -18,7 +18,7 @@ seconds, the reference peers' loop cadence.
 from __future__ import annotations
 
 import logging
-from typing import Callable
+from collections.abc import Callable
 
 import numpy as np
 
@@ -75,7 +75,7 @@ class _NetzoneShim:
     never receive a peer (like the reference's ``observer``) simply don't
     join the gossip graph."""
 
-    def __init__(self, engine: "Engine"):
+    def __init__(self, engine: Engine):
         self._engine = engine
 
     def add_host(self, name: str, speed: float):
@@ -261,7 +261,7 @@ class Engine:
                     # "invalid literal" (ADVICE r5 #2)
                     raise ValueError(
                         f"--cfg={key}:{val}: not a valid "
-                        f"{ftype.__name__} value")
+                        f"{ftype.__name__} value") from None
             else:
                 overrides[key] = val.strip()
         return _dc.replace(cfg, **overrides) if overrides else cfg
@@ -271,11 +271,11 @@ class Engine:
     def clock(self) -> float:
         return self._clock
 
-    def load_platform(self, path: str) -> "Engine":
+    def load_platform(self, path: str) -> Engine:
         self.platform = load_platform(path)
         return self
 
-    def register_actor(self, name: str, fn=None) -> "Engine":
+    def register_actor(self, name: str, fn=None) -> Engine:
         """Register a deployable actor.
 
         ``fn=None`` selects the built-in gossip protocols (variant via
@@ -391,7 +391,7 @@ class Engine:
                 "pod" if self._pod_mode else
                 "node" if self._node_like else "edge")
 
-    def load_deployment(self, path: str, function: str | None = None) -> "Engine":
+    def load_deployment(self, path: str, function: str | None = None) -> Engine:
         if function is None and len(self._registered) == 1:
             function = next(iter(self._registered))
         self.deployment = load_deployment(path, function=function)
@@ -428,11 +428,11 @@ class Engine:
             des.spawn(spec.host, des.host(spec.host),
                       lambda _f=fn, _a=spec.args: _f(*_a)(), ())
 
-    def set_topology(self, topo: Topology) -> "Engine":
+    def set_topology(self, topo: Topology) -> Engine:
         self.topology = topo
         return self
 
-    def _resolve_topology(self, latency_scale: float = 0.0) -> "Engine":
+    def _resolve_topology(self, latency_scale: float = 0.0) -> Engine:
         if self.topology is None:
             if self.deployment is None:
                 raise RuntimeError("no deployment loaded and no topology set")
@@ -744,7 +744,7 @@ class Engine:
                 out["payload_schedule"] = {"error": str(exc)}
         return out
 
-    def build(self, latency_scale: float = 0.0, seed: int = 0) -> "Engine":
+    def build(self, latency_scale: float = 0.0, seed: int = 0) -> Engine:
         """Resolve deployment(+platform) into topology + fresh state."""
         self._resolve_topology(latency_scale)
         self._apply_plan()
@@ -773,7 +773,7 @@ class Engine:
         run_until: float = 1000.0,
         time_interval: float = 10.0,
         callback: Callable | None = None,
-    ) -> "Engine":
+    ) -> Engine:
         """The reference's watcher actor (``collectall.py:139-148``): sample
         global state every ``time_interval`` simulated seconds, and at
         ``run_until`` stop all peers ("kill_all").
@@ -917,7 +917,7 @@ class Engine:
                 ids.append(int(n))
         return np.asarray(ids, dtype=np.int32)
 
-    def kill_nodes(self, nodes) -> "Engine":
+    def kill_nodes(self, nodes) -> Engine:
         """Crash-stop the given nodes (ids or host names): they stop firing,
         sending and processing.  Delivered-but-undrained messages stay queued
         and are processed on revival — the protocol's idempotent state
@@ -934,7 +934,7 @@ class Engine:
             self.state, self._node_ids(nodes), False)
         return self
 
-    def revive_nodes(self, nodes) -> "Engine":
+    def revive_nodes(self, nodes) -> Engine:
         from flow_updating_tpu.service import membership
 
         self._require_edge_kernel("revive_nodes")
@@ -960,7 +960,7 @@ class Engine:
                 ids.append(e)
         return np.asarray(ids, dtype=np.int64)
 
-    def fail_links(self, links) -> "Engine":
+    def fail_links(self, links) -> Engine:
         """Fail the given undirected links (pairs of node ids or names):
         every message put on them is lost, in both directions, until
         :meth:`restore_links`.  Senders' ledgers still update — the exact
@@ -974,7 +974,7 @@ class Engine:
         )
         return self
 
-    def restore_links(self, links) -> "Engine":
+    def restore_links(self, links) -> Engine:
         self._require_edge_kernel("restore_links")
         if self.state is None:
             raise RuntimeError("engine not built")
@@ -985,7 +985,7 @@ class Engine:
         return self
 
     # ---- checkpoint / resume --------------------------------------------
-    def save_checkpoint(self, path: str) -> "Engine":
+    def save_checkpoint(self, path: str) -> Engine:
         """Write the full run state (one pytree) + config + topology
         fingerprint to ``path``.  The reference has no checkpointing
         (SURVEY.md §5); here it is a by-product of the array design."""
@@ -1032,7 +1032,7 @@ class Engine:
         )
         return self
 
-    def restore_checkpoint(self, path: str) -> "Engine":
+    def restore_checkpoint(self, path: str) -> Engine:
         """Resume from a checkpoint taken on the *same* topology (verified
         by content fingerprint).  Restores state, config and clock;
         ``build()`` is not required first.  Built-in kernels restore
@@ -1165,7 +1165,7 @@ class Engine:
                 self.state, self._topo_arrays, self.config, n
             )
 
-    def run_rounds(self, n: int) -> "Engine":
+    def run_rounds(self, n: int) -> Engine:
         if self.state is None:
             self.build()
         if not self._killed and n > 0:
@@ -1446,14 +1446,12 @@ class Engine:
         elif kind == "pod":
             fn, args, nd = self._node_kernel.round_program(self.state, n)
         elif kind == "node":
-            from flow_updating_tpu.models import sync
-
-            if not isinstance(self._node_kernel, sync.NodeKernel):
+            if not hasattr(self._node_kernel, "round_program"):
                 raise NotImplementedError(
                     f"cost attribution is not wired into "
-                    f"{type(self._node_kernel).__name__} yet — use the "
-                    "plain NodeKernel, the pod kernel, or the edge "
-                    "kernel")
+                    f"{type(self._node_kernel).__name__} yet — every "
+                    "built-in kernel exposes round_program (the "
+                    "kernel-round-program lint rule); add the hook")
             fn, args, nd = self._node_kernel.round_program(self.state, n)
         else:
             fn, args, nd = (run_rounds,
@@ -1539,7 +1537,7 @@ class Engine:
 
     def run_streamed(
         self, n: int, observe_every: int = 10, emit=None
-    ) -> "Engine":
+    ) -> Engine:
         """Run ``n`` rounds as ONE compiled computation, streaming watcher
         metrics to the host mid-run via ``jax.debug.callback`` (no host
         round-trips between sampling points, unlike :meth:`run_until`).
@@ -1590,7 +1588,7 @@ class Engine:
         self._clock += n * TICK_INTERVAL
         return self
 
-    def _host_run_until(self, t_end: float) -> "Engine":
+    def _host_run_until(self, t_end: float) -> Engine:
         """host_actors mode: drive the s4u DES (actors were spawned at
         ``load_deployment``; any extras via ``s4u.Actor.create``)."""
         des = self._host_des()
@@ -1598,7 +1596,7 @@ class Engine:
         self._clock = des.clock
         return self
 
-    def run_until(self, t_end: float) -> "Engine":
+    def run_until(self, t_end: float) -> Engine:
         """Advance simulated time to ``t_end``, honoring watchers: compiled
         chunks of rounds between sampling points, host callbacks at each
         sample, and a hard stop of peer execution at a watcher's ``until``

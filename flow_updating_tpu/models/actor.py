@@ -28,7 +28,8 @@ compiles to the same form the built-in gather kernel uses).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from collections.abc import Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -173,6 +174,14 @@ class ActorKernel:
     def run(self, carry, n: int):
         return self._run(carry, int(n))
 
+    def round_program(self, carry, num_rounds: int):
+        """``(jitted_fn, full_args, n_dynamic)`` for the actor's round
+        scan — the AOT cost-attribution + golden-ledger hook
+        (obs/profile.py, analysis/golden.py): the same jitted scan
+        :meth:`run` calls, so the profiled executable IS the plain
+        program."""
+        return (self._run, (carry, int(num_rounds)), 1)
+
     def run_streamed(self, carry, n: int, observe_every: int, emit):
         # streamed observation is a built-in-kernel optimization; custom
         # actors chunk between samples (same results, more dispatches).
@@ -227,7 +236,7 @@ def push_sum_actor() -> VectorActor:
         return ({"s": s * share, "w": w * share},
                 {"s": view.send(s * share), "w": view.send(w * share)})
 
-    def estimate(state, view: TopoView):
+    def estimate(state, view: TopoView):  # noqa: ARG001  # VectorActor protocol signature
         return state["s"] / state["w"]
 
     return VectorActor(init=init, round=round_, estimate=estimate,

@@ -94,9 +94,9 @@ class RoundParams:
     contention_scale: jnp.ndarray  # () float32
 
     @classmethod
-    def from_config(cls, cfg: "RoundConfig", drop_rate=None, timeout=None,
+    def from_config(cls, cfg: RoundConfig, drop_rate=None, timeout=None,
                     latency_scale=None,
-                    contention_scale=None) -> "RoundParams":
+                    contention_scale=None) -> RoundParams:
         """Params mirroring ``cfg``'s numeric knobs; any keyword
         overrides its field (the grid fan-out's per-point constructor)."""
         return cls(
@@ -113,7 +113,7 @@ class RoundParams:
                 jnp.float32),
         )
 
-    def without_drop(self) -> "RoundParams":
+    def without_drop(self) -> RoundParams:
         """Drop-free variant: the Bernoulli mask is omitted from the
         compiled program (valid only when the drop rate is 0)."""
         return self.replace(drop_rate=None)
@@ -418,7 +418,7 @@ class RoundConfig:
         return self.variant == PAIRWISE and self.fire_policy == "every_round"
 
     @classmethod
-    def reference(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
+    def reference(cls, variant: str = COLLECTALL, **kw) -> RoundConfig:
         """The faithful mode: reproduces the reference's asynchronous
         dynamics (1 msg/round drain, 50-round timeouts, depth-2 mailbox
         FIFO — tests/test_dynamics_parity.py shows rounds-to-RMSE curves
@@ -432,7 +432,7 @@ class RoundConfig:
         return cls(variant=variant, **kw)
 
     @classmethod
-    def fidelity(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
+    def fidelity(cls, variant: str = COLLECTALL, **kw) -> RoundConfig:
         """The measured-best network-fidelity preset: faithful dynamics +
         shared-link contention with the per-round max-min water-fill, and
         (pairwise only) in-flight backlog accounting.  These are the
@@ -447,7 +447,7 @@ class RoundConfig:
         return cls.reference(variant=variant, **kw)
 
     @classmethod
-    def fast(cls, variant: str = COLLECTALL, **kw) -> "RoundConfig":
+    def fast(cls, variant: str = COLLECTALL, **kw) -> RoundConfig:
         """The throughput mode: synchronous averaging every round."""
         kw.setdefault("fire_policy", "every_round")
         kw.setdefault("drain", 0)

@@ -150,24 +150,24 @@ class FieldSpec:
         return tuple(f for f in self.fields if f in EDGE_FIELDS)
 
     @classmethod
-    def off(cls) -> "FieldSpec":
+    def off(cls) -> FieldSpec:
         return cls(fields=())
 
     @classmethod
     def default(cls, stride: int = 1, topk: int = 0,
-                tol: float = 1e-6) -> "FieldSpec":
+                tol: float = 1e-6) -> FieldSpec:
         return cls(fields=DEFAULT_FIELDS, stride=stride, topk=topk,
                    tol=tol, strict=False)
 
     @classmethod
     def full(cls, stride: int = 1, topk: int = 0,
-             tol: float = 1e-6) -> "FieldSpec":
+             tol: float = 1e-6) -> FieldSpec:
         return cls(fields=ALL_FIELDS, stride=stride, topk=topk, tol=tol,
                    strict=False)
 
     @classmethod
     def parse(cls, text: str | None, stride: int = 1, topk: int = 0,
-              tol: float = 1e-6) -> "FieldSpec":
+              tol: float = 1e-6) -> FieldSpec:
         """CLI surface: ``off`` / ``default`` / ``full`` / ``f1,f2,...``.
         Unknown names fail loudly with the valid vocabulary (and a
         closest-match hint) — a typo must never silently record
@@ -188,7 +188,7 @@ class FieldSpec:
         return cls(fields=tuple(f for f in ALL_FIELDS if f in names),
                    stride=stride, topk=topk, tol=tol)
 
-    def for_kernel(self, kind: str) -> "FieldSpec":
+    def for_kernel(self, kind: str) -> FieldSpec:
         """Narrow to what ``kind`` can record (or raise, if strict), and
         validate the downsampling knobs against the mode."""
         try:
@@ -196,7 +196,7 @@ class FieldSpec:
         except KeyError:
             raise ValueError(
                 f"unknown kernel kind {kind!r}; have "
-                f"{sorted(SUPPORTED_FIELDS)}")
+                f"{sorted(SUPPORTED_FIELDS)}") from None
         missing = [f for f in self.fields if f not in sup]
         if missing and self.strict:
             raise ValueError(
@@ -243,7 +243,7 @@ class FieldSeries:
         self.coords = np.asarray(coords) if coords is not None else None
 
     @classmethod
-    def empty(cls) -> "FieldSeries":
+    def empty(cls) -> FieldSeries:
         return cls()
 
     def __len__(self) -> int:
@@ -368,7 +368,7 @@ class FieldSeries:
         return block
 
     @classmethod
-    def from_jsonable(cls, block: dict) -> "FieldSeries":
+    def from_jsonable(cls, block: dict) -> FieldSeries:
         """Rebuild from a manifest ``fields`` block (inspect / doctor
         offline paths)."""
         sp = block.get("spec") or {}

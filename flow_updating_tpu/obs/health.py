@@ -990,6 +990,47 @@ def attach_field_culprits(checks, fields_block: dict) -> None:
             c.evidence["culprits"] = culprits
 
 
+def check_program_conformance(audit_report: dict) -> CheckResult:
+    """Judge a golden-program audit report
+    (:func:`flow_updating_tpu.analysis.golden.audit` output, or the
+    ``golden`` block of a ``flow-updating-audit-report/v1`` manifest):
+    FAIL names every drifted/missing cell and the first divergent HLO
+    line; an environment mismatch (different jax version/backend than
+    the ledger was lowered under) is a WARN naming the fix, never a
+    false drift verdict."""
+    name = "program_conformance"
+    if not isinstance(audit_report, dict) or "overall" not in audit_report:
+        return CheckResult(
+            name, SKIP,
+            "no golden audit report — run `python -m flow_updating_tpu "
+            "audit --report PATH`")
+    overall_ = audit_report.get("overall")
+    if overall_ == "env-mismatch":
+        return CheckResult(
+            name, WARN, audit_report.get("reason",
+                                         "lowering environment mismatch"),
+            {"environment": audit_report.get("environment")})
+    cells = audit_report.get("cells") or []
+    n = len(cells)
+    if overall_ == "pass":
+        return CheckResult(
+            name, PASS,
+            f"all {n} golden-program cells lower bit-identically",
+            {"cells": n})
+    bad = [r for r in cells if r.get("status") != "match"]
+    detail = "; ".join(
+        f"{r.get('cell')}: {r.get('status')}"
+        + (f" @ HLO line {r['first_divergence'].get('line')}"
+           if r.get("first_divergence") else "")
+        for r in bad[:5])
+    return CheckResult(
+        name, FAIL,
+        f"{len(bad)}/{n} golden-program cells drifted — {detail}"
+        + (" ..." if len(bad) > 5 else ""),
+        {"drifted": [r.get("cell") for r in bad],
+         "details": bad[:10]})
+
+
 def diagnose_manifest(manifest: dict) -> list:
     """Judge a saved ``flow-updating-*-report/v1`` manifest: the
     environment block, the final convergence report, and — when the run
@@ -1015,6 +1056,11 @@ def diagnose_manifest(manifest: dict) -> list:
         # signatures only; the healthy-run series rules would flag the
         # planted faults as defects (they are the point)
         checks.extend(check_scenario_conformance(manifest))
+        return checks
+    if isinstance(manifest.get("golden"), dict):
+        # a flow-updating-audit-report/v1 manifest (`audit --report`):
+        # the golden-program conformance verdict is the whole point
+        checks.append(check_program_conformance(manifest["golden"]))
         return checks
     report = manifest.get("report")
     if isinstance(report, dict):

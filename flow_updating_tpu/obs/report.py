@@ -33,6 +33,7 @@ FIELD_SCHEMA = "flow-updating-field-report/v1"
 PLAN_SCHEMA = "flow-updating-plan-report/v1"
 SERVICE_SCHEMA = "flow-updating-service-report/v1"
 SCENARIO_SCHEMA = "flow-updating-scenario-report/v1"
+AUDIT_SCHEMA = "flow-updating-audit-report/v1"
 
 
 def environment_info() -> dict:
@@ -291,6 +292,29 @@ def build_scenario_manifest(*, argv=None, scenarios=None, summary=None,
         "summary": dict(summary) if summary else None,
         "timings": dict(timings) if timings else None,
         "scenarios": list(scenarios) if scenarios is not None else [],
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_audit_manifest(*, argv=None, audit=None, ledger_path=None,
+                         lint=None, extra=None) -> dict:
+    """Assemble the program-conformance v1 manifest: the standard
+    argv/environment binding around a golden-ledger audit report
+    (:func:`flow_updating_tpu.analysis.golden.audit` output, under
+    ``golden``) and optionally the lint findings that ran alongside it
+    (``lint``: list of formatted finding strings).  The doctor judges
+    the ``golden`` block via
+    ``obs.health.check_program_conformance``."""
+    manifest = {
+        "schema": AUDIT_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "environment": environment_info(),
+        "ledger": ledger_path,
+        "golden": dict(audit) if audit is not None else None,
+        "lint": list(lint) if lint is not None else None,
     }
     if extra:
         manifest.update(extra)

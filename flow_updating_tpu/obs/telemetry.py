@@ -85,19 +85,19 @@ class TelemetrySpec:
         return name in self.metrics
 
     @classmethod
-    def off(cls) -> "TelemetrySpec":
+    def off(cls) -> TelemetrySpec:
         return cls(metrics=())
 
     @classmethod
-    def default(cls) -> "TelemetrySpec":
+    def default(cls) -> TelemetrySpec:
         return cls(metrics=DEFAULT_METRICS, strict=False)
 
     @classmethod
-    def full(cls) -> "TelemetrySpec":
+    def full(cls) -> TelemetrySpec:
         return cls(metrics=ALL_METRICS, strict=False)
 
     @classmethod
-    def parse(cls, text: str | None) -> "TelemetrySpec":
+    def parse(cls, text: str | None) -> TelemetrySpec:
         """CLI surface: ``off`` / ``default`` / ``full`` / ``m1,m2,...``."""
         if text is None or text in ("", "off", "none"):
             return cls.off()
@@ -120,14 +120,14 @@ class TelemetrySpec:
         # canonical order regardless of user order — stable jit keys
         return cls(metrics=tuple(m for m in ALL_METRICS if m in names))
 
-    def for_kernel(self, kind: str) -> "TelemetrySpec":
+    def for_kernel(self, kind: str) -> TelemetrySpec:
         """Narrow to the metrics ``kind`` supports (or raise, if strict)."""
         try:
             sup = SUPPORTED_METRICS[kind]
         except KeyError:
             raise ValueError(
                 f"unknown kernel kind {kind!r}; have "
-                f"{sorted(SUPPORTED_METRICS)}")
+                f"{sorted(SUPPORTED_METRICS)}") from None
         missing = [m for m in self.metrics if m not in sup]
         if missing and self.strict:
             raise ValueError(
@@ -149,7 +149,7 @@ class TelemetrySeries:
             raise ValueError("telemetry series needs the 't' round axis")
 
     @classmethod
-    def empty(cls) -> "TelemetrySeries":
+    def empty(cls) -> TelemetrySeries:
         return cls({})
 
     def __len__(self) -> int:

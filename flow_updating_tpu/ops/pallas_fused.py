@@ -308,7 +308,7 @@ def _local_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
     # batch axis innermost: consecutive grid steps share the mask
     # block index, so the pipeline skips its re-fetch across lanes
     own = lambda i, b: (b, i, 0)
-    mown = lambda i, b: (i, 0)
+    mown = lambda i, _b: (i, 0)
     return pl.pallas_call(
         kern,
         grid=(fused.grid, x3.shape[0]),
@@ -338,8 +338,8 @@ def _window_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
 
     prev = lambda i, b: (b, jnp.maximum(i - 1, 0), 0)
     own = lambda i, b: (b, i, 0)
-    mprev = lambda i, b: (jnp.maximum(i - 1, 0), 0)
-    mown = lambda i, b: (i, 0)
+    mprev = lambda i, _b: (jnp.maximum(i - 1, 0), 0)
+    mown = lambda i, _b: (i, 0)
     return pl.pallas_call(
         kern,
         grid=(fused.grid, x3.shape[0]),
@@ -371,7 +371,7 @@ def _wide_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
         # never mask-selected, so clamping at 0 is safe
         partner = lambda i, b: (b, jnp.maximum(i - D, 0), 0)
     own = lambda i, b: (b, i, 0)
-    mown = lambda i, b: (i, 0)
+    mown = lambda i, _b: (i, 0)
     return pl.pallas_call(
         kern,
         grid=(fused.grid, x3.shape[0]),
@@ -411,12 +411,12 @@ def _wide2_pass(x3, mask_plane, ps: PassSpec, fused: FusedPlan,
 
     if ps.kind == "wide_swap2":
         at = lambda D: (lambda i, b, D=D: (b, i ^ D, 0))
-        mat = lambda i, b: (i ^ D2, 0)
+        mat = lambda i, _b: (i ^ D2, 0)
     else:
         at = lambda D: (lambda i, b, D=D: (b, jnp.maximum(i - D, 0), 0))
-        mat = lambda i, b: (jnp.maximum(i - D2, 0), 0)
+        mat = lambda i, _b: (jnp.maximum(i - D2, 0), 0)
     own = lambda i, b: (b, i, 0)
-    mown = lambda i, b: (i, 0)
+    mown = lambda i, _b: (i, 0)
     return pl.pallas_call(
         kern,
         grid=(fused.grid, x3.shape[0]),
@@ -545,8 +545,8 @@ def _dist_window_call(kern, x, dist_plane, geom: Geometry, interpret: bool):
     d2 = dist_plane.reshape(geom.rows, LANE)
     prev = lambda i, b: (b, jnp.maximum(i - 1, 0), 0)
     own = lambda i, b: (b, i, 0)
-    mprev = lambda i, b: (jnp.maximum(i - 1, 0), 0)
-    mown = lambda i, b: (i, 0)
+    mprev = lambda i, _b: (jnp.maximum(i - 1, 0), 0)
+    mown = lambda i, _b: (i, 0)
     out = pl.pallas_call(
         kern,
         grid=(geom.grid, x3.shape[0]),

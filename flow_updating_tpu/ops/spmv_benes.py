@@ -141,7 +141,7 @@ def _disk_path(key0):
         d, f"ns_v{_PLANNER_VERSION}_{digest[:20]}_m{m1}.npz")
 
 
-def _disk_save(key0, plan: "NeighborSumPlan") -> None:
+def _disk_save(key0, plan: NeighborSumPlan) -> None:
     path = _disk_path(key0)
     if path is None:
         return

@@ -104,7 +104,7 @@ def chunked_feature_specs(cs: ChunkedState):
     control window replicates.  The window's payload planes are scratch
     (overwritten every visit) — :func:`run_chunked_feature` zeroes them
     on exit so the returned state is deterministic and replicated."""
-    window = jax.tree.map(lambda x: P(), cs.state)
+    window = jax.tree.map(lambda _: P(), cs.state)
     specs = {f: P(FEATURE_AXIS) for f in _CHUNK_LEAVES}
     return cs.replace(state=window, **specs)
 
@@ -139,7 +139,7 @@ def run_rounds_feature(
             "robust='trim' is scalar-only (control-plane estimate marks); "
             "vector payloads use robust='clip'")
     specs = state_feature_specs(state)
-    arrays_specs = jax.tree.map(lambda x: P(), topo)
+    arrays_specs = jax.tree.map(lambda _: P(), topo)
 
     def body(st, ta):
         return run_rounds(st, ta, cfg, num_rounds, params=params)
@@ -181,7 +181,7 @@ def run_chunked_feature(
             f"num_rounds={num_rounds} must be a multiple of the LOCAL "
             f"pass length (n_chunks/S_f)*rounds_per_visit = {local_pass}")
     specs = chunked_feature_specs(cs)
-    arrays_specs = jax.tree.map(lambda x: P(), topo)
+    arrays_specs = jax.tree.map(lambda _: P(), topo)
 
     def body(c, ta):
         out = run_rounds_chunked(c, ta, cfg, num_rounds,
@@ -242,7 +242,7 @@ def global_average_feature(state: FlowUpdatingState, topo,
     of a host gather/scatter round-trip."""
     check_feature_mesh(mesh)
     specs = state_feature_specs(state)
-    arrays_specs = jax.tree.map(lambda x: P(), topo)
+    arrays_specs = jax.tree.map(lambda _: P(), topo)
     node_axis = (NODE_AXIS in mesh.axis_names
                  and int(mesh.shape[NODE_AXIS]) > 1)
 

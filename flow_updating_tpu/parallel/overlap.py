@@ -338,7 +338,7 @@ def _msg_payloads(st, pl, ov, cfg, Eb, perm, offsets, compact: bool):
     return payloads
 
 
-def _fastpair_payloads(st, ov, pl, Eb, offsets):
+def _fastpair_payloads(st, ov, pl, Eb, offsets):  # noqa: ARG001  # pl: signature parity with the message-mode payload builder
     """Per-offset wire blocks for fast synchronous pairwise: the
     frontier rows' current estimates + sender-side validity."""
     ge = jnp.minimum(ov.f_edges, Eb - 1)
@@ -380,8 +380,8 @@ def _start_exchange(payloads, offsets, S, wire):
 
 # ---- the overlap round bodies -------------------------------------------
 
-def local_round_overlap(st, pl, halo, perm, ov, cfg, Eb: int, S: int,
-                        offsets, halo_mode: str):
+def local_round_overlap(st, pl, halo, perm, ov, cfg,  # noqa: ARG001  # halo: drop-in signature of sharded._local_round
+                        Eb: int, S: int, offsets, halo_mode: str):
     """One split-schedule round on one shard's block (message modes).
     Drop-in replacement for ``sharded._local_round`` — same return
     contract, bit-identical state evolution for ``halo='overlap'``."""
@@ -448,8 +448,8 @@ def local_round_overlap(st, pl, halo, perm, ov, cfg, Eb: int, S: int,
     return st, processed, send_mask
 
 
-def local_round_overlap_fastpair(st, pl, halo, perm, ov, cfg, Eb: int,
-                                 S: int, offsets, halo_mode: str,
+def local_round_overlap_fastpair(st, pl, halo, perm, ov, cfg,  # noqa: ARG001  # halo/cfg: drop-in signature of _local_round_fastpair
+                                 Eb: int, S: int, offsets, halo_mode: str,
                                  num_colors: int):
     """Split-schedule round for fast synchronous pairwise: the cut
     endpoints' estimates go on the wire first, the bulk est/partner

@@ -613,7 +613,8 @@ def _local_round(st: FlowUpdatingState, pl: PlanArrays, halo: HaloTables,
 
 def _local_round_fastpair(st: FlowUpdatingState, pl: PlanArrays,
                           halo: HaloTables, perm: PermTables,
-                          cfg: RoundConfig, Eb: int, S: int, offsets: tuple,
+                          cfg: RoundConfig,  # noqa: ARG001  # cfg: signature parity with _local_round (dispatch table)
+                          Eb: int, S: int, offsets: tuple,
                           halo_mode: str, num_colors: int):
     """One fast-synchronous-pairwise round on one shard's block.
 
@@ -734,7 +735,7 @@ def _run_sharded(state, arrays, halo, perm, ov, cfg, mesh, num_rounds, Eb,
                  offsets, halo_mode, num_colors=0):
     state_specs = _state_specs(state, mesh)
     plan_specs = jax.tree.map(_spec, arrays)
-    halo_specs = jax.tree.map(lambda x: P(), halo)
+    halo_specs = jax.tree.map(lambda _: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
     ov_specs = jax.tree.map(_spec, ov)
     S = int(mesh.shape[NODE_AXIS])  # node-axis size (2-D mesh aware)
@@ -903,7 +904,7 @@ def _run_sharded_telemetry(state, arrays, halo, perm, ov, mean, cfg, mesh,
             "chunked-schedule telemetry (models/rounds.py)")
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
-    halo_specs = jax.tree.map(lambda x: P(), halo)
+    halo_specs = jax.tree.map(lambda _: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
     ov_specs = jax.tree.map(_spec, ov)
     S = int(mesh.shape[NODE_AXIS])  # node-axis size (2-D mesh aware)
@@ -1023,7 +1024,7 @@ def _run_sharded_fields(state, arrays, halo, perm, ov, mean, cfg, mesh,
             "psum); run fields on a 1-D node mesh")
     state_specs = jax.tree.map(_spec, state)
     plan_specs = jax.tree.map(_spec, arrays)
-    halo_specs = jax.tree.map(lambda x: P(), halo)
+    halo_specs = jax.tree.map(lambda _: P(), halo)
     perm_specs = jax.tree.map(_spec, perm)
     ov_specs = jax.tree.map(_spec, ov)
     S = int(mesh.shape[NODE_AXIS])  # node-axis size (2-D mesh aware)

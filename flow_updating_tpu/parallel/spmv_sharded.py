@@ -244,6 +244,14 @@ class ShardedNodeKernel:
         return _run_sharded(state, self.arrays, self.cfg, self.mesh,
                             num_rounds)
 
+    def round_program(self, state, num_rounds: int):
+        """``(jitted_fn, full_args, n_dynamic)`` for the plain sharded
+        round scan — the AOT cost-attribution + golden-ledger hook
+        (obs/profile.py, analysis/golden.py); exactly what :meth:`run`
+        dispatches, so the profiled executable IS the plain program."""
+        return (_run_sharded,
+                (state, self.arrays, self.cfg, self.mesh, num_rounds), 2)
+
     def _uninterleave(self, x_l: np.ndarray) -> np.ndarray:
         """(S, M/S) local-layout array -> (M,) global padded order."""
         plan = self._plan
@@ -333,7 +341,8 @@ def _interleave_global(gathered, plan: _ShardedPlan):
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "mesh", "num_rounds"))
-def _run_sharded(state, arrays: ShardedSpmvArrays, cfg: RoundConfig,
+def _run_sharded(state, arrays: ShardedSpmvArrays,
+                 cfg: RoundConfig,  # noqa: ARG001  # cfg: jit static argname — a cache key, not body data
                  mesh, num_rounds: int):
     plan = arrays.plan
 

@@ -443,7 +443,7 @@ def _pod_telemetry_sample(s: PodState, value, spec, mean, n: int,
 
 
 def _pod_field_sample(s: PodState, value, spec, mean, n: int,
-                      axis_name: str):
+                      axis_name: str):  # noqa: ARG001  # sampler signature parity (halo twin psums over it)
     """One recorded per-node field row across the sections, kept in
     section layout (the host flattens).  The fat-tree tiles exactly (no
     padding, no churn on this kernel), so no alive masking is needed; in

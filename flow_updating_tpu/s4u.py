@@ -44,7 +44,7 @@ import threading
 logger = logging.getLogger("flow_updating_tpu")
 
 _TLS = threading.local()          # _TLS.ctx = running _ActorCtx
-_CURRENT_DES: "HostDes | None" = None
+_CURRENT_DES: HostDes | None = None
 
 
 class ActorKilled(BaseException):
@@ -61,7 +61,7 @@ class CancelException(Exception):
     — the reference's quirk at ``collectall.py:78``."""
 
 
-def _des() -> "HostDes":
+def _des() -> HostDes:
     if _CURRENT_DES is None:
         raise RuntimeError(
             "no host actor runtime is active — construct "
@@ -69,7 +69,7 @@ def _des() -> "HostDes":
     return _CURRENT_DES
 
 
-def _ctx() -> "_ActorCtx":
+def _ctx() -> _ActorCtx:
     ctx = getattr(_TLS, "ctx", None)
     if ctx is None:
         raise RuntimeError(
@@ -78,7 +78,7 @@ def _ctx() -> "_ActorCtx":
 
 
 class _ActorCtx:
-    def __init__(self, des: "HostDes", name: str, host: "Host", fn, args):
+    def __init__(self, des: HostDes, name: str, host: Host, fn, args):
         self.des = des
         self.name = name
         self.host = host
@@ -132,7 +132,7 @@ class Host:
         return f"Host({self.name!r})"
 
     @staticmethod
-    def by_name(name: str) -> "Host":
+    def by_name(name: str) -> Host:
         return _des().host(name)
 
 
@@ -140,7 +140,7 @@ class Comm:
     """Future for one asynchronous put/get (reference contact:
     ``collectall.py:74-79,123-125``)."""
 
-    def __init__(self, des: "HostDes", kind: str):
+    def __init__(self, des: HostDes, kind: str):
         self.des = des
         self.kind = kind              # 'send' | 'recv'
         self.payload = None
@@ -151,7 +151,7 @@ class Comm:
     def test(self) -> bool:
         return self.finished
 
-    def wait(self) -> "Comm":
+    def wait(self) -> Comm:
         ctx = _ctx()
         while not self.finished and not self.cancelled:
             self._waiter = ctx
@@ -194,14 +194,14 @@ class Comm:
 class Mailbox:
     """Named rendezvous point (SURVEY.md N4)."""
 
-    def __init__(self, des: "HostDes", name: str):
+    def __init__(self, des: HostDes, name: str):
         self.des = des
         self.name = name
         self._pending_puts: list = []   # (send_comm, payload, size, src_ctx)
         self._pending_gets: list = []   # recv Comm
 
     @staticmethod
-    def by_name(name: str) -> "Mailbox":
+    def by_name(name: str) -> Mailbox:
         return _des().mailbox(name)
 
     def _pop_live_get(self) -> Comm | None:
@@ -290,7 +290,7 @@ class Actor:
     """``Actor.create`` / ``Actor.kill_all`` (``collectall.py:162,145``)."""
 
     @staticmethod
-    def create(name: str, host: Host, fn, *args) -> "_ActorCtx":
+    def create(name: str, host: Host, fn, *args) -> _ActorCtx:
         return _des().spawn(name, host, fn, args)
 
     @staticmethod

@@ -342,7 +342,7 @@ class ServiceEngine:
         self._log("join", node=int(slot))
         return int(slot)
 
-    def leave(self, ids) -> "ServiceEngine":
+    def leave(self, ids) -> ServiceEngine:
         """Graceful departure: detach every incident edge pair (both
         ledger directions zeroed, in-flight on those slots invalidated),
         then free the slot (dead, value 0).  Each neighbor's estimate
@@ -379,7 +379,7 @@ class ServiceEngine:
             self._log("leave", node=int(i))
         return self
 
-    def update(self, ids, values) -> "ServiceEngine":
+    def update(self, ids, values) -> ServiceEngine:
         """Overwrite members' input values (the protocol tracks dynamic
         inputs natively: estimates shift by the same delta as values, so
         the mass residual is unchanged bit-exactly)."""
@@ -399,7 +399,7 @@ class ServiceEngine:
             self._log("update", node=int(i))
         return self
 
-    def suspend(self, ids) -> "ServiceEngine":
+    def suspend(self, ids) -> ServiceEngine:
         """Temporary failure (the paper's crash churn): alive mask off,
         ledgers intact — :func:`membership.set_alive`.  A suspended node
         keeps its slot; :meth:`resume` revives it in place."""
@@ -409,7 +409,7 @@ class ServiceEngine:
             self._log("suspend", node=int(i))
         return self
 
-    def resume(self, ids) -> "ServiceEngine":
+    def resume(self, ids) -> ServiceEngine:
         ids = self._check_member(ids, "resume")
         self.state = membership.set_alive(self.state, ids, True)
         for i in ids:
@@ -417,7 +417,7 @@ class ServiceEngine:
         return self
 
     # ---- edge events -----------------------------------------------------
-    def add_edges(self, pairs) -> "ServiceEngine":
+    def add_edges(self, pairs) -> ServiceEngine:
         """Add undirected member edges: each (u, v) claims two free edge
         slots and one free row-matrix column at each endpoint.  The
         whole batch is validated first, then applied as one device edit
@@ -508,7 +508,7 @@ class ServiceEngine:
             edge_ok=self.state.edge_ok.at[ei].set(True))
         return self
 
-    def remove_edges(self, pairs) -> "ServiceEngine":
+    def remove_edges(self, pairs) -> ServiceEngine:
         """Remove undirected member edges (ledger pair zeroed — mass-
         neutral up to the pair's antisymmetry deficit, see :meth:`leave`).
         Validated as a batch before anything is applied."""
@@ -805,7 +805,7 @@ class ServiceEngine:
         return {k: [s[k] for s in self._samples] for k in keys}
 
     # ---- durability ------------------------------------------------------
-    def save_checkpoint(self, path: str) -> "ServiceEngine":
+    def save_checkpoint(self, path: str) -> ServiceEngine:
         """Write the full service state — protocol state, dynamic
         topology leaves, free lists, epoch counters — as one versioned
         archive (utils/checkpoint.py, ``service-checkpoint`` schema).
@@ -836,7 +836,7 @@ class ServiceEngine:
         return self
 
     @classmethod
-    def restore_checkpoint(cls, path: str) -> "ServiceEngine":
+    def restore_checkpoint(cls, path: str) -> ServiceEngine:
         """Rebuild a service from :meth:`save_checkpoint`'s archive —
         same capacity, same membership, bit-exact state."""
         from flow_updating_tpu.utils.checkpoint import (

@@ -319,7 +319,7 @@ def topology_from_spec(spec: str, seed: int = 0) -> Topology:
         params = [int(p) if p.lstrip("-").isdigit() else float(p)
                   for p in parts[1:]]
     except ValueError:
-        raise ValueError(f"bad generator parameters in {spec!r}")
+        raise ValueError(f"bad generator parameters in {spec!r}") from None
     return GENERATORS[name](*params, seed=seed)
 
 

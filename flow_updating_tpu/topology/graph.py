@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Mapping, Sequence
+from collections.abc import Mapping, Sequence
 
 import numpy as np
 
@@ -222,7 +222,7 @@ class Topology:
         object.__setattr__(self, "_edge_coloring", (full, c))
         return full, c
 
-    def ell_buckets(self) -> "EllBuckets":
+    def ell_buckets(self) -> EllBuckets:
         """Degree-bucketed ELL adjacency for scatter-free neighbor sums.
 
         Nodes are permuted into ascending-degree order and grouped into
@@ -395,7 +395,7 @@ class Topology:
             **link,
         )
 
-    def with_values(self, values: np.ndarray) -> "Topology":
+    def with_values(self, values: np.ndarray) -> Topology:
         values = np.asarray(values, dtype=np.float64)
         if values.ndim not in (1, 2) or values.shape[0] != self.num_nodes:
             raise ValueError(

@@ -17,9 +17,10 @@ scope and rejected loudly rather than silently misparsed.
 from __future__ import annotations
 
 import dataclasses
+import math
 import re
 import xml.etree.ElementTree as ET
-from typing import Mapping
+from collections.abc import Mapping
 
 import numpy as np
 
@@ -94,7 +95,7 @@ class Platform:
     def host_names(self) -> tuple:
         return tuple(self.hosts.keys())
 
-    def add_host(self, name: str, speed: float) -> "Platform":
+    def add_host(self, name: str, speed: float) -> Platform:
         """Programmatic host creation — the analogue of the reference's
         ``e.netzone_root.add_host("observer", 25e6)``
         (``flowupdating-collectall.py:159``)."""
@@ -112,7 +113,8 @@ class Platform:
         r = self.route(src, dst)
         return r.latency(self.links) if r is not None else default
 
-    def route_bandwidth(self, src: str, dst: str, default: float = float("inf")) -> float:
+    def route_bandwidth(self, src: str, dst: str,
+                        default: float = math.inf) -> float:
         r = self.route(src, dst)
         return r.bandwidth(self.links) if r is not None else default
 

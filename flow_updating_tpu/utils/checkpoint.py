@@ -91,12 +91,12 @@ def _open_archive(path: str):
     try:
         return np.load(path)
     except FileNotFoundError:
-        raise ValueError(f"checkpoint {path}: no such file")
+        raise ValueError(f"checkpoint {path}: no such file") from None
     except (zipfile.BadZipFile, EOFError, OSError, ValueError) as exc:
         raise ValueError(
             f"checkpoint {path}: not a readable checkpoint archive "
             f"({type(exc).__name__}: {exc}) — the file is truncated, "
-            "still being written, or not a checkpoint at all")
+            "still being written, or not a checkpoint at all") from exc
 
 
 def _read_manifest(z, path: str) -> dict:
@@ -110,7 +110,7 @@ def _read_manifest(z, path: str) -> dict:
     except (ValueError, UnicodeDecodeError) as exc:
         raise ValueError(
             f"checkpoint {path}: manifest is corrupt "
-            f"({type(exc).__name__}: {exc})")
+            f"({type(exc).__name__}: {exc})") from exc
     got = manifest.get("format_version")
     if got != FORMAT_VERSION:
         raise ValueError(

@@ -143,7 +143,7 @@ def _outer_step_feature(state, arrays, X, y, rcfg: RoundConfig,
     from flow_updating_tpu.parallel.mesh import NODE_AXIS, shard_map
 
     specs = _F.state_feature_specs(state)
-    aspec = jax.tree.map(lambda x: P(), arrays)
+    aspec = jax.tree.map(lambda _: P(), arrays)
     xspec = P(None, None, _F.FEATURE_AXIS)
     node_axis = (NODE_AXIS in mesh.axis_names
                  and int(mesh.shape[NODE_AXIS]) > 1)
@@ -310,7 +310,7 @@ def train_grid(topo, datasets, periods, cfg: GossipSGDConfig,
                             task)
     reports = []
     for i, (d, h) in enumerate(lanes):
-        st = jax.tree.map(lambda x: x[i], states)
+        st = jax.tree.map(lambda x, i=i: x[i], states)
         w = np.asarray(node_estimates(st, arrays))
         alive = np.asarray(st.alive)
         w_mean = w[alive].mean(axis=0) if alive.any() else w.mean(axis=0)
@@ -342,7 +342,7 @@ class GossipSGDTrainer:
     """
 
     def __init__(self, topo, data: NodeDataset,
-                 cfg: GossipSGDConfig = GossipSGDConfig(),
+                 cfg: GossipSGDConfig | None = None,
                  round_cfg: RoundConfig | None = None,
                  w0: np.ndarray | None = None,
                  chunk: int = 0,
@@ -360,6 +360,7 @@ class GossipSGDTrainer:
                 "it drives the edge kernel (kernel='edge')")
         self.topo = topo
         self.data = data
+        cfg = cfg if cfg is not None else GossipSGDConfig()
         self.cfg = cfg
         self.round_cfg = round_cfg
         self.arrays = topo.device_arrays(

@@ -114,8 +114,15 @@ def main() -> int:
               "threshold_pct": args.threshold}
     failures = []
 
-    # 1. program identity: off-path state == plain kernel state
+    # 1. program identity: off-path state == plain kernel state, AND
+    # the plain round program lowers byte-identically before/after the
+    # telemetry dispatch exists — via the one canonicalizer every
+    # program-identity assert routes through (analysis/golden.py)
+    from flow_updating_tpu.analysis import golden
+
     kern = sync.NodeKernel(topo, cfg)
+    fn, fargs, _nd = kern.round_program(kern.init_state(), 8)
+    text_before = golden.canonical_program(fn, *fargs)
     plain_out = kern.run(kern.init_state(), 8)
     eng = Engine(config=cfg).set_topology(topo).build()
     eng.run_telemetry(8, TelemetrySpec.off())
@@ -123,6 +130,10 @@ def main() -> int:
                           np.asarray(eng.state.G)):
         failures.append("telemetry-off state diverges from the plain "
                         "kernel (the off path must be the SAME program)")
+    if golden.canonical_program(fn, *fargs) != text_before:
+        failures.append("telemetry dispatch perturbed the plain round "
+                        "program's lowering (off must be the SAME "
+                        "program)")
     result["program_identical"] = not failures
 
     # 2. rates: plain kernel, telemetry-off dispatch, telemetry-on

@@ -50,7 +50,8 @@ def _time_chain(step, state, aux, r0: int):
 
     r = r0
     while True:
-        run(r), run(2 * r)
+        run(r)
+        run(2 * r)
         t0 = time.perf_counter(); run(r); t1 = time.perf_counter()
         run(2 * r); t2 = time.perf_counter()
         if (t2 - t1) - (t1 - t0) > 0.05 or (t2 - t1) * 8 > MAX_LAUNCH_S:

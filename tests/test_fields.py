@@ -61,11 +61,13 @@ def test_fields_off_is_the_plain_program():
     entry as run_rounds): empty series, bit-identical state, and the
     plain lowered program is byte-identical before and after a
     fields-on run exists in the process."""
+    from flow_updating_tpu.analysis import golden
+
     topo = ring(40, k=2, seed=1)
     cfg = RoundConfig.fast(variant="collectall")
     arrays = topo.device_arrays()
     state0 = init_state(topo, cfg)
-    before = run_rounds.lower(state0, arrays, cfg, 30).as_text()
+    before = golden.canonical_program(run_rounds, state0, arrays, cfg, 30)
 
     e = Engine(config=cfg).set_topology(topo).build()
     series = e.run_fields(30, FieldSpec.off())
@@ -76,9 +78,11 @@ def test_fields_off_is_the_plain_program():
                                   np.asarray(plain.flow))
 
     # a fields-ON program existing must not perturb the plain lowering
+    # (one canonicalizer for every program-identity assert:
+    # analysis/golden.py — the golden-ledger helper)
     e2 = Engine(config=cfg).set_topology(topo).build()
     e2.run_fields(30, FieldSpec.default())
-    after = run_rounds.lower(state0, arrays, cfg, 30).as_text()
+    after = golden.canonical_program(run_rounds, state0, arrays, cfg, 30)
     assert before == after
 
 

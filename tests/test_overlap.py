@@ -184,9 +184,10 @@ def _assert_frontier_core(case):
     import jax.numpy as jnp
 
     for s in range(plan.num_shards):
-        sst = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[s]), host)
-        pl = jax.tree.map(lambda x: jnp.asarray(x[s]), arrays)
-        ov = jax.tree.map(lambda x: np.asarray(x)[s], ov_all)
+        sst = jax.tree.map(lambda x, s=s: jnp.asarray(np.asarray(x)[s]),
+                           host)
+        pl = jax.tree.map(lambda x, s=s: jnp.asarray(x[s]), arrays)
+        ov = jax.tree.map(lambda x, s=s: np.asarray(x)[s], ov_all)
         flow_f, est_f, send_f = overlap.frontier_core(
             sst, ov, cfg, plan.Eb)
         ltopo = TopoArrays(src=pl.src_local, dst=pl.src_local,

@@ -79,15 +79,20 @@ def test_profile_leaves_plain_program_identical():
     there is no instrumented twin), the plain path lowers to the
     bit-identical program before and after a profile, and state
     evolution is unchanged by an interleaved profile call."""
+    from flow_updating_tpu.analysis import golden
+
     topo = ring(24, k=2, seed=0)
     cfg = RoundConfig.fast(dtype="float64")
     arrays = topo.device_arrays()
     state = init_state(topo, cfg)
-    text_before = run_rounds.lower(state, arrays, cfg, 12).as_text()
+    # one canonicalizer for program-identity asserts (analysis/golden.py)
+    text_before = golden.canonical_program(run_rounds, state, arrays,
+                                           cfg, 12)
 
     e1 = Engine(config=cfg).set_topology(topo).build()
     e1.profile(12)
-    text_after = run_rounds.lower(state, arrays, cfg, 12).as_text()
+    text_after = golden.canonical_program(run_rounds, state, arrays,
+                                          cfg, 12)
     assert text_before == text_after
 
     e1.run_rounds(30)

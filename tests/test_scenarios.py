@@ -89,14 +89,18 @@ def test_robust_mode_validation():
 
 
 def _lowered_text(topo, cfg, adversary=None, rounds=4):
+    # one canonicalizer for every program-identity assert: the
+    # golden-ledger helper (analysis/golden.py; run_rounds is already
+    # jit-wrapped with cfg/num_rounds static)
+    from flow_updating_tpu.analysis import golden
+
     arrays = topo.device_arrays(coloring=cfg.needs_coloring)
     if adversary is not None:
         arrays = arrays.replace(**adversary.device_leaves(
             topo.num_nodes, topo.num_edges, cfg.jnp_dtype))
     state = init_state(topo, cfg, seed=0)
-    return jax.jit(run_rounds, static_argnames=(
-        "cfg", "num_rounds")).lower(
-            state, arrays, cfg, rounds).as_text()
+    return golden.canonical_program(run_rounds, state, arrays, cfg,
+                                    rounds)
 
 
 def test_robust_off_and_empty_adversary_compile_the_plain_program():
