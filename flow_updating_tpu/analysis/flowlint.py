@@ -32,9 +32,10 @@ generic linter knows about:
 ``baseline-key-family`` (FL005)
     Keys handed to ``record_baseline``/``recorded_baseline`` in bench.py
     must come from the documented key families (k-configs, ``dfl_d*``,
-    ``scn_*``, ``*_planned``, ``*_scale_s*``, ``*_sweep_b*``,
-    ``*_service``).  An undocumented ad-hoc key silently shadows or
-    forks the measurement history the regress gate judges against.
+    ``scn_*``, ``qps_*``, ``*_planned``, ``*_scale_s*``,
+    ``*_sweep_b*``, ``*_service``).  An undocumented ad-hoc key
+    silently shadows or forks the measurement history the regress gate
+    judges against.
 
 Suppression: append ``# flowlint: ok(<rule>) <reason>`` to the flagged
 line (or the line above).  The reason is mandatory — a bare suppression
@@ -71,6 +72,7 @@ _KEY_FAMILIES = (
     r".+_service",                  # streaming-service rows
     r"dfl_d.+",                     # model-scale DFL rows
     r"scn_.+",                      # scenario rows
+    r"qps_.+",                      # query-fabric queries/s rows
     r"(er|ba)\d+k?_[a-z_0-9]+",     # named generator configs
 )
 _KEY_FAMILY_RES = tuple(re.compile(p) for p in _KEY_FAMILIES)

@@ -113,7 +113,7 @@ class Cell:
     lower."""
 
     key: str
-    mode: str          # edge | node | halo | pod
+    mode: str          # edge | node | halo | pod | query
     twin: str          # plain | telemetry | fields
     build: object      # () -> (fn, args, kwargs)
 
@@ -405,6 +405,40 @@ def cells() -> list:
     for twin in ("plain", "telemetry", "fields"):
         _pod_cell(f"pod-s2/{twin}/robust=none/adv=none/payload=scalar",
                   twin)
+
+    # -- query fabric (lane machine over the service engine) ------------
+    # The fabric's round program IS run_rounds on the service layout
+    # (capacity padding + dynamic row-matrix reductions) with a
+    # lanes-wide payload and traced RoundParams; lane admission /
+    # retirement must never change it (the zero-recompile contract), so
+    # its lowering is pinned here — drop-free and drop>0 variants (the
+    # two param structures a fabric can compile).
+    def _build_query(drop=False):
+        def build(drop=drop):
+            from flow_updating_tpu.models.rounds import run_rounds
+            from flow_updating_tpu.query import QueryFabric
+            from flow_updating_tpu.topology.generators import ring
+
+            cfg = RoundConfig.fast(
+                variant="collectall",
+                drop_rate=0.05 if drop else 0.0)
+            fab = fx.get(
+                f"query_fabric_drop={drop}",
+                lambda: QueryFabric(
+                    ring(12, k=2, seed=0), lanes=4, capacity=16,
+                    degree_budget=6, config=cfg,
+                    segment_rounds=CELL_ROUNDS))
+            fab.submit(1.0)
+            return (run_rounds,
+                    (fab.svc.state, fab.svc.arrays, fab.svc.config,
+                     CELL_ROUNDS), {"params": fab.svc.params})
+        return build
+    out.append(Cell(
+        key="query-fabric/plain/robust=none/adv=none/payload=lanes4",
+        mode="query", twin="plain", build=_build_query(False)))
+    out.append(Cell(
+        key="query-fabric-drop/plain/robust=none/adv=none/payload=lanes4",
+        mode="query", twin="plain", build=_build_query(True)))
 
     return out
 

@@ -852,6 +852,106 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_query(args) -> int:
+    """``query``: the multi-tenant query fabric — one compiled
+    ``(capacity, lanes)`` engine serving a stream of cohort aggregates:
+    Poisson arrivals admit into free lanes (zero recompiles), per-lane
+    convergence detection retires + recycles lanes between scan
+    segments (flow_updating_tpu.query, docs/QUERY.md)."""
+    import time as _time
+
+    import numpy as np
+
+    _select_backend(args.backend)
+
+    from flow_updating_tpu.models.config import RoundConfig
+    from flow_updating_tpu.query import QueryFabric
+
+    if args.resume:
+        try:
+            fab = QueryFabric.restore_checkpoint(args.resume)
+        except ValueError as err:
+            raise SystemExit(f"query: {err}") from err
+        topo = None
+    else:
+        topo = _build_topology(args)
+        maker = (RoundConfig.reference
+                 if args.fire_policy == "reference" else RoundConfig.fast)
+        kw = dict(variant="collectall", dtype=args.dtype,
+                  drop_rate=args.drop_rate, drain=0)
+        if args.timeout is not None:
+            kw["timeout"] = args.timeout
+        if args.fire_policy == "reference":
+            kw["pending_depth"] = 1
+        try:
+            cfg = maker(**kw)
+            fab = QueryFabric(
+                topo, lanes=args.lanes,
+                capacity=args.capacity or None,
+                degree_budget=args.degree_budget or None,
+                edge_capacity=args.edge_capacity or None,
+                config=cfg, segment_rounds=args.segment_rounds,
+                seed=args.seed, conv_eps=args.eps,
+                admission_slo_rounds=args.admission_slo or None)
+        except ValueError as err:
+            raise SystemExit(f"invalid query configuration: {err}") from err
+
+    # Poisson-arrival driver: random-cohort mean queries submitted at
+    # --arrival-rate per round until --queries have been offered, then
+    # drain (stop early once every query retires)
+    rng = np.random.default_rng(args.seed + 1)
+    seg = fab.svc.segment_rounds
+    t0 = _time.perf_counter()
+    submitted = rounds_run = 0
+    while rounds_run < args.rounds:
+        arrivals = min(int(rng.poisson(args.arrival_rate * seg)),
+                       args.queries - submitted)
+        if arrivals:
+            members = fab.svc.live_ids()
+            m = max(1, int(round(len(members) * args.cohort_frac)))
+        for _ in range(arrivals):
+            cohort = rng.choice(members, size=m, replace=False)
+            fab.submit(rng.random(m), cohort=np.sort(cohort))
+            submitted += 1
+        try:
+            fab.run(seg)
+        except ValueError as err:
+            raise SystemExit(f"query: {err}") from err
+        rounds_run += seg
+        if args.queries and submitted >= args.queries \
+                and not fab.active_lanes and not fab.queued:
+            break
+    wall_s = _time.perf_counter() - t0
+
+    block = fab.query_block()
+    out = {
+        "t": fab.clock,
+        "lanes": args.lanes if not args.resume else fab.lanes,
+        "submitted": submitted,
+        "completed": block["retired_total"],
+        "active": block["lanes"]["active"],
+        "queued": block["lanes"]["queued"],
+        "compile_count": block["compile_count"],
+        "admission_p95": block["admission_latency"].get("p95"),
+        "wall_s": round(wall_s, 3),
+    }
+    if args.checkpoint:
+        fab.save_checkpoint(args.checkpoint)
+    if args.report:
+        from flow_updating_tpu.obs.report import (
+            build_query_manifest,
+            write_report,
+        )
+
+        write_report(args.report, build_query_manifest(
+            argv=getattr(args, "_argv", None), config=fab.svc.config,
+            topo=topo, query=block,
+            timings={"wall_s": round(wall_s, 6)}))
+        out["report_path"] = args.report
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_generate(args) -> int:
     import numpy as np
 
@@ -1775,6 +1875,68 @@ def build_parser() -> argparse.ArgumentParser:
                          "manifest (capacity accounting, per-epoch mass "
                          "history, compile count) to PATH")
     sv.set_defaults(fn=cmd_serve)
+
+    qr = sub.add_parser(
+        "query",
+        help="multi-tenant query fabric: thousands of concurrent cohort "
+             "aggregates on ONE compiled engine — Poisson arrivals "
+             "admit into free payload lanes with zero recompiles, "
+             "per-lane convergence detection retires + recycles lanes "
+             "between scan segments, doctor-checkable "
+             "flow-updating-query-report/v1 manifests (docs/QUERY.md)")
+    _add_common(qr)
+    qr.add_argument("--lanes", type=int, default=64,
+                    help="concurrent-query capacity (the compiled "
+                         "payload width D; admission beyond it queues)")
+    qr.add_argument("--capacity", type=int, default=0,
+                    help="maximum concurrent members (node slots; "
+                         "default: the initial topology's node count)")
+    qr.add_argument("--edge-capacity", type=int, default=0,
+                    help="total directed edge slots (default: initial "
+                         "edges + headroom)")
+    qr.add_argument("--degree-budget", type=int, default=0,
+                    help="per-member degree budget W (default: the "
+                         "initial max degree)")
+    qr.add_argument("--segment-rounds", type=int, default=32,
+                    help="compiled scan length; lanes admit/retire at "
+                         "segment boundaries")
+    qr.add_argument("--queries", type=int, default=16,
+                    help="total queries to offer (0 = just run "
+                         "--rounds)")
+    qr.add_argument("--arrival-rate", type=float, default=0.25,
+                    help="Poisson arrival rate (queries per round)")
+    qr.add_argument("--cohort-frac", type=float, default=0.25,
+                    help="cohort size as a fraction of live members "
+                         "(random member subsets)")
+    qr.add_argument("--rounds", type=int, default=4096,
+                    help="round budget (the driver stops early once "
+                         "every offered query retires)")
+    qr.add_argument("--eps", type=float, default=1e-6,
+                    help="default per-query convergence tolerance "
+                         "(relative estimate spread for retirement)")
+    qr.add_argument("--admission-slo", type=int, default=0,
+                    help="admission-latency SLO in rounds (doctor's "
+                         "query_admission budget; default: 2 segments)")
+    qr.add_argument("--fire-policy", default="every_round",
+                    choices=("every_round", "reference"),
+                    help="collect-all firing rule")
+    qr.add_argument("--timeout", type=int, default=None,
+                    help="collect-all tick timeout (reference firing)")
+    qr.add_argument("--drop-rate", type=float, default=0.0,
+                    help="per-message loss probability")
+    qr.add_argument("--dtype", default="float32",
+                    choices=("float32", "float64"))
+    qr.add_argument("--resume", metavar="CKPT",
+                    help="restore a query-fabric checkpoint (lane "
+                         "tables included) instead of building fresh")
+    qr.add_argument("--checkpoint", metavar="PATH",
+                    help="save a query-fabric checkpoint at exit")
+    qr.add_argument("--report", metavar="PATH",
+                    help="write the flow-updating-query-report/v1 "
+                         "manifest (lane/compile accounting, admission "
+                         "latency vs SLO, per-boundary lane-mass rows) "
+                         "to PATH")
+    qr.set_defaults(fn=cmd_query)
 
     gen = sub.add_parser("generate", help="topology summary")
     _add_common(gen)

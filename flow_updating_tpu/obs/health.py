@@ -653,6 +653,127 @@ def check_service(service: dict | None, *, dtype: str | None = None
     return checks
 
 
+def check_query(query: dict | None, *, dtype: str | None = None) -> list:
+    """The query fabric's SLO checks (``flow-updating-query-report/v1``
+    manifests; docs/QUERY.md):
+
+    * **query_compile** — the lane zero-recompile contract: the round
+      program compiled at most once across every admission, retirement
+      and membership event (lane admission is a value-column write, a
+      retirement is a payload scrub — never a retrace);
+    * **query_lanes** — lane accounting is consistent (active + free =
+      lane capacity; peak within capacity);
+    * **query_lane_mass** — the per-lane mass SLO at EVERY segment
+      boundary: free (scrubbed) lanes carry a ledger residual of
+      exactly 0.0, active lanes stay within float tolerance + the
+      boundary's own in-flight allowance;
+    * **query_admission** — the admission-latency SLO: the measured p95
+      rounds-in-queue within the fabric's declared budget.
+    """
+    if not query:
+        return [CheckResult("query", SKIP, "no query block recorded")]
+    checks = []
+    dtype = query.get("dtype", dtype)
+
+    compiles = query.get("compile_count")
+    if compiles is None:
+        checks.append(CheckResult("query_compile", SKIP,
+                                  "no compile count recorded"))
+    elif int(compiles) > 1:
+        checks.append(CheckResult(
+            "query_compile", FAIL,
+            f"round program compiled {compiles}x — lane admission/"
+            "retirement and membership events must be payload-plane "
+            "edits, never a retrace",
+            {"compile_count": int(compiles),
+             "admitted_total": query.get("admitted_total"),
+             "retired_total": query.get("retired_total")}))
+    else:
+        checks.append(CheckResult(
+            "query_compile", PASS,
+            f"zero recompiles ({compiles} compile across "
+            f"{query.get('admitted_total', '?')} admissions / "
+            f"{query.get('retired_total', '?')} retirements)",
+            {"compile_count": int(compiles)}))
+
+    lanes = query.get("lanes") or {}
+    if lanes:
+        cap = int(lanes.get("capacity", 0))
+        active = int(lanes.get("active", 0))
+        free = lanes.get("free")
+        peak = int(lanes.get("peak_active", 0))
+        ok = (0 <= active <= cap and peak <= cap
+              and (free is None or active + int(free) == cap))
+        checks.append(CheckResult(
+            "query_lanes", PASS if ok else FAIL,
+            (f"lane accounting consistent ({active}/{cap} active, "
+             f"peak {peak})") if ok else
+            (f"lane accounting inconsistent: active={active}, "
+             f"free={free}, capacity={cap}, peak={peak}"),
+            dict(lanes)))
+
+    rows = query.get("boundaries") or []
+    if not rows:
+        checks.append(CheckResult(
+            "query_lane_mass", SKIP, "no boundary rows recorded"))
+    else:
+        bad = None
+        for row in rows:
+            free_res = float(row.get("max_resid_free", 0.0))
+            if free_res != 0.0:
+                bad = {"t": row.get("t"), "kind": "free_lane",
+                       "residual": free_res}
+                break
+            scale = float(row.get("scale", 0.0) or 0.0)
+            spread = float(row.get("max_spread", 0.0) or 0.0)
+            live = float(row.get("live", 1) or 1)
+            tol = (_float_tol(max(scale, 1.0), dtype, None)
+                   + 2.0 * spread * max(live, 1.0))
+            res = float(row.get("max_resid_active", 0.0))
+            if not math.isfinite(res) or res > tol:
+                bad = {"t": row.get("t"), "kind": "active_lane",
+                       "residual": res, "tolerance": tol}
+                break
+        if bad is not None:
+            kind = ("scrubbed free lane leaked mass"
+                    if bad["kind"] == "free_lane" else
+                    "active lane residual beyond the in-flight "
+                    "allowance")
+            checks.append(CheckResult(
+                "query_lane_mass", FAIL,
+                f"per-lane mass SLO violated at round {bad['t']}: "
+                f"{kind} (|residual| {bad['residual']:.3e}"
+                + (f" > tolerance {bad['tolerance']:.3e}"
+                   if "tolerance" in bad else " != 0.0") + ")", bad))
+        else:
+            checks.append(CheckResult(
+                "query_lane_mass", PASS,
+                f"per-lane mass held at all {len(rows)} boundaries "
+                "(free lanes exactly 0.0, active within float + "
+                "in-flight allowance)", {"boundaries": len(rows)}))
+
+    lat = query.get("admission_latency") or {}
+    if not lat.get("count"):
+        checks.append(CheckResult(
+            "query_admission", SKIP, "no admissions recorded"))
+    else:
+        slo = lat.get("slo_rounds")
+        p95 = lat.get("p95", 0.0)
+        if slo is not None and p95 is not None and float(p95) > float(slo):
+            checks.append(CheckResult(
+                "query_admission", FAIL,
+                f"admission-latency SLO violated: p95 {p95:.0f} rounds "
+                f"in queue > budget {slo} (lanes saturated — raise "
+                "lanes= or retire faster)", dict(lat)))
+        else:
+            checks.append(CheckResult(
+                "query_admission", PASS,
+                f"admission latency within SLO (p95 "
+                f"{float(p95 or 0):.0f} <= {slo} rounds, "
+                f"{lat['count']} admissions)", dict(lat)))
+    return checks
+
+
 def check_report(report: dict | None, *, dtype: str | None = None
                  ) -> CheckResult:
     """Final-state sanity from a run manifest's convergence report:
@@ -1086,6 +1207,9 @@ def diagnose_manifest(manifest: dict) -> list:
     service = manifest.get("service")
     if isinstance(service, dict):
         checks.extend(check_service(service, dtype=dtype))
+    query = manifest.get("query")
+    if isinstance(query, dict):
+        checks.extend(check_query(query, dtype=dtype))
     results = manifest.get("results")
     if (isinstance(results, list) and results
             and isinstance(results[0], dict)

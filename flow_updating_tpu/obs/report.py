@@ -34,6 +34,7 @@ PLAN_SCHEMA = "flow-updating-plan-report/v1"
 SERVICE_SCHEMA = "flow-updating-service-report/v1"
 SCENARIO_SCHEMA = "flow-updating-scenario-report/v1"
 AUDIT_SCHEMA = "flow-updating-audit-report/v1"
+QUERY_SCHEMA = "flow-updating-query-report/v1"
 
 
 def environment_info() -> dict:
@@ -267,6 +268,34 @@ def build_service_manifest(*, argv=None, config=None, topo=None,
             "derived_from": "segment_boundaries",
             "series": {k: list(v) for k, v in series.items()},
         }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_query_manifest(*, argv=None, config=None, topo=None,
+                         query=None, timings=None, extra=None) -> dict:
+    """Assemble the query-fabric v1 manifest: the run manifest's
+    argv/config/environment binding around a ``query`` block
+    (``QueryFabric.query_block()`` — lane/compile accounting, the
+    admission-latency distribution vs its SLO, per-boundary lane-mass
+    rows, per-query lifecycle records with results).  The doctor judges
+    it via ``obs.health.check_query`` (lane compile-count, per-lane
+    mass SLO, admission-latency SLO); ``topo`` is the INITIAL topology
+    (membership is mutable state afterwards)."""
+    manifest = {
+        "schema": QUERY_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "config": (
+            {k: _config_dict(v) for k, v in config.items()}
+            if isinstance(config, dict) else _config_dict(config)
+        ),
+        "topology": topology_summary(topo) if topo is not None else None,
+        "environment": environment_info(),
+        "timings": dict(timings) if timings else None,
+        "query": dict(query) if query else None,
+    }
     if extra:
         manifest.update(extra)
     return manifest

@@ -1,0 +1,16 @@
+"""Multi-tenant query fabric: thousands of concurrent aggregates on one
+compiled engine.
+
+The ``(N, D)`` payload feature axis is a bit-exact lane machine (each
+feature lane is an independent scalar protocol instance sharing one set
+of messages — models/state.py); this package promotes it to a **query
+axis** on top of the streaming service engine: each lane is an
+independent aggregate with its own value stream, node-cohort mask, start
+round and lifecycle, admitted into free lanes with ZERO recompiles and
+retired/recycled mid-flight between scan segments.  See
+:mod:`flow_updating_tpu.query.fabric` and docs/QUERY.md.
+"""
+
+from flow_updating_tpu.query.fabric import QueryFabric
+
+__all__ = ["QueryFabric"]

@@ -60,6 +60,8 @@ from flow_updating_tpu.models.config import (
 from flow_updating_tpu.topology.padding import (
     bucket_ceil,
     edge_rows,
+    mask_ghost_state,
+    masked_values,
     pad_topology_to,
 )
 from flow_updating_tpu.service import membership
@@ -139,7 +141,8 @@ class ServiceEngine:
     def __init__(self, topo, capacity: int, *, degree_budget: int | None
                  = None, edge_capacity: int | None = None,
                  config: RoundConfig | None = None,
-                 segment_rounds: int = 32, seed: int = 0, values=None):
+                 segment_rounds: int = 32, seed: int = 0, values=None,
+                 boundary_samples: bool = True):
         import jax.numpy as jnp
 
         from flow_updating_tpu.models.state import (
@@ -185,14 +188,9 @@ class ServiceEngine:
         if values is not None:
             vals = np.asarray(values, np.float64)
             check_payload_values(vals, N)
-            pv = np.concatenate(
-                [vals, np.zeros((n_cap - vals.shape[0],) + vals.shape[1:])],
-                axis=0)
+            pv = masked_values(vals, n_cap)
         state = init_state(padded, cfg, seed=seed, values=pv)
-        state = state.replace(
-            alive=state.alive.at[N:].set(False),
-            edge_ok=state.edge_ok.at[E:].set(False),
-        )
+        state = mask_ghost_state(state, N, E)
         params = RoundParams.from_config(cfg)
         if cfg.drop_rate == 0.0:
             params = params.without_drop()
@@ -230,7 +228,11 @@ class ServiceEngine:
         self._samples: list = []        # boundary telemetry rows
         self._est_cache = None          # (t, est (n_cap,)+F, alive)
         self._capture_cache_floor()
-        self._sample("init")
+        if boundary_samples:
+            # a construction-time sample materializes the full (n_cap,)+F
+            # estimate matrix on host; a driver that samples per LANE
+            # (the query fabric's device-side probe) opts out
+            self._sample("init")
 
     # ---- compile accounting ---------------------------------------------
     def _capture_cache_floor(self) -> None:
@@ -805,12 +807,15 @@ class ServiceEngine:
         return {k: [s[k] for s in self._samples] for k in keys}
 
     # ---- durability ------------------------------------------------------
-    def save_checkpoint(self, path: str) -> ServiceEngine:
+    def save_checkpoint(self, path: str,
+                        extra_meta: dict | None = None) -> ServiceEngine:
         """Write the full service state — protocol state, dynamic
         topology leaves, free lists, epoch counters — as one versioned
         archive (utils/checkpoint.py, ``service-checkpoint`` schema).
         Restore via :meth:`restore_checkpoint`; round-trip is bit-exact
-        (tests/test_service.py)."""
+        (tests/test_service.py).  ``extra_meta`` merges extra JSON blocks
+        into the service meta (the query fabric's lane tables ride here —
+        a plain :meth:`restore_checkpoint` ignores them)."""
         from flow_updating_tpu.utils.checkpoint import (
             save_service_checkpoint,
         )
@@ -831,6 +836,8 @@ class ServiceEngine:
             "epoch": self._epoch,
             "event_counts": dict(self._event_counts),
         }
+        if extra_meta:
+            meta.update(extra_meta)
         save_service_checkpoint(path, self.state, self.config,
                                 topo_arrays, meta)
         return self

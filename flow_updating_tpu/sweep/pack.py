@@ -34,6 +34,8 @@ from flow_updating_tpu.topology.graph import Topology
 from flow_updating_tpu.topology.padding import (
     bucket_ceil as _bucket_ceil,
     edge_rows as _shared_edge_rows,
+    mask_ghost_state,
+    masked_values,
     pad_topology_to as _shared_pad_topology_to,
     row_width,
 )
@@ -185,14 +187,10 @@ def pack_instance(inst: SweepInstance, cfg: RoundConfig,
     if inst.values is not None:
         vals = np.asarray(inst.values, np.float64)
         check_payload_values(vals, inst.topo.num_nodes)
-        pad_rows = np.zeros((n_pad - vals.shape[0],) + vals.shape[1:])
-        values = np.concatenate([vals, pad_rows], axis=0)
+        values = masked_values(vals, n_pad)
     state = init_state(padded, cfg, seed=inst.seed, values=values)
-    N, E = inst.topo.num_nodes, inst.topo.num_edges
-    state = state.replace(
-        alive=state.alive.at[N:].set(False),
-        edge_ok=state.edge_ok.at[E:].set(False),
-    )
+    state = mask_ghost_state(state, inst.topo.num_nodes,
+                             inst.topo.num_edges)
     params = inst.params(cfg)
     if static_no_drop:
         params = params.without_drop()

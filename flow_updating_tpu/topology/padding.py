@@ -47,6 +47,60 @@ def pow2_ceil(x: int) -> int:
     return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
 
 
+def mask_ghost_state(state, n_real: int, e_real: int):
+    """The packed-state ghost mask: nodes past ``n_real`` are born dead
+    and edges past ``e_real`` are failed links — the ONE mass-neutral
+    masking edit every capacity consumer applies after
+    :func:`pad_topology_to` + ``init_state`` (the sweep packer, the
+    streaming service, the query fabric's isolated comparators).  Dead
+    ghosts never fire and failed pad links never carry a message, so
+    the real prefix evolves bit-identically to the unpadded run."""
+    return state.replace(
+        alive=state.alive.at[n_real:].set(False),
+        edge_ok=state.edge_ok.at[e_real:].set(False),
+    )
+
+
+def masked_values(values, n_rows: int, cohort=None) -> np.ndarray:
+    """A ``(n_rows,) + F`` float64 value array with ``values`` written on
+    ``cohort``'s slots and exactly ``0.0`` everywhere else — the
+    mass-neutral masking rule: a slot outside the cohort contributes
+    nothing to the aggregate (a ghost for THIS value stream), yet still
+    relays like any other node.
+
+    ``cohort=None`` writes ``values`` as the leading prefix — the
+    capacity-padding case (sweep lanes, service construction), where the
+    ghosts are the trailing pad slots.  An explicit ``cohort`` is the
+    query fabric's per-lane case: one row per cohort slot id, every
+    non-cohort slot (members included) masked to zero."""
+    vals = np.asarray(values, np.float64)
+    if cohort is None:
+        if vals.shape[0] > n_rows:
+            raise ValueError(
+                f"masked_values: {vals.shape[0]} value rows exceed "
+                f"{n_rows} slots")
+        pad = np.zeros((n_rows - vals.shape[0],) + vals.shape[1:])
+        return np.concatenate([vals, pad], axis=0)
+    cohort = np.asarray(cohort, np.int64)
+    if cohort.ndim != 1:
+        raise ValueError(
+            f"masked_values: cohort must be a 1-D id array "
+            f"(got shape {cohort.shape})")
+    if vals.shape[0] != cohort.shape[0]:
+        raise ValueError(
+            f"masked_values: {vals.shape[0]} value rows for "
+            f"{cohort.shape[0]} cohort ids (need one row per id)")
+    if cohort.size and (cohort.min() < 0 or cohort.max() >= n_rows):
+        raise ValueError(
+            f"masked_values: cohort ids must lie in [0, {n_rows}) "
+            f"(got [{cohort.min()}, {cohort.max()}])")
+    if np.unique(cohort).size != cohort.size:
+        raise ValueError("masked_values: duplicate cohort ids")
+    out = np.zeros((n_rows,) + vals.shape[1:])
+    out[cohort] = vals
+    return out
+
+
 def bucket_ceil(x: int) -> int:
     """Round up to an eighth-power-of-two boundary: at most 12.5% pad
     waste per axis, at most 8 bucket sizes per octave (the

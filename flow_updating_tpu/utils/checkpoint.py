@@ -40,7 +40,14 @@ FORMAT_VERSION = 2
 # schema on top of the archive format: the dynamic-topology mirror set
 # (src/dst/rev/out_deg/rows/delay/free lists/member mask) is part of the
 # contract, so adding or renaming one bumps this.
-SERVICE_FORMAT_VERSION = 1
+# 2: the meta block may carry the query fabric's lane tables under
+#    meta["query"] (QueryFabric.save_checkpoint) — lane -> query
+#    bindings, cohorts, free-lane list, admission queue.  Version-1
+#    archives (pre-lane) carry no such block and still restore: the
+#    mirror set and state schema are unchanged, so SERVICE_READ_VERSIONS
+#    accepts both; a ServiceEngine restore ignores the block either way.
+SERVICE_FORMAT_VERSION = 2
+SERVICE_READ_VERSIONS = (1, 2)
 _SERVICE_TOPO_KEYS = ("src", "dst", "rev", "out_deg", "rows", "delay",
                       "free_nodes", "free_edges", "member")
 
@@ -391,11 +398,13 @@ def load_service_checkpoint(path: str):
                 "ServiceEngine.save_checkpoint; plain run checkpoints "
                 "restore via Engine.restore_checkpoint")
         got = manifest["service_version"]
-        if got != SERVICE_FORMAT_VERSION:
+        if got not in SERVICE_READ_VERSIONS:
+            readable = "/".join(str(v) for v in SERVICE_READ_VERSIONS)
             raise ValueError(
                 f"checkpoint {path}: service schema version {got}, but "
-                f"this runtime reads version {SERVICE_FORMAT_VERSION} — "
-                "re-create the checkpoint with the current code")
+                f"this runtime reads versions {readable} (writes "
+                f"{SERVICE_FORMAT_VERSION}) — re-create the checkpoint "
+                "with the current code")
         fields = {k[len("state."):]: z[k] for k in z.files
                   if k.startswith("state.")}
         svc = {k[len("svc."):]: z[k] for k in z.files

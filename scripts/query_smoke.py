@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""CI query-fabric smoke: the multi-tenant lane engine's acceptance
+scenario on the CPU proxy (ISSUE 12; docs/QUERY.md).
+
+1. build a ``QueryFabric`` on a >= 100k-node-capacity engine with
+   ``--lanes`` concurrent-query lanes (CI default 64; the full
+   acceptance run is ``--lanes 1024``);
+2. offer ~1.5x lanes queries under Poisson arrival while membership
+   churn (join/add-edge/leave) runs between segments — asserting the
+   round program compiles EXACTLY once across every admission,
+   retirement and membership event;
+3. assert at least one retired lane was RECYCLED (a lane that served
+   one query admitted a second);
+4. write the ``flow-updating-query-report/v1`` manifest and run
+   ``doctor`` over it — lane compile-count, per-lane mass SLO (free
+   lanes exactly 0.0), admission-latency SLO.
+
+Exit code: the doctor's (0 healthy; 1 on any failing check), or 1 on
+any assertion above.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--nodes", type=int, default=99_000,
+                    help="initial members (erdos_renyi:N:6)")
+    ap.add_argument("--capacity", type=int, default=100_000,
+                    help="node-slot capacity (acceptance floor: 100k)")
+    ap.add_argument("--lanes", type=int, default=64,
+                    help="concurrent-query lanes (acceptance run: 1024)")
+    ap.add_argument("--queries", type=int, default=0,
+                    help="queries to offer (default: 1.5x lanes, so "
+                         "retired lanes must recycle)")
+    ap.add_argument("--events", type=int, default=24,
+                    help="membership/edge churn events interleaved "
+                         "between segments")
+    ap.add_argument("--segment-rounds", type=int, default=8)
+    ap.add_argument("--eps", type=float, default=1e-2,
+                    help="per-query retirement tolerance (the smoke "
+                         "checks lane mechanics, not precision)")
+    ap.add_argument("--max-rounds", type=int, default=4096)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.models.rounds import run_rounds
+    from flow_updating_tpu.obs.report import (
+        build_query_manifest,
+        write_report,
+    )
+    from flow_updating_tpu.query import QueryFabric
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    queries = args.queries or (args.lanes + args.lanes // 2)
+    t0 = time.perf_counter()
+    topo = erdos_renyi(args.nodes, avg_degree=6.0, seed=0)
+    fab = QueryFabric(topo, lanes=args.lanes, capacity=args.capacity,
+                      degree_budget=24, segment_rounds=args.segment_rounds,
+                      seed=0, conv_eps=args.eps)
+    print(f"query_smoke: capacity {fab.svc.capacity} nodes x "
+          f"{fab.lanes} lanes, {fab.svc.live_count} members, built in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    cache0 = run_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    members = fab.svc.live_ids()
+    held: list = []
+    submitted = events = rounds = 0
+    while (submitted < queries or fab.active_lanes or fab.queued) \
+            and rounds < args.max_rounds:
+        arrivals = min(int(rng.poisson(0.5 * args.lanes)),
+                       queries - submitted)
+        for _ in range(arrivals):
+            m = int(rng.integers(8, 64))
+            cohort = rng.choice(members, size=m, replace=False)
+            fab.submit(rng.random(m), cohort=np.sort(cohort))
+            submitted += 1
+        boundary_budget = 6
+        while events < args.events and boundary_budget > 0:
+            # membership churn between segments: join + wire in, or a
+            # previously joined member leaves
+            if held and rng.random() < 0.4:
+                fab.leave([held.pop()])
+                events += 1
+                boundary_budget -= 1
+            else:
+                slot = fab.join()
+                a = int(rng.integers(0, args.nodes))
+                fab.add_edges([(slot, a)])
+                held.append(slot)
+                events += 2
+                boundary_budget -= 2
+        fab.run(args.segment_rounds)
+        rounds += args.segment_rounds
+
+    compiles = run_rounds._cache_size() - cache0
+    if compiles != 1:
+        print(f"query_smoke: round program compiled {compiles}x over "
+              f"{submitted} queries + {events} membership events "
+              "(expected exactly 1)", file=sys.stderr)
+        return 1
+    if fab.retired_total < queries:
+        print(f"query_smoke: only {fab.retired_total}/{queries} queries "
+              f"retired within {rounds} rounds", file=sys.stderr)
+        return 1
+    lanes_used: dict = {}
+    for q in fab._queries.values():
+        if q["lane"] is not None:
+            lanes_used[q["lane"]] = lanes_used.get(q["lane"], 0) + 1
+    recycled = sum(1 for n in lanes_used.values() if n > 1)
+    if recycled == 0:
+        print("query_smoke: no retired lane was recycled (every query "
+              "got a fresh lane — raise queries vs lanes)",
+              file=sys.stderr)
+        return 1
+    resid = fab.mass_residual()
+    print(f"query_smoke: {submitted} queries through {args.lanes} lanes "
+          f"({recycled} lanes recycled), {events} membership events, "
+          f"{rounds} rounds, 1 compile, "
+          f"max|free-lane residual|={float(np.max(np.abs(resid))):.3e}, "
+          f"{time.perf_counter() - t0:.1f}s total", file=sys.stderr)
+
+    manifest_path = os.path.join(args.outdir, "query_report.json")
+    write_report(manifest_path, build_query_manifest(
+        argv=sys.argv[1:], config=fab.svc.config, topo=topo,
+        query=fab.query_block()))
+    return cli_main(["doctor", manifest_path])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,101 @@ def test_format_version_mismatch_names_file_and_versions(tmp_path):
         load_checkpoint(old)
 
 
+def test_service_version_2_carries_lane_tables_and_reads_v1(tmp_path):
+    """SERVICE_FORMAT_VERSION bumped to 2 for lane-table checkpoints:
+    new archives write version 2 (the query fabric's lane tables ride
+    in meta['query']); version-1 (pre-lane) archives still restore —
+    the mirror set and state schema are unchanged; an unknown version
+    errors naming the file AND both versions (read set + write)."""
+    import json
+
+    from flow_updating_tpu.service import ServiceEngine
+    from flow_updating_tpu.utils import checkpoint as ck
+
+    assert ck.SERVICE_FORMAT_VERSION == 2
+    assert set(ck.SERVICE_READ_VERSIONS) == {1, 2}
+
+    topo = ring(8, k=1, seed=0)
+    svc = ServiceEngine(topo, capacity=10,
+                        config=RoundConfig.fast(variant="collectall"),
+                        segment_rounds=4)
+    svc.run(8)
+    path = str(tmp_path / "svc.npz")
+    svc.save_checkpoint(path)
+    with np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    assert manifest["service_version"] == 2
+
+    # a pre-lane (v1) archive restores identically
+    manifest_v1 = dict(manifest)
+    manifest_v1["service_version"] = 1
+    old = str(tmp_path / "prelane.npz")
+    ck._write_archive(old, manifest_v1, arrays)
+    twin = ServiceEngine.restore_checkpoint(old)
+    svc2 = ServiceEngine.restore_checkpoint(path)
+    for name in svc.state.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(svc2.state, name)),
+            np.asarray(getattr(twin.state, name)),
+            err_msg=f"leaf {name}: v1 restore diverged from v2")
+
+    # an unknown version names the file, the archive's version, the
+    # readable set AND the written version
+    manifest_v9 = dict(manifest)
+    manifest_v9["service_version"] = 9
+    future = str(tmp_path / "future.npz")
+    ck._write_archive(future, manifest_v9, arrays)
+    with pytest.raises(
+            ValueError,
+            match=r"future.npz.*service schema version 9.*"
+                  r"reads versions 1/2.*writes 2"):
+        ServiceEngine.restore_checkpoint(future)
+
+
+def test_query_fabric_checkpoint_interop(tmp_path):
+    """A fabric checkpoint (v2 + meta['query'] lane tables) restores as
+    a fabric with its lane tables intact, AND as a plain service (the
+    lane block is ignored); a plain service checkpoint refuses to
+    restore as a fabric, naming the fix."""
+    from flow_updating_tpu.query import QueryFabric
+    from flow_updating_tpu.service import ServiceEngine
+
+    topo = ring(12, k=2, seed=1)
+    cfg = RoundConfig(variant="collectall", fire_policy="every_round",
+                      dtype="float64")
+    fab = QueryFabric(topo, lanes=2, capacity=16, degree_budget=8,
+                      config=cfg, segment_rounds=8, conv_eps=1e-30)
+    q = fab.submit([1.0, 2.0], cohort=[3, 7])
+    fab.submit([5.0, -1.0], cohort=[0, 4])   # occupies lane 1
+    waiting = fab.submit([9.0, 2.0], cohort=[1, 2])   # must queue
+    fab.run(16)
+    path = str(tmp_path / "fab.npz")
+    fab.save_checkpoint(path)
+
+    twin = QueryFabric.restore_checkpoint(path)
+    assert twin.lanes == 2
+    assert twin.read(q)["status"] == "active"
+    assert twin.read(waiting)["status"] == "queued"
+    fab.run(16)
+    twin.run(16)
+    for name in fab.svc.state.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fab.svc.state, name)),
+            np.asarray(getattr(twin.svc.state, name)),
+            err_msg=f"leaf {name} diverged after fabric restore")
+    assert twin.compile_count <= 1
+
+    svc = ServiceEngine.restore_checkpoint(path)   # lane block ignored
+    assert svc.feature_shape == (2,)
+
+    plain = str(tmp_path / "plain.npz")
+    svc.save_checkpoint(plain)
+    with pytest.raises(ValueError,
+                       match="plain.npz.*no query lane tables"):
+        QueryFabric.restore_checkpoint(plain)
+
+
 def test_topology_mismatch_rejected(tmp_path):
     cfg = RoundConfig.fast()
     topo = ring(16, k=2, seed=0)

@@ -1,0 +1,439 @@
+"""Query fabric: multi-tenant lane conformance suite (docs/QUERY.md).
+
+Contracts pinned here:
+
+* **per-lane bit-exactness** — a fabric lane is bit-identical to an
+  *isolated single-query run*: an idle (zero value plane) service at
+  the same capacity/seed, driven through the same membership events,
+  whose value plane receives the query's cohort-masked column at the
+  admission round — including drop > 0, churn and busy neighbor lanes,
+  and including a RECYCLED lane (scrubbed back to the all-zero fixed
+  point between queries);
+* **zero recompiles** — the round program compiles exactly once across
+  200+ admission/retirement events plus membership churn (the
+  ``run_rounds`` jit cache is the witness, as in tests/test_service.py);
+* **cohort masking** — admission is bit-exactly mass-neutral per lane
+  (the ledger-form residual cannot move), and the lane's mass at
+  admission equals the cohort sum exactly (non-cohort members
+  contribute exactly 0.0 — the mass-neutral masking of
+  topology/padding.masked_values);
+* **bounded-staleness reads** — ``read(qid, max_staleness=k)`` serves
+  the boundary probe within its round age and refreshes beyond it;
+  events always invalidate it;
+* **sweep layout pin** — the shared ghost-mask helpers the sweep packer
+  now routes through (topology/padding.mask_ghost_state /
+  masked_values) reproduce the historical packed layout bit-exactly;
+* **bench key isolation** — ``qps_*`` rows live in their own baseline
+  key family and never shadow k-configs (and the family is registered
+  with flowlint's baseline-key-family rule).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.cli import main as cli_main
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.obs import health
+from flow_updating_tpu.query import QueryFabric
+from flow_updating_tpu.service import ServiceEngine
+from flow_updating_tpu.topology.generators import grid2d, ring
+from flow_updating_tpu.topology.padding import masked_values
+
+
+def _cfg(**kw):
+    kw.setdefault("variant", "collectall")
+    kw.setdefault("fire_policy", "every_round")
+    kw.setdefault("dtype", "float64")
+    return RoundConfig(**kw)
+
+
+def _mk(topo, lanes, cfg, **kw):
+    kw.setdefault("capacity", 20)
+    kw.setdefault("degree_budget", 8)
+    kw.setdefault("edge_capacity", 96)
+    kw.setdefault("segment_rounds", 8)
+    kw.setdefault("seed", 1)
+    return QueryFabric(topo, lanes=lanes, config=cfg, conv_eps=1e-30,
+                       **kw)
+
+
+PAYLOAD_LEAVES = ("value", "flow", "est", "last_avg", "pending_flow",
+                  "pending_est", "buf_flow", "buf_est")
+CONTROL_LEAVES = ("ticks", "fired", "alive", "edge_ok", "recv", "stamp",
+                  "pending_valid", "buf_valid", "t", "key")
+
+
+def _assert_lane_parity(fab, iso, lane):
+    for name in PAYLOAD_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fab.svc.state, name))[..., lane],
+            np.asarray(getattr(iso.state, name)),
+            err_msg=f"payload leaf {name} lane {lane} diverged from "
+                    "the isolated run")
+    for name in CONTROL_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fab.svc.state, name)),
+            np.asarray(getattr(iso.state, name)),
+            err_msg=f"shared control leaf {name} diverged")
+
+
+# ---- per-lane bit-exactness ----------------------------------------------
+
+def test_lane_bitexact_vs_isolated_run_with_drop_and_churn():
+    """The tentpole theorem: lane d == the isolated single-query run,
+    with drop > 0, suspend/resume + join/add-edge churn, a cohort mask
+    and a busy neighbor lane — every payload plane bit-equal, every
+    shared control plane identical."""
+    topo = ring(12, k=2, seed=3)
+    cfg = _cfg(drop_rate=0.1)
+    fab = _mk(topo, 4, cfg)
+    iso = ServiceEngine(topo, 20, degree_budget=8, edge_capacity=96,
+                        config=cfg, segment_rounds=8, seed=1,
+                        values=np.zeros(12))   # idle: zero value plane
+
+    fab.submit(2.0, cohort=[0, 1])   # decoy occupies lane 0
+    fab.run(16)
+    iso.run(16)
+    cohort, vals = [2, 5, 9], [1.5, -0.25, 3.0]
+    q = fab.submit(vals, cohort=cohort)
+    lane = fab._queries[q]["lane"]
+    assert lane == 1
+    import jax.numpy as jnp
+
+    col = masked_values(np.asarray(vals), iso._n_cap, np.asarray(cohort))
+    iso.state = iso.state.replace(
+        value=jnp.asarray(col, iso.state.value.dtype))
+
+    for s in (fab, iso):
+        s.suspend([7])
+        s.run(16)
+        s.resume([7])
+    slot_f = fab.join()
+    fab.add_edges([(slot_f, 0)])
+    slot_i = iso.join(0.0)
+    iso.add_edges([(slot_i, 0)])
+    assert slot_f == slot_i
+    fab.run(32)
+    iso.run(32)
+    _assert_lane_parity(fab, iso, lane)
+
+
+def test_recycled_lane_bitexact_vs_isolated_run():
+    """A lane that served one query, retired (scrubbed to the all-zero
+    fixed point) and admitted a second is bit-identical to an isolated
+    run that sat idle until the SECOND admission round — the recycle
+    leaves no residue."""
+    topo = grid2d(4, 4, seed=0)
+    cfg = _cfg()
+    fab = QueryFabric(topo, lanes=1, capacity=20, degree_budget=8,
+                      edge_capacity=96, config=cfg, segment_rounds=8,
+                      seed=2, conv_eps=1e-9)
+    iso = ServiceEngine(topo, 20, degree_budget=8, edge_capacity=96,
+                        config=cfg, segment_rounds=8, seed=2,
+                        values=np.zeros(16))
+    q1 = fab.submit(1.0)             # converges, retires, frees lane 0
+    fab.run(128)
+    assert fab.read(q1)["status"] == "done"
+    assert fab.active_lanes == 0
+    cohort, vals = [3, 8], [10.0, -4.0]
+    q2 = fab.submit(vals, cohort=cohort)
+    assert fab._queries[q2]["lane"] == 0   # recycled
+    iso.run(128)
+    import jax.numpy as jnp
+
+    col = masked_values(np.asarray(vals), iso._n_cap, np.asarray(cohort))
+    iso.state = iso.state.replace(
+        value=jnp.asarray(col, iso.state.value.dtype))
+    fab.run(32)
+    iso.run(32)
+    _assert_lane_parity(fab, iso, 0)
+
+
+# ---- cohort masking ------------------------------------------------------
+
+def test_admission_is_mass_neutral_and_cohort_exact():
+    topo = grid2d(4, 4, seed=1)
+    fab = _mk(topo, 3, _cfg(), capacity=24)
+    fab.submit(1.0, cohort=[0, 5])
+    fab.run(32)                       # mid-flight: lane 0 has residual
+    r0 = fab.mass_residual().copy()
+    q = fab.submit([2.5, -1.0, 4.0], cohort=[1, 6, 11])
+    lane = fab._queries[q]["lane"]
+    # the admission write cannot move any lane's ledger residual by a ulp
+    np.testing.assert_array_equal(fab.mass_residual(), r0)
+    # at admission the lane's mass IS the cohort sum, exactly: every
+    # non-cohort member contributes exactly 0.0
+    est = np.asarray(fab.svc.state.value)[:, lane]  # zero flows: est==value
+    alive = np.asarray(fab.svc.state.alive)
+    assert est[alive].sum() == 2.5 - 1.0 + 4.0
+    assert not est[[i for i in range(est.size)
+                    if i not in (1, 6, 11)]].any()
+
+
+def test_masked_values_validation():
+    with pytest.raises(ValueError, match="duplicate"):
+        masked_values([1.0, 2.0], 8, [3, 3])
+    with pytest.raises(ValueError, match="one row per id"):
+        masked_values([1.0], 8, [3, 4])
+    with pytest.raises(ValueError, match=r"\[0, 8\)"):
+        masked_values([1.0], 8, [9])
+    with pytest.raises(ValueError, match="exceed"):
+        masked_values(np.ones(9), 8)
+
+
+# ---- zero recompiles across admit/retire churn ---------------------------
+
+def test_compile_count_one_across_200_admit_retire_events():
+    topo = ring(16, k=2, seed=2)
+    fab = QueryFabric(topo, lanes=8, capacity=20, degree_budget=6,
+                      edge_capacity=96, config=_cfg(), segment_rounds=4,
+                      seed=0, conv_eps=1e9)   # retire at first boundary
+    n0 = run_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    while fab.admitted_total + fab.retired_total < 200:
+        for _ in range(8 - fab.active_lanes - fab.queued):
+            m = int(rng.integers(1, 6))
+            cohort = rng.choice(16, size=m, replace=False)
+            fab.submit(rng.random(m), cohort=np.sort(cohort))
+        fab.run(4)
+    assert fab.admitted_total + fab.retired_total >= 200
+    assert fab.compile_count == 1
+    assert run_rounds._cache_size() == n0 + 1, \
+        "lane admission/retirement must never retrace the round program"
+    assert fab.probe_compile_count <= 1
+    by_name = {c.name: c for c in
+               health.check_query(fab.query_block(), dtype="float64")}
+    assert by_name["query_compile"].status == health.PASS
+    assert by_name["query_lane_mass"].status == health.PASS
+    assert by_name["query_lanes"].status == health.PASS
+
+
+# ---- bounded-staleness reads ---------------------------------------------
+
+def test_read_bounded_staleness_contract():
+    topo = ring(12, k=2, seed=1)
+    fab = _mk(topo, 2, _cfg(), capacity=16)
+    # non-constant values: a constant cohort column has spread exactly
+    # 0.0 and would retire at the first boundary
+    q = fab.submit(np.arange(12.0))
+    fab.run(16)
+    assert fab.read(q)["status"] == "active"
+    import jax.numpy as jnp
+
+    lane = fab._queries[q]["lane"]
+    # poke the lane out of band: a bounded-staleness read keeps serving
+    # the boundary probe (age 0), a fresh read sees the new mass
+    st = fab.svc.state
+    fab.svc.state = st.replace(
+        value=st.value.at[0, lane].add(jnp.asarray(1.0, st.value.dtype)))
+    stale = fab.read(q, max_staleness=100)
+    fresh = fab.read(q)               # None = always fresh
+    assert abs((fresh["sum"] - stale["sum"]) - 1.0) < 1e-9
+    assert stale["staleness"] == 0 and fresh["staleness"] == 0
+    # membership events invalidate the probe even at unchanged clock
+    before = fab.read(q, max_staleness=10**9)["sum"]
+    fab.join()
+    st = fab.svc.state
+    fab.svc.state = st.replace(
+        value=st.value.at[1, lane].add(jnp.asarray(2.0, st.value.dtype)))
+    after = fab.read(q, max_staleness=10**9)["sum"]
+    assert abs((after - before) - 2.0) < 1e-9, \
+        "an event must invalidate the staleness cache"
+    # done queries serve their recorded result regardless of staleness
+    done = QueryFabric(topo, lanes=1, capacity=16, degree_budget=8,
+                       config=_cfg(), segment_rounds=8, conv_eps=1e-6)
+    qd = done.submit(1.0)
+    done.run(64)
+    r = done.read(qd, max_staleness=0)
+    assert r["status"] == "done" and r["converged"]
+    assert abs(r["mean"] - 1.0) < 1e-6
+
+
+# ---- lifecycle + validation ----------------------------------------------
+
+def test_queue_lifecycle_and_validation():
+    topo = ring(8, k=1, seed=0)
+    fab = QueryFabric(topo, lanes=1, capacity=10, degree_budget=4,
+                      config=_cfg(), segment_rounds=4, conv_eps=1e-8)
+    q1 = fab.submit(1.0)
+    q2 = fab.submit(2.0)
+    assert fab.read(q1)["status"] == "active"
+    r2 = fab.read(q2)
+    assert r2["status"] == "queued" and r2["queue_position"] == 0
+    fab.run(64)
+    assert fab.read(q1)["status"] == "done"
+    assert fab.read(q2)["status"] == "done"
+    lat = fab.query_block()["admission_latency"]
+    assert lat["count"] == 2 and lat["max"] > 0
+    with pytest.raises(ValueError, match="not a member"):
+        fab.submit(1.0, cohort=[99])
+    with pytest.raises(ValueError, match="duplicate"):
+        fab.submit([1.0, 2.0], cohort=[3, 3])
+    with pytest.raises(ValueError, match="shape"):
+        fab.submit([1.0, 2.0], cohort=[3])
+    with pytest.raises(ValueError, match="whole number"):
+        fab.run(3)
+    with pytest.raises(ValueError, match="lanes"):
+        QueryFabric(topo, lanes=0, config=_cfg())
+    with pytest.raises(ValueError, match="conv_eps"):
+        QueryFabric(topo, lanes=1, config=_cfg(), conv_eps=0.0)
+    q3 = fab.submit(1.0, cohort=[2, 4])
+    with pytest.raises(ValueError, match="cohort"):
+        fab.update_query(q3, [5], [1.0])
+    with pytest.raises(ValueError, match="only active"):
+        fab.update_query(q2, [3], [1.0])
+
+
+def test_update_query_moves_the_lane_mass():
+    topo = ring(12, k=2, seed=0)
+    fab = _mk(topo, 2, _cfg(), capacity=16)
+    q = fab.submit([1.0, 2.0], cohort=[3, 7])
+    fab.run(16)
+    fab.update_query(q, [7], [5.0])
+    fab.run(64)
+    r = fab.read(q)
+    assert abs(r["sum"] - 6.0) < 1e-6
+
+
+# ---- doctor (negative directions) ----------------------------------------
+
+def test_check_query_fails_on_violations():
+    block = {
+        "dtype": "float64",
+        "compile_count": 2,
+        "lanes": {"capacity": 4, "active": 1, "free": 2,
+                  "peak_active": 3},
+        "boundaries": [{"t": 8, "live": 10, "scale": 1.0,
+                        "max_spread": 0.0, "max_resid_active": 0.0,
+                        "max_resid_free": 1e-9}],
+        "admission_latency": {"count": 3, "slo_rounds": 16, "p95": 40.0},
+    }
+    by_name = {c.name: c for c in health.check_query(block)}
+    assert by_name["query_compile"].status == health.FAIL
+    assert "retrace" in by_name["query_compile"].summary
+    assert by_name["query_lanes"].status == health.FAIL
+    assert by_name["query_lane_mass"].status == health.FAIL
+    assert "free lane" in by_name["query_lane_mass"].summary
+    assert by_name["query_admission"].status == health.FAIL
+    assert "SLO" in by_name["query_admission"].summary
+    # empty block degrades to a skip, never a traceback
+    assert health.check_query(None)[0].status == health.SKIP
+
+
+# ---- CLI + manifest + doctor e2e -----------------------------------------
+
+def test_query_cli_manifest_and_doctor(tmp_path, capsys):
+    rep = str(tmp_path / "query.json")
+    ckpt = str(tmp_path / "fab.npz")
+    rc = cli_main(["query", "--backend", "cpu",
+                   "--generator", "ring:16:2", "--lanes", "4",
+                   "--queries", "6", "--segment-rounds", "8",
+                   "--rounds", "512", "--eps", "1e-6",
+                   "--dtype", "float64", "--cohort-frac", "0.5",
+                   "--admission-slo", "128",
+                   "--report", rep, "--checkpoint", ckpt])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert rc == 0
+    assert summary["compile_count"] == 1
+    assert summary["completed"] == 6
+    m = json.load(open(rep))
+    assert m["schema"] == "flow-updating-query-report/v1"
+    assert m["query"]["lanes"]["capacity"] == 4
+    assert m["query"]["retired_total"] == 6
+    assert m["query"]["boundaries"]
+    assert all(b["max_resid_free"] == 0.0
+               for b in m["query"]["boundaries"])
+
+    rc = cli_main(["doctor", rep])
+    capsys.readouterr()
+    assert rc == 0
+
+    # resume the saved fabric checkpoint via the CLI
+    rc = cli_main(["query", "--backend", "cpu", "--resume", ckpt,
+                   "--queries", "0", "--rounds", "16",
+                   "--segment-rounds", "8"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    assert json.loads(out)["t"] == summary["t"] + 16
+
+    # a doctored manifest FAILS: free-lane mass leak
+    m["query"]["boundaries"][0]["max_resid_free"] = 1e-6
+    bad = str(tmp_path / "bad.json")
+    json.dump(m, open(bad, "w"))
+    rc = cli_main(["doctor", bad])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# ---- sweep layout pin (shared mask helpers) ------------------------------
+
+def test_shared_mask_helpers_pin_the_sweep_layout():
+    """The packer's ghost masking now routes through the shared helpers;
+    this pins their semantics to the historical inline construction
+    (born-dead ghosts, failed pad links, zero-padded value rows)."""
+    from flow_updating_tpu.models.state import init_state
+    from flow_updating_tpu.sweep.pack import (
+        SweepInstance,
+        bucket_shape,
+        pack_instance,
+    )
+    from flow_updating_tpu.topology.padding import (
+        mask_ghost_state,
+        pad_topology_to,
+    )
+
+    topo = ring(12, k=2, seed=5)
+    cfg = RoundConfig.fast(variant="collectall", dtype="float64")
+    vals = np.linspace(-1.0, 1.0, 24).reshape(12, 2)
+    n_pad, e_pad = bucket_shape(topo)
+    state, _arrays, _params = pack_instance(
+        SweepInstance(topo=topo, seed=7, values=vals), cfg, n_pad, e_pad)
+
+    padded = pad_topology_to(topo, n_pad, e_pad, spread="even")
+    ref = init_state(
+        padded, cfg, seed=7,
+        values=np.concatenate(
+            [vals, np.zeros((n_pad - 12, 2))], axis=0))
+    ref = ref.replace(
+        alive=ref.alive.at[12:].set(False),
+        edge_ok=ref.edge_ok.at[topo.num_edges:].set(False))
+    for name in state.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state, name)),
+            np.asarray(getattr(ref, name)),
+            err_msg=f"packed leaf {name} diverged from the historical "
+                    "inline construction")
+    # and the helper alone reproduces the mask edit bit-exactly
+    again = mask_ghost_state(ref, 12, topo.num_edges)
+    np.testing.assert_array_equal(np.asarray(again.alive),
+                                  np.asarray(ref.alive))
+
+
+# ---- bench key isolation -------------------------------------------------
+
+def test_bench_qps_baseline_key_isolation(tmp_path, monkeypatch):
+    import bench
+
+    path = str(tmp_path / "baseline.json")
+    monkeypatch.setattr(bench, "MEASURED_PATH", path)
+    k16 = {"des_rounds_per_sec": 100.0, "nodes": 1344, "edges": 6144,
+           "des": {"rounds_per_sec": 100.0, "ticks": 10, "repeats": 3,
+                   "spread_pct": 5.0}}
+    bench.record_baseline("16", k16)
+    qps = {"des_rounds_per_sec": 20.0, "nodes": 2048, "edges": 20430,
+           "des": {"rounds_per_sec": 20.0, "ticks": 1963, "repeats": 3,
+                   "spread_pct": 28.4}}
+    bench.record_baseline("qps_er2048_l256", qps)
+    data = json.load(open(path))
+    assert set(data) == {"k16", "qps_er2048_l256"}
+    assert data["k16"]["des_rounds_per_sec"] == 100.0
+    assert bench.recorded_baseline("qps_er2048_l256") == 20.0
+    # the family is registered with the baseline-key-family lint rule
+    from flow_updating_tpu.analysis.flowlint import _KEY_FAMILY_RES
+
+    assert any(r.fullmatch("qps_er2048_l256") for r in _KEY_FAMILY_RES)
+    assert any(r.fullmatch("qps_er100k_l1024") for r in _KEY_FAMILY_RES)
