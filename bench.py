@@ -1047,14 +1047,123 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
     }
 
 
+def measure_recovery(topo, lanes: int, segment_rounds: int,
+                     eps: float, repeats: int = 3) -> dict:
+    """Crash-recovery row: recovery-time-to-first-read of a
+    durability-armed query fabric (flow_updating_tpu.resilience).
+
+    Each repeat arms a fresh WAL + checkpoint ring, drives queries +
+    segments so the journal and ring carry real history, then abandons
+    the live object (the kill point — the directory is exactly what a
+    SIGKILL leaves) and times ``QueryFabric.recover``: newest-ring
+    restore + WAL replay + the first bounded-staleness read off a
+    fresh lane probe.  The metric is seconds-to-first-read; lower is
+    better, so the baseline ratio inverts (vs_baseline > 1 = faster
+    recovery than recorded)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from flow_updating_tpu.query import QueryFabric
+
+    rng = np.random.default_rng(0)
+    members = np.arange(topo.num_nodes)
+    m = max(1, topo.num_nodes // 4)
+    times, replayed = [], []
+    for rep in range(repeats):
+        scratch = tempfile.mkdtemp(prefix="bench-recovery-")
+        try:
+            fab = QueryFabric(topo, lanes=lanes,
+                              capacity=topo.num_nodes,
+                              segment_rounds=segment_rounds,
+                              conv_eps=eps, seed=rep)
+            fab.enable_durability(scratch, checkpoint_every=4,
+                                  retain=3)
+            for _ in range(min(lanes, 32)):
+                cohort = np.sort(rng.choice(members, size=m,
+                                            replace=False))
+                fab.submit(rng.random(m), cohort=cohort)
+            fab.run(8 * segment_rounds)
+            # one more submit AFTER the last possible checkpoint so the
+            # replay always has work (the realistic kill point)
+            cohort = np.sort(rng.choice(members, size=m, replace=False))
+            qid = fab.submit(rng.random(m), cohort=cohort)
+            del fab          # the "kill": only the directory survives
+            t0 = time.perf_counter()
+            rec = QueryFabric.recover(scratch)
+            rec.read(qid, max_staleness=None)
+            times.append(time.perf_counter() - t0)
+            replayed.append(
+                rec._recovery["replay"]["records_replayed"])
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+    mean = sum(times) / len(times)
+    spread = 100 * (max(times) - min(times)) / mean if mean else 0.0
+    return {
+        "recovery_s": mean,
+        "recovery_s_min": min(times),
+        "recovery_s_max": max(times),
+        "spread_pct": round(spread, 1),
+        "repeats": repeats,
+        "records_replayed": replayed,
+        "lanes": lanes,
+        "segment_rounds": segment_rounds,
+    }
+
+
 def run_serve_bench(args) -> dict:
     """The ``--serve`` measurement body (child-side, settled backend):
     the query fabric's sustained queries/s row, recorded under the
-    disjoint ``qps_*`` baseline family."""
+    disjoint ``qps_*`` baseline family — or, with ``--chaos kill``, the
+    crash-recovery row under the ``recovery_*`` family."""
     from flow_updating_tpu.topology.generators import erdos_renyi
 
     nodes, lanes = args.serve_nodes, args.serve_lanes
     topo = erdos_renyi(nodes, avg_degree=8.0, seed=0)
+    if args.chaos == "kill":
+        rv = measure_recovery(topo, lanes, args.segment_rounds,
+                              args.serve_eps)
+        slug = f"{nodes // 1000}k" if nodes % 1000 == 0 else str(nodes)
+        base_key = f"recovery_er{slug}_l{lanes}"
+        # seconds-to-first-read inverts: rounds_per_sec-style "higher
+        # is better" is preserved by recording 1/time as the rate
+        rate = 1.0 / rv["recovery_s"] if rv["recovery_s"] else 0.0
+        des = {
+            "rounds_per_sec": rate,
+            "ticks": int(sum(rv["records_replayed"])),
+            "repeats": rv["repeats"],
+            "spread_pct": rv["spread_pct"],
+            "note": ("recoveries/sec of the durability-armed query "
+                     "fabric (ring restore + WAL replay + first "
+                     "read; not a DES measurement)"),
+        }
+        if rv["spread_pct"] <= SPREAD_VALIDITY_PCT:
+            record_baseline(base_key, baseline_entry(topo, des))
+        base_rps = recorded_baseline(base_key)
+        base_src = "recorded" if base_rps is not None else "measured"
+        if base_rps is None:
+            base_rps = rate
+        return {
+            "metric": (f"crash recovery to first read (ER {nodes} "
+                       f"nodes, {lanes} lanes, WAL replay of "
+                       f"{rv['records_replayed']} records)"),
+            "value": round(rv["recovery_s"], 4),
+            "unit": "seconds",
+            "backend": "cpu",
+            "vs_baseline": (round(rate / base_rps, 3)
+                            if base_rps else None),
+            "extra": {
+                "nodes": topo.num_nodes,
+                "directed_edges": topo.num_edges,
+                "recovery": {k: (round(v, 5) if isinstance(v, float)
+                                 else v) for k, v in rv.items()},
+                "baseline_recoveries_per_sec": (round(base_rps, 4)
+                                                if base_rps else None),
+                "baseline_source": base_src,
+                "baseline_key": _baseline_key(base_key),
+            },
+        }
     sv = measure_query_serve(topo, lanes, args.segment_rounds,
                              args.serve_rate, args.serve_eps)
 
@@ -1761,6 +1870,14 @@ def parse_args(argv=None):
     ap.add_argument("--serve-eps", type=float, default=1e-4,
                     help="with --serve: per-query convergence "
                          "tolerance (relative estimate spread)")
+    ap.add_argument("--chaos", default=None, choices=("kill",),
+                    help="with --serve: crash-recovery variant — arm "
+                         "the fabric's WAL + checkpoint ring, abandon "
+                         "the live engine mid-churn (the kill point), "
+                         "and measure recovery-time-to-first-read "
+                         "(ring restore + WAL replay + first lane "
+                         "probe), recorded under the isolated "
+                         "'recovery_er<N>_l<L>' baseline family")
     ap.add_argument("--scaling", action="store_true",
                     help="weak-scaling ladder row: fixed nodes per shard "
                          "on the virtual CPU mesh (scripts/"
@@ -1812,6 +1929,9 @@ def parse_args(argv=None):
                  "--scenario/--scaling/--dfl")
     if args.serve and (args.serve_lanes < 1 or args.serve_nodes < 16):
         ap.error("--serve-lanes must be >= 1 and --serve-nodes >= 16")
+    if args.chaos and not args.serve:
+        ap.error("--chaos is a --serve variant (the crash-recovery "
+                 "row measures the query fabric); add --serve")
     if (args.serve_lanes != 256 or args.serve_nodes != 2048
             or args.serve_rate or args.serve_eps != 1e-4) \
             and not args.serve:

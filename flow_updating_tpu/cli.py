@@ -742,7 +742,17 @@ def cmd_serve(args) -> int:
     from flow_updating_tpu.models.config import RoundConfig
     from flow_updating_tpu.service import ServiceEngine
 
-    if args.resume:
+    if args.recover:
+        if not args.wal:
+            raise SystemExit(
+                "serve: --recover needs --wal DIR (the durability "
+                "directory the crashed service was journaling into)")
+        try:
+            svc = ServiceEngine.recover(args.wal)
+        except ValueError as err:
+            raise SystemExit(f"serve: {err}") from err
+        topo = None
+    elif args.resume:
         try:
             svc = ServiceEngine.restore_checkpoint(args.resume)
         except ValueError as err:
@@ -768,6 +778,13 @@ def cmd_serve(args) -> int:
                 seed=args.seed)
         except ValueError as err:
             raise SystemExit(f"invalid service configuration: {err}") from err
+    if args.wal and not args.recover:
+        try:
+            svc.enable_durability(args.wal,
+                                  checkpoint_every=args.checkpoint_every,
+                                  retain=args.retain)
+        except (ValueError, OSError) as err:
+            raise SystemExit(f"serve: cannot arm durability: {err}") from err
 
     if args.events == "-":
         events = _parse_service_events(sys.stdin.readlines())
@@ -837,6 +854,13 @@ def cmd_serve(args) -> int:
     }
     if joined:
         out["joined"] = joined
+    resil = svc.resilience_block()
+    if resil is not None:
+        out["durability"] = {
+            "dir": resil.get("dir"),
+            "wal_seq": (resil.get("wal") or {}).get("last_seq"),
+            "recovered": svc._recovery is not None,
+        }
     if args.report:
         from flow_updating_tpu.obs.report import (
             build_service_manifest,
@@ -846,7 +870,8 @@ def cmd_serve(args) -> int:
         write_report(args.report, build_service_manifest(
             argv=getattr(args, "_argv", None), config=svc.config,
             topo=topo, service=svc.service_block(),
-            series=svc.boundary_series(), report=report))
+            series=svc.boundary_series(), report=report,
+            extra={"recovery": resil} if resil is not None else None))
         out["report_path"] = args.report
     print(json.dumps(out))
     return 0
@@ -867,7 +892,17 @@ def cmd_query(args) -> int:
     from flow_updating_tpu.models.config import RoundConfig
     from flow_updating_tpu.query import QueryFabric
 
-    if args.resume:
+    if args.recover:
+        if not args.wal:
+            raise SystemExit(
+                "query: --recover needs --wal DIR (the durability "
+                "directory the crashed fabric was journaling into)")
+        try:
+            fab = QueryFabric.recover(args.wal)
+        except ValueError as err:
+            raise SystemExit(f"query: {err}") from err
+        topo = None
+    elif args.resume:
         try:
             fab = QueryFabric.restore_checkpoint(args.resume)
         except ValueError as err:
@@ -895,6 +930,15 @@ def cmd_query(args) -> int:
                 admission_slo_rounds=args.admission_slo or None)
         except ValueError as err:
             raise SystemExit(f"invalid query configuration: {err}") from err
+    if args.watchdog and fab._watchdog is None:
+        fab.attach_watchdog()
+    if args.wal and not args.recover:
+        try:
+            fab.enable_durability(args.wal,
+                                  checkpoint_every=args.checkpoint_every,
+                                  retain=args.retain)
+        except (ValueError, OSError) as err:
+            raise SystemExit(f"query: cannot arm durability: {err}") from err
 
     # Poisson-arrival driver: random-cohort mean queries submitted at
     # --arrival-rate per round until --queries have been offered, then
@@ -926,7 +970,7 @@ def cmd_query(args) -> int:
     block = fab.query_block()
     out = {
         "t": fab.clock,
-        "lanes": args.lanes if not args.resume else fab.lanes,
+        "lanes": fab.lanes,
         "submitted": submitted,
         "completed": block["retired_total"],
         "active": block["lanes"]["active"],
@@ -937,6 +981,14 @@ def cmd_query(args) -> int:
     }
     if args.checkpoint:
         fab.save_checkpoint(args.checkpoint)
+    resil = fab.resilience_block()
+    if resil is not None:
+        out["durability"] = {
+            "dir": resil.get("dir"),
+            "wal_seq": (resil.get("wal") or {}).get("last_seq"),
+            "recovered": fab._recovery is not None,
+            "quarantined": fab.quarantined_total,
+        }
     if args.report:
         from flow_updating_tpu.obs.report import (
             build_query_manifest,
@@ -946,10 +998,69 @@ def cmd_query(args) -> int:
         write_report(args.report, build_query_manifest(
             argv=getattr(args, "_argv", None), config=fab.svc.config,
             topo=topo, query=block,
-            timings={"wall_s": round(wall_s, 6)}))
+            timings={"wall_s": round(wall_s, 6)},
+            extra={"recovery": resil} if resil is not None else None))
         out["report_path"] = args.report
     print(json.dumps(out))
     return 0
+
+
+def cmd_chaos(args) -> int:
+    """``chaos``: the infrastructure-fault conformance suite
+    (flow_updating_tpu.resilience.chaos) — inject each registered fault
+    into a real subprocess run, exercise the declared recovery
+    machinery, doctor-assert the recovery signature and require
+    ``inspect --blame`` to name the planted fault at rank 1.  With
+    ``--perturb`` the recovery is disabled and the signature is
+    EXPECTED to fail (the negative control).  Exit 1 on any violated
+    contract."""
+    from flow_updating_tpu.resilience.chaos import (
+        CHAOS_REGISTRY,
+        get_fault,
+        run_chaos,
+    )
+
+    if args.list:
+        print(json.dumps({
+            name: {"summary": f.summary, "kind": f.kind,
+                   "kill": f.kill, "tamper": f.tamper,
+                   "inject": f.inject, "watchdog": f.watchdog}
+            for name, f in CHAOS_REGISTRY.items()}))
+        return 0
+    names = list(args.names) or sorted(CHAOS_REGISTRY)
+    for n in names:
+        try:
+            get_fault(n)
+        except ValueError as err:
+            raise SystemExit(f"chaos: {err}") from err
+    _select_backend(args.backend)
+    results, bad = [], []
+    for n in names:
+        try:
+            out = run_chaos(
+                n, nodes=args.nodes, lanes=args.lanes,
+                segment_rounds=args.segment_rounds, n_ops=args.ops,
+                seed=args.seed, outdir=args.outdir,
+                perturb=args.perturb)
+        except (ValueError, RuntimeError) as err:
+            raise SystemExit(f"chaos: {n}: {err}") from err
+        if args.perturb:
+            # the recovery-disabled control MUST fail its signature
+            ok = out["exit_code"] != 0
+        else:
+            ok = out["exit_code"] == 0 and out["blame_top"] == n
+        if not ok:
+            bad.append(n)
+        results.append({k: out[k] for k in
+                        ("fault", "perturb", "overall", "blame_top",
+                         "manifest_path")})
+    print(json.dumps({
+        "faults": names,
+        "perturb": bool(args.perturb),
+        "violations": bad,
+        "results": results,
+    }))
+    return 1 if bad else 0
 
 
 def cmd_generate(args) -> int:
@@ -1211,9 +1322,18 @@ def cmd_inspect(args) -> int:
                 timings={"run_s": round(run_s, 6)}))
         targets.append((args.report or "<live>", series))
     sweep_targets = []
+    recovery_targets = []
     for path in args.reports:
         doc = _load_inspect_manifest(path)
-        if (isinstance(doc.get("instances"), list)
+        if isinstance(doc.get("recovery"), dict):
+            # a flow-updating-recovery-report/v1 manifest: blame ranks
+            # the registered infra faults from the recovery evidence
+            if not args.blame:
+                raise SystemExit(
+                    f"inspect: {path} is a recovery manifest — pass "
+                    "--blame to rank the infra faults that explain it")
+            recovery_targets.append((path, doc))
+        elif (isinstance(doc.get("instances"), list)
                 and not isinstance(doc.get("fields"), dict)):
             # a sweep manifest: blame ranks the worst instances and
             # cites each lane's recorded worst nodes as stragglers
@@ -1225,7 +1345,7 @@ def cmd_inspect(args) -> int:
             sweep_targets.append((path, doc))
         else:
             targets.append((path, _field_series_from(doc, path)))
-    if not targets and not sweep_targets:
+    if not targets and not sweep_targets and not recovery_targets:
         raise SystemExit(
             "inspect: nothing to inspect — pass saved field-manifest "
             "paths, --diff A B, or a topology (--generator/"
@@ -1279,6 +1399,12 @@ def cmd_inspect(args) -> int:
         except ValueError as err:
             raise SystemExit(f"inspect: {path}: {err}") from err
         out.append({"source": path, "sweep_blame": verdict})
+    for path, doc in recovery_targets:
+        try:
+            verdict = _inspect.blame_recovery(doc)
+        except ValueError as err:
+            raise SystemExit(f"inspect: {path}: {err}") from err
+        out.append({"source": path, "recovery_blame": verdict})
     _emit_json(out[0] if len(out) == 1 else {"inspected": out},
                args.output)
     return 0
@@ -1601,6 +1727,28 @@ def cmd_audit(args) -> int:
     return health.exit_code([check], strict=args.strict)
 
 
+def _add_durability_flags(p, prog: str) -> None:
+    """The crash-safety flag set shared by ``serve`` and ``query``
+    (flow_updating_tpu.resilience, docs/RESILIENCE.md)."""
+    p.add_argument("--wal", metavar="DIR",
+                   help="durability directory: journal every event in "
+                        "an fsync'd CRC-framed WAL and write automatic "
+                        "ring checkpoints — a SIGKILL at any point "
+                        f"recovers bit-exactly via `{prog} --wal DIR "
+                        "--recover`")
+    p.add_argument("--checkpoint-every", type=int, default=8,
+                   metavar="K",
+                   help="ring cadence: one checkpoint every K compiled "
+                        "segments (with --wal)")
+    p.add_argument("--retain", type=int, default=3, metavar="N",
+                   help="ring retention: keep the newest N checkpoints "
+                        "(corrupt newest falls back to next)")
+    p.add_argument("--recover", action="store_true",
+                   help="rebuild the engine from --wal DIR (newest "
+                        "valid ring checkpoint + WAL replay) instead "
+                        "of building fresh")
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="flow_updating_tpu",
@@ -1865,6 +2013,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-message loss probability")
     sv.add_argument("--dtype", default="float32",
                     choices=("float32", "float64"))
+    _add_durability_flags(sv, "serve")
     sv.add_argument("--resume", metavar="CKPT",
                     help="restore a service checkpoint instead of "
                          "building from a topology (bit-exact resume)")
@@ -1926,6 +2075,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-message loss probability")
     qr.add_argument("--dtype", default="float32",
                     choices=("float32", "float64"))
+    _add_durability_flags(qr, "query")
+    qr.add_argument("--watchdog", action="store_true",
+                    help="arm the inline lane watchdog: NaN/divergence "
+                         "lanes are quarantined mass-neutrally between "
+                         "segments, admissions back off when lanes are "
+                         "exhausted (flow_updating_tpu.resilience."
+                         "watchdog)")
     qr.add_argument("--resume", metavar="CKPT",
                     help="restore a query-fabric checkpoint (lane "
                          "tables included) instead of building fresh")
@@ -1937,6 +2093,43 @@ def build_parser() -> argparse.ArgumentParser:
                          "latency vs SLO, per-boundary lane-mass rows) "
                          "to PATH")
     qr.set_defaults(fn=cmd_query)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="infrastructure-fault conformance: inject each registered "
+             "infra fault (SIGKILL, torn WAL, corrupt/bitflipped "
+             "checkpoint, NaN-poisoned lane, admission storm) into a "
+             "real subprocess run, doctor-assert the declared recovery "
+             "signature, and require blame to name the planted fault "
+             "at rank 1 (flow_updating_tpu.resilience.chaos, "
+             "docs/RESILIENCE.md)")
+    ch.add_argument("names", nargs="*", metavar="FAULT",
+                    help="registered fault names (default: the whole "
+                         "registry; see --list)")
+    ch.add_argument("--list", action="store_true",
+                    help="print the fault registry and exit")
+    ch.add_argument("--nodes", type=int, default=128,
+                    help="scripted-run member count")
+    ch.add_argument("--lanes", type=int, default=8,
+                    help="query-lane capacity for fabric faults")
+    ch.add_argument("--segment-rounds", type=int, default=8)
+    ch.add_argument("--ops", type=int, default=28,
+                    help="scripted event-stream length (one WAL record "
+                         "per op)")
+    ch.add_argument("--seed", type=int, default=0)
+    ch.add_argument("--outdir", default="obs-artifacts",
+                    help="where the flow-updating-recovery-report/v1 "
+                         "manifests land")
+    ch.add_argument("--perturb", action="store_true",
+                    help="negative control: disable the recovery "
+                         "machinery — every signature is EXPECTED to "
+                         "fail")
+    ch.add_argument("--backend", default="auto",
+                    choices=("auto", "cpu", "jax_tpu"),
+                    help="JAX backend pin for the in-process "
+                         "control/recovery runs (children always pin "
+                         "cpu)")
+    ch.set_defaults(fn=cmd_chaos)
 
     gen = sub.add_parser("generate", help="topology summary")
     _add_common(gen)

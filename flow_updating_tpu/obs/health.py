@@ -774,6 +774,204 @@ def check_query(query: dict | None, *, dtype: str | None = None) -> list:
     return checks
 
 
+#: Planted faults whose recovery path MUST include a WAL replay —
+#: used by check_recovery when a chaos manifest carries ground truth.
+_CRASH_FAULTS = ("kill_at_segment", "kill_mid_checkpoint",
+                 "truncate_wal_tail", "corrupt_newest_ckpt",
+                 "bitflip_archive")
+
+
+def check_recovery(recovery: dict | None) -> list:
+    """The crash-safety SLO checks over a manifest's ``recovery`` block
+    (``flow-updating-recovery-report/v1``; docs/RESILIENCE.md):
+
+    * **wal_replay_exact** — the recovery replayed every journaled
+      record after its base checkpoint, and — when a harness recorded a
+      control digest — the recovered state is bit-exact vs the
+      uninterrupted run;
+    * **ring_integrity** — recovery restored an undamaged archive,
+      falling back past every corrupt newer one (the scan's per-archive
+      integrity verdicts are the evidence), retention within bounds;
+    * **quarantine_mass** — every watchdog quarantine scrubbed its lane
+      back to a ledger residual of exactly 0.0 (the mass-neutral
+      free-lane fixed point);
+    * **degraded_mode_bounded** — every lane-exhaustion episode ended
+      (the queue drained) with the admission backoff within its cap.
+
+    When the block carries chaos ``ground_truth``, the planted fault's
+    expected evidence becomes mandatory: a recovery-disabled control
+    FAILS instead of skipping (the PR-9 conformance loop closed over
+    the infrastructure layer)."""
+    if not recovery:
+        return [CheckResult("recovery", SKIP,
+                            "no recovery block recorded")]
+    checks = []
+    gt = (recovery.get("ground_truth") or {}).get("fault")
+    replay = recovery.get("replay") or {}
+    verify = recovery.get("verify") or replay.get("verify")
+
+    name = "wal_replay_exact"
+    if verify:
+        exact = bool(verify.get("exact"))
+        checks.append(CheckResult(
+            name, PASS if exact else FAIL,
+            "recovered state bit-exact vs the uninterrupted control "
+            "(digests match)" if exact else
+            "recovered state DIVERGED from the uninterrupted control "
+            "(digest mismatch — events lost or replayed out of order)",
+            {"verify": dict(verify),
+             "records_replayed": replay.get("records_replayed")}))
+    elif replay:
+        pending = int(replay.get("records_pending", 0))
+        applied = int(replay.get("records_replayed", 0))
+        if not replay.get("enabled", True):
+            checks.append(CheckResult(
+                name, FAIL,
+                f"recovery disabled: WAL replay skipped with {pending} "
+                "journaled record(s) pending — the recovered state is "
+                "the stale checkpoint, not the acknowledged timeline",
+                dict(replay)))
+        elif applied < pending:
+            checks.append(CheckResult(
+                name, FAIL,
+                f"replay incomplete: {applied}/{pending} journaled "
+                "records applied", dict(replay)))
+        else:
+            checks.append(CheckResult(
+                name, PASS,
+                f"replayed all {applied} journaled record(s) "
+                f"({replay.get('events_replayed', 0)} events, "
+                f"{replay.get('rounds_replayed', 0)} rounds) since "
+                f"wal_seq {replay.get('base_wal_seq')} (no control "
+                "digest recorded — exactness asserted by the chaos "
+                "harness)", dict(replay)))
+    elif gt in _CRASH_FAULTS:
+        checks.append(CheckResult(
+            name, FAIL,
+            f"planted fault {gt!r} requires a crash recovery, but no "
+            "replay was recorded", {"ground_truth": gt}))
+    else:
+        checks.append(CheckResult(
+            name, SKIP, "no crash recovery ran (durability-only run)"))
+
+    name = "ring_integrity"
+    ring = recovery.get("ring")
+    if not isinstance(ring, dict):
+        checks.append(CheckResult(name, SKIP, "no ring block recorded"))
+    else:
+        scanned = ring.get("scanned") or []
+        used = ring.get("used")
+        fallbacks = int(ring.get("fallbacks", 0))
+        kept = ring.get("kept")
+        retain = ring.get("retain")
+        bad = None
+        if scanned and used is None:
+            bad = ("no archive in the ring restored — recovery could "
+                   "not fall back to a valid checkpoint")
+        elif used and used.get("integrity") not in ("valid",
+                                                    "unindexed"):
+            bad = (f"recovery restored a damaged archive "
+                   f"({used.get('integrity')}: "
+                   f"{used.get('path')}) instead of falling back")
+        elif retain is not None and kept is not None \
+                and int(kept) > int(retain):
+            bad = (f"retention violated: {kept} archives kept, "
+                   f"retain={retain}")
+        elif gt in ("corrupt_newest_ckpt", "bitflip_archive") \
+                and fallbacks == 0:
+            bad = (f"planted fault {gt!r} should have forced a "
+                   "fallback, but every archive restored cleanly")
+        ev = {"used": used, "fallbacks": fallbacks, "kept": kept,
+              "retain": retain,
+              "scanned": [{k: s.get(k) for k in
+                           ("path", "integrity", "status")}
+                          for s in scanned]}
+        if bad:
+            checks.append(CheckResult(name, FAIL, bad, ev))
+        else:
+            checks.append(CheckResult(
+                name, PASS,
+                "ring intact: restored "
+                + (str(used.get("path")) if used else "no archive")
+                + (f" after falling back past {fallbacks} damaged "
+                   f"newer archive(s)" if fallbacks else
+                   " (newest archive valid)"), ev))
+
+    wd = recovery.get("watchdog") or {}
+    actions = wd.get("actions") or []
+    name = "quarantine_mass"
+    if actions:
+        leaked = [a for a in actions
+                  if float(a.get("post_scrub_residual", 0.0)) != 0.0]
+        if leaked:
+            worst = leaked[0]
+            checks.append(CheckResult(
+                name, FAIL,
+                f"quarantined lane {worst.get('lane')} left a non-zero "
+                f"ledger residual {worst.get('post_scrub_residual')!r} "
+                "after the scrub (the free-lane fixed point must be "
+                "exactly 0.0)",
+                {"leaked": leaked, "actions": len(actions)}))
+        else:
+            reasons = sorted({a.get("reason") for a in actions})
+            checks.append(CheckResult(
+                name, PASS,
+                f"{len(actions)} lane(s) quarantined "
+                f"({'/'.join(str(r) for r in reasons)}), every "
+                "post-scrub residual exactly 0.0",
+                {"actions": actions}))
+    elif gt == "nan_poison_lane":
+        checks.append(CheckResult(
+            name, FAIL,
+            "planted NaN-poisoned lane was never quarantined (watchdog "
+            "absent or blind) — the poison stays in the compiled "
+            "engine", {"ground_truth": gt, "watchdog": bool(wd)}))
+    else:
+        checks.append(CheckResult(
+            name, SKIP, "no quarantine actions recorded"))
+
+    name = "degraded_mode_bounded"
+    episodes = wd.get("degraded") or []
+    if episodes:
+        cap = ((wd.get("config") or {}).get("backoff_max"))
+        unended = [e for e in episodes if e.get("end_t") is None]
+        overcap = [e for e in episodes
+                   if cap is not None
+                   and int(e.get("max_backoff", 0)) > int(cap)]
+        if unended:
+            e = unended[0]
+            checks.append(CheckResult(
+                name, FAIL,
+                f"degraded episode starting at round "
+                f"{e.get('start_t')} never ended (queue never drained "
+                f"over {e.get('boundaries')} boundaries)",
+                {"unended": unended}))
+        elif overcap:
+            checks.append(CheckResult(
+                name, FAIL,
+                f"admission backoff exceeded its cap {cap}",
+                {"overcap": overcap}))
+        else:
+            longest = max(int(e.get("boundaries", 0)) for e in episodes)
+            checks.append(CheckResult(
+                name, PASS,
+                f"{len(episodes)} degraded episode(s), all drained "
+                f"(longest {longest} boundaries, backoff within "
+                f"{cap})", {"episodes": episodes,
+                            "deferred_admissions":
+                            wd.get("deferred_admissions")}))
+    elif gt == "admission_storm":
+        checks.append(CheckResult(
+            name, FAIL,
+            "planted admission storm left no degraded-mode episode "
+            "(watchdog absent or backoff never engaged)",
+            {"ground_truth": gt, "watchdog": bool(wd)}))
+    else:
+        checks.append(CheckResult(
+            name, SKIP, "no degraded-mode episodes recorded"))
+    return checks
+
+
 def check_report(report: dict | None, *, dtype: str | None = None
                  ) -> CheckResult:
     """Final-state sanity from a run manifest's convergence report:
@@ -1210,6 +1408,11 @@ def diagnose_manifest(manifest: dict) -> list:
     query = manifest.get("query")
     if isinstance(query, dict):
         checks.extend(check_query(query, dtype=dtype))
+    recovery = manifest.get("recovery")
+    if isinstance(recovery, dict):
+        # a flow-updating-recovery-report/v1 manifest (or any manifest
+        # from a durability-armed engine): the crash-safety SLOs
+        checks.extend(check_recovery(recovery))
     results = manifest.get("results")
     if (isinstance(results, list) and results
             and isinstance(results[0], dict)

@@ -320,6 +320,93 @@ def blame_sweep(manifest: dict, *, top: int = 3) -> dict:
             "ranked_of": len(instances)}
 
 
+def blame_recovery(manifest: dict) -> dict:
+    """Blame over a ``flow-updating-recovery-report/v1`` manifest: rank
+    the registered infra faults (flow_updating_tpu.resilience.chaos) by
+    how strongly the recovery evidence implicates each — the chaos
+    harness asserts its planted fault ranks first.
+
+    The evidence → fault map (each signature is written by a different
+    layer, so they compose rather than collide):
+
+    * a truncated WAL tail (``wal.torn_bytes_truncated``) → the journal
+      was torn mid-append (``truncate_wal_tail``);
+    * a ring archive classified ``truncated`` by its sidecar → a torn
+      archive copy (``corrupt_newest_ckpt``); ``bitflipped`` (size
+      intact, digest off) → in-place corruption (``bitflip_archive``);
+    * stale ``*.tmp.*`` files swept at recovery → the crash hit between
+      the atomic write's temp and its rename
+      (``kill_mid_checkpoint``);
+    * watchdog quarantines with reason ``nan`` →
+      ``nan_poison_lane``;
+    * degraded-mode episodes / deferred admissions →
+      ``admission_storm``;
+    * a bare replay with none of the above → a plain
+      ``kill_at_segment`` (every crash recovery replays, so this only
+      ranks first when nothing more specific fired).
+    """
+    rec = manifest.get("recovery") if isinstance(manifest, dict) else None
+    if not isinstance(rec, dict):
+        raise ValueError(
+            "manifest has no recovery block to blame (recovery "
+            "manifests are written by the chaos harness / the "
+            "serve|query CLIs' --recover path)")
+    wal = rec.get("wal") or {}
+    ring = rec.get("ring") or {}
+    wd = rec.get("watchdog") or {}
+    replay = rec.get("replay") or {}
+    scanned = ring.get("scanned") or []
+    scores: dict = {}
+
+    def _vote(fault, score, why):
+        cur = scores.get(fault)
+        if cur is None or score > cur["score"]:
+            scores[fault] = {"fault": fault, "score": score,
+                             "evidence": why}
+
+    torn = int(wal.get("torn_bytes_truncated", 0) or 0)
+    if torn or wal.get("torn_tail"):
+        _vote("truncate_wal_tail", 3,
+              f"WAL tail torn ({torn} bytes truncated on open)")
+    for s in scanned:
+        if s.get("integrity") == "truncated":
+            _vote("corrupt_newest_ckpt", 3,
+                  f"{s.get('path')} shrank vs its integrity sidecar")
+        elif s.get("integrity") == "bitflipped":
+            _vote("bitflip_archive", 3,
+                  f"{s.get('path')} digest mismatch at intact size")
+    if rec.get("stale_tmp_swept"):
+        _vote("kill_mid_checkpoint", 3,
+              f"stale atomic-write temp(s) swept: "
+              f"{rec['stale_tmp_swept']}")
+    nan_acts = [a for a in (wd.get("actions") or [])
+                if a.get("reason") == "nan"]
+    if nan_acts:
+        # score 4: a quarantine is the most specific evidence there is
+        # — a storm that happens to accompany the poisoned workload
+        # (deferred admissions, score 3) must not outrank it
+        _vote("nan_poison_lane", 4,
+              f"{len(nan_acts)} lane(s) quarantined with non-finite "
+              "probe entries")
+    if wd.get("degraded"):
+        # a storm DEFERS admissions (backoff active while lanes free
+        # up); a brief full-lane blip records an episode with zero
+        # deferrals — weak evidence that must not outrank a specific
+        # fault like a NaN quarantine
+        deferred = int(wd.get("deferred_admissions", 0) or 0)
+        _vote("admission_storm", 3 if deferred else 1,
+              f"{len(wd['degraded'])} lane-exhaustion episode(s), "
+              f"{deferred} deferred admissions")
+    if int(replay.get("records_replayed", 0) or 0) > 0:
+        _vote("kill_at_segment", 1,
+              f"crash recovery replayed "
+              f"{replay.get('records_replayed')} journaled record(s)")
+    ranked = sorted(scores.values(),
+                    key=lambda v: (-v["score"], v["fault"]))
+    return {"ranked": ranked,
+            "top": ranked[0]["fault"] if ranked else None}
+
+
 def blame_divergence(fields) -> dict | None:
     """Origin of the first non-finite value: the earliest recorded row
     any per-node field goes NaN/Inf, and the node ids carrying it.

@@ -174,7 +174,114 @@ class QueryFabric:
         self.admitted_total = 0
         self.retired_total = 0
         self.peak_active = 0
+        self.quarantined_total = 0
+        self._watchdog = None
+        self._watchdog_pending_state = None
+        self._init_resilience()
         self._probe_floor = _probe_jit()._cache_size()
+
+    # ---- resilience (flow_updating_tpu.resilience) -----------------------
+    def _init_resilience(self) -> None:
+        self._wal = None
+        self._ring = None
+        self._resil_dir = None
+        self._replaying = False
+        self._wal_applied_seq = 0
+        self._recovery = None
+
+    def _journal(self, kind: str, args: dict) -> None:
+        if self._wal is not None and not self._replaying:
+            self._wal_applied_seq = self._wal.append(kind, args,
+                                                     self.clock)
+
+    def enable_durability(self, directory: str, *,
+                          checkpoint_every: int = 8, retain: int = 3,
+                          fsync: bool = True) -> QueryFabric:
+        """Arm the fabric's event WAL + checkpoint ring (the service
+        engine's durability applied at the fabric level: submissions,
+        query updates and membership events journal through the fabric
+        so replay drives the fabric's own lifecycle — docs/
+        RESILIENCE.md).  Recover with :meth:`recover`."""
+        from flow_updating_tpu.resilience.recover import arm_durability
+
+        arm_durability(self, directory, kind="query",
+                       checkpoint_every=checkpoint_every,
+                       retain=retain, fsync=fsync)
+        return self
+
+    @classmethod
+    def recover(cls, directory: str) -> QueryFabric:
+        """Rebuild the fabric journaled in ``directory`` (newest valid
+        ring checkpoint + WAL replay; the watchdog re-attaches from the
+        directory config) — bit-exact vs the uninterrupted run."""
+        from flow_updating_tpu.resilience.recover import recover
+
+        return recover(directory, kind="query")
+
+    def attach_watchdog(self, config=None) -> QueryFabric:
+        """Arm the inline lane watchdog
+        (:class:`flow_updating_tpu.resilience.watchdog.Watchdog`):
+        NaN/divergence/stall lanes are quarantined mass-neutrally at
+        segment boundaries, admissions back off when lanes are
+        exhausted.  When durability is armed, the config persists to
+        the directory so :meth:`recover` re-arms it."""
+        from flow_updating_tpu.resilience.recover import (
+            _write_config,
+            read_config,
+        )
+        from flow_updating_tpu.resilience.watchdog import (
+            Watchdog,
+            WatchdogConfig,
+        )
+
+        if config is None:
+            config = WatchdogConfig()
+        self._watchdog = Watchdog(config)
+        if self._watchdog_pending_state is not None:
+            # a checkpoint restored watchdog runtime (backoff counters,
+            # open episode, stall windows): the admission schedule must
+            # continue where the dead process stopped, or replay is no
+            # longer bit-exact
+            self._watchdog.load_state(self._watchdog_pending_state)
+            self._watchdog_pending_state = None
+        if self._resil_dir is not None:
+            doc = read_config(self._resil_dir)
+            doc["watchdog"] = config.to_jsonable()
+            _write_config(self._resil_dir, doc)
+        return self
+
+    def state_digest(self) -> str:
+        """sha256 over the service digest + the lane tables — the
+        fabric's bit-exactness verdict in one string."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(self.svc.state_digest().encode())
+        h.update(repr(sorted(self._free_lanes)).encode())
+        h.update(repr(self._lane_q).encode())
+        h.update(repr(self._queue).encode())
+        h.update(repr(self._next_qid).encode())
+        return h.hexdigest()
+
+    def resilience_block(self) -> dict | None:
+        """The manifest's ``recovery`` block (see
+        ``ServiceEngine.resilience_block``); None with durability off
+        and no watchdog attached."""
+        if self._wal is None and self._recovery is None \
+                and self._watchdog is None:
+            return None
+        out = {"dir": self._resil_dir, "kind": "query"}
+        if self._recovery is not None:
+            out.update(self._recovery)
+        if self._wal is not None:
+            out.setdefault("wal", self._wal.block())
+        if self._ring is not None:
+            ring = dict(out.get("ring") or {})
+            ring.update(self._ring.block())
+            out["ring"] = ring
+        if self._watchdog is not None:
+            out["watchdog"] = self._watchdog.block()
+        return out
 
     # ---- views -----------------------------------------------------------
     @property
@@ -212,15 +319,24 @@ class QueryFabric:
     # cohort — its freed slot may be recycled by a later join that must
     # not count toward old queries) and invalidates the boundary probe.
 
+    # Passthrough journaling happens AFTER the delegated call succeeds:
+    # the call validates+applies atomically from the fabric's view, and
+    # no checkpoint can interleave (ring writes only happen inside
+    # run()), so a crash mid-event loses at most that one
+    # never-acknowledged event — the same guarantee as write-ahead.
+
     def join(self) -> int:
         """Admit one member (contributes 0 to every in-flight lane; it
         enters future queries' cohorts).  Returns the slot id."""
         slot = self.svc.join(np.zeros(self.lanes))
+        self._journal("join", {})
         self._probe = None
         return slot
 
     def leave(self, ids) -> QueryFabric:
         self.svc.leave(ids)
+        self._journal("leave", {"ids": [int(i) for i in
+                                        np.atleast_1d(np.asarray(ids))]})
         gone = {int(i) for i in np.atleast_1d(np.asarray(ids, np.int64))}
         for q in self._queries.values():
             if q["status"] in ("queued", "active") and \
@@ -234,21 +350,29 @@ class QueryFabric:
 
     def add_edges(self, pairs) -> QueryFabric:
         self.svc.add_edges(pairs)
+        self._journal("add_edges",
+                      {"pairs": [[int(u), int(v)] for u, v in pairs]})
         self._probe = None
         return self
 
     def remove_edges(self, pairs) -> QueryFabric:
         self.svc.remove_edges(pairs)
+        self._journal("remove_edges",
+                      {"pairs": [[int(u), int(v)] for u, v in pairs]})
         self._probe = None
         return self
 
     def suspend(self, ids) -> QueryFabric:
         self.svc.suspend(ids)
+        self._journal("suspend", {"ids": [int(i) for i in
+                                          np.atleast_1d(np.asarray(ids))]})
         self._probe = None
         return self
 
     def resume(self, ids) -> QueryFabric:
         self.svc.resume(ids)
+        self._journal("resume", {"ids": [int(i) for i in
+                                         np.atleast_1d(np.asarray(ids))]})
         self._probe = None
         return self
 
@@ -275,6 +399,11 @@ class QueryFabric:
                 f"submit: values shape {vals.shape} != cohort shape "
                 f"{cohort.shape} (one value per cohort member, or one "
                 "scalar for all)")
+        self._journal("submit", {
+            "values": vals.tolist(),
+            "cohort": [int(i) for i in cohort],
+            "eps": eps, "tag": tag,
+        })
         qid = self._next_qid
         self._next_qid += 1
         self._queries[qid] = {
@@ -289,6 +418,10 @@ class QueryFabric:
             "eps": self.conv_eps if eps is None else float(eps),
             "tag": tag,
             "result": None,
+            # the watchdog's divergence reference: a lane's healthy
+            # estimate scale is bounded by its own input magnitude
+            "value_scale": float(np.max(np.abs(vals)))
+            if vals.size else 1.0,
             "_values": vals,
         }
         self._queue.append(qid)
@@ -318,6 +451,12 @@ class QueryFabric:
             raise ValueError(
                 f"update_query: values shape {vals.shape} != ids shape "
                 f"{ids.shape}")
+        self._journal("update_query", {
+            "qid": int(qid), "ids": [int(i) for i in ids],
+            "values": vals.tolist()})
+        q["value_scale"] = max(float(q.get("value_scale", 1.0)),
+                               float(np.max(np.abs(vals)))
+                               if vals.size else 1.0)
         st = self.svc.state
         self.svc.state = st.replace(
             value=st.value.at[jnp.asarray(ids), q["lane"]].set(
@@ -379,6 +518,37 @@ class QueryFabric:
             buf_est=st.buf_est.at[:, :, li].set(0.0),
         )
 
+    def _quarantine(self, items) -> list:
+        """Watchdog-ordered lane quarantine: scrub each pathological
+        lane's payload planes back to the exact-zero fixed point (the
+        retirement scrub — mass-neutral, every OTHER lane untouched),
+        return the lanes to the free heap, and mark the queries
+        ``quarantined``.  ``items``: ``[(lane, qid, reason, evidence),
+        ...]``.  Returns one action record per lane with the post-scrub
+        ledger residual measured off a fresh probe (exactly 0.0 — the
+        doctor's ``quarantine_mass`` evidence)."""
+        lanes = [lane for lane, *_ in items]
+        self._scrub_lanes(lanes)
+        for lane, qid, _reason, _ev in items:
+            q = self._queries[qid]
+            q.update(status="quarantined", done_round=self.clock,
+                     result=None)
+            self._lane_q[lane] = None
+            heapq.heappush(self._free_lanes, lane)
+        self.quarantined_total += len(items)
+        self._probe = None
+        probe = self._probe_fresh()
+        return [{
+            "t": self.clock,
+            "lane": int(lane),
+            "qid": int(qid),
+            "reason": reason,
+            "evidence": evidence,
+            # abs(): the ledger form of a scrubbed lane sums zeros to
+            # -0.0; the record must read "exactly 0.0"
+            "post_scrub_residual": float(np.abs(probe["resid"][lane])),
+        } for lane, qid, reason, evidence in items]
+
     # ---- execution -------------------------------------------------------
     def run(self, rounds: int) -> QueryFabric:
         """Advance ``rounds`` (a whole number of compiled segments).  At
@@ -395,6 +565,7 @@ class QueryFabric:
                 f"rounds={rounds} must be a whole number of compiled "
                 f"segments (segment_rounds={seg}) — the zero-recompile "
                 "contract fixes the scan length")
+        self._journal("run", {"rounds": int(rounds)})
         svc = self.svc
         # membership events queued on the service since the last segment
         # belong to the fabric's timeline, not a service epoch
@@ -404,10 +575,19 @@ class QueryFabric:
                                    seg, params=svc.params)
             self._boundary()
             svc._pending_events = []
+        if self._ring is not None and rounds:
+            self._ring.tick(self, self._wal_applied_seq,
+                            segments=rounds // seg)
         return self
 
     def _boundary(self) -> dict:
         probe = self._probe_fresh()
+        if self._watchdog is not None:
+            # the watchdog rides THIS probe (zero extra compiles); a
+            # quarantine scrubs lane planes, so the verdict inputs
+            # below must come from a fresh probe
+            if self._watchdog.inspect(self, probe):
+                probe = self._probe_fresh()
         mx, mn = probe["max"], probe["min"]
         resid, live = probe["resid"], probe["live"]
         active = [ln for ln in range(self.lanes)
@@ -429,9 +609,19 @@ class QueryFabric:
             for ln in done:
                 self._lane_q[ln] = None
                 heapq.heappush(self._free_lanes, ln)
+                if self._watchdog is not None:
+                    # a recycled lane must not inherit the retired
+                    # query's stall window
+                    self._watchdog._lane_trend.pop(ln, None)
             self.retired_total += len(done)
             self._probe = None   # lane planes changed under the probe
-        admitted = self._admit_free()
+        if self._watchdog is not None \
+                and not self._watchdog.admission_allowed(self):
+            admitted = 0         # degraded mode: backoff defers this one
+        else:
+            admitted = self._admit_free()
+        if self._watchdog is not None:
+            self._watchdog.after_admission(self)
         act_idx = np.asarray(active, np.int64)
         spread_a = (mx[act_idx] - mn[act_idx]) if active else \
             np.zeros(0)
@@ -511,6 +701,11 @@ class QueryFabric:
         if q["status"] == "done":
             return {**base, "t": q["done_round"], "staleness": 0,
                     "converged": True, **q["result"]}
+        if q["status"] == "quarantined":
+            # the lane was scrubbed by the watchdog: no result, and the
+            # read says so instead of probing a lane it no longer owns
+            return {**base, "t": q["done_round"], "converged": False,
+                    "quarantined": True}
         if q["status"] == "queued":
             return {**base, "queue_position":
                     self._queue.index(qid),
@@ -567,6 +762,7 @@ class QueryFabric:
             "segment_rounds": self.svc.segment_rounds,
             "admitted_total": self.admitted_total,
             "retired_total": self.retired_total,
+            "quarantined_total": self.quarantined_total,
             "admission_latency": latency,
             "boundaries": [dict(b) for b in self._boundaries],
             "queries": qs,
@@ -575,12 +771,15 @@ class QueryFabric:
         }
 
     # ---- durability ------------------------------------------------------
-    def save_checkpoint(self, path: str) -> QueryFabric:
+    def save_checkpoint(self, path: str,
+                        extra_meta: dict | None = None) -> QueryFabric:
         """One versioned archive: the full service checkpoint plus the
         fabric's lane tables (``meta['query']`` — the
         SERVICE_FORMAT_VERSION=2 extension).  Round-trip is bit-exact;
         a plain ``ServiceEngine.restore_checkpoint`` of the same file
-        ignores the lane block (tests/test_checkpoint.py)."""
+        ignores the lane block (tests/test_checkpoint.py).
+        ``extra_meta`` merges further JSON blocks (the checkpoint
+        ring's ``resilience`` binding rides here)."""
         queries = []
         for q in self._queries.values():
             rec = {k: v for k, v in q.items() if not k.startswith("_")}
@@ -599,9 +798,13 @@ class QueryFabric:
             "retired_total": self.retired_total,
             "peak_active": self.peak_active,
             "latencies": [int(x) for x in self._latencies],
+            "quarantined_total": self.quarantined_total,
             "queries": queries,
         }
-        self.svc.save_checkpoint(path, extra_meta={"query": qmeta})
+        if self._watchdog is not None:
+            qmeta["watchdog_state"] = self._watchdog.state_dict()
+        self.svc.save_checkpoint(
+            path, extra_meta={"query": qmeta, **(extra_meta or {})})
         return self
 
     @classmethod
@@ -649,8 +852,14 @@ class QueryFabric:
         self.admitted_total = int(qmeta["admitted_total"])
         self.retired_total = int(qmeta["retired_total"])
         self.peak_active = int(qmeta["peak_active"])
+        self.quarantined_total = int(qmeta.get("quarantined_total", 0))
         self._latencies = [int(x) for x in qmeta["latencies"]]
         self._probe = None
         self._boundaries = []
+        self._watchdog = None
+        # watchdog runtime rides the archive; attach_watchdog (called
+        # by recover() with the persisted config) resumes it
+        self._watchdog_pending_state = qmeta.get("watchdog_state")
+        self._init_resilience()
         self._probe_floor = _probe_jit()._cache_size()
         return self

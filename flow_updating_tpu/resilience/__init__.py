@@ -1,0 +1,45 @@
+"""Crash-safe serving: event WAL, checkpoint ring, lane watchdog, chaos.
+
+The protocol layer already self-heals (the paper's churn tolerance,
+PR-9's adversarial registry); this package gives the long-running
+engines the *infrastructure*-layer fault tolerance a production service
+needs — and the chaos harness that proves it, fault by planted fault:
+
+* :mod:`~flow_updating_tpu.resilience.wal` — append-only, CRC-framed,
+  fsync'd event journal; a torn tail truncates cleanly;
+* :mod:`~flow_updating_tpu.resilience.ring` — automatic checkpoint
+  ring (every K segments, N retained, atomic writes, integrity
+  sidecars, corrupt-newest falls back to next);
+* :mod:`~flow_updating_tpu.resilience.recover` — arm durability on a
+  live engine; rebuild one from its directory by checkpoint restore +
+  WAL replay (bit-exact vs the uninterrupted run);
+* :mod:`~flow_updating_tpu.resilience.watchdog` — inline per-lane
+  NaN/divergence/stall detection riding the existing lane probe, with
+  mass-neutral lane quarantine and admission backoff;
+* :mod:`~flow_updating_tpu.resilience.chaos` — the infra-fault
+  registry (kill, torn WAL, corrupt/bitflipped archives, NaN poison,
+  admission storm), each injected into a real subprocess run with its
+  recovery signature doctor-asserted and ``inspect --blame`` naming
+  the planted fault.
+
+Surface: ``ServiceEngine.enable_durability`` / ``.recover``,
+``QueryFabric.enable_durability`` / ``.attach_watchdog`` / ``.recover``,
+the ``chaos`` CLI subcommand, ``serve``/``query`` ``--wal`` flags, and
+``flow-updating-recovery-report/v1`` manifests judged by
+``obs.health.check_recovery``.  See docs/RESILIENCE.md.
+"""
+
+from flow_updating_tpu.resilience.recover import arm_durability, recover
+from flow_updating_tpu.resilience.ring import CheckpointRing
+from flow_updating_tpu.resilience.wal import WriteAheadLog, scan_wal
+from flow_updating_tpu.resilience.watchdog import Watchdog, WatchdogConfig
+
+__all__ = [
+    "CheckpointRing",
+    "Watchdog",
+    "WatchdogConfig",
+    "WriteAheadLog",
+    "arm_durability",
+    "recover",
+    "scan_wal",
+]

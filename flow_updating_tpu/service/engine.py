@@ -227,12 +227,94 @@ class ServiceEngine:
         self.history: list = []         # one record per epoch (run call)
         self._samples: list = []        # boundary telemetry rows
         self._est_cache = None          # (t, est (n_cap,)+F, alive)
+        self._init_resilience()
         self._capture_cache_floor()
         if boundary_samples:
             # a construction-time sample materializes the full (n_cap,)+F
             # estimate matrix on host; a driver that samples per LANE
             # (the query fabric's device-side probe) opts out
             self._sample("init")
+
+    # ---- resilience (flow_updating_tpu.resilience) -----------------------
+    def _init_resilience(self) -> None:
+        self._wal = None            # WriteAheadLog when durability is on
+        self._ring = None           # CheckpointRing when durability is on
+        self._resil_dir = None
+        self._replaying = False     # recovery replay: never re-journal
+        self._wal_applied_seq = 0   # last journaled seq reflected in state
+        self._recovery = None       # recover()'s evidence block
+
+    def _journal(self, kind: str, args: dict) -> None:
+        """Write-ahead: journal the validated event (fsync'd) BEFORE it
+        is applied; recovery re-applies journaled-but-unapplied events."""
+        if self._wal is not None and not self._replaying:
+            self._wal_applied_seq = self._wal.append(kind, args,
+                                                     self.clock)
+
+    def enable_durability(self, directory: str, *,
+                          checkpoint_every: int = 8, retain: int = 3,
+                          fsync: bool = True) -> ServiceEngine:
+        """Arm the event WAL + checkpoint ring in ``directory``: every
+        subsequent event/run is journaled before it is applied, and a
+        ring archive is written every ``checkpoint_every`` segments
+        (``retain`` kept).  Recover after a crash with
+        :meth:`recover` (docs/RESILIENCE.md)."""
+        from flow_updating_tpu.resilience.recover import arm_durability
+
+        arm_durability(self, directory, kind="service",
+                       checkpoint_every=checkpoint_every,
+                       retain=retain, fsync=fsync)
+        return self
+
+    @classmethod
+    def recover(cls, directory: str) -> ServiceEngine:
+        """Rebuild the service journaled in ``directory``: newest valid
+        ring checkpoint (corrupt newest falls back) + WAL replay of
+        every event since — bit-exact vs the uninterrupted run at ANY
+        kill point, with the evidence in :meth:`resilience_block`."""
+        from flow_updating_tpu.resilience.recover import recover
+
+        return recover(directory, kind="service")
+
+    def state_digest(self) -> str:
+        """sha256 over every state leaf + the dynamic topology mirrors
+        + free lists — bit-exactness in one comparable string (the
+        chaos harness's recovered-vs-control verdict)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for name in sorted(self.state.__dataclass_fields__):
+            a = np.ascontiguousarray(np.asarray(getattr(self.state,
+                                                        name)))
+            h.update(name.encode())
+            h.update(a.tobytes())
+        for name, arr in (("src", self._src), ("dst", self._dst),
+                          ("rev", self._rev), ("deg", self._deg),
+                          ("rows", self._rows), ("delay", self._delay),
+                          ("member", self._member)):
+            h.update(name.encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        h.update(repr(sorted(self._free_nodes)).encode())
+        h.update(repr(sorted(self._free_edges)).encode())
+        return h.hexdigest()
+
+    def resilience_block(self) -> dict | None:
+        """The manifest's ``recovery`` block: live WAL/ring accounting
+        plus — after :meth:`recover` — the scan/replay evidence
+        (``obs.health.check_recovery`` judges it).  None when
+        durability is off."""
+        if self._wal is None and self._recovery is None:
+            return None
+        out = {"dir": self._resil_dir, "kind": "service"}
+        if self._recovery is not None:
+            out.update(self._recovery)
+        if self._wal is not None:
+            out.setdefault("wal", self._wal.block())
+        if self._ring is not None:
+            ring = dict(out.get("ring") or {})
+            ring.update(self._ring.block())
+            out["ring"] = ring
+        return out
 
     # ---- compile accounting ---------------------------------------------
     def _capture_cache_floor(self) -> None:
@@ -330,6 +412,7 @@ class ServiceEngine:
             raise ValueError(
                 f"join value shape {v.shape} != service feature shape "
                 f"{self.feature_shape}")
+        self._journal("join", {"value": v.tolist()})
         slot = heapq.heappop(self._free_nodes)
         st = self.state
         z = jnp.zeros(self.feature_shape, st.last_avg.dtype)
@@ -355,6 +438,7 @@ class ServiceEngine:
         import jax.numpy as jnp
 
         ids = self._check_member(ids, "leave")
+        self._journal("leave", {"ids": [int(i) for i in ids]})
         pairs = set()
         for u in ids:
             for e in self._rows[int(u)]:
@@ -394,6 +478,8 @@ class ServiceEngine:
             raise ValueError(
                 f"update values shape {vals.shape} != {want} "
                 f"(one row per id, feature shape {self.feature_shape})")
+        self._journal("update", {"ids": [int(i) for i in ids],
+                                 "values": vals.tolist()})
         self.state = self.state.replace(
             value=self.state.value.at[jnp.asarray(ids)].set(
                 jnp.asarray(vals, self.state.value.dtype)))
@@ -406,6 +492,7 @@ class ServiceEngine:
         ledgers intact — :func:`membership.set_alive`.  A suspended node
         keeps its slot; :meth:`resume` revives it in place."""
         ids = self._check_member(ids, "suspend")
+        self._journal("suspend", {"ids": [int(i) for i in ids]})
         self.state = membership.set_alive(self.state, ids, False)
         for i in ids:
             self._log("suspend", node=int(i))
@@ -413,6 +500,7 @@ class ServiceEngine:
 
     def resume(self, ids) -> ServiceEngine:
         ids = self._check_member(ids, "resume")
+        self._journal("resume", {"ids": [int(i) for i in ids]})
         self.state = membership.set_alive(self.state, ids, True)
         for i in ids:
             self._log("resume", node=int(i))
@@ -480,6 +568,7 @@ class ServiceEngine:
             done.append((u, v))
         if not eidx:
             return self
+        self._journal("add_edges", {"pairs": [[u, v] for u, v in done]})
         # commit: host mirrors ...
         self._rows = rows_scratch
         self._free_edges = free_scratch[taken:]
@@ -525,6 +614,8 @@ class ServiceEngine:
             todo.append((min(e1, e2), max(e1, e2)))
             logs.append((u, v))
         if todo:
+            self._journal("remove_edges",
+                          {"pairs": [[u, v] for u, v in logs]})
             self._detach_pairs(sorted(set(todo)))
             for u, v in logs:
                 self._log("remove_edge", u=u, v=v)
@@ -659,6 +750,7 @@ class ServiceEngine:
                 f"rounds={rounds} must be a whole number of compiled "
                 f"segments (segment_rounds={self.segment_rounds}) — the "
                 "zero-recompile contract fixes the scan length")
+        self._journal("run", {"rounds": int(rounds)})
         events = self._pending_events
         self._pending_events = []
         if events or not self._samples \
@@ -708,6 +800,12 @@ class ServiceEngine:
                        "active")},
         })
         self._epoch += 1
+        if self._ring is not None and rounds:
+            # the archive reflects every journaled record up to
+            # _wal_applied_seq (this run's record included) — recovery
+            # replays only what came after
+            self._ring.tick(self, self._wal_applied_seq,
+                            segments=rounds // self.segment_rounds)
         if series_rows is not None:
             from flow_updating_tpu.obs.telemetry import TelemetrySeries
 
@@ -896,6 +994,7 @@ class ServiceEngine:
         self.history = []
         self._samples = []
         self._est_cache = None
+        self._init_resilience()
         self._capture_cache_floor()
         self._sample("restore")
         return self
@@ -914,7 +1013,16 @@ def _service_topo_arrays(src, dst, rev, deg, row_start, rows, delay):
     leaf set match the constructed path — the live leaves the kernel
     reads (src, rev, out_deg, delay, sweep_edge_rows) come from the
     checkpointed mirrors bit-exactly.  Relaxing the config domain means
-    carrying these as mirrors too."""
+    carrying these as mirrors too.
+
+    The passed-in mirrors are the engine's HOST bookkeeping arrays,
+    mutated in place by later events (``_detach_pairs`` does
+    ``self._deg[u] -= 1``); ``jnp.asarray`` on CPU may alias the numpy
+    buffer zero-copy, so the device leaves MUST be built with
+    ``jnp.array`` (always copies) — an aliased leaf lets a host edit
+    race the functional device edit of the same event, nondeterministic
+    double-application (found by the recovery replay's bit-exactness
+    gate, tests/test_resilience.py)."""
     import jax.numpy as jnp
 
     from flow_updating_tpu.topology.graph import TopoArrays
@@ -923,13 +1031,13 @@ def _service_topo_arrays(src, dst, rev, deg, row_start, rows, delay):
     edge_rank = (np.arange(E, dtype=np.int64)
                  - row_start[src]).astype(np.int32)
     return TopoArrays(
-        src=jnp.asarray(src),
-        dst=jnp.asarray(dst),
-        rev=jnp.asarray(rev),
-        out_deg=jnp.asarray(deg),
+        src=jnp.array(src),
+        dst=jnp.array(dst),
+        rev=jnp.array(rev),
+        out_deg=jnp.array(deg),
         row_start=jnp.asarray(row_start, dtype=jnp.int32),
         edge_rank=jnp.asarray(edge_rank),
-        delay=jnp.asarray(delay),
+        delay=jnp.array(delay),
         deg_e=jnp.asarray(deg[src]),
-        sweep_edge_rows=jnp.asarray(rows),
+        sweep_edge_rows=jnp.array(rows),
     )

@@ -24,6 +24,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import re
 import warnings
 
 import jax
@@ -74,18 +75,38 @@ def topology_fingerprint(topo) -> dict:
     }
 
 
+#: Chaos-harness crash-point hook: called with the final path between
+#: the temp write and its atomic rename (resilience/chaos.py plants a
+#: SIGKILL here to prove mid-checkpoint-write kills recover cleanly).
+_CRASH_BEFORE_REPLACE = None
+
+_TMP_RE = re.compile(r"\.tmp\.\d+$")
+
+
 def _write_archive(path: str, manifest: dict, arrays: dict) -> None:
     """Single durability-critical write path for every checkpoint flavor:
     compressed npz with the JSON manifest as a uint8 buffer, written to a
-    pid-suffixed temp file and atomically renamed."""
+    pid-suffixed temp file and atomically renamed — a crash mid-write
+    leaves a stale temp and NO final file, never a truncated archive at
+    the final path.  A failed write removes its temp (only a hard kill
+    can leave one; recovery sweeps and counts those)."""
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(
-            f, __manifest__=np.frombuffer(
-                json.dumps(manifest).encode(), dtype=np.uint8
-            ), **arrays,
-        )
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f, __manifest__=np.frombuffer(
+                    json.dumps(manifest).encode(), dtype=np.uint8
+                ), **arrays,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        if _CRASH_BEFORE_REPLACE is not None:
+            _CRASH_BEFORE_REPLACE(path)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
 
 
 def _open_archive(path: str):
@@ -100,6 +121,12 @@ def _open_archive(path: str):
     except FileNotFoundError:
         raise ValueError(f"checkpoint {path}: no such file") from None
     except (zipfile.BadZipFile, EOFError, OSError, ValueError) as exc:
+        if _TMP_RE.search(path):
+            raise ValueError(
+                f"checkpoint {path}: this is a partially-written temp "
+                "file from an interrupted save (checkpoints write to "
+                "a .tmp.<pid> then atomically rename) — restore from "
+                "the final checkpoint path; the temp is garbage") from exc
         raise ValueError(
             f"checkpoint {path}: not a readable checkpoint archive "
             f"({type(exc).__name__}: {exc}) — the file is truncated, "
@@ -405,10 +432,21 @@ def load_service_checkpoint(path: str):
                 f"this runtime reads versions {readable} (writes "
                 f"{SERVICE_FORMAT_VERSION}) — re-create the checkpoint "
                 "with the current code")
-        fields = {k[len("state."):]: z[k] for k in z.files
-                  if k.startswith("state.")}
-        svc = {k[len("svc."):]: z[k] for k in z.files
-               if k.startswith("svc.")}
+        try:
+            fields = {k[len("state."):]: z[k] for k in z.files
+                      if k.startswith("state.")}
+            svc = {k[len("svc."):]: z[k] for k in z.files
+                   if k.startswith("svc.")}
+        except Exception as exc:
+            # member reads are lazy: in-place corruption (a bitflipped
+            # byte, a torn copy) surfaces HERE as zlib/zipfile errors,
+            # not at open — translate so ring fallback and callers see
+            # one exception type naming the file and the fix
+            raise ValueError(
+                f"checkpoint {path}: archive member unreadable "
+                f"({type(exc).__name__}: {exc}) — the file is corrupt "
+                "(bitflip or torn copy); restore from an older "
+                "checkpoint") from exc
     want = set(FlowUpdatingState.__dataclass_fields__)
     have = set(fields)
     if have != want:

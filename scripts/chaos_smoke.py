@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""CI chaos smoke: the crash-safety acceptance scenario.
+
+Runs the ISSUE-13 acceptance criteria end to end on the CPU proxy and
+leaves the recovery manifest in ``--outdir`` (uploaded by the tier1
+workflow):
+
+1. drive a 10k-node durability-armed SERVICE in a subprocess through a
+   scripted churn stream (join/leave/update/edge events + compiled
+   segments, drop>0) and SIGKILL it mid-run — between a ring archive's
+   temp write and its atomic rename, the nastiest kill point;
+2. recover from the durability directory (stale temp swept, newest
+   valid ring checkpoint restored, WAL replayed) and resume the
+   script: the final state must be BIT-EXACT (sha256 state digest) vs
+   an uninterrupted control run;
+3. the ``flow-updating-recovery-report/v1`` manifest must pass
+   ``doctor --strict`` and ``inspect --blame`` must name the planted
+   fault at rank 1;
+4. the NEGATIVE control — the same fault with recovery disabled — must
+   FAIL its signature (the conformance loop has both directions).
+
+Exit code: 0 only if every assertion above holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--nodes", type=int, default=10_000,
+                    help="scripted-service member count (floor: 10k)")
+    ap.add_argument("--ops", type=int, default=24,
+                    help="scripted event-stream length")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.resilience.chaos import run_chaos
+
+    fault = "kill_mid_checkpoint"
+    t0 = time.perf_counter()
+    out = run_chaos(fault, nodes=args.nodes, lanes=4,
+                    segment_rounds=8, n_ops=args.ops, seed=args.seed,
+                    outdir=args.outdir)
+    print(f"chaos_smoke: {fault} at {args.nodes} nodes — "
+          f"overall={out['overall']}, blame_top={out['blame_top']}, "
+          f"recover={out['timings'].get('recover_s', '?')}s, "
+          f"{time.perf_counter() - t0:.1f}s total", file=sys.stderr)
+    if not (out["verify"] or {}).get("exact"):
+        print(f"chaos_smoke: recovered state NOT bit-exact vs the "
+              f"uninterrupted control: {out['verify']}",
+              file=sys.stderr)
+        return 1
+    if out["blame_top"] != fault:
+        print(f"chaos_smoke: blame ranked {out['blame_top']!r} first, "
+              f"expected {fault!r}: {out['blame']}", file=sys.stderr)
+        return 1
+
+    # the negative control: recovery disabled, signature must FAIL
+    bad = run_chaos(fault, nodes=max(256, args.nodes // 16), lanes=4,
+                    segment_rounds=8, n_ops=args.ops, seed=args.seed,
+                    outdir=args.outdir, perturb=True)
+    if bad["exit_code"] == 0:
+        print("chaos_smoke: the recovery-DISABLED control passed its "
+              "signature — the gate cannot fail", file=sys.stderr)
+        return 1
+    print(f"chaos_smoke: negative control fails as declared "
+          f"({[c['name'] for c in bad['checks'] if c['status'] == 'fail']})",
+          file=sys.stderr)
+    print(json.dumps({"fault": fault, "manifest":
+                      out["manifest_path"],
+                      "recover_s": out["timings"].get("recover_s")}))
+    # doctor --strict over the saved manifest is the CI contract
+    return cli_main(["doctor", "--strict", out["manifest_path"]])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,6 +118,9 @@ SLOW_TESTS = {
         "test_backlog_kernel_matches_same_model_oracle",
     },
     "test_pairwise.py": {"test_segmented_affine_scan_matches_loop"},
+    "test_resilience.py": {
+        "test_chaos_kill_fault_end_to_end_subprocess",
+    },
     "test_scenarios.py": {
         "test_full_registry_conformance_and_perturbations",
         "test_byzantine_lie_signature_passes_and_perturbation_fails",
