@@ -1,0 +1,252 @@
+"""Host-mirror aliasing analysis — the PR-13 zero-copy race class,
+mechanized.
+
+The bug class: an engine keeps *host mirrors* (long-lived numpy arrays
+mutated in place by event bookkeeping — ``self._deg[u] -= 1``) and
+builds *device leaves* from them.  ``jnp.asarray`` / ``jax.device_put``
+on CPU may alias the numpy buffer zero-copy, so a later in-place mirror
+edit races the functional device edit of the same event —
+nondeterministic double-application that five PRs of round-trip tests
+never caught (the ``restore_checkpoint`` incident, fixed in PR 13 with
+``jnp.array``, which always copies).  This module closes the class from
+both ends:
+
+* **static** — the ``device-from-mirror`` flowlint rule: an AST +
+  dataflow pass flagging zero-copy device-array construction over a
+  mutated host mirror, both directly (``jnp.asarray(self._deg)``) and
+  one call deep (passing ``self._deg`` into a helper whose parameter
+  feeds ``jnp.asarray`` — the exact historical shape);
+* **runtime** — :func:`assert_no_shared_mirrors`, an
+  ``np.shares_memory`` sweep of every device leaf against every host
+  mirror, wired into the restore/recover paths of ``ServiceEngine`` /
+  ``QueryFabric`` and surfaced to ``doctor`` through the service
+  block's ``mirror_probe`` record.
+
+The documented remedy is always the same: build device leaves with
+``jnp.array`` (copies), or ``.copy()`` the mirror first.
+"""
+
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+RULE = "device-from-mirror"
+RULE_DOC = ("no zero-copy device arrays (jnp.asarray/device_put) over "
+            "in-place-mutated host mirrors — use jnp.array (copies)")
+
+#: callables that may alias a numpy buffer zero-copy on CPU
+_ZERO_COPY_CALLS = ("asarray", "device_put")
+
+
+def _attr_tail(node) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _self_attr(node) -> str | None:
+    """``self.X`` -> ``X`` (the mirror name), else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_zero_copy_call(call: ast.Call) -> bool:
+    """``jnp.asarray(...)`` / ``jax.device_put(...)`` — the forms that
+    may alias on CPU.  ``jnp.array`` copies and is the remedy."""
+    return _attr_tail(call.func) in _ZERO_COPY_CALLS
+
+
+def _mutated_attrs(cls: ast.ClassDef) -> set:
+    """Attribute names the class mutates IN PLACE: subscript stores /
+    subscript aug-assigns on ``self.X``, whole-array aug-assigns
+    (``self.X += delta`` — ndarray ``__iadd__`` edits the buffer), and
+    ``out=self.X`` keywords — the host-mirror bookkeeping edits."""
+    out: set = set()
+    for node in ast.walk(cls):
+        tgt = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    tgt = _self_attr(t.value)
+                elif isinstance(node, ast.AugAssign) \
+                        and isinstance(t, ast.Attribute):
+                    tgt = _self_attr(t)
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "out":
+                    tgt = _self_attr(kw.value)
+        if tgt:
+            out.add(tgt)
+    return out
+
+
+def _zero_copy_params(fn) -> set:
+    """Parameter names of ``fn`` that flow BARE into a zero-copy device
+    construction (directly, or through a trivial ``x = p`` alias)."""
+    params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                              + fn.args.kwonlyargs)}
+    alias = {p: p for p in params}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in params):
+            alias[node.targets[0].id] = node.value.id
+    hits: set = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call) and _is_zero_copy_call(node)):
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                root = alias.get(arg.id)
+                if root in params:
+                    hits.add(root)
+    return hits
+
+
+def lint_device_from_mirror(mod):
+    """The flowlint pass (registered as ``device-from-mirror`` in
+    :mod:`flow_updating_tpu.analysis.flowlint`).  ``mod`` is flowlint's
+    parsed ``_Module``."""
+    from flow_updating_tpu.analysis.flowlint import LintFinding
+
+    # module-local function defs, for the one-call-deep check
+    fns = {n.name: n for n in ast.walk(mod.tree)
+           if isinstance(n, ast.FunctionDef)}
+    zero_copy_cache: dict = {}
+
+    def zc_params(name: str) -> set:
+        if name not in zero_copy_cache:
+            fn = fns.get(name)
+            zero_copy_cache[name] = _zero_copy_params(fn) if fn else set()
+        return zero_copy_cache[name]
+
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        mutated = _mutated_attrs(cls)
+        if not mutated:
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            # direct: jnp.asarray(self.X) over a mutated mirror
+            if _is_zero_copy_call(node) and node.args:
+                attr = _self_attr(node.args[0])
+                if attr in mutated:
+                    yield LintFinding(
+                        RULE, mod.path, node.lineno, node.col_offset,
+                        f"zero-copy `{_attr_tail(node.func)}` over host "
+                        f"mirror `self.{attr}` (mutated in place by "
+                        f"`{cls.name}`) — on CPU the device leaf "
+                        "aliases the numpy buffer and later mirror "
+                        "edits race the device state; build it with "
+                        "jnp.array (copies)")
+                continue
+            # one call deep: helper(self.X, ...) whose parameter feeds
+            # jnp.asarray — the historical restore_checkpoint shape
+            callee = node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if callee not in fns:
+                continue
+            fn = fns[callee]
+            pos_params = [a.arg for a in fn.args.posonlyargs
+                          + fn.args.args]
+            for k, arg in enumerate(node.args):
+                attr = _self_attr(arg)
+                if attr not in mutated or k >= len(pos_params):
+                    continue
+                if pos_params[k] in zc_params(callee):
+                    yield LintFinding(
+                        RULE, mod.path, node.lineno, node.col_offset,
+                        f"host mirror `self.{attr}` (mutated in place "
+                        f"by `{cls.name}`) reaches a zero-copy "
+                        f"jnp.asarray/device_put via parameter "
+                        f"`{pos_params[k]}` of `{callee}` — the PR-13 "
+                        "restore race; copy with jnp.array inside the "
+                        "helper or pass a .copy()")
+
+
+# ---------------------------------------------------------------------------
+# runtime probe
+
+def _host_mirrors(obj) -> dict:
+    """name -> numpy mirror, over the instance's own attributes."""
+    out = {}
+    for name, v in vars(obj).items():
+        if isinstance(v, np.ndarray):
+            out[name] = v
+    return out
+
+
+def _device_leaves(obj):
+    """(label, leaf) pairs for every device-array leaf of the engine's
+    state + topology pytrees."""
+    import jax
+
+    for attr in ("state", "arrays"):
+        tree = getattr(obj, attr, None)
+        if tree is None:
+            continue
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in flat:
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                yield f"{attr}{jax.tree_util.keystr(path)}", leaf
+
+
+def shared_mirror_report(engine) -> dict:
+    """``np.shares_memory`` sweep of every device leaf against every
+    host mirror of ``engine`` (a ``ServiceEngine``, or a ``QueryFabric``
+    — probed through its ``svc``).  Returns the ``mirror_probe`` record
+    the service manifest embeds: ``{"checked": n_pairs, "shared":
+    [{"leaf", "mirror"}, ...]}`` — ``shared`` must be empty."""
+    import jax
+
+    target = getattr(engine, "svc", engine)
+    if jax.default_backend() != "cpu":
+        # accelerator backends always copy host buffers to device
+        # memory — the zero-copy class cannot exist, and np.asarray on
+        # every leaf would cost a real device->host transfer
+        return {"checked": 0, "shared": [],
+                "skipped": "non-cpu backend (host buffers are copied)"}
+    mirrors = _host_mirrors(target)
+    shared, checked = [], 0
+    for label, leaf in _device_leaves(target):
+        try:
+            view = np.asarray(leaf)
+        except Exception:
+            continue
+        for name, mirror in mirrors.items():
+            checked += 1
+            try:
+                if np.shares_memory(view, mirror):
+                    shared.append({"leaf": label, "mirror": name})
+            except Exception:
+                continue
+    return {"checked": checked, "shared": shared}
+
+
+def assert_no_shared_mirrors(engine) -> None:
+    """Raise if any device leaf aliases a host mirror — wired into the
+    ``ServiceEngine`` / ``QueryFabric`` restore and recover paths so a
+    reintroduced zero-copy build fails the moment it is constructed,
+    not rounds later as a flaky double-applied event."""
+    rep = shared_mirror_report(engine)
+    if rep["shared"]:
+        pairs = ", ".join(f"{s['leaf']}<->{s['mirror']}"
+                          for s in rep["shared"])
+        raise AssertionError(
+            f"device leaves alias in-place-mutated host mirrors "
+            f"({pairs}) — zero-copy jnp.asarray over a live numpy "
+            "mirror; build device leaves with jnp.array (copies). "
+            "See docs/ANALYSIS.md (device-from-mirror) and the PR-13 "
+            "restore_checkpoint race.")

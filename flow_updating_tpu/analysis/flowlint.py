@@ -88,6 +88,8 @@ RULE_DOCS = {
     "kernel-round-program": "every *Kernel class exposes round_program",
     "bare-prngkey": "jax.random.PRNGKey only in seeding entry points",
     "baseline-key-family": "bench baseline keys from documented families",
+    "device-from-mirror": "no zero-copy device arrays over in-place-"
+                          "mutated host mirrors (analysis/aliasing.py)",
 }
 
 
@@ -375,12 +377,22 @@ def _r_baseline_key_family(mod: _Module):
                     "families need a doc row + a family regex here")
 
 
+def _r_device_from_mirror(mod: _Module):
+    # the AST+dataflow half of the host-mirror aliasing analysis lives
+    # with its runtime probe (analysis/aliasing.py); imported lazily so
+    # flowlint stays importable standalone
+    from flow_updating_tpu.analysis import aliasing
+
+    yield from aliasing.lint_device_from_mirror(mod)
+
+
 _RULE_PASSES = {
     "numpy-in-kernel": _r_numpy_in_kernel,
     "traced-if": _r_traced_if,
     "kernel-round-program": _r_kernel_round_program,
     "bare-prngkey": _r_bare_prngkey,
     "baseline-key-family": _r_baseline_key_family,
+    "device-from-mirror": _r_device_from_mirror,
 }
 
 

@@ -307,6 +307,11 @@ def cells() -> list:
                    twin)
     _node_cell("node-benes/plain/robust=none/adv=none/payload=scalar",
                "plain", spmv="benes")
+    # the topology-compiler banded executor (PR 6): RCM reorder + dense
+    # masked rolls + Beneš remainder — the fast path ROADMAP item 1
+    # fuses next, so its lowering joins the ledger now
+    _node_cell("node-banded/plain/robust=none/adv=none/payload=scalar",
+               "plain", spmv="banded")
 
     # -- halo x twin (2-shard virtual mesh) -----------------------------
     def _halo_parts(vector=False):
@@ -364,6 +369,24 @@ def cells() -> list:
                    twin)
     _halo_cell("halo-s2/plain/robust=none/adv=none/payload=vector3",
                "plain", vector=True)
+
+    # -- halo overlap schedules (PR 8): the interior/frontier split and
+    # the single-kernel Pallas form (interpret-executed on the CPU mesh,
+    # so the SHIPPED kernel's lowering is what the ledger pins)
+    def _halo_overlap_cell(key, mode):
+        def build(mode=mode):
+            sharded, _topo, mesh, cfg, plan, state = _halo_parts()
+            fn, args, _ = sharded.round_program(
+                state, plan, cfg, mesh, CELL_ROUNDS, halo=mode)
+            return fn, args, {}
+        out.append(Cell(key=key, mode="halo", twin="plain", build=build))
+
+    _halo_overlap_cell(
+        "halo-s2-overlap/plain/robust=none/adv=none/payload=scalar",
+        "overlap")
+    _halo_overlap_cell(
+        "halo-s2-overlap-pallas/plain/robust=none/adv=none/"
+        "payload=scalar", "overlap_pallas")
 
     # -- pod x twin (fat-tree stencil, 2-shard mesh) --------------------
     def _pod_kernel():

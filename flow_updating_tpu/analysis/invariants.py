@@ -1,0 +1,968 @@
+"""Semantic invariant prover — protocol theorems checked on the jaxpr.
+
+The structural rule engine (:mod:`flow_updating_tpu.analysis.rules`)
+catches *performance hazards*; this module proves *protocol
+correctness* properties as dataflow theorems over the round-scan jaxpr,
+so the invariants the repo otherwise only samples at runtime (doctor's
+trailing-window mass checks, the golden-hash observer tests) hold for
+EVERY round of EVERY input by construction:
+
+``ledger-negation`` (antisymmetry pairing)
+    Every receive-side write into the flow ledger is a pure NEGATION of
+    a wire-derived value (``flow[e] = -msg.flow`` through at most a
+    symmetric clamp), and the wire payload itself derives from the flow
+    ledger with no literal rescale — the two halves of Flow-Updating's
+    ``flow[e] == -flow[rev[e]]`` self-healing argument.  A one-sided
+    (positive) wire-to-ledger write, or a wire that ships a scaled copy
+    of the ledger, is exactly the mass-leak amplifier the
+    ``flow_corruption`` scenario plants — and the adversary cells are
+    this prover's built-in positive controls.
+
+``clip-symmetry`` (robust transform at BOTH ends)
+    The ``robust='clip'`` clamp must appear on the send-side ledger
+    delta AND on the receive-side antisymmetry write, with the same
+    literal bound (per edge, not per endpoint).  A clamp at one end
+    only lets a Byzantine peer pump the unclamped end past the bound —
+    the planted ``clip-at-one-end`` mutation this prover must fail.
+
+``mask-neutrality`` (the topology/padding.py contract)
+    Masked writes keep the carried ledger BIT-exactly (the kept branch
+    of every ledger-write ``where`` bottoms out at the carried value —
+    never a rescaled copy), and every masked fill that directly feeds a
+    segment reduction is exactly ``0.0`` (a ``1e-30``-style fill leaks
+    mass through every ghost/cohort slot, every round).
+
+``observer-purity``
+    Telemetry/field taps ride the scan as ys and must never feed back
+    into carried protocol state: the backward slice of the protocol
+    carry legs in the observed twin is equation-for-equation the plain
+    twin's slice.  This is the dataflow-theorem form of the golden
+    "fields-off == plain" hash tests — it also covers fields ON.
+
+Everything here is trace-only (``jax.make_jaxpr`` machinery — nothing
+compiles, nothing executes).  The prover drives the same golden-ledger
+cells as ``audit`` (:func:`prove_cells`), and each theorem cites the
+primitive path of its violation, e.g.
+``scan/pjit[_where]/select_n: wire-derived write is not negated``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flow_updating_tpu.analysis import walk
+
+# ---------------------------------------------------------------------------
+# inlined dataflow graph over one loop body
+
+#: call-like primitives the inliner makes transparent (their sub-jaxpr
+#: is the same program, just wrapped); control-flow loops/branches stay
+#: opaque nodes.
+_CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat", "remat2",
+               "custom_jvp_call", "custom_vjp_call", "custom_vmap_call",
+               "checkpoint", "custom_jvp_call_jaxpr")
+
+#: ops through which a value keeps its identity (selection, layout,
+#: permutation, dtype width) — the "sign/magnitude-preserving" set of
+#: the negation-pairing walk.
+_PRESERVING = ("squeeze", "reshape", "broadcast_in_dim", "transpose",
+               "convert_element_type", "copy", "slice", "dynamic_slice",
+               "gather", "rev", "expand_dims", "device_put")
+
+
+@dataclasses.dataclass
+class _N:
+    """One value in the inlined dataflow graph."""
+
+    prim: str                  # producing primitive ('carry'/'arg'/'lit'/
+    #                            'const' for leaves)
+    ins: tuple = ()            # operand nodes (pred first for select_n)
+    lit: object = None         # concrete value for lit/const leaves
+    role: str | None = None    # protocol role of a carry leaf
+    path: str = ""             # citation: primitive path from the body root
+    seq: int = 0               # creation order (topological)
+    aval: object = None        # abstract value of the produced output
+
+
+class BodyGraph:
+    """The inlined dataflow graph of one loop body: every call-like
+    primitive (pjit-wrapped jnp helpers, custom_* wrappers) is made
+    transparent; scans/whiles/conds inside the body stay opaque."""
+
+    def __init__(self, body_jaxpr, *, carry_offset: int, num_consts: int,
+                 num_carry: int, roles: dict):
+        self.nodes: list = []
+        self._env: dict = {}
+        self.roles = dict(roles)
+        jaxpr = getattr(body_jaxpr, "jaxpr", body_jaxpr)
+        consts = getattr(body_jaxpr, "consts", ())
+        invars = list(jaxpr.invars)
+        role_of_pos = {carry_offset + rel: name
+                       for name, rel in roles.items()}
+        for i, v in enumerate(invars):
+            kind = ("carry" if num_consts <= i < num_consts + num_carry
+                    else "arg")
+            role = role_of_pos.get(i - num_consts) if kind == "carry" \
+                else None
+            self._env[id(v)] = self._new(kind, role=role,
+                                         aval=walk.aval_of(v))
+        for v, c in zip(jaxpr.constvars, consts):
+            self._env[id(v)] = self._new("const", lit=c,
+                                         aval=walk.aval_of(v))
+        self._inline(jaxpr, path=())
+        self.carry_in = {r: self._env[id(invars[num_consts + rel
+                                              + carry_offset])]
+                         for r, rel in roles.items()}
+        outvars = list(jaxpr.outvars)
+        self.carry_out = {}
+        for r, rel in roles.items():
+            self.carry_out[r] = self.node_of(
+                outvars[carry_offset + rel])
+        self.outvars = outvars
+        self.num_carry = num_carry
+        self.carry_offset = carry_offset
+
+    # -- construction ------------------------------------------------------
+
+    def _new(self, prim, *, ins=(), lit=None, role=None, path="",
+             aval=None) -> _N:
+        n = _N(prim=prim, ins=tuple(ins), lit=lit, role=role, path=path,
+               seq=len(self.nodes), aval=aval)
+        self.nodes.append(n)
+        return n
+
+    def node_of(self, atom) -> _N:
+        node = self._env.get(id(atom))
+        if node is not None:
+            return node
+        # a Literal atom (inline constant)
+        val = getattr(atom, "val", None)
+        node = self._new("lit", lit=val, aval=walk.aval_of(atom))
+        self._env[id(atom)] = node
+        return node
+
+    def _inline(self, jaxpr, path: tuple) -> None:
+        jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            label = name
+            if name == "pjit":
+                inner = eqn.params.get("name")
+                if inner:
+                    label = f"pjit[{inner}]"
+            here = path + (label,)
+            subs = walk.subjaxprs(eqn)
+            if name in _CALL_PRIMS and subs:
+                sub = subs[0]
+                consts = ()
+                closed = next((v for v in eqn.params.values()
+                               if getattr(v, "jaxpr", None) is sub), None)
+                if closed is not None:
+                    consts = getattr(closed, "consts", ())
+                inner_invars = list(sub.invars)
+                for v, c in zip(sub.constvars, consts):
+                    self._env[id(v)] = self._new("const", lit=c,
+                                                 aval=walk.aval_of(v))
+                # align operands from the END (call conventions prepend
+                # consts to the inner invars)
+                outer = list(eqn.invars)[-len(inner_invars):] \
+                    if inner_invars else []
+                offset = len(inner_invars) - len(outer)
+                for k, iv in enumerate(inner_invars):
+                    if k >= offset:
+                        self._env[id(iv)] = self.node_of(outer[k - offset])
+                    else:
+                        self._env[id(iv)] = self._new(
+                            "arg", aval=walk.aval_of(iv))
+                self._inline(sub, here)
+                for ov, inner_ov in zip(eqn.outvars, sub.outvars):
+                    self._env[id(ov)] = self.node_of(inner_ov)
+                continue
+            ins = tuple(self.node_of(a) for a in eqn.invars)
+            for ov in eqn.outvars:
+                self._env[id(ov)] = self._new(
+                    label if not subs else name, ins=ins,
+                    path="/".join(here), aval=walk.aval_of(ov))
+
+
+def _scalar_lit(node: _N):
+    """Concrete scalar value of a lit/const (possibly broadcast /
+    converted / negated at trace time), or None."""
+    seen = 0
+    while node is not None and seen < 16:
+        if node.prim in ("lit", "const"):
+            v = node.lit
+            try:
+                arr = np.asarray(v)
+            except Exception:
+                return None
+            if arr.size == 1:
+                return arr.reshape(()).item()
+            # a broadcast constant plane counts when uniform
+            if arr.size and (arr == arr.flat[0]).all():
+                return arr.flat[0].item()
+            return None
+        if node.prim in _PRESERVING or node.prim == "neg":
+            flip = node.prim == "neg"
+            node = node.ins[0] if node.ins else None
+            if node is not None and flip:
+                v = _scalar_lit(node)
+                return -v if v is not None else None
+            seen += 1
+            continue
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# theorem machinery: write chains, provenance, clamps
+
+_CLAMPS = ("max", "min", "clamp")
+
+
+def _is_float(node: _N) -> bool:
+    dt = getattr(node.aval, "dtype", None)
+    try:
+        return np.dtype(dt).kind == "f"
+    except TypeError:
+        return False
+
+
+def _passthrough_case(graph: BodyGraph, node: _N, base: _N,
+                      _depth=0) -> bool:
+    """Does ``node`` bottom out at the carried value ``base`` through
+    write-preserving structure only (selects keeping one branch, layout
+    ops)?  This is the "masked slots keep the ledger bit-exactly" leg
+    of mask-neutrality."""
+    if _depth > 64:
+        return False
+    if node is base:
+        return True
+    if node.prim == "select_n":
+        return any(_passthrough_case(graph, c, base, _depth + 1)
+                   for c in node.ins[1:])
+    if node.prim == "scatter" and node.ins:
+        return _passthrough_case(graph, node.ins[0], base, _depth + 1)
+    if node.prim in _PRESERVING and node.ins:
+        return _passthrough_case(graph, node.ins[0], base, _depth + 1)
+    return False
+
+
+def write_chain(graph: BodyGraph, out: _N, base: _N) -> tuple:
+    """Decompose a carry leg's out-node into its masked writes.
+
+    Returns ``(writes, passthrough_ok)`` where each write is ``(value
+    node, path)`` — the non-carried branch of a ``select_n`` (or the
+    updates operand of an overwrite scatter) along the chain from the
+    out-node back to the carried-in value — and ``passthrough_ok`` says
+    the kept branch bottoms out at the carried value itself (bit-exact
+    masked slots; False = a rescaled "keep" branch, the mask-neutrality
+    violation)."""
+    writes: list = []
+    ok = True
+    seen: set = set()
+    stack = [out]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node is base:
+            continue
+        if node.prim == "select_n":
+            cont = [c for c in node.ins[1:]
+                    if _passthrough_case(graph, c, base)]
+            if cont:
+                stack.extend(cont)
+                writes.extend((c, node.path) for c in node.ins[1:]
+                              if not _passthrough_case(graph, c, base))
+            else:
+                ok = False
+                writes.extend((c, node.path) for c in node.ins[1:])
+            continue
+        if node.prim == "scatter" and len(node.ins) >= 3:
+            stack.append(node.ins[0])
+            writes.append((node.ins[2], node.path))
+            continue
+        if node.prim in _PRESERVING and node.ins:
+            stack.append(node.ins[0])
+            continue
+        # the leg is wholly rewritten (no masked keep) — treat the whole
+        # expression as one write; passthrough does not apply
+        writes.append((node, node.path))
+    return writes, ok
+
+
+@dataclasses.dataclass
+class Prov:
+    """Provenance of a value along sign/magnitude-preserving paths:
+    which protocol-role carried values it IS (a selection / permutation
+    / clamp of), with what sign, plus the float clamp bounds and any
+    literal rescales met on the way."""
+
+    origins: set = dataclasses.field(default_factory=set)  # (role, sign)
+    clamps: set = dataclasses.field(default_factory=set)   # |bound|
+    rescales: list = dataclasses.field(default_factory=list)  # (k, path)
+    opaque: bool = False
+
+
+def provenance(graph: BodyGraph, node: _N, _memo=None, _depth=0) -> Prov:
+    """Walk backward through preserving ops only; arithmetic that mixes
+    values (add/sub/div of two data operands) makes the result opaque —
+    provenance answers "is this value still role X's value?", not "does
+    it depend on X"."""
+    if _memo is None:
+        _memo = {}
+    if id(node) in _memo:
+        return _memo[id(node)]
+    out = Prov()
+    _memo[id(node)] = out
+    if _depth > 256:
+        out.opaque = True
+        return out
+    if node.role is not None:
+        out.origins.add((node.role, +1))
+        return out
+    if node.prim in ("lit", "const", "arg", "carry"):
+        return out
+
+    def merge(p: Prov, flip=False):
+        out.origins |= {(r, -s if flip else s) for r, s in p.origins}
+        out.clamps |= p.clamps
+        out.rescales.extend(p.rescales)
+        out.opaque = out.opaque or p.opaque
+
+    if node.prim == "neg":
+        merge(provenance(graph, node.ins[0], _memo, _depth + 1),
+              flip=True)
+        return out
+    if node.prim == "select_n":
+        for c in node.ins[1:]:
+            merge(provenance(graph, c, _memo, _depth + 1))
+        return out
+    if node.prim in _CLAMPS:
+        # max/min against a literal bound = one half of a clamp; the
+        # lax.clamp primitive is (lo, x, hi)
+        data, bounds = [], []
+        for c in node.ins:
+            v = _scalar_lit(c)
+            (bounds if v is not None else data).append((c, v))
+        if _is_float(node):
+            for _, v in bounds:
+                out.clamps.add(abs(v))
+        for c, _ in data:
+            merge(provenance(graph, c, _memo, _depth + 1))
+        if not data:
+            out.opaque = True
+        return out
+    if node.prim == "mul":
+        lits = [(c, _scalar_lit(c)) for c in node.ins]
+        data = []
+        for c, v in lits:
+            if v is None:
+                size = getattr(getattr(c, "aval", None), "size", None)
+                if size == 1:
+                    # a TRACED scalar multiplier rescales uniformly —
+                    # the adversary corrupt_gain form (masks are
+                    # elementwise planes, never scalars)
+                    out.rescales.append(("<traced scalar>", node.path))
+                else:
+                    data.append(c)
+                continue
+            if v == 1 or v == -1:
+                continue
+            out.rescales.append((v, node.path))
+        flip = any(v == -1 for _, v in lits)
+        if not data:
+            return out
+        for c in data:
+            # two data operands = masked routing (the Beneš butterfly:
+            # value * mask) — origins union, signs kept
+            merge(provenance(graph, c, _memo, _depth + 1), flip=flip)
+        return out
+    if node.prim in _PRESERVING or node.prim in ("concatenate", "pad"):
+        for c in node.ins:
+            merge(provenance(graph, c, _memo, _depth + 1))
+        return out
+    out.opaque = True
+    return out
+
+
+def _contains_prim(node: _N, prim: str, limit: int = 2048) -> bool:
+    """Does ``node``'s backward cone (all ops) contain ``prim``?"""
+    seen: set = set()
+    stack = [node]
+    while stack and len(seen) < limit:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if n.prim == prim:
+            return True
+        stack.extend(n.ins)
+    return False
+
+
+def _forward_index(graph: BodyGraph) -> dict:
+    """node -> direct consumer nodes."""
+    consumers: dict = {}
+    for n in graph.nodes:
+        for c in n.ins:
+            consumers.setdefault(id(c), []).append(n)
+    return consumers
+
+
+def _reaches(consumers: dict, src: _N, dst: _N) -> bool:
+    seen = set()
+    stack = [src]
+    while stack:
+        n = stack.pop()
+        if n is dst:
+            return True
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        stack.extend(consumers.get(id(n), ()))
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the theorems
+
+ROLE_FIELDS = ("flow", "buf_flow", "pending_flow")
+WIRE_ROLES = ("buf_flow", "pending_flow")
+#: protocol-state legs whose defining slices observer twins must not
+#: perturb (the purity theorem's quantifier)
+PURITY_FIELDS = ("flow", "est", "value", "buf_flow", "buf_est",
+                 "pending_flow", "pending_est")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    theorem: str
+    message: str
+    where: str = ""
+    program: str = ""
+
+    def format(self) -> str:
+        loc = f" at {self.where}" if self.where else ""
+        prog = f"[{self.program}] " if self.program else ""
+        return f"{prog}{self.theorem}{loc}: {self.message}"
+
+
+def prove_antisymmetry(graph: BodyGraph, *, program: str = "",
+                       expect_clip: bool | None = None) -> list:
+    """The negation-pairing + clip-symmetry + masked-keep theorems on
+    one round-loop body graph.  ``expect_clip`` pins the robust mode
+    when the caller knows it (golden cell keys carry it); None infers
+    nothing and only symmetry is judged."""
+    out: list = []
+    flow_in = graph.carry_in.get("flow")
+    flow_out = graph.carry_out.get("flow")
+    if flow_in is None or flow_out is None:
+        return out
+    wires_in = [graph.carry_in[r] for r in WIRE_ROLES
+                if r in graph.carry_in]
+
+    writes, keep_ok = write_chain(graph, flow_out, flow_in)
+    if not keep_ok:
+        out.append(Violation(
+            "mask-neutrality", program=program,
+            message="a masked flow-ledger write does not keep the "
+                    "carried ledger bit-exactly on its kept branch "
+                    "(non-firing slots must be untouched — the "
+                    "topology/padding.py mass-neutral contract)"))
+    memo: dict = {}
+    recv_negs, recv_clamps = [], set()
+    for value, where in writes:
+        p = provenance(graph, value, memo)
+        wire_hits = {(r, s) for r, s in p.origins if r in WIRE_ROLES}
+        if not wire_hits:
+            continue
+        signs = {s for _, s in wire_hits}
+        if signs == {-1}:
+            recv_negs.append((value, where))
+            recv_clamps |= p.clamps
+        else:
+            out.append(Violation(
+                "ledger-negation", where=where, program=program,
+                message="wire-derived flow-ledger write is not a pure "
+                        "negation (one-sided write: the receiver "
+                        "installs +msg.flow, so the edge pair no "
+                        "longer cancels and mass leaks)"))
+    if not recv_negs and wires_in:
+        # a flow ledger fed by wire buffers must somewhere apply the
+        # antisymmetry write; a program with wire roles but no negated
+        # receive write has lost the pairing entirely
+        out.append(Violation(
+            "ledger-negation", program=program,
+            message="no negated wire-to-ledger write found: the "
+                    "antisymmetry receive write (flow[e] = -msg.flow) "
+                    "is missing from the round body"))
+
+    # -- wire integrity: the payload written into the ring buffer IS the
+    # ledger (no literal rescale on any reachable branch)
+    wire_out = graph.carry_out.get("buf_flow")
+    wire_in = graph.carry_in.get("buf_flow")
+    if wire_out is not None and wire_in is not None:
+        w_writes, w_keep = write_chain(graph, wire_out, wire_in)
+        if not w_keep:
+            out.append(Violation(
+                "mask-neutrality", program=program,
+                message="a masked wire-buffer write does not keep the "
+                        "carried buffer bit-exactly on its kept branch"))
+        ledger_hit = in_kernel = False
+        for value, where in w_writes:
+            if _contains_prim(value, "pallas_call"):
+                # the single-kernel Pallas form merges the delivery
+                # INSIDE pallas_call (receiver-pull between the DMA
+                # start and wait) — an analyzability boundary, not a
+                # violation; the receive-negation theorem above still
+                # sees the XLA half
+                in_kernel = True
+                continue
+            p = provenance(graph, value, memo)
+            if any(r == "flow" for r, _ in p.origins):
+                ledger_hit = True
+                for k, rp in p.rescales:
+                    out.append(Violation(
+                        "wire-integrity", where=rp or where,
+                        program=program,
+                        message=f"wire payload carries the flow ledger "
+                                f"rescaled by literal {k!r} — the "
+                                "receiver's antisymmetry write can no "
+                                "longer cancel the sender's ledger "
+                                "(the flow_corruption amplifier)"))
+        if w_writes and not ledger_hit and not in_kernel:
+            out.append(Violation(
+                "wire-integrity", program=program,
+                message="no wire-buffer write derives from the flow "
+                        "ledger along a value-preserving path — the "
+                        "wire does not carry the ledger"))
+
+    # -- clip symmetry: the robust clamp must bound BOTH the send-side
+    # ledger delta and the receive-side antisymmetry write, with equal
+    # literal bounds (per edge, not per endpoint)
+    consumers = _forward_index(graph)
+    fire_clamps = set()
+    for n in graph.nodes:
+        if n.prim not in _CLAMPS or not _is_float(n):
+            continue
+        bounds = {abs(v) for v in
+                  (_scalar_lit(c) for c in n.ins) if v is not None}
+        if not bounds:
+            continue
+        if _reaches(consumers, n, flow_out):
+            p = provenance(graph, n, memo)
+            if {(r, s) for r, s in p.origins if r in WIRE_ROLES}:
+                continue       # the receive-side clamp, counted above
+            fire_clamps |= bounds
+    if fire_clamps and not recv_clamps:
+        out.append(Violation(
+            "clip-symmetry", program=program,
+            message=f"flow clamp bound(s) {sorted(fire_clamps)} applied "
+                    "on the send-side ledger delta but NOT on the "
+                    "receive-side antisymmetry write (clip at one end "
+                    "only — the unclamped end can be pumped past the "
+                    "bound)"))
+    if recv_clamps and not fire_clamps:
+        out.append(Violation(
+            "clip-symmetry", program=program,
+            message=f"flow clamp bound(s) {sorted(recv_clamps)} applied "
+                    "on the receive-side write but NOT on the "
+                    "send-side ledger delta (clip at one end only)"))
+    if fire_clamps and recv_clamps and fire_clamps != recv_clamps:
+        out.append(Violation(
+            "clip-symmetry", program=program,
+            message=f"send-side clamp bounds {sorted(fire_clamps)} != "
+                    f"receive-side bounds {sorted(recv_clamps)} — the "
+                    "robust transform must be the same at both ends"))
+    if expect_clip is True and not (fire_clamps or recv_clamps):
+        out.append(Violation(
+            "clip-symmetry", program=program,
+            message="robust='clip' program lowered without any float "
+                    "clamp on the flow-ledger path"))
+    if expect_clip is False and (fire_clamps | recv_clamps):
+        out.append(Violation(
+            "clip-symmetry", program=program,
+            message=f"robust='none' program clamps the flow ledger at "
+                    f"{sorted(fire_clamps | recv_clamps)} — the plain "
+                    "lowering must not bound flows"))
+    return out
+
+
+#: reduction sinks of the masked-fill theorem
+_REDUCTIONS = ("reduce_sum", "dot_general", "scatter-add")
+
+
+def prove_masked_fills(graph: BodyGraph, *, program: str = "") -> list:
+    """Every select fill / pad value that DIRECTLY feeds a segment
+    reduction must be exactly 0.0: a near-zero fill (1e-30) contributes
+    to every masked slot of every reduction, every round — the slow
+    mass leak the padding contract exists to exclude."""
+    out = []
+    direct_src: dict = {}
+    for n in graph.nodes:
+        if n.prim in _REDUCTIONS:
+            stack = list(n.ins)
+            depth = 0
+            while stack and depth < 512:
+                depth += 1
+                c = stack.pop()
+                if c.prim in _PRESERVING or c.prim == "concatenate":
+                    stack.extend(c.ins)
+                elif c.prim == "select_n":
+                    direct_src.setdefault(id(c), (c, n))
+    for c, sink in direct_src.values():
+        if not _is_float(c):
+            continue
+        for case in c.ins[1:]:
+            v = _scalar_lit(case)
+            if v is not None and v != 0.0:
+                out.append(Violation(
+                    "mask-neutrality", where=c.path, program=program,
+                    message=f"masked fill {v!r} feeds a {sink.prim} "
+                            "reduction — masked contributions must be "
+                            "exactly 0.0 (topology/padding.py contract)"))
+    return out
+
+
+def carry_slice_signature(graph: BodyGraph, legs) -> list:
+    """Ordered (prim, shape, dtype) signature of the backward slice of
+    the given carry-leg out-nodes — the purity theorem's object."""
+    seen: set = set()
+    stack = [graph.carry_out[r] for r in legs if r in graph.carry_out]
+    keep = []
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if n.prim not in ("lit", "const", "arg", "carry"):
+            keep.append(n)
+        stack.extend(n.ins)
+    keep.sort(key=lambda n: n.seq)
+    sig = []
+    for n in keep:
+        aval = n.aval
+        sig.append((n.prim,
+                    tuple(getattr(aval, "shape", ()) or ()),
+                    str(getattr(aval, "dtype", "?"))))
+    return sig
+
+
+def prove_observer_purity(observed: BodyGraph, plain: BodyGraph, *,
+                          program: str = "") -> list:
+    """The observed twin's protocol-state carry slices must match the
+    plain twin's equation-for-equation: an observer tap that feeds back
+    into carried state grows the slice, and the first extra primitive
+    is the citation."""
+    legs = [r for r in PURITY_FIELDS
+            if r in observed.carry_out and r in plain.carry_out]
+    if not legs:
+        legs = None
+    if legs is None:
+        n = min(observed.num_carry, plain.num_carry)
+        obs_sig = _full_carry_signature(observed, n)
+        plain_sig = _full_carry_signature(plain, n)
+    else:
+        obs_sig = carry_slice_signature(observed, legs)
+        plain_sig = carry_slice_signature(plain, legs)
+    if obs_sig == plain_sig:
+        return []
+    # order-insensitive fallback: CSE/tracing may reorder independent
+    # equations without changing the slice's contents
+    from collections import Counter
+
+    co, cp = Counter(obs_sig), Counter(plain_sig)
+    if co == cp:
+        return []
+    extra = list((co - cp).elements())
+    missing = list((cp - co).elements())
+    msg = []
+    if extra:
+        msg.append(f"observed slice grows {extra[:3]!r}")
+    if missing:
+        msg.append(f"observed slice loses {missing[:3]!r}")
+    return [Violation(
+        "observer-purity", program=program,
+        message="protocol-state carry slice differs from the plain "
+                "twin's (" + "; ".join(msg) + f"; plain {len(plain_sig)}"
+                f" vs observed {len(obs_sig)} slice equations) — "
+                "observer taps must ride the scan as ys only")]
+
+
+def _full_carry_signature(graph: BodyGraph, n_legs: int) -> list:
+    class _G:
+        carry_out = {i: graph.node_of(graph.outvars[graph.carry_offset
+                                                    + i])
+                     for i in range(n_legs)}
+    g = _G()
+    g.nodes = graph.nodes
+    return carry_slice_signature(g, list(range(n_legs)))
+
+
+# ---------------------------------------------------------------------------
+# locating the round loop + roles inside a traced program
+
+def role_indices(state) -> dict | None:
+    """role -> position among the flattened leaves of ``state`` (the
+    scan carry order), for every protocol-state field present."""
+    import jax.tree_util as jtu
+
+    try:
+        flat = jtu.tree_flatten_with_path(state)[0]
+    except Exception:
+        return None
+    idx: dict = {}
+    for i, (path, _leaf) in enumerate(flat):
+        name = str(path[-1]) if path else ""
+        name = name.strip(".")
+        for field in set(ROLE_FIELDS) | set(PURITY_FIELDS):
+            if name == field:
+                idx[field] = i
+    return idx or None
+
+
+def find_state(args):
+    """The protocol-state object inside a cell's argument tuple: the
+    first pytree node exposing the ledger + wire fields (the
+    FlowUpdatingState duck type, chunked window included)."""
+    stack = list(args)
+    while stack:
+        x = stack.pop(0)
+        if hasattr(x, "state") and hasattr(getattr(x, "state"), "flow"):
+            # ChunkedState: the chunk-major leaves shadow the window's
+            # field names; the round loop's carry is the one-chunk
+            # working window
+            return x.state
+        if hasattr(x, "flow") and hasattr(x, "buf_flow"):
+            return x
+        if isinstance(x, (tuple, list)):
+            stack.extend(x)
+    return None
+
+
+def _iter_loops(closed_jaxpr, depth=0, path=()):
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        here = path + (name,)
+        if name in walk.LOOP_PRIMS:
+            yield eqn, depth, here
+        inner_depth = depth + (1 if name in walk.LOOP_PRIMS else 0)
+        for sub in walk.subjaxprs(eqn):
+            yield from _iter_loops(sub, inner_depth, here)
+
+
+def _loop_layout(eqn):
+    """(body, num_consts, num_carry) of a scan/while eqn, body as the
+    closed jaxpr whose invars follow consts+carry(+xs)."""
+    if eqn.primitive.name == "scan":
+        return (eqn.params["jaxpr"], eqn.params.get("num_consts", 0),
+                eqn.params.get("num_carry", 0))
+    body = eqn.params.get("body_jaxpr")
+    jaxpr = getattr(body, "jaxpr", body)
+    nk = len(jaxpr.outvars)
+    return body, len(jaxpr.invars) - nk, nk
+
+
+def _avals_match(body, num_consts, offset, roles, state) -> bool:
+    import jax
+
+    leaves = jax.tree_util.tree_flatten(state)[0]
+    jaxpr = getattr(body, "jaxpr", body)
+    invars = list(jaxpr.invars)
+    for role, rel in roles.items():
+        pos = num_consts + offset + rel
+        if pos >= len(invars):
+            return False
+        aval = walk.aval_of(invars[pos])
+        leaf = leaves[rel]
+        got = tuple(getattr(aval, "shape", ()) or ())
+        want = tuple(leaf.shape)
+        # sharded programs carry the PER-SHARD block inside shard_map:
+        # the global leaf's leading shard axis is stripped in the body
+        if got != want and got != want[1:]:
+            return False
+    return True
+
+
+def find_round_loop(closed_jaxpr, roles: dict, state):
+    """Locate the round loop: the deepest scan/while whose carry
+    contains the protocol-state leaves (shape-matched at the role
+    positions, at some carry offset).  Returns ``(eqn, offset)`` or
+    ``None``."""
+    best = None
+    for eqn, depth, _path in _iter_loops(closed_jaxpr):
+        body, nc, nk = _loop_layout(eqn)
+        max_rel = max(roles.values())
+        for offset in range(0, max(nk - max_rel, 0)):
+            if _avals_match(body, nc, offset, roles, state):
+                key = (depth, -offset)
+                if best is None or key > best[0]:
+                    best = (key, eqn, offset)
+                break
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+def body_graph(eqn, offset: int, roles: dict) -> BodyGraph:
+    body, nc, nk = _loop_layout(eqn)
+    return BodyGraph(body, carry_offset=offset, num_consts=nc,
+                     num_carry=nk, roles=roles)
+
+
+def trace_program(fn, args, kwargs=None):
+    """Closed jaxpr of a jit-wrapped call (trace only, no compile)."""
+    kwargs = kwargs or {}
+    tracer = getattr(fn, "trace", None)
+    if tracer is not None:
+        return tracer(*args, **kwargs).jaxpr
+    import jax
+
+    return jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+
+
+# ---------------------------------------------------------------------------
+# the golden-cell driver
+
+@dataclasses.dataclass
+class CellProof:
+    cell: str
+    status: str          # proved | violated | expected-violation |
+    #                      inapplicable | error
+    violations: list = dataclasses.field(default_factory=list)
+    detail: str = ""
+
+    def to_jsonable(self) -> dict:
+        return {"cell": self.cell, "status": self.status,
+                "detail": self.detail,
+                "violations": [v.format() for v in self.violations]}
+
+
+#: cells planted with a wire adversary ARE the prover's positive
+#: controls: their expected theorem violations, by key fragment
+_EXPECTED = {"adv=corrupt": ("wire-integrity",)}
+
+
+def _expected_violations(key: str) -> tuple:
+    for frag, theorems in _EXPECTED.items():
+        if frag in key:
+            return theorems
+    return ()
+
+
+def prove_cell(cell, *, plain_graphs: dict | None = None) -> CellProof:
+    """Run every applicable theorem over one golden-ledger cell."""
+    try:
+        fn, args, kwargs = cell.build()
+        state = find_state(args)
+        roles = role_indices(state) if state is not None else None
+        if not roles or "flow" not in roles:
+            return CellProof(cell.key, "inapplicable",
+                             detail="no per-edge flow ledger in the "
+                                    "carried state (node-collapsed "
+                                    "kernel) — antisymmetry holds by "
+                                    "algebraic construction there")
+        jx = trace_program(fn, args, kwargs)
+        loc = find_round_loop(jx, roles, state)
+        if loc is None:
+            return CellProof(cell.key, "error",
+                             detail="round loop not located in the "
+                                    "traced program")
+        graph = body_graph(loc[0], loc[1], roles)
+    except Exception as exc:
+        return CellProof(cell.key, "error",
+                         detail=f"{type(exc).__name__}: {exc}")
+    expect_clip = None
+    if "/robust=clip/" in cell.key or "robust=clip" in cell.key:
+        expect_clip = True
+    elif "robust=none" in cell.key:
+        expect_clip = False
+    violations = prove_antisymmetry(graph, program=cell.key,
+                                    expect_clip=expect_clip)
+    violations += prove_masked_fills(graph, program=cell.key)
+    if plain_graphs is not None:
+        for twin in ("telemetry", "fields"):
+            if f"/{twin}/" not in cell.key:
+                continue
+            plain_key = cell.key.replace(f"/{twin}/", "/plain/")
+            plain = plain_graphs.get(plain_key)
+            if plain is not None:
+                violations += prove_observer_purity(
+                    graph, plain, program=cell.key)
+    expected = _expected_violations(cell.key)
+    if expected:
+        hit = {v.theorem for v in violations}
+        if set(expected) <= hit:
+            spurious = [v for v in violations
+                        if v.theorem not in expected]
+            if spurious:
+                return CellProof(cell.key, "violated", spurious)
+            return CellProof(
+                cell.key, "expected-violation", violations,
+                detail="planted adversary correctly detected "
+                       f"({', '.join(expected)})")
+        return CellProof(
+            cell.key, "violated",
+            [Violation("positive-control", program=cell.key,
+                       message=f"adversary cell must trip "
+                               f"{expected} but the prover found "
+                               f"{sorted(hit) or 'nothing'}")])
+    if violations:
+        return CellProof(cell.key, "violated", violations)
+    return CellProof(cell.key, "proved",
+                     detail="antisymmetry pairing, clip symmetry, "
+                            "mask neutrality"
+                            + (", observer purity"
+                               if plain_graphs is not None
+                               and ("/telemetry/" in cell.key
+                                    or "/fields/" in cell.key)
+                               else ""))
+
+
+def prove_cells(keys=None) -> list:
+    """Prove every golden-ledger cell (or the ``keys`` subset).
+    Trace-only: the whole matrix proves in well under the audit's
+    lowering time."""
+    from flow_updating_tpu.analysis import golden
+
+    index = golden.cell_index()
+    keys = list(keys) if keys is not None else list(index)
+    # build plain-twin graphs first (purity pairs against them)
+    plain_graphs: dict = {}
+    for key in keys:
+        if "/plain/" not in key:
+            continue
+        cell = index[key]
+        try:
+            fn, args, kwargs = cell.build()
+            state = find_state(args)
+            roles = role_indices(state) if state is not None else None
+            if not roles:
+                continue
+            jx = trace_program(fn, args, kwargs)
+            loc = find_round_loop(jx, roles, state)
+            if loc is not None:
+                plain_graphs[key] = body_graph(loc[0], loc[1], roles)
+        except Exception:
+            continue
+    return [prove_cell(index[k], plain_graphs=plain_graphs)
+            for k in keys]
+
+
+def summarize(proofs) -> dict:
+    by = {}
+    for p in proofs:
+        by.setdefault(p.status, []).append(p.cell)
+    return {
+        "overall": ("fail" if any(p.status in ("violated", "error")
+                                  for p in proofs) else "pass"),
+        "counts": {k: len(v) for k, v in by.items()},
+        "violated": by.get("violated", []) + by.get("error", []),
+        "proofs": [p.to_jsonable() for p in proofs],
+    }

@@ -301,17 +301,28 @@ _CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat", "remat2",
                "shard_map", "xla_call")
 
 
-def _key_flow(jaxpr, env: dict, sites: dict, path: tuple) -> None:
+def _key_flow(jaxpr, env: dict, sites: dict, path: tuple,
+              uid: list | None = None) -> None:
     """Walk ``jaxpr`` propagating value tokens through key-shaped
     dataflow; record each consuming equation against its key's root
-    token in ``sites`` (token -> list of locations)."""
+    token in ``sites`` (token -> list of locations).
+
+    ``uid`` is the traversal-wide freshness counter: tokens must be
+    unique PER VISIT, not per variable object — jax caches the traced
+    body of identical scan calls, so two scans over the same function
+    share one body jaxpr and ``id(var)`` alone would alias their
+    (independent) key streams into a false reuse (multi-scan programs,
+    tests/test_invariants.py)."""
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    if uid is None:
+        uid = [0]
 
     def tok(atom):
         return env.get(id(atom))
 
     def fresh(var, label):
-        env[id(var)] = (label, id(var))
+        uid[0] += 1
+        env[id(var)] = (label, uid[0])
 
     for i, eqn in enumerate(jaxpr.eqns):
         name = eqn.primitive.name
@@ -360,8 +371,8 @@ def _key_flow(jaxpr, env: dict, sites: dict, path: tuple) -> None:
             for sub in inner:
                 sub_sites: dict = {}
                 sub_env = dict(env)
-                _bind(sub, ops, sub_env)
-                _key_flow(sub, sub_env, sub_sites, here)
+                _bind(sub, ops, sub_env, uid)
+                _key_flow(sub, sub_env, sub_sites, here, uid)
                 for t, locs in sub_sites.items():
                     if len(locs) > len(merged.get(t, ())):
                         merged[t] = locs
@@ -376,9 +387,9 @@ def _key_flow(jaxpr, env: dict, sites: dict, path: tuple) -> None:
             # carry token.
             for sub in inner:
                 sub_env = dict(env)
-                _bind(sub, eqn.invars, sub_env)
+                _bind(sub, eqn.invars, sub_env, uid)
                 before = {t: len(locs) for t, locs in sites.items()}
-                _key_flow(sub, sub_env, sites, here)
+                _key_flow(sub, sub_env, sites, here, uid)
                 sub_jaxpr = getattr(sub, "jaxpr", sub)
                 for cin, cout in _loop_carry_pairs(eqn, sub_jaxpr):
                     t_in = sub_env.get(id(cin))
@@ -392,8 +403,8 @@ def _key_flow(jaxpr, env: dict, sites: dict, path: tuple) -> None:
                         or name == "custom_vmap_call_jvp"):
             for sub in inner:
                 sub_env = dict(env)
-                _bind(sub, eqn.invars, sub_env)
-                _key_flow(sub, sub_env, sites, here)
+                _bind(sub, eqn.invars, sub_env, uid)
+                _key_flow(sub, sub_env, sites, here, uid)
         for ov in eqn.outvars:
             if id(ov) not in env:
                 fresh(ov, name)
@@ -419,9 +430,11 @@ def _loop_carry_pairs(eqn, body_jaxpr):
     return []
 
 
-def _bind(jaxpr, outer_atoms, env: dict) -> None:
+def _bind(jaxpr, outer_atoms, env: dict,
+          uid: list | None = None) -> None:
     """Bind an inner jaxpr's invars to the outer operands' tokens
-    (positional; extra/missing positions get fresh tokens)."""
+    (positional; extra/missing positions get fresh per-visit tokens —
+    see the ``uid`` note on :func:`_key_flow`)."""
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
     invars = list(jaxpr.invars)
     # align from the END: call conventions prepend consts to invars
@@ -430,7 +443,13 @@ def _bind(jaxpr, outer_atoms, env: dict) -> None:
     for k, iv in enumerate(invars):
         src = outer[k - offset] if k >= offset else None
         t = env.get(id(src)) if src is not None else None
-        env[id(iv)] = t if t is not None else ("arg", id(iv))
+        if t is None:
+            if uid is None:
+                t = ("arg", id(iv))
+            else:
+                uid[0] += 1
+                t = ("arg", uid[0])
+        env[id(iv)] = t
 
 
 @_rule(

@@ -1717,14 +1717,45 @@ def cmd_audit(args) -> int:
                 f"audit: cannot read ledger {args.ledger}: {err} — "
                 "generate it with `audit --rebase`") from err
     report = golden.audit(ledger)
-    check = health.check_program_conformance(report)
+    checks = [health.check_program_conformance(report)]
+    inv_summary = None
+    if not args.no_invariants:
+        # the invariant prover (analysis/invariants.py): antisymmetry
+        # pairing / clip symmetry / mask neutrality / observer purity
+        # proved on every registered cell — trace-only, a few seconds
+        from flow_updating_tpu.analysis import invariants
+
+        inv_summary = invariants.summarize(invariants.prove_cells())
+        checks.append(health.check_invariants(inv_summary))
+    budget_report = None
+    if args.budget:
+        # the collective-byte budget verifier (analysis/budget.py):
+        # compiled HLO collective bytes vs plan accounting ±5%, any
+        # unbudgeted collective named — written as its own manifest
+        from flow_updating_tpu.analysis import budget as budget_mod
+        from flow_updating_tpu.obs.report import build_budget_manifest
+
+        budget_report = budget_mod.verify_matrix()
+        checks.append(health.check_budget(budget_report))
+        write_report(args.budget, build_budget_manifest(
+            argv=getattr(args, "_argv", None), budget=budget_report,
+            invariants=inv_summary))
     if args.report:
         write_report(args.report, build_audit_manifest(
             argv=getattr(args, "_argv", None), audit=report,
-            ledger_path=args.ledger))
-    print(json.dumps({"overall": report["overall"],
-                      "check": check.to_jsonable()}))
-    return health.exit_code([check], strict=args.strict)
+            ledger_path=args.ledger,
+            extra=({"invariants": inv_summary}
+                   if inv_summary is not None else None)))
+    out = {"overall": health.overall(checks),
+           "check": checks[0].to_jsonable()}
+    if inv_summary is not None:
+        out["invariants"] = {"overall": inv_summary["overall"],
+                             "counts": inv_summary["counts"]}
+    if budget_report is not None:
+        out["budget"] = {"overall": budget_report["overall"],
+                         "failed": budget_report["failed"]}
+    print(json.dumps(out))
+    return health.exit_code(checks, strict=args.strict)
 
 
 def _add_durability_flags(p, prog: str) -> None:
@@ -2385,7 +2416,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="repo-specific static analysis: AST rules ruff cannot "
              "express (numpy in kernels, traced `if`, kernel "
              "round_program coverage, bare PRNGKey, baseline key "
-             "families) + the jaxpr rule engine over every kernel's "
+             "families, zero-copy device arrays over mutated host "
+             "mirrors) + the jaxpr rule engine over every kernel's "
              "round program (serializing scatters, fast-path gathers, "
              "callbacks/collectives in the round scan, dtype drift, "
              "PRNG key reuse); exit 1 on any finding "
@@ -2414,6 +2446,20 @@ def build_parser() -> argparse.ArgumentParser:
     au.add_argument("--report", metavar="PATH",
                     help="write a flow-updating-audit-report/v1 "
                          "manifest (doctor judges it)")
+    au.add_argument("--no-invariants", action="store_true",
+                    help="skip the semantic invariant prover "
+                         "(antisymmetry pairing / clip symmetry / mask "
+                         "neutrality / observer purity over every "
+                         "registered cell — analysis/invariants.py; on "
+                         "by default, trace-only)")
+    au.add_argument("--budget", metavar="PATH",
+                    help="also run the collective/wire-byte budget "
+                         "verifier (compiled HLO collective bytes vs "
+                         "plan accounting ±5%%, unbudgeted collectives "
+                         "named — analysis/budget.py) and write the "
+                         "flow-updating-budget-report/v1 manifest here "
+                         "(doctor judges it; regress --against gates "
+                         "byte growth)")
     au.add_argument("--strict", action="store_true",
                     help="environment-mismatch warnings also exit 1")
     au.set_defaults(fn=cmd_audit)

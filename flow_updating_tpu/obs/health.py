@@ -650,6 +650,24 @@ def check_service(service: dict | None, *, dtype: str | None = None
         checks.append(CheckResult(
             "service_churn_recovery", SKIP,
             "no epoch applied membership events"))
+
+    probe = service.get("mirror_probe")
+    if isinstance(probe, dict):
+        shared = probe.get("shared") or []
+        if shared:
+            checks.append(CheckResult(
+                "service_mirror_aliasing", FAIL,
+                f"{len(shared)} device leaf(s) alias in-place-mutated "
+                "host mirrors (zero-copy jnp.asarray — the PR-13 "
+                "restore race); build device leaves with jnp.array",
+                {"shared": shared[:10],
+                 "checked": probe.get("checked")}))
+        else:
+            checks.append(CheckResult(
+                "service_mirror_aliasing", PASS,
+                f"no device leaf shares memory with a host mirror "
+                f"({probe.get('checked', 0)} pairs probed)",
+                {"checked": probe.get("checked")}))
     return checks
 
 
@@ -1350,6 +1368,75 @@ def check_program_conformance(audit_report: dict) -> CheckResult:
          "details": bad[:10]})
 
 
+def check_budget(budget_report: dict | None) -> CheckResult:
+    """Judge a collective-byte-budget report
+    (:func:`flow_updating_tpu.analysis.budget.verify_matrix` output, or
+    the ``budget`` block of a ``flow-updating-budget-report/v1``
+    manifest): FAIL names every over-budget cell and every unbudgeted
+    collective with its HLO position."""
+    name = "collective_budget"
+    if not isinstance(budget_report, dict) \
+            or "overall" not in budget_report:
+        return CheckResult(
+            name, SKIP,
+            "no budget report — run `python -m flow_updating_tpu audit "
+            "--budget PATH`")
+    cells = budget_report.get("cells") or []
+    bad = [r for r in cells if r.get("status") != "pass"]
+    if budget_report.get("overall") == "pass" and not bad:
+        total = sum(r.get("measured_bytes") or 0 for r in cells)
+        return CheckResult(
+            name, PASS,
+            f"all {len(cells)} budgeted programs within "
+            f"±{budget_report.get('tolerance_pct')}% of plan "
+            f"accounting, no unbudgeted collectives "
+            f"({total} B/round total)",
+            {"cells": len(cells), "measured_bytes_total": total})
+    detail = "; ".join(
+        f"{r.get('cell')}: " + (r.get("detail")
+                                or "; ".join(r.get("problems") or []))
+        for r in bad[:4])
+    return CheckResult(
+        name, FAIL,
+        f"{len(bad)}/{len(cells)} budgeted programs violate their "
+        f"collective-byte budget — {detail}"
+        + (" ..." if len(bad) > 4 else ""),
+        {"failed": [r.get("cell") for r in bad], "details": bad[:10]})
+
+
+def check_invariants(summary: dict | None) -> CheckResult:
+    """Judge an invariant-prover summary
+    (:func:`flow_updating_tpu.analysis.invariants.summarize` output):
+    FAIL names every violated/error cell with its theorem citations;
+    expected-violation cells (the adversary positive controls) pass."""
+    name = "invariant_proofs"
+    if not isinstance(summary, dict) or "overall" not in summary:
+        return CheckResult(
+            name, SKIP,
+            "no invariant-prover summary — run `python -m "
+            "flow_updating_tpu audit` (prover on by default)")
+    counts = summary.get("counts") or {}
+    bad = summary.get("violated") or []
+    if summary.get("overall") == "pass" and not bad:
+        return CheckResult(
+            name, PASS,
+            f"protocol invariants proved on {counts.get('proved', 0)} "
+            f"cells ({counts.get('expected-violation', 0)} adversary "
+            f"positive controls detected, "
+            f"{counts.get('inapplicable', 0)} node-collapsed cells "
+            "inapplicable)", {"counts": counts})
+    cites = []
+    for p in summary.get("proofs") or []:
+        if p.get("cell") in bad:
+            cites.extend(p.get("violations") or
+                         [f"{p.get('cell')}: {p.get('detail')}"])
+    return CheckResult(
+        name, FAIL,
+        f"{len(bad)} cell(s) violate protocol invariants — "
+        + "; ".join(cites[:4]) + (" ..." if len(cites) > 4 else ""),
+        {"violated": bad, "citations": cites[:10]})
+
+
 def diagnose_manifest(manifest: dict) -> list:
     """Judge a saved ``flow-updating-*-report/v1`` manifest: the
     environment block, the final convergence report, and — when the run
@@ -1376,10 +1463,16 @@ def diagnose_manifest(manifest: dict) -> list:
         # planted faults as defects (they are the point)
         checks.extend(check_scenario_conformance(manifest))
         return checks
-    if isinstance(manifest.get("golden"), dict):
-        # a flow-updating-audit-report/v1 manifest (`audit --report`):
-        # the golden-program conformance verdict is the whole point
-        checks.append(check_program_conformance(manifest["golden"]))
+    if isinstance(manifest.get("golden"), dict) \
+            or isinstance(manifest.get("budget"), dict):
+        # an audit-report or budget-report manifest (`audit --report` /
+        # `audit --budget`): the conformance verdicts are the point
+        if isinstance(manifest.get("golden"), dict):
+            checks.append(check_program_conformance(manifest["golden"]))
+        if isinstance(manifest.get("budget"), dict):
+            checks.append(check_budget(manifest["budget"]))
+        if isinstance(manifest.get("invariants"), dict):
+            checks.append(check_invariants(manifest["invariants"]))
         return checks
     report = manifest.get("report")
     if isinstance(report, dict):

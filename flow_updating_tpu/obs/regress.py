@@ -315,13 +315,78 @@ def compare_profile(fresh: dict, against: dict, *,
     return checks
 
 
+def compare_budget(fresh: dict, against: dict | None, *,
+                   margin_pct: float = PROGRAM_MARGIN_PCT) -> list:
+    """Judge a fresh collective-byte-budget manifest: its own verdicts
+    always gate (an over-budget or unbudgeted collective fails here
+    too), and per-cell measured bytes are compared against a reference
+    manifest when one is given — growth beyond ``margin_pct`` fails,
+    naming the cell (wire bytes are a compile artifact: 2% growth is a
+    payload-layout change, not noise)."""
+    from flow_updating_tpu.obs.health import check_budget
+
+    fb = fresh.get("budget") if isinstance(fresh, dict) else None
+    checks = [check_budget(fb)]
+    ab = against.get("budget") if isinstance(against, dict) else None
+    if ab is None:
+        if against is not None:
+            checks.append(CheckResult(
+                "budget_regression", SKIP,
+                "reference document carries no budget block"))
+        return checks
+    ref = {r.get("cell"): r for r in ab.get("cells") or []}
+    for rec in (fb or {}).get("cells") or []:
+        cell = rec.get("cell")
+        old = (ref.get(cell) or {}).get("measured_bytes")
+        new = rec.get("measured_bytes")
+        name = f"budget_bytes[{cell}]"
+        if old is None or new is None:
+            checks.append(CheckResult(
+                name, SKIP, "cell not measured on both sides",
+                {"fresh": new, "reference": old}))
+            continue
+        if old == 0:
+            if new == 0:          # the collective-free claims
+                checks.append(CheckResult(
+                    name, PASS, "0 collective bytes on both sides",
+                    {"fresh": new, "reference": old}))
+            else:                 # 0 -> N is unbounded growth, not skip
+                checks.append(CheckResult(
+                    name, FAIL,
+                    f"collective bytes grew from 0 to {new} B/round — "
+                    "a collective-free program acquired a wire",
+                    {"fresh": new, "reference": old,
+                     "margin_pct": margin_pct}))
+            continue
+        growth = _pct_growth(new, old)
+        if growth is None:
+            checks.append(CheckResult(
+                name, SKIP, "cell not comparable",
+                {"fresh": new, "reference": old}))
+            continue
+        ev = {"fresh": new, "reference": old,
+              "growth_pct": round(growth, 2), "margin_pct": margin_pct}
+        if growth > margin_pct:
+            checks.append(CheckResult(
+                name, FAIL,
+                f"collective bytes grew {growth:.1f}% ({old} -> {new} "
+                "B/round) — the wire got fatter; update the plan "
+                "accounting if intentional", ev))
+        else:
+            checks.append(CheckResult(
+                name, PASS,
+                f"collective bytes within {margin_pct:g}% "
+                f"({growth:+.1f}%)", ev))
+    return checks
+
+
 def gate(fresh: dict, *, history_pattern: str | None = None,
          against: dict | None = None,
          margin_pct: float | None = None) -> list:
     """Dispatch on document shape: scaling ladders gate per-chip
-    efficiency against the ``MULTICHIP_SCALING_*`` history; profile
-    manifests compare against a reference manifest; bench lines compare
-    against the artifact history."""
+    efficiency against the ``MULTICHIP_SCALING_*`` history; profile /
+    budget manifests compare against a reference manifest; bench lines
+    compare against the artifact history."""
     if isinstance(fresh, dict) and "metric" not in fresh \
             and isinstance(fresh.get("parsed"), dict):
         fresh = fresh["parsed"]  # driver-wrapped artifact
@@ -330,6 +395,10 @@ def gate(fresh: dict, *, history_pattern: str | None = None,
         history = load_scaling_history(
             history_pattern or "MULTICHIP_SCALING_*.json")
         return compare_scaling(fresh, history, margin_pct=margin_pct)
+    if isinstance(fresh, dict) and isinstance(fresh.get("budget"), dict):
+        return compare_budget(fresh, against,
+                              **({"margin_pct": margin_pct}
+                                 if margin_pct is not None else {}))
     if _profile_block(fresh) is not None and against is not None:
         return compare_profile(fresh, against,
                                **({"margin_pct": margin_pct}

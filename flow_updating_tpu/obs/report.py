@@ -36,6 +36,7 @@ SCENARIO_SCHEMA = "flow-updating-scenario-report/v1"
 AUDIT_SCHEMA = "flow-updating-audit-report/v1"
 QUERY_SCHEMA = "flow-updating-query-report/v1"
 RECOVERY_SCHEMA = "flow-updating-recovery-report/v1"
+BUDGET_SCHEMA = "flow-updating-budget-report/v1"
 
 
 def environment_info() -> dict:
@@ -382,6 +383,31 @@ def build_audit_manifest(*, argv=None, audit=None, ledger_path=None,
         "ledger": ledger_path,
         "golden": dict(audit) if audit is not None else None,
         "lint": list(lint) if lint is not None else None,
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def build_budget_manifest(*, argv=None, budget=None, invariants=None,
+                          extra=None) -> dict:
+    """Assemble the collective-byte-budget v1 manifest: the standard
+    argv/environment binding around a budget verification report
+    (:func:`flow_updating_tpu.analysis.budget.verify_matrix` output,
+    under ``budget``) and optionally the invariant-prover summary that
+    ran alongside it (``invariants``:
+    :func:`flow_updating_tpu.analysis.invariants.summarize` output).
+    ``doctor`` judges the ``budget`` block via
+    ``obs.health.check_budget``; ``regress --against`` gates
+    measured-byte growth between two manifests."""
+    manifest = {
+        "schema": BUDGET_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "environment": environment_info(),
+        "budget": dict(budget) if budget is not None else None,
+        "invariants": (dict(invariants) if invariants is not None
+                       else None),
     }
     if extra:
         manifest.update(extra)

@@ -862,4 +862,11 @@ class QueryFabric:
         self._watchdog_pending_state = qmeta.get("watchdog_state")
         self._init_resilience()
         self._probe_floor = _probe_jit()._cache_size()
+        # the PR-13 regression probe (analysis/aliasing.py): lane-table
+        # restore must not have re-introduced a mirror-aliased leaf
+        from flow_updating_tpu.analysis.aliasing import (
+            assert_no_shared_mirrors,
+        )
+
+        assert_no_shared_mirrors(self)
         return self

@@ -234,6 +234,15 @@ def recover(directory: str, *, kind: str | None = None,
             "last_seq": wal.last_seq,
         },
     }
+    # post-replay aliasing probe (analysis/aliasing.py): replayed
+    # events edit host mirrors in place, so a zero-copy restored leaf
+    # would have raced the replay itself — assert the recovered engine
+    # holds no mirror-aliased device leaves before handing it back
+    from flow_updating_tpu.analysis.aliasing import (
+        assert_no_shared_mirrors,
+    )
+
+    assert_no_shared_mirrors(engine)
     return engine
 
 

@@ -892,6 +892,7 @@ class ServiceEngine:
             "event_counts": {k: v for k, v in self._event_counts.items()
                              if v},
             "dtype": self.config.dtype,
+            "mirror_probe": _mirror_probe(self),
         }
 
     def boundary_series(self) -> dict:
@@ -996,8 +997,25 @@ class ServiceEngine:
         self._est_cache = None
         self._init_resilience()
         self._capture_cache_floor()
+        # the PR-13 regression probe: a restored engine must never hold
+        # device leaves aliasing its host mirrors (zero-copy asarray) —
+        # fail at construction, not rounds later as a flaky race
+        from flow_updating_tpu.analysis.aliasing import (
+            assert_no_shared_mirrors,
+        )
+
+        assert_no_shared_mirrors(self)
         self._sample("restore")
         return self
+
+
+def _mirror_probe(engine) -> dict:
+    """The service block's host-mirror aliasing record
+    (analysis/aliasing.py) — ``shared`` must be empty; doctor's
+    ``service_mirror_aliasing`` check judges it."""
+    from flow_updating_tpu.analysis.aliasing import shared_mirror_report
+
+    return shared_mirror_report(engine)
 
 
 def _service_topo_arrays(src, dst, rev, deg, row_start, rows, delay):
