@@ -77,7 +77,8 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 segment: str = "auto", fire_policy: str = "fast",
                 variant: str = "collectall", delivery: str = "gather",
                 delay_depth: int | None = None, features: int = 0,
-                values=None, plan=None):
+                values=None, plan=None, fused_tile=None,
+                fused_remainder="auto"):
     """Build the fast collect-all measurement closure for one topology.
 
     Returns ``(run, read_est)``: ``run(r)`` executes an r-round compiled
@@ -122,9 +123,13 @@ def make_runner(topo, kernel: str = "node", spmv: str = "xla",
                 "the node-collapsed kernel is collect-all only; pairwise "
                 "runs on the edge kernel (--kernel edge)")
         cfg = RoundConfig.fast(variant="collectall", kernel="node", spmv=spmv)
-        # ``plan`` (spmv='banded') reuses a pre-compiled ExecutionPlan so
-        # the planner's host work is paid once per bench, not per runner
-        k = sync.NodeKernel(topo, cfg, values=vals, plan=plan)
+        # ``plan`` (spmv='banded'/'banded_fused') reuses a pre-compiled
+        # ExecutionPlan so the planner's host work is paid once per
+        # bench, not per runner; the fused knobs carry the autotuner's
+        # measured tile/remainder choice into the headline measurement
+        k = sync.NodeKernel(topo, cfg, values=vals, plan=plan,
+                            fused_tile=fused_tile,
+                            fused_remainder=fused_remainder)
         state = k.init_state()
 
         def run(r):
@@ -179,7 +184,8 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
                 variant: str = "collectall",
                 delivery: str = "gather",
                 delay_depth: int | None = None,
-                features: int = 0, plan=None) -> dict:
+                features: int = 0, plan=None, fused_tile=None,
+                fused_remainder="auto") -> dict:
     """Time the fast synchronous collect-all kernel.
 
     Timing notes: each executable launch carries a large fixed tunnel
@@ -199,7 +205,9 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
                                 segment=segment, fire_policy=fire_policy,
                                 variant=variant, delivery=delivery,
                                 delay_depth=delay_depth, features=features,
-                                values=vals, plan=plan)
+                                values=vals, plan=plan,
+                                fused_tile=fused_tile,
+                                fused_remainder=fused_remainder)
     plan_s = time.perf_counter() - t0  # host work: ELL build, Benes
     #                                    routing, fused-pass planning
 
@@ -1666,6 +1674,53 @@ def run_generator_bench(args) -> dict:
     n, e = topo.num_nodes, topo.num_edges
     cfg = RoundConfig.fast(variant="collectall")
     decision = select_plan(topo, cfg)
+    force_fused = getattr(args, "plan", "auto") == "fused"
+    fused_kw = {}
+    if force_fused:
+        # --plan fused pins the one-kernel banded round as the headline
+        # (rows land under the disjoint '<slug>_fused' key family; the
+        # auto decision and its autotune record still ship as evidence).
+        # On gather-friendly backends the remainder rides the gather
+        # form — the Beneš lanes are the TPU route and replay ~300x
+        # slower on the CPU proxy (plan/select.py PROBE_BUDGET_S note)
+        import dataclasses as _dc
+
+        from flow_updating_tpu.plan import compile_topology
+        from flow_updating_tpu.plan.select import GATHER_COST
+
+        fused_plan = decision.plan
+        backend_name = jax.devices()[0].platform
+        gather_friendly = GATHER_COST.get(backend_name, 8.0) < 100.0
+        tune = decision.fused or {}
+        best = tune.get("best") or {}
+        if best.get("spmv") == "banded_fused":
+            # ship the EXACT configuration the autotuner measured:
+            # plan recompiled at the probed band width and remainder
+            # family (a tile tuned on a coarser-fill plan can fail
+            # bandwidth validation against a foreign plan)
+            mf = best.get("min_fill")
+            fused_plan = compile_topology(
+                topo,
+                **({"min_fill": float(mf)} if mf is not None else {}),
+                remainder=tune.get("remainder") or "auto")
+            fused_kw = {"fused_tile": best.get("fused_tile"),
+                        "fused_remainder":
+                        best.get("fused_remainder") or "auto"}
+        elif fused_plan is None:
+            # structured-generator decisions carry no plan; the fused
+            # row still needs one — compiled with the backend's
+            # remainder form, never the pathological cross-form
+            fused_plan = compile_topology(
+                topo, remainder="gather" if gather_friendly else "auto")
+        elif gather_friendly and fused_plan.spmv.rem_mode == "benes":
+            fused_plan = compile_topology(topo, remainder="gather")
+        decision = _dc.replace(decision, kernel="node",
+                               spmv="banded_fused", plan=fused_plan)
+    elif decision.spmv == "banded_fused":
+        # the AUTO path picked the fused round: run the configuration
+        # the autotuner selected (select_plan already recompiled the
+        # plan to match its probed family)
+        fused_kw = dict((decision.fused or {}).get("chosen") or {})
     chosen = decision.kernel + (f"/{decision.spmv}" if decision.spmv
                                 else "/gather")
 
@@ -1682,20 +1737,54 @@ def run_generator_bench(args) -> dict:
         return rows[label]
 
     plan_kw = {}
-    if decision.spmv == "banded":
+    if decision.spmv in ("banded", "banded_fused"):
         plan_kw["plan"] = decision.plan
+    plan_kw.update(fused_kw)
     tpu = _measure(chosen, kernel=decision.kernel,
                    spmv=decision.spmv or "xla", **plan_kw)
     if "error" in tpu:
         raise RuntimeError(
             f"planned measurement failed: {tpu['error']}")
+    if force_fused:
+        # the unfused banded executor is the fused row's direct
+        # comparator (same plan, separate XLA ops per stage)
+        _measure("node/banded", kernel="node", spmv="banded",
+                 plan=decision.plan)
     if chosen != "node/xla" and decision.kernel == "node":
         _measure("node/xla", kernel="node", spmv="xla")
     edge = _measure("edge/gather", kernel="edge")
 
     slug = _generator_slug(args.generator, n)
-    base_key = f"{slug}_planned"
-    if "error" not in edge:
+    base_key = f"{slug}_fused" if force_fused else f"{slug}_planned"
+    if force_fused:
+        # the '<slug>_fused' family records the fused measurement
+        # ITSELF (keep-fastest, spread-gated first write): `regress`
+        # then gates the one-kernel round's rate across sessions; the
+        # edge comparator stays in extra as vs_edge evidence
+        try:
+            second = measure_tpu(topo, args.rounds, kernel="node",
+                                 spmv="banded_fused", **plan_kw)
+        except Exception:
+            second = {"error": "repeat failed"}
+        rows[f"{chosen}#repeat"] = second
+        rates = [r["rounds_per_sec"] for r in (tpu, second)
+                 if "error" not in r]
+        spread = (100.0 * (max(rates) - min(rates))
+                  / max(sum(rates) / len(rates), 1e-9))
+        if len(rates) >= 2 and spread <= SPREAD_VALIDITY_PCT:
+            record_baseline(base_key, baseline_entry(topo, {
+                "rounds_per_sec": max(rates),
+                "ticks": tpu["rounds"],
+                "repeats": len(rates),
+                "spread_pct": round(spread, 1),
+                "note": ("one-kernel fused banded round "
+                         "(ops/pallas_round.py; R-vs-2R harness, "
+                         "interpret mode off-TPU)"),
+            }))
+        # a noisy pair (machine contention) refuses to bank a first
+        # record — the validity gate applies to first writes here, not
+        # just displacements
+    elif "error" not in edge:
         comparator = {
             "rounds_per_sec": edge["rounds_per_sec"],
             "ticks": edge["rounds"],
@@ -1713,7 +1802,9 @@ def run_generator_bench(args) -> dict:
 
     return {
         "metric": (f"gossip rounds/sec, {n} nodes "
-                   f"({args.generator}, planned, fast synchronous)"),
+                   f"({args.generator}, "
+                   f"{'fused' if force_fused else 'planned'}, "
+                   "fast synchronous)"),
         "value": round(tpu["rounds_per_sec"], 2),
         "unit": "rounds/sec",
         "backend": {"axon": "tpu"}.get(tpu["platform"], tpu["platform"]),
@@ -1726,6 +1817,9 @@ def run_generator_bench(args) -> dict:
             "directed_edges": e,
             "plan": decision.describe(),
             "chosen": chosen,
+            **({"vs_edge": round(tpu["rounds_per_sec"]
+                                 / edge["rounds_per_sec"], 2)}
+               if force_fused and "error" not in edge else {}),
             "measured": {k: round(v, 4) for k, v in measured.items()},
             "candidates": {
                 k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
@@ -1756,6 +1850,14 @@ def parse_args(argv=None):
                          "xla edge path under the stable "
                          "'<slug>_planned' baseline key (ba100k_planned)"
                          " — fat-tree records are never shadowed")
+    ap.add_argument("--plan", default="auto", choices=("auto", "fused"),
+                    help="with --generator: 'auto' headlines the "
+                         "planner's choice under '<slug>_planned'; "
+                         "'fused' pins the ONE-KERNEL banded round "
+                         "(spmv='banded_fused', ops/pallas_round.py) "
+                         "and records it under the disjoint "
+                         "'<slug>_fused' key family, with the unfused "
+                         "banded executor measured as its comparator")
     ap.add_argument("--rounds", type=int, default=64,
                     help="starting timed scan length (grows adaptively while "
                          "each launch stays under the tunnel execution cap; "

@@ -67,6 +67,7 @@ _KEY_FAMILIES = (
     r"\d+(_[a-z0-9]+)*",            # str(k) numeric configs + suffixes
     r"k\d+(_[a-z0-9]+)*",           # explicit k-configs
     r".+_planned",                  # topology-compiler rows
+    r".+_fused",                    # one-kernel fused-round rows
     r".+_scale_s.+",                # weak-scaling ladder rows
     r".+_sweep_b.+",                # sweep-engine rows
     r".+_service",                  # streaming-service rows

@@ -312,6 +312,11 @@ def cells() -> list:
     # fuses next, so its lowering joins the ledger now
     _node_cell("node-banded/plain/robust=none/adv=none/payload=scalar",
                "plain", spmv="banded")
+    # the ONE-KERNEL fused round (this PR): the banded plan executed as
+    # a single VMEM-resident Pallas program, interpret-executed on CPU
+    # so the ledger pins the SHIPPED kernel's lowering
+    _node_cell("node-banded-fused/plain/robust=none/adv=none/"
+               "payload=scalar", "plain", spmv="banded_fused")
 
     # -- halo x twin (2-shard virtual mesh) -----------------------------
     def _halo_parts(vector=False):
@@ -387,6 +392,34 @@ def cells() -> list:
     _halo_overlap_cell(
         "halo-s2-overlap-pallas/plain/robust=none/adv=none/"
         "payload=scalar", "overlap_pallas")
+
+    # -- sharded fused banded round (this PR): one remote-DMA Pallas
+    # kernel per shard on the 2-shard virtual mesh, interpret mode
+    def _banded_fused_sharded_cell(key):
+        def build():
+            from flow_updating_tpu.models.config import (
+                RoundConfig as _RC,
+            )
+            from flow_updating_tpu.parallel.banded_sharded import (
+                ShardedBandedKernel,
+            )
+            from flow_updating_tpu.parallel.mesh import make_mesh
+            from flow_updating_tpu.topology.generators import erdos_renyi
+
+            topo = fx.get("topo_node",
+                          lambda: erdos_renyi(24, avg_degree=4.0, seed=3))
+            mesh = fx.get("mesh2", lambda: make_mesh(2))
+            cfg = _RC.fast(kernel="node", spmv="banded_fused")
+            kern = fx.get(
+                "banded_fused_sharded_kernel",
+                lambda: ShardedBandedKernel(topo, cfg, mesh))
+            fn, args, _ = kern.round_program(kern.init_state(),
+                                             CELL_ROUNDS)
+            return fn, args, {}
+        out.append(Cell(key=key, mode="node", twin="plain", build=build))
+
+    _banded_fused_sharded_cell(
+        "node-banded-fused-s2/plain/robust=none/adv=none/payload=scalar")
 
     # -- pod x twin (fat-tree stencil, 2-shard mesh) --------------------
     def _pod_kernel():

@@ -863,11 +863,24 @@ def prove_cell(cell, *, plain_graphs: dict | None = None) -> CellProof:
         state = find_state(args)
         roles = role_indices(state) if state is not None else None
         if not roles or "flow" not in roles:
-            return CellProof(cell.key, "inapplicable",
-                             detail="no per-edge flow ledger in the "
-                                    "carried state (node-collapsed "
-                                    "kernel) — antisymmetry holds by "
-                                    "algebraic construction there")
+            detail = ("no per-edge flow ledger in the carried state "
+                      "(node-collapsed kernel) — antisymmetry holds by "
+                      "algebraic construction there")
+            if "banded-fused" in cell.key or "banded_fused" in cell.key:
+                # the one-kernel round is an EXPLICIT analyzability
+                # boundary, the pallas_halo DMA-merge precedent: fire,
+                # band delivery and ledger merge execute inside
+                # pallas_call, where the dataflow prover cannot follow
+                # — its semantics are pinned instead by the bit-parity
+                # suite (tests/test_pallas_round.py: fused == unfused
+                # banded executor == edge kernel after unpermutation)
+                detail += (
+                    "; fused-round cells additionally keep their "
+                    "delivery/merge INSIDE pallas_call (ops/"
+                    "pallas_round.py) — a recognized analyzability "
+                    "boundary like the pallas halo DMA merge, covered "
+                    "by bit-exactness tests instead of the prover")
+            return CellProof(cell.key, "inapplicable", detail=detail)
         jx = trace_program(fn, args, kwargs)
         loc = find_round_loop(jx, roles, state)
         if loc is None:

@@ -108,12 +108,15 @@ def _add_kernel_flags(ap: argparse.ArgumentParser) -> None:
                          "fastest TPU form)")
     ap.add_argument("--spmv", default="xla",
                     choices=("xla", "pallas", "benes", "benes_fused",
-                             "structured"),
+                             "structured", "banded", "banded_fused"),
                     help="node-kernel neighbor-sum implementation "
                          "(benes_fused batches the permutation-network "
                          "stages into Pallas HBM passes; structured uses "
                          "the generator's closed-form stencil — regular "
-                         "topologies only)")
+                         "topologies only; banded runs the topology "
+                         "compiler's RCM masked-roll plan, banded_fused "
+                         "the whole round as ONE VMEM-resident Pallas "
+                         "kernel over that plan)")
     ap.add_argument("--segment", default="auto",
                     choices=("auto", "segment", "ell", "benes",
                              "benes_fused"),
@@ -1427,7 +1430,8 @@ def cmd_plan(args) -> int:
             topo, cfg, backend=args.plan_backend or None,
             probe="aot" if args.probe else "analytic",
             max_lanes=args.max_lanes, min_fill=args.min_fill,
-            remainder=args.remainder)
+            remainder=args.remainder,
+            autotune=True if args.autotune else None)
     except (ValueError, NotImplementedError) as err:
         raise SystemExit(f"plan: {err}") from err
     doc = decision.describe()
@@ -2305,6 +2309,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "the lowered programs (obs/profile.py AOT) "
                          "instead of the analytic HBM-traffic model — "
                          "compiles each candidate once")
+    pl.add_argument("--autotune", action="store_true",
+                    help="time the banded-family candidates (band width "
+                         "x fused-round tile x remainder route) on the "
+                         "ambient device and rank from MEASURED rates; "
+                         "results persist in the autotune cache keyed "
+                         "by (plan hash, backend, jax version), so a "
+                         "warm cache re-ranks with zero probes")
     pl.add_argument("--max-lanes", type=int, default=96,
                     help="dense roll-lane budget (each kept diagonal "
                          "costs one streamed pass per neighbor sum)")

@@ -180,6 +180,8 @@ class Engine:
         self.plan_spec = plan
         self.plan_decision = None   # PlanDecision once build() resolved it
         self._plan = None           # ExecutionPlan handed to the NodeKernel
+        self._fused_kw = None       # autotuned fused-round knobs
+        #                             (tile / remainder route)
         self.netzone_root = _NetzoneShim(self)
         # optional EventLog sink for engine lifecycle records ("advance"
         # compiled-chunk dispatches, "kill_all") — together with the s4u
@@ -555,10 +557,25 @@ class Engine:
                 self._node_kernel = ShardedNodeKernel(
                     self.topology, self.config, self.mesh
                 )
+            elif self.mesh is not None and \
+                    self.config.spmv == "banded_fused":
+                from flow_updating_tpu.parallel.banded_sharded import (
+                    ShardedBandedKernel,
+                )
+
+                # halo='ppermute' keeps the serialized XLA oracle; every
+                # other wire setting takes the one-kernel-per-shard
+                # remote-DMA form (interpret mode off-TPU)
+                self._node_kernel = ShardedBandedKernel(
+                    self.topology, self.config, self.mesh,
+                    plan=self._plan,
+                    exchange="ppermute" if self.halo == "ppermute"
+                    else "pallas",
+                )
             else:
                 self._node_kernel = sync.NodeKernel(
                     self.topology, self.config, mesh=self.mesh,
-                    plan=self._plan,
+                    plan=self._plan, **(self._fused_kw or {}),
                 )
             self._topo_arrays = None
             return
@@ -707,8 +724,14 @@ class Engine:
         if decision.kernel == "node":
             self.config = dataclasses.replace(
                 self.config, kernel="node", spmv=decision.spmv)
-            self._plan = decision.plan if decision.spmv == "banded" \
-                else None
+            self._plan = decision.plan \
+                if decision.spmv in ("banded", "banded_fused") else None
+            if decision.spmv == "banded_fused":
+                # the autotuner's measured tile / remainder route (or
+                # the heuristic defaults when probing was skipped)
+                self._fused_kw = dict(
+                    (decision.fused or {}).get("chosen")
+                    or {"fused_tile": None, "fused_remainder": "auto"})
         else:
             self.config = dataclasses.replace(self.config, kernel="edge")
             self._plan = None
@@ -742,6 +765,14 @@ class Engine:
                         self.config.jnp_dtype).itemsize)
             except ValueError as exc:
                 out["payload_schedule"] = {"error": str(exc)}
+        if getattr(self, "_node_kernel", None) is not None:
+            from flow_updating_tpu.obs.profile import fused_round_report
+
+            fused = fused_round_report(self._node_kernel)
+            if fused is not None:
+                # the one-kernel round's HBM attribution (pass counts,
+                # bytes/round) — regress --against gates growth here
+                out["fused_round"] = fused
         return out
 
     def build(self, latency_scale: float = 0.0, seed: int = 0) -> Engine:

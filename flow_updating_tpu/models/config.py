@@ -219,7 +219,13 @@ class RoundConfig:
     #                                    -roll bands + Benes/gather
     #                                    remainder for ARBITRARY graphs,
     #                                    flow_updating_tpu.plan — RCM
-    #                                    reorder handled by the kernel)
+    #                                    reorder handled by the kernel) |
+    #                                    'banded_fused' (the same banded
+    #                                    plan with the WHOLE round — fire,
+    #                                    band delivery, ledger merge — in
+    #                                    one VMEM-resident Pallas kernel,
+    #                                    ops/pallas_round.py; interpret
+    #                                    mode off-TPU)
     robust: str = "off"                # robust-aggregation variant of the
     #                                    fire/average step, BOTH protocol
     #                                    families (Byzantine tolerance,
@@ -306,7 +312,7 @@ class RoundConfig:
                                  "benes_fused"):
             raise ValueError(f"unknown delivery {self.delivery!r}")
         if self.spmv not in ("xla", "pallas", "benes", "benes_fused",
-                             "structured", "banded"):
+                             "structured", "banded", "banded_fused"):
             raise ValueError(f"unknown spmv {self.spmv!r}")
         if self.segment_impl not in ("auto", "segment", "ell", "benes",
                                      "benes_fused"):

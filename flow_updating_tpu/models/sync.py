@@ -79,6 +79,13 @@ class NodeSyncArrays:
     ns_band: object = struct.field(pytree_node=False, default=None)
     #                        spmv='banded': static BandedSpmvPlan
     #                        (identity-hashed, like ns_plan)
+    ns_fused_leaves: object = None
+    #                        spmv='banded_fused': FusedRoundLeaves pytree
+    #                        (bitpacked band planes, window-coord
+    #                        remainder ELL — ops/pallas_round.py)
+    ns_fused: object = struct.field(pytree_node=False, default=None)
+    #                        spmv='banded_fused': static FusedRoundSpec
+    #                        (tile geometry + remainder route)
 
 
 def _check_cfg(cfg: RoundConfig) -> None:
@@ -108,7 +115,7 @@ class NodeKernel:
 
     def __init__(self, topo: Topology, cfg: RoundConfig,
                  row_multiple: int = 1, mesh=None, values=None,
-                 plan=None):
+                 plan=None, fused_tile=None, fused_remainder="auto"):
         """``values`` overrides ``topo.values`` and may be ``(N, D)`` —
         the node-collapsed recurrence is linear in the payload, so a
         vector run is exactly D independent scalar recurrences sharing
@@ -118,9 +125,13 @@ class NodeKernel:
         feature axis); the pallas/benes/structured layouts reshape the
         node axis into circuit/stencil geometry and stay scalar.
 
-        ``plan`` (spmv='banded' only) supplies a pre-compiled
+        ``plan`` (spmv='banded'/'banded_fused' only) supplies a
+        pre-compiled
         :class:`~flow_updating_tpu.plan.compile.ExecutionPlan`; omitted,
-        the kernel compiles one itself (``plan.compile_topology``)."""
+        the kernel compiles one itself (``plan.compile_topology``).
+        ``fused_tile``/``fused_remainder`` (spmv='banded_fused') pin the
+        one-kernel round's tile height / remainder route — normally left
+        to the measured-probe autotuner (``plan/select.py``)."""
         _check_cfg(cfg)
         self.topo = topo
         self.cfg = cfg
@@ -128,26 +139,31 @@ class NodeKernel:
             topo.values if values is None else values, np.float64)
         check_payload_values(self._values, topo.num_nodes)
         self.feature_shape = tuple(self._values.shape[1:])
-        if self.feature_shape and cfg.spmv not in ("xla", "banded"):
+        if self.feature_shape and cfg.spmv not in ("xla", "banded",
+                                                   "banded_fused"):
             raise ValueError(
-                f"vector payloads run the node kernel with spmv='xla' "
-                f"or 'banded' (spmv={cfg.spmv!r} reshapes the node axis "
-                "into circuit/stencil geometry; use the edge kernel for "
-                "vector runs on those paths)")
+                f"vector payloads run the node kernel with spmv='xla', "
+                f"'banded' or 'banded_fused' (spmv={cfg.spmv!r} reshapes "
+                "the node axis into circuit/stencil geometry; use the "
+                "edge kernel for vector runs on those paths)")
         import math
 
-        if cfg.spmv in ("pallas", "benes", "benes_fused", "banded"):
+        if cfg.spmv in ("pallas", "benes", "benes_fused", "banded",
+                        "banded_fused"):
             if mesh is not None:
                 # a config-validity error: the CLI's build/resume handlers
                 # turn ValueError into a clean "invalid flag combination"
                 # exit (cli.py:cmd_run)
-                hint = (
-                    "use parallel.spmv_sharded.ShardedNodeKernel (the "
-                    "shard_map fused-circuit path)"
-                    if cfg.spmv == "benes_fused"
-                    else "use spmv='xla' with a mesh (GSPMD handles the "
-                         "collective)"
-                )
+                if cfg.spmv == "benes_fused":
+                    hint = ("use parallel.spmv_sharded.ShardedNodeKernel "
+                            "(the shard_map fused-circuit path)")
+                elif cfg.spmv == "banded_fused":
+                    hint = ("use parallel.banded_sharded."
+                            "ShardedBandedKernel (the one-kernel-per-"
+                            "shard halo path)")
+                else:
+                    hint = ("use spmv='xla' with a mesh (GSPMD handles "
+                            "the collective)")
                 raise ValueError(
                     f"spmv={cfg.spmv!r} has no GSPMD partitioning path; "
                     + hint
@@ -165,8 +181,9 @@ class NodeKernel:
             self._init_structured(topo, dt)
             self._place_on_mesh()
             return
-        if cfg.spmv == "banded":
-            self._init_banded(topo, dt, plan)
+        if cfg.spmv in ("banded", "banded_fused"):
+            self._init_banded(topo, dt, plan, fused_tile=fused_tile,
+                              fused_remainder=fused_remainder)
             return
         ell = topo.ell_buckets()
 
@@ -217,14 +234,18 @@ class NodeKernel:
         )
         self._place_on_mesh()
 
-    def _init_banded(self, topo: Topology, dt, plan) -> None:
-        """spmv='banded': node vectors live in the topology compiler's
-        RCM order (``plan.order[new] = old``; the existing
-        ``_perm``/``_unpermute`` machinery restores original node order
-        for every readback, field series and topk id), padding appended
-        at the tail.  The neighbor sum runs the plan's masked-roll bands
-        plus its Benes/gather remainder (``plan/banded.py``) — the
-        generalization of the structured stencil to arbitrary graphs."""
+    def _init_banded(self, topo: Topology, dt, plan, fused_tile=None,
+                     fused_remainder="auto") -> None:
+        """spmv='banded'/'banded_fused': node vectors live in the
+        topology compiler's RCM order (``plan.order[new] = old``; the
+        existing ``_perm``/``_unpermute`` machinery restores original
+        node order for every readback, field series and topk id),
+        padding appended at the tail.  The neighbor sum runs the plan's
+        masked-roll bands plus its Benes/gather remainder
+        (``plan/banded.py``) — the generalization of the structured
+        stencil to arbitrary graphs; 'banded_fused' executes the whole
+        round through the one-kernel Pallas program
+        (``ops/pallas_round.py``), padding sized to its tile grid."""
         features = int(np.prod(self.feature_shape)) \
             if self.feature_shape else 0
         if plan is None:
@@ -252,6 +273,31 @@ class NodeKernel:
                 f"compile_topology(topo, features={features})")
         self.plan = plan
         n = topo.num_nodes
+        fused_spec = fused_leaves = None
+        if self.cfg.spmv == "banded_fused":
+            from flow_updating_tpu.ops.pallas_round import (
+                build_fused_leaves,
+                plan_fused_round,
+            )
+
+            fused_spec = plan_fused_round(
+                plan.spmv, block_rows=fused_tile,
+                rem_route=fused_remainder)
+            fused_leaves = build_fused_leaves(plan.spmv, plan.leaves,
+                                              fused_spec)
+            # padding sized to the tile grid: the kernel then runs with
+            # zero per-round pad/slice traffic.  The padded length is
+            # FIXED by the tile geometry — an external row multiple
+            # that does not divide it cannot be honored
+            if self.row_multiple > 1 and \
+                    fused_spec.P % self.row_multiple:
+                raise ValueError(
+                    f"spmv='banded_fused' pads to the tile grid "
+                    f"({fused_spec.P} = {fused_spec.grid} x "
+                    f"{fused_spec.block_rows} x 128 elements); "
+                    f"row_multiple={self.row_multiple} does not divide "
+                    "it — drop row_multiple or pick a compatible tile")
+            self.row_multiple = fused_spec.P
         self.padded_size = M = _ceil_to(n, self.row_multiple)
         self._pos_of_real = np.arange(n, dtype=np.int64)
         self._perm = np.asarray(plan.order, np.int64)
@@ -266,6 +312,8 @@ class NodeKernel:
             mats=(),
             ns_band_leaves=plan.leaves,
             ns_band=plan.spmv,
+            ns_fused_leaves=fused_leaves,
+            ns_fused=fused_spec,
         )
 
     def _init_structured(self, topo: Topology, dt) -> None:
@@ -428,9 +476,41 @@ def neighbor_sum(x: jnp.ndarray, mats: tuple) -> jnp.ndarray:
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
+def _fused_round_step(
+    state: NodeSyncState, arrs: NodeSyncArrays
+) -> NodeSyncState:
+    """spmv='banded_fused': the whole round — fire, band delivery,
+    ledger merge — through ONE ``pallas_call`` (``ops/pallas_round.
+    fused_banded_round``).  The plan's remainder rides its existing
+    Beneš/gather lanes outside the kernel (``rem_route='lanes'``: the
+    addend is computed from a bit-identical elementwise ``avg`` and
+    enters the kernel as one extra input, keeping the fused round
+    bit-exact vs the unfused executor) or an in-kernel bucketed gather
+    (``'inline'``)."""
+    from flow_updating_tpu.ops.pallas_round import fused_banded_round
+    from flow_updating_tpu.plan.banded import banded_remainder_sum
+
+    spec = arrs.ns_fused
+    a_rem = None
+    if spec.rem_route == "lanes":
+        avg = ((arrs.value - state.S + state.A_prev)
+               * _ex(arrs.inv_depp1, arrs.value))
+        a_rem = banded_remainder_sum(avg, arrs.ns_band,
+                                     arrs.ns_band_leaves)
+    S_next, G_next, avg_o, A_cur = fused_banded_round(
+        state.S, state.G, state.avg_prev, state.A_prev,
+        arrs.value, arrs.inv_depp1, arrs.deg,
+        arrs.ns_fused_leaves, spec, a_rem=a_rem)
+    return NodeSyncState(
+        t=state.t + 1, S=S_next, G=G_next, avg_prev=avg_o, A_prev=A_cur
+    )
+
+
 def node_round_step(
     state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig
 ) -> NodeSyncState:
+    if cfg.spmv == "banded_fused":
+        return _fused_round_step(state, arrs)
     avg = ((arrs.value - state.S + state.A_prev)
            * _ex(arrs.inv_depp1, arrs.value))
     if cfg.spmv == "pallas":

@@ -422,6 +422,31 @@ def payload_bytes_per_round(num_edges: int, features: int, *,
     }
 
 
+def fused_round_report(kernel) -> dict | None:
+    """HBM-pass and bytes-per-round attribution of a fused-round
+    NodeKernel (``spmv='banded_fused'``) — the profile/plan manifest
+    block ``regress --against`` gates: a fused program that silently
+    grows extra HBM passes (a de-fusion regression) moves the
+    ``bytes_per_round`` figure, which the >2% growth gate catches.
+
+    Returns None for kernels without a fused spec (the caller embeds
+    the block only when it applies)."""
+    spec = getattr(getattr(kernel, "arrays", None), "ns_fused", None)
+    if spec is None:
+        return None
+    import numpy as np
+
+    from flow_updating_tpu.ops.pallas_round import fused_round_bytes
+
+    feats = int(np.prod(kernel.feature_shape)) \
+        if getattr(kernel, "feature_shape", ()) else 1
+    import jax.numpy as jnp
+
+    dtype_bytes = jnp.dtype(kernel.cfg.jnp_dtype).itemsize
+    return fused_round_bytes(spec, dtype_bytes=dtype_bytes,
+                             features=feats)
+
+
 def dfl_efficiency(rate: float, bytes_per_round: float,
                    anchor_rate: float, anchor_bytes_per_round: float
                    ) -> float | None:

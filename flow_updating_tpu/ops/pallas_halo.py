@@ -43,6 +43,52 @@ import functools
 
 import numpy as np
 
+#: the Mosaic compiler-params class, resolved ONCE at import under the
+#: names it has carried across jax releases (``TPUCompilerParams`` up to
+#: ~0.4.x, ``CompilerParams`` afterwards).  Cross-chip DMA kernels need
+#: its ``collective_id`` on real hardware; interpret mode never touches
+#: it.  ``None`` here means THIS jax exposes neither name — resolved
+#: eagerly so the failure is a named error at first hardware use
+#: (:func:`require_compiler_params`), not a silently dropped parameter.
+_COMPILER_PARAMS_NAMES = ("TPUCompilerParams", "CompilerParams")
+
+
+def _resolve_compiler_params_cls():
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:
+        # a jax build whose Mosaic extras fail to import can still use
+        # every interpret-mode path in this module; the None sentinel
+        # surfaces as require_compiler_params' named error at first
+        # hardware use
+        return None
+    for name in _COMPILER_PARAMS_NAMES:
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            return cls
+    return None
+
+
+_COMPILER_PARAMS_CLS = _resolve_compiler_params_cls()
+
+
+def require_compiler_params(collective_id: int):
+    """The ``compiler_params`` value for a cross-chip DMA
+    ``pallas_call`` on real TPU hardware.  Raises a named error (jax
+    version + the class names probed) when this jax exposes no Mosaic
+    params class — a silent omission would deadlock collective kernels
+    on device instead."""
+    if _COMPILER_PARAMS_CLS is None:
+        import jax
+
+        raise RuntimeError(
+            "cannot compile a cross-chip DMA kernel: jax "
+            f"{jax.__version__} exposes none of "
+            f"{'/'.join('pallas.tpu.' + n for n in _COMPILER_PARAMS_NAMES)}"
+            " — the Mosaic compiler-params class moved again; add its "
+            "current name to ops/pallas_halo._COMPILER_PARAMS_NAMES")
+    return _COMPILER_PARAMS_CLS(collective_id=collective_id)
+
 
 def _on_tpu() -> bool:
     import jax
@@ -166,10 +212,11 @@ def _call(payloads, offsets, *, extra, axis_name, axis_size, interpret):
     kwargs = {}
     if not interpret:
         # cross-chip DMA kernels need a collective id on real hardware;
-        # the param class moved across jax versions — best effort
-        params_cls = getattr(pltpu, "TPUCompilerParams", None)
-        if params_cls is not None:
-            kwargs["compiler_params"] = params_cls(collective_id=0)
+        # the params class is import-resolved and REQUIRED here — a
+        # missing class fails with the jax version named rather than
+        # compiling a kernel that deadlocks on device
+        kwargs["compiler_params"] = require_compiler_params(
+            collective_id=0)
     out = pl.pallas_call(
         functools.partial(_exchange_kernel, offsets=offsets,
                           axis_name=axis_name, axis_size=int(axis_size),

@@ -1,0 +1,702 @@
+"""One-kernel banded round: fire → delivery → merge in a single Pallas pass.
+
+The banded executor (``plan/banded.py`` driven by ``models/sync.py``)
+lowers one Flow-Updating round as separate XLA ops — the fire decision
+(an elementwise ``avg`` update), one masked-roll delivery per kept
+diagonal, the remainder network, and the ledger merge — with an HBM
+round trip between each.  At N nodes and L band lanes that is ``~3L+6``
+streamed passes over the node vectors, the exact tax
+``ops/pallas_fused.py`` already eliminated for permutation stages.  This
+module executes the WHOLE round inside one ``pl.pallas_call``: a
+band-tile of protocol state (S, G, avg_prev, A_prev plus the value /
+degree constants) stays resident in VMEM while the kernel
+
+1. **fires** — ``avg = (value - S + A_prev) / (deg + 1)``, computed on
+   the halo-widened tile so every band read below finds its operand
+   already on chip;
+2. **delivers** — one ``where(mask_d, shift(avg, d), 0)`` accumulation
+   per kept diagonal, masks bitpacked 32 lanes per ``uint32`` plane (the
+   ``pallas_fused`` recipe), shifts as lane/sublane rolls of the VMEM
+   window — no HBM between lanes;
+3. **adds the remainder** — out-of-band edges ride either the existing
+   Beneš/gather lanes *outside* the kernel (``rem_route='lanes'``: the
+   precomputed addend enters as one extra input, keeping the fused round
+   BIT-identical to the unfused executor), or a bucketed in-kernel
+   gather over the halo window (``rem_route='inline'``: one kernel for
+   everything; per-row neighbor sums are order-equivalent — exact on
+   integer-valued payloads, ULP-level on floats);
+4. **merges** — ``S' = -G - A + deg*avg_prev``, ``G' = -S - deg*avg +
+   A_prev`` written straight from VMEM.
+
+Tiling: the padded node vector is viewed as ``(rows, 128)`` (TPU lane
+tiling); the grid walks ``block_rows``-row tiles with the previous and
+next tiles loaded as halos (three BlockSpecs on one array — the
+``pallas_fused`` window-pass shape), valid while the graph's RCM
+bandwidth fits one tile (``max |offset| <= block_rows * 128``; the
+planner guarantees it or falls back to a single whole-array tile).
+Clamped boundary tiles are safe for the same reason circular rolls are:
+a band mask never selects a source outside ``[0, n)``, so halo garbage
+is never kept.  Vector payloads ride a trailing grid axis sharing every
+mask/constant plane (batch-innermost, again the ``pallas_fused``
+pipeline trick).
+
+Off-TPU the kernel runs in Pallas **interpret mode** with identical
+semantics, so the CPU test suite exercises the shipped kernel
+(``tests/test_pallas_round.py`` pins bit-parity against the unfused
+banded executor and the general edge kernel).  Tile shape and remainder
+route are chosen by the measured-probe autotune cache in
+``plan/select.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+LANE = 128
+#: default band-tile height (rows of 128 lanes): 512 rows x 128 lanes x
+#: 4 B = 256 KiB per vector plane — ~20 resident planes stay well under
+#: the ~16 MiB VMEM budget
+DEFAULT_BLOCK_ROWS = 512
+#: sublane multiple every tile honors (f32 min tile is (8, 128))
+MIN_BLOCK_ROWS = 8
+
+
+def _interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _roll(x, shift: int, axis: int, size: int, interpret: bool):
+    """Non-negative circular roll; pltpu.roll on TPU, jnp.roll otherwise."""
+    shift %= size
+    if shift == 0:
+        return x
+    if interpret:
+        import jax.numpy as jnp
+
+        return jnp.roll(x, shift, axis=axis)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.roll(x, shift, axis)
+
+
+def _flat_roll_any(x, sh: int, nrows: int, interpret: bool):
+    """Forward circular roll by ``sh`` elements of the flat row-major
+    view of a ``(nrows, 128)`` tile: ``out[p] = x[(p - sh) % P]``.
+    Arbitrary ``sh`` (band offsets are not powers of two): lane roll
+    with a one-row carry for the sub-lane part, then a sublane roll."""
+    import jax
+    import jax.numpy as jnp
+
+    sh %= nrows * LANE
+    q, r = divmod(sh, LANE)
+    if r:
+        lr = _roll(x, r, 1, LANE, interpret)
+        carry = _roll(lr, 1, 0, nrows, interpret)
+        laneid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(laneid < r, carry, lr)
+    return _roll(x, q, 0, nrows, interpret) if q else x
+
+
+def _shift_back(x, d: int, nrows: int, interpret: bool):
+    """``out[p] = x[(p + d) % P]`` — the ``jnp.roll(x, -d)`` of the
+    banded executor's delivery, on the tile view.  Wrapped entries are
+    never mask-selected (no edge leaves ``[0, n)``), exactly the no-wrap
+    invariant the fused permutation kernels rely on."""
+    return _flat_roll_any(x, (-d) % (nrows * LANE), nrows, interpret)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FusedRoundSpec:
+    """Static descriptor of one fused-round program (identity-hashed,
+    jit-static — the ``BandedSpmvPlan`` convention)."""
+
+    n: int               # real node count (RCM space)
+    P: int               # padded vector length (rows * 128)
+    rows: int
+    block_rows: int      # tile height R; window is [prev; own; next]
+    grid: int            # rows // block_rows
+    offsets: tuple       # kept signed diagonals, plan order
+    rem_route: str       # 'none' | 'lanes' | 'inline'
+    rem_width: int       # 'inline': padded per-row remainder degree
+    n_planes: int        # bitpacked band-mask planes (32 offsets each)
+
+    @property
+    def needs_window(self) -> bool:
+        """Band shifts and inline gathers read beyond the own tile;
+        a bandless lanes/none round is purely elementwise."""
+        return bool(self.offsets) or self.rem_route == "inline"
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedRoundLeaves:
+    """Device arrays of one fused-round program (pytree leaves)."""
+
+    planes: tuple        # n_planes x (rows, 128) uint32 band-mask bits
+    rem_idx: object      # 'inline': (rows, 128, W) int32 window coords,
+    #                      -1 = empty slot; else None
+
+
+try:  # registered once; reimports (pytest importmode) must not re-register
+    import jax as _jax
+
+    _jax.tree_util.register_pytree_node(
+        FusedRoundLeaves,
+        lambda lv: ((lv.planes, lv.rem_idx), None),
+        lambda _, ch: FusedRoundLeaves(planes=ch[0], rem_idx=ch[1]),
+    )
+except ValueError:  # pragma: no cover - double registration
+    pass
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def choose_block_rows(n: int, max_abs_offset: int,
+                      block_rows: int | None = None) -> int:
+    """Tile height for a graph of ``n`` nodes and RCM half-bandwidth
+    ``max_abs_offset``: the smallest power of two >= MIN_BLOCK_ROWS that
+    (a) covers the bandwidth (every band read lands in the 3-tile
+    window) and (b) caps at the whole (lane-padded) array — the
+    single-tile degenerate case.  An explicit ``block_rows`` (the
+    autotuner's probe knob) is validated against (a) and used as-is."""
+    rows_all = _ceil_to(max(n, 1), LANE * MIN_BLOCK_ROWS) // LANE
+    need = max(MIN_BLOCK_ROWS,
+               -(-max_abs_offset // LANE))  # ceil(H / LANE)
+    if block_rows is not None:
+        r = int(block_rows)
+        if r < MIN_BLOCK_ROWS or r & (r - 1):
+            raise ValueError(
+                f"block_rows={r} must be a power of two >= "
+                f"{MIN_BLOCK_ROWS}")
+        if r * LANE < max_abs_offset and r < rows_all:
+            raise ValueError(
+                f"block_rows={r} tile ({r * LANE} elements) cannot cover "
+                f"the plan's bandwidth {max_abs_offset}; the halo window "
+                "would read beyond the neighbor tiles")
+        return min(r, 1 << (rows_all - 1).bit_length())
+    r = MIN_BLOCK_ROWS
+    while r < need or r < DEFAULT_BLOCK_ROWS // 8:
+        r <<= 1
+    r = min(max(r, MIN_BLOCK_ROWS), DEFAULT_BLOCK_ROWS * 8)
+    # never tile finer than the array: a single whole-array tile is the
+    # degenerate (and always-valid) geometry
+    while r * LANE >= max(n, 1) * 2 and r > MIN_BLOCK_ROWS:
+        r >>= 1
+    if r * LANE < max_abs_offset:
+        r = 1 << (rows_all - 1).bit_length()  # whole array, one tile
+    return r
+
+
+def plan_fused_round(spmv, *, block_rows: int | None = None,
+                     rem_route: str = "auto") -> FusedRoundSpec:
+    """Build the static spec for a :class:`~flow_updating_tpu.plan.
+    banded.BandedSpmvPlan`.
+
+    ``rem_route='auto'`` keeps the plan's remainder on its existing
+    lanes (bit-exact route); 'inline' pulls a gather-remainder into the
+    kernel (one kernel per round, order-equivalent sums); 'none' asserts
+    the plan has no remainder."""
+    offs = tuple(int(d) for d in spmv.offsets)
+    H = max((abs(d) for d in offs), default=0)
+    route = rem_route
+    if spmv.rem_mode == "none":
+        route = "none"
+    elif route == "auto":
+        route = "lanes"
+    if route == "none" and spmv.rem_mode != "none":
+        raise ValueError(
+            f"rem_route='none' but the plan routes {spmv.remainder_edges} "
+            "edge(s) through its remainder — use 'lanes' or 'inline'")
+    if route == "inline" and spmv.rem_mode != "gather":
+        raise ValueError(
+            "rem_route='inline' gathers the plan's bucketed ELL "
+            f"remainder in-kernel; this plan's remainder is "
+            f"{spmv.rem_mode!r} — recompile with remainder='gather' or "
+            "keep rem_route='lanes'")
+    W = 0
+    if route == "inline":
+        # inline reads sit in the same halo window as the bands; the
+        # exact remainder reach is validated at leaf-build time
+        # (_rem_window_index raises with the fix named)
+        W = max((s[1] for s in spmv.rem_bucket_shapes), default=0)
+    R = choose_block_rows(spmv.n, H, block_rows)
+    P = _ceil_to(max(spmv.n, 1), R * LANE)
+    rows = P // LANE
+    return FusedRoundSpec(
+        n=spmv.n, P=P, rows=rows, block_rows=R, grid=rows // R,
+        offsets=offs, rem_route=route, rem_width=W,
+        n_planes=-(-len(offs) // 32),
+    )
+
+
+def pack_band_planes(band_masks, P: int, n_planes: int) -> list:
+    """Bitpack per-offset bool band masks into flat ``(P,)`` uint32
+    planes, 32 offsets each — shared by the single-device leaf builder
+    and the sharded kernel's stacked planes."""
+    planes = []
+    for g in range(n_planes):
+        plane = np.zeros(P, np.uint32)
+        for j, mask in enumerate(band_masks[g * 32:(g + 1) * 32]):
+            m = np.asarray(mask)
+            plane[:m.shape[0]] |= m.astype(np.uint32) << j
+        planes.append(plane)
+    return planes
+
+
+def build_fused_leaves(spmv, leaves, spec: FusedRoundSpec
+                       ) -> FusedRoundLeaves:
+    """Bitpack the plan's band masks (and, inline route, flatten the
+    bucketed remainder ELL to window coordinates) into device leaves."""
+    import jax.numpy as jnp
+
+    rows = spec.rows
+    planes = [p.reshape(rows, LANE) for p in
+              pack_band_planes(leaves.band_masks, spec.P, spec.n_planes)]
+    rem_idx = None
+    if spec.rem_route == "inline":
+        idx = _rem_window_index(spmv, leaves, spec)
+        rem_idx = jnp.asarray(idx.reshape(rows, LANE, max(spec.rem_width,
+                                                          1)))
+    return FusedRoundLeaves(
+        planes=tuple(jnp.asarray(p) for p in planes), rem_idx=rem_idx)
+
+
+def _rem_window_index(spmv, leaves, spec: FusedRoundSpec) -> np.ndarray:
+    """Per-row remainder neighbor matrix in WINDOW coordinates.
+
+    The bucketed ELL (``rem_mats`` grouped by degree, ``rem_pos`` row ->
+    bucket position) is flattened back to row order at the global max
+    width; each index then shifts by the owning tile's window origin
+    ``(tile - 1) * R * 128`` so the kernel gathers straight from its
+    ``[prev; own; next]`` window.  Empty slots are -1 (gather-clamped,
+    zero-masked)."""
+    n, W = spec.n, max(spec.rem_width, 1)
+    R = spec.block_rows
+    out = np.full((spec.P, W), -1, np.int64)
+    rem_pos = np.asarray(leaves.rem_pos) if leaves.rem_pos is not None \
+        else None
+    if rem_pos is not None and spmv.remainder_edges:
+        flat = np.full((n, W), -1, np.int64)
+        row0 = 0
+        for m in leaves.rem_mats:
+            m = np.asarray(m)
+            rows_b, w = m.shape
+            if w:
+                blk = m.astype(np.int64)
+                blk = np.where(blk >= n, -1, blk)  # n = the pad slot
+                flat[row0:row0 + rows_b, :w] = blk
+            row0 += rows_b
+        out[:n] = flat[rem_pos]
+        span = np.abs(out[:n] - np.arange(n)[:, None],
+                      where=out[:n] >= 0, out=np.zeros_like(out[:n]))
+        if span.max(initial=0) > R * LANE:
+            raise ValueError(
+                f"remainder reach {int(span.max())} exceeds the "
+                f"{R * LANE}-element tile window; use rem_route='lanes' "
+                "or a larger block_rows")
+    tile = np.arange(spec.P, dtype=np.int64) // (R * LANE)
+    origin = (tile - 1) * (R * LANE)
+    out = np.where(out >= 0, out - origin[:, None], -1)
+    return out.astype(np.int32)
+
+
+def _pad_plane(x, P: int):
+    """(M, ...) node array -> (P, ...) lane-padded (zero fill)."""
+    import jax.numpy as jnp
+
+    if x.shape[0] == P:
+        return x
+    pad = jnp.zeros((P - x.shape[0],) + x.shape[1:], x.dtype)
+    return jnp.concatenate([x, pad])
+
+
+def _to_tiles(x, spec: FusedRoundSpec):
+    """(P,) or (P, D) -> (D?, rows, 128) batch-major tile view."""
+    if x.ndim == 1:
+        return x.reshape(1, spec.rows, LANE)
+    return x.T.reshape(x.shape[1], spec.rows, LANE)
+
+
+def _from_tiles(x3, like, spec: FusedRoundSpec):
+    if like.ndim == 1:
+        return x3.reshape(spec.P)[:like.shape[0]]
+    return x3.reshape(x3.shape[0], spec.P).T[:like.shape[0]]
+
+
+def _round_kernel(*refs, spec: FusedRoundSpec, interpret: bool):
+    """Kernel body.  ``refs`` lays out as::
+
+        [value{3|1}, S{3|1}, A_prev{3|1}, inv{3|1},   # windowed inputs
+         G, deg, avg_prev,                            # own-tile inputs
+         plane_0..plane_{k-1},                        # band-mask planes
+         rem_idx?, a_rem?,                            # remainder route
+         S', G', avg, A]                              # outputs (own)
+
+    where {3|1} is prev/own/next window tiles when the spec needs a
+    window, else the own tile alone."""
+    import jax.numpy as jnp
+
+    R = spec.block_rows
+    w = 3 if spec.needs_window else 1
+    nw = 3 * R if spec.needs_window else R
+
+    pos = 0
+
+    def pull_window():
+        nonlocal pos
+        parts = [refs[pos + j][0] for j in range(w)]
+        pos += w
+        return jnp.concatenate(parts, axis=0) if w > 1 else parts[0]
+
+    v_w = pull_window()
+    s_w = pull_window()
+    ap_w = pull_window()
+    iv_w = pull_window()
+    g_o = refs[pos][0]; pos += 1
+    dg_o = refs[pos][0]; pos += 1
+    avp_o = refs[pos][0]; pos += 1
+    planes = [refs[pos + j] for j in range(spec.n_planes)]
+    pos += spec.n_planes
+    rem_idx = None
+    if spec.rem_route == "inline":
+        rem_idx = refs[pos]; pos += 1
+    a_rem = None
+    if spec.rem_route == "lanes":
+        a_rem = refs[pos][0]; pos += 1
+    out_S, out_G, out_avg, out_A = refs[pos:pos + 4]
+
+    # 1. fire: the elementwise avg update, on the whole window so every
+    #    band shift below reads an on-chip operand
+    avg_w = (v_w - s_w + ap_w) * iv_w
+    own = slice(R, 2 * R) if spec.needs_window else slice(0, R)
+    avg_o = avg_w[own]
+
+    # 2. delivery: one masked shift per kept diagonal, accumulated in
+    #    plan order (bit-identical to banded_neighbor_sum's loop)
+    acc = jnp.zeros_like(avg_o)
+    for gi, d in enumerate(spec.offsets):
+        bit = ((planes[gi // 32][...] >> (gi % 32)) & 1) != 0
+        shifted = _shift_back(avg_w, d, nw, interpret)[own]
+        acc = acc + jnp.where(bit, shifted, 0)
+
+    # 3. remainder
+    if rem_idx is not None:
+        idx = rem_idx[...]
+        flat = avg_w.reshape(-1)
+        gathered = flat[jnp.maximum(idx, 0)]
+        acc = acc + jnp.sum(jnp.where(idx >= 0, gathered, 0), axis=-1)
+    if a_rem is not None:
+        acc = acc + a_rem
+
+    # 4. merge: exactly node_round_step's ledger recurrences
+    s_o = s_w[own]
+    ap_o = ap_w[own]
+    out_S[0] = -g_o - acc + dg_o * avp_o
+    out_G[0] = -s_o - dg_o * avg_o + ap_o
+    out_avg[0] = avg_o
+    out_A[0] = acc
+
+
+def fused_banded_round(S, G, avg_prev, A_prev, value, inv_depp1, deg,
+                       fused_leaves: FusedRoundLeaves,
+                       spec: FusedRoundSpec, a_rem=None, *,
+                       interpret: bool | None = None):
+    """One full Flow-Updating round through a single ``pallas_call``.
+
+    All node arrays are ``(M,)`` or ``(M, D)`` with ``M <= spec.P``
+    (lane-padding happens here; the banded NodeKernel sizes its padded
+    vectors to ``spec.P`` so this is a no-op on the hot path).  Returns
+    ``(S_next, G_next, avg, A_cur)`` shaped like the inputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = _interpret()
+    if spec.rem_route == "lanes" and a_rem is None:
+        raise ValueError("rem_route='lanes' needs the precomputed "
+                         "remainder addend (a_rem)")
+    P, R = spec.P, spec.block_rows
+    like = S
+    feat = S.shape[1:]
+    B = int(np.prod(feat)) if feat else 1
+
+    S3, G3, avp3, ap3 = (_to_tiles(_pad_plane(x, P), spec)
+                         for x in (S, G, avg_prev, A_prev))
+    v3 = _to_tiles(_pad_plane(value, P), spec)
+    iv3 = _pad_plane(inv_depp1, P).reshape(1, spec.rows, LANE)
+    dg3 = _pad_plane(deg, P).reshape(1, spec.rows, LANE)
+
+    # batch axis maps to tile 0 for the feature-shared constant planes
+    def maps(batched):
+        b_of = (lambda b: b) if batched else (lambda _b: 0)
+        own = lambda i, b: (b_of(b), i, 0)
+        prv = lambda i, b: (b_of(b), jnp.maximum(i - 1, 0), 0)
+        nxt = lambda i, b: (b_of(b), jnp.minimum(i + 1, spec.grid - 1), 0)
+        return prv, own, nxt
+
+    inputs, in_specs = [], []
+
+    def add(arr, batched, window):
+        prv, own, nxt = maps(batched)
+        for mp in ((prv, own, nxt) if window and spec.needs_window
+                   else (own,)):
+            inputs.append(arr)
+            in_specs.append(pl.BlockSpec((1, R, LANE), mp))
+
+    add(v3, True, True)
+    add(S3, True, True)
+    add(ap3, True, True)
+    add(iv3, False, True)
+    add(G3, True, False)
+    add(dg3, False, False)
+    add(avp3, True, False)
+    for p in fused_leaves.planes:
+        inputs.append(p)
+        in_specs.append(pl.BlockSpec((R, LANE), lambda i, _b: (i, 0)))
+    if spec.rem_route == "inline":
+        inputs.append(fused_leaves.rem_idx)
+        in_specs.append(pl.BlockSpec(
+            (R, LANE, fused_leaves.rem_idx.shape[-1]),
+            lambda i, _b: (i, 0, 0)))
+    if spec.rem_route == "lanes":
+        add(_to_tiles(_pad_plane(a_rem, P), spec), True, False)
+    own_out = maps(True)[1]
+
+    shape3 = (B, spec.rows, LANE)
+    out_shape = tuple(jax.ShapeDtypeStruct(shape3, S.dtype)
+                      for _ in range(4))
+    out = pl.pallas_call(
+        lambda *refs: _round_kernel(*refs, spec=spec,
+                                    interpret=interpret),
+        grid=(spec.grid, B),
+        in_specs=in_specs,
+        out_specs=tuple(pl.BlockSpec((1, R, LANE), own_out)
+                        for _ in range(4)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*inputs)
+    return tuple(_from_tiles(o, like, spec) for o in out)
+
+
+# ---------------------------------------------------------------------
+# sharded form: one kernel per shard, halo exchange via async remote DMA
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ShardedRoundSpec:
+    """Static descriptor of the one-kernel-per-shard banded round
+    (identity-hashed, jit-static).  Each shard owns ``local`` contiguous
+    RCM rows; ``halo_rows`` tile-rows of ``avg`` cross the wire to each
+    neighbor per round — the ``make_async_remote_copy`` exchange of
+    ``ops/pallas_halo.py`` composed INSIDE the fused round kernel."""
+
+    n: int               # real node count (RCM space)
+    P: int               # padded global length (num_shards * local)
+    local: int           # per-shard element count (multiple of 1024)
+    halo_rows: int       # exchanged tile-rows per direction
+    num_shards: int
+    offsets: tuple
+    rem_route: str       # 'none' | 'inline'
+    rem_width: int
+    n_planes: int
+
+    @property
+    def local_rows(self) -> int:
+        return self.local // LANE
+
+    @property
+    def halo(self) -> int:
+        """Exchanged elements per direction."""
+        return self.halo_rows * LANE
+
+
+def _sharded_round_kernel(*refs, spec: ShardedRoundSpec, axis_name,
+                          interpret: bool):
+    """Kernel body: fire, START both halo DMAs, run the whole band +
+    remainder accumulation on the zero-halo window while the wire is
+    busy (exact for every interior row — all its reads are on-shard),
+    wait, recompute through the received window and keep the boundary
+    rows from it.  ``refs``::
+
+        [value, S, A_prev, inv, G, deg, avg_prev,     # (local_rows, 128)
+         plane_0..plane_{k-1}, rem_idx?,              # local slices
+         S', G', avg, A, recv_lo, recv_hi,            # outputs
+         avg_scratch, send_sems x2, recv_sems x2]     # scratch
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = spec.local_rows
+    Hr = spec.halo_rows
+    pos = 0
+    v, s, ap, iv, g, dg, avp = refs[:7]
+    pos = 7
+    planes = refs[pos:pos + spec.n_planes]
+    pos += spec.n_planes
+    rem_idx = None
+    if spec.rem_route == "inline":
+        rem_idx = refs[pos]
+        pos += 1
+    out_S, out_G, out_avg, out_A, recv_lo, recv_hi = refs[pos:pos + 6]
+    avg_ref = refs[pos + 6]
+    sems = refs[pos + 7:]
+
+    me = jax.lax.axis_index(axis_name)
+    S_ = np.int32(spec.num_shards)
+
+    # 1. fire on the own tile, land it in scratch so the DMA engines can
+    #    read the boundary slices while compute continues
+    avg_o = (v[...] - s[...] + ap[...]) * iv[...]
+    avg_ref[...] = avg_o
+
+    # 2. start both boundary copies: my first Hr rows feed the LEFT
+    #    neighbor's high halo, my last Hr rows the RIGHT neighbor's low
+    #    halo (a ring; wrapped blocks are never mask-selected, the
+    #    no-wrap invariant again)
+    ops = []
+    for (sl, dst, d) in ((slice(0, Hr), recv_hi, -1),
+                         (slice(R - Hr, R), recv_lo, +1)):
+        op = pltpu.make_async_remote_copy(
+            src_ref=avg_ref.at[sl],
+            dst_ref=dst,
+            send_sem=sems[0 if d < 0 else 1],
+            recv_sem=sems[2 if d < 0 else 3],
+            device_id=jax.lax.rem(me + np.int32(d) + S_, S_),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        op.start()
+        ops.append(op)
+
+    def accumulate(window):
+        nw = R + 2 * Hr
+        acc = jnp.zeros_like(avg_o)
+        own = slice(Hr, Hr + R)
+        for gi, d in enumerate(spec.offsets):
+            bit = ((planes[gi // 32][...] >> (gi % 32)) & 1) != 0
+            shifted = _shift_back(window, d, nw, interpret)[own]
+            acc = acc + jnp.where(bit, shifted, 0)
+        if rem_idx is not None:
+            idx = rem_idx[...]
+            flat = window.reshape(-1)
+            gathered = flat[jnp.maximum(idx, 0)]
+            acc = acc + jnp.sum(jnp.where(idx >= 0, gathered, 0),
+                                axis=-1)
+        return acc
+
+    # 3. the overlap window: the full accumulation on the zero-halo
+    #    view — bit-exact for every row whose reads stay on-shard.
+    #    (The post-wait pass recomputes all rows and a select keeps the
+    #    boundary: ~2x VPU work for the simplest possible parity story.
+    #    A boundary-only post pass — O(halo_rows) instead of O(R) —
+    #    halves the compute once the overlap window needs widening on
+    #    real hardware; the wire bytes are unchanged either way.)
+    zh = jnp.zeros((Hr, LANE), avg_o.dtype)
+    acc_pre = accumulate(jnp.concatenate([zh, avg_o, zh], axis=0))
+
+    for op in ops:
+        op.wait()
+
+    # 4. boundary rows re-read through the received halos
+    acc_post = accumulate(
+        jnp.concatenate([recv_lo[...], avg_o, recv_hi[...]], axis=0))
+    rowid = jax.lax.broadcasted_iota(jnp.int32, avg_o.shape, 0)
+    interior = (rowid >= Hr) & (rowid < R - Hr)
+    acc = jnp.where(interior, acc_pre, acc_post)
+
+    # 5. merge: node_round_step's ledger recurrences, unchanged
+    out_S[...] = -g[...] - acc + dg[...] * avp[...]
+    out_G[...] = -s[...] - dg[...] * avg_o + ap[...]
+    out_avg[...] = avg_o
+    out_A[...] = acc
+
+
+def fused_sharded_round(S, G, avg_prev, A_prev, value, inv_depp1, deg,
+                        planes, rem_idx, spec: ShardedRoundSpec, *,
+                        axis_name, interpret: bool | None = None):
+    """One fused banded round for ONE shard (call inside ``shard_map``):
+    a single ``pallas_call`` that fires, exchanges ``halo`` elements of
+    ``avg`` with both ring neighbors via ``make_async_remote_copy``,
+    accumulates every band and remainder read, and merges the ledgers.
+    All arrays are the shard's ``(local,)`` slices.  Returns
+    ``(S_next, G_next, avg, A_cur)``."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _interpret()
+    R, Hr = spec.local_rows, spec.halo_rows
+    t2 = lambda x: x.reshape(R, LANE)
+    inputs = [t2(value), t2(S), t2(A_prev), t2(inv_depp1), t2(G),
+              t2(deg), t2(avg_prev)]
+    inputs += list(planes)
+    if spec.rem_route == "inline":
+        inputs.append(rem_idx)
+    dt = S.dtype
+    out_shape = (
+        [jax.ShapeDtypeStruct((R, LANE), dt) for _ in range(4)]
+        + [jax.ShapeDtypeStruct((Hr, LANE), dt) for _ in range(2)])
+    spec_any = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+    kwargs = {}
+    if not interpret:
+        from flow_updating_tpu.ops.pallas_halo import (
+            require_compiler_params,
+        )
+
+        kwargs["compiler_params"] = require_compiler_params(
+            collective_id=1)
+    out = pl.pallas_call(
+        lambda *refs: _sharded_round_kernel(
+            *refs, spec=spec, axis_name=axis_name, interpret=interpret),
+        out_shape=tuple(out_shape),
+        in_specs=[spec_any] * len(inputs),
+        out_specs=tuple([spec_any] * 6),
+        scratch_shapes=[pltpu.VMEM((R, LANE), dt)]
+        + [pltpu.SemaphoreType.DMA] * 4,
+        interpret=interpret,
+        **kwargs,
+    )(*inputs)
+    return tuple(o.reshape(spec.local) for o in out[:4])
+
+
+def fused_round_bytes(spec: FusedRoundSpec, *, dtype_bytes: int = 4,
+                      features: int = 1) -> dict:
+    """HBM bytes one fused round moves, vs the unfused banded executor —
+    the attribution block of profile/plan manifests and the quantity
+    ``regress --against`` gates (obs/profile.fused_round_report)."""
+    D = max(features, 1)
+    vec = spec.P * dtype_bytes
+    # kernel reads: the halo-windowed planes (value, S, A_prev carry
+    # the payload axis; inv is shared) are fetched once per window
+    # tile, the own-tile planes (G, avg_prev payload-wide; deg shared)
+    # once, plus the bitpacked masks; writes: 4 payload-wide planes
+    window = 3 if spec.needs_window else 1
+    reads = ((3 * D + 1) * window + (2 * D + 1)) * vec \
+        + spec.n_planes * spec.P * 4
+    if spec.rem_route == "inline":
+        reads += spec.P * max(spec.rem_width, 1) * 4
+    writes = 4 * D * vec
+    fused_passes = 1
+    if spec.rem_route == "lanes":
+        reads += D * vec            # the precomputed remainder addend
+        fused_passes += 1           # the outside avg+remainder pass
+    lanes = len(spec.offsets)
+    unfused = (3 * lanes + 6) * D * vec
+    return {
+        "bytes_per_round": int(reads + writes),
+        "unfused_bytes_per_round": int(unfused),
+        "passes_per_round": fused_passes,
+        "unfused_passes_per_round": 3 * lanes + 6,
+        "band_lanes": lanes,
+        "tile_rows": spec.block_rows,
+        "grid": spec.grid,
+        "rem_route": spec.rem_route,
+    }

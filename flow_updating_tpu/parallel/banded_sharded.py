@@ -1,0 +1,368 @@
+"""Sharded one-kernel banded round: fused Pallas round + halo DMA.
+
+The single-device fused round (``ops/pallas_round.py``) keeps a band
+tile of protocol state in VMEM for the whole fire → delivery → merge
+pass.  This module is its multi-chip form: after RCM reordering the
+graph's bandwidth ``H`` bounds every edge's |dst - src|, so a
+**contiguous block partition** of the node axis needs only ``H``
+elements of ``avg`` from each ring neighbor per round — the banded
+analogue of the edge kernel's cut-edge halo.  Each shard then runs ONE
+``pallas_call`` per round (``ops/pallas_round.fused_sharded_round``)
+that
+
+1. fires its own tile,
+2. **starts** one ``pltpu.make_async_remote_copy`` per ring direction
+   (the ``ops/pallas_halo.py`` exchange composed INSIDE the round
+   kernel — SNIPPETS [1]/[2] taken to the whole-round conclusion),
+3. accumulates every band lane and remainder gather on the zero-halo
+   window while the wire is busy (bit-exact for all interior rows —
+   their reads never leave the shard),
+4. waits, re-reads the boundary rows through the received halos, and
+5. merges the ledgers.
+
+``exchange='ppermute'`` is the serialized XLA oracle — the same window
+algebra through ``lax.ppermute`` and static slices — and the Pallas
+path is pinned BIT-exact against it on the virtual CPU mesh in Pallas
+interpret mode (``tests/test_pallas_round.py``), the ``pallas_halo``
+testing discipline: interpret mode executes the real remote-copy
+semantics, so the shipped kernel is the tested kernel.
+
+Scope: the fast synchronous collect-all mode (the banded executor's
+domain), scalar payloads, plans whose remainder is 'gather' (inlined)
+or 'none'; a Beneš-remainder plan asks for recompilation with
+``remainder='gather'``.  Wire cost: ``2 * H * dtype_bytes`` per shard
+per round, independent of the cut edge count — compare
+``parallel/sharded.py``'s per-cut-edge payload blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from flow_updating_tpu.utils import struct
+import jax
+import jax.numpy as jnp
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.parallel.mesh import NODE_AXIS, shard_map
+from flow_updating_tpu.topology.graph import Topology
+
+P = jax.sharding.PartitionSpec
+
+LANE = 128
+_TILE = 8 * LANE  # per-shard length multiple (f32 min tile rows x lanes)
+
+
+@struct.dataclass
+class ShardedBandedArrays:
+    """Constants, stacked per shard on the leading axis."""
+
+    value: jnp.ndarray      # (S, L)
+    inv_depp1: jnp.ndarray  # (S, L)
+    deg: jnp.ndarray        # (S, L)
+    planes: tuple           # per 32-offset group: (S, L/128, 128) uint32
+    rem_idx: object = None  # 'inline': (S, L/128, 128, W) int32 window
+    #                         coords, -1 = empty slot
+    spec: object = struct.field(pytree_node=False, default=None)
+    #                         static ops.pallas_round.ShardedRoundSpec
+    exchange: str = struct.field(pytree_node=False, default="pallas")
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+class ShardedBandedKernel:
+    """Node-collapsed fast collect-all over a device mesh, the banded
+    plan executed as one fused Pallas kernel per shard.  Mirrors
+    :class:`models.sync.NodeKernel`'s recurrence exactly (pinned
+    bit-exact against the single-device banded executor and the
+    ppermute oracle in tests)."""
+
+    def __init__(self, topo: Topology, cfg: RoundConfig, mesh,
+                 plan=None, exchange: str = "pallas"):
+        from flow_updating_tpu.models import sync
+        from flow_updating_tpu.ops.pallas_round import ShardedRoundSpec
+
+        sync._check_cfg(cfg)
+        if cfg.spmv != "banded_fused":
+            raise ValueError(
+                "ShardedBandedKernel is the spmv='banded_fused' mesh "
+                "path")
+        if exchange not in ("pallas", "ppermute"):
+            raise ValueError(
+                f"unknown exchange {exchange!r}: 'pallas' (one fused "
+                "remote-DMA kernel per shard) or 'ppermute' (the "
+                "serialized XLA oracle)")
+        vals = topo.values
+        if vals is not None and getattr(vals, "ndim", 1) > 1:
+            raise ValueError(
+                "the sharded fused round is scalar-payload (vector "
+                "payloads run the single-device banded kernels or the "
+                "feature-axis mesh, parallel/feature.py)")
+        self.topo = topo
+        self.cfg = cfg
+        self.mesh = mesh
+        S = mesh.devices.size
+        if S < 2:
+            raise ValueError("the sharded fused round needs >= 2 shards")
+
+        if plan is None:
+            from flow_updating_tpu.plan import compile_topology
+
+            # the per-shard remainder is an in-kernel gather; a
+            # self-compiled plan must not route it through global
+            # Beneš lanes
+            plan = compile_topology(topo, remainder="gather")
+        from flow_updating_tpu.plan.compile import _topo_key
+
+        if plan.source_key and plan.source_key != _topo_key(topo):
+            raise ValueError(
+                "execution plan was compiled from a different topology "
+                "(edge-content fingerprint mismatch) — recompile with "
+                "plan.compile_topology(topo)")
+        self.plan = plan
+        n = topo.num_nodes
+        spmv = plan.spmv
+        if spmv.rem_mode == "benes":
+            raise ValueError(
+                "the sharded fused round inlines a gather remainder "
+                "per shard; this plan routes its remainder through "
+                "global Beneš lanes — recompile with "
+                "compile_topology(topo, remainder='gather')")
+        rem_route = "none" if spmv.rem_mode == "none" else "inline"
+
+        H = int(plan.stats.get("bandwidth_after", 0)) or 1
+        Hr = _ceil_to(max(-(-H // LANE), 8), 8)
+        M = _ceil_to(n, S * _TILE)
+        L = M // S
+        while Hr * LANE > L:
+            # halo must fit one neighbor shard: grow the shard blocks
+            M += S * _TILE
+            L = M // S
+        offs = tuple(int(d) for d in spmv.offsets)
+        W = max((s[1] for s in spmv.rem_bucket_shapes), default=0) \
+            if rem_route == "inline" else 0
+        self.spec = spec = ShardedRoundSpec(
+            n=n, P=M, local=L, halo_rows=Hr, num_shards=S,
+            offsets=offs, rem_route=rem_route, rem_width=W,
+            n_planes=-(-len(offs) // 32),
+        )
+        self.padded_size = M
+        self._perm = np.asarray(plan.order, np.int64)
+
+        value = np.zeros(M, np.float64)
+        deg = np.zeros(M, np.float64)
+        base_vals = np.asarray(topo.values, np.float64)
+        value[:n] = base_vals[self._perm]
+        deg[:n] = topo.out_deg[self._perm]
+
+        planes = self._band_planes(spec)
+        rem_idx = self._rem_window_index(spec) \
+            if rem_route == "inline" else None
+
+        import jax.sharding as jsh
+
+        dt = cfg.jnp_dtype
+        ns = lambda *ax: jsh.NamedSharding(mesh, P(NODE_AXIS, *ax))
+        put = lambda x, sh: jax.device_put(np.ascontiguousarray(x), sh)
+        rows = L // LANE
+        self.arrays = ShardedBandedArrays(
+            value=put(value.reshape(S, L).astype(dt), ns(None)),
+            inv_depp1=put((1.0 / (deg + 1.0)).reshape(S, L).astype(dt),
+                          ns(None)),
+            deg=put(deg.reshape(S, L).astype(dt), ns(None)),
+            planes=tuple(
+                put(p.reshape(S, rows, LANE), ns(None, None))
+                for p in planes),
+            rem_idx=None if rem_idx is None else put(
+                rem_idx.reshape(S, rows, LANE, spec.rem_width or 1),
+                ns(None, None, None)),
+            spec=spec,
+            exchange=exchange,
+        )
+
+    def _band_planes(self, spec) -> list:
+        """Global bitpacked band-mask planes, (P,) uint32 per group
+        (the single-device packer, shared)."""
+        from flow_updating_tpu.ops.pallas_round import pack_band_planes
+
+        return pack_band_planes(self.plan.leaves.band_masks, spec.P,
+                                spec.n_planes)
+
+    def _rem_window_index(self, spec) -> np.ndarray:
+        """Remainder ELL in per-shard WINDOW coordinates: global
+        neighbor g of a row owned by shard s sits at ``g - (s*L -
+        halo)`` inside that shard's [recv_lo; own; recv_hi] window."""
+        from flow_updating_tpu.ops.pallas_round import (
+            FusedRoundSpec,
+            _rem_window_index,
+        )
+
+        one = FusedRoundSpec(
+            n=spec.n, P=spec.P, rows=spec.P // LANE,
+            block_rows=spec.local // LANE, grid=spec.num_shards,
+            offsets=spec.offsets, rem_route="inline",
+            rem_width=spec.rem_width, n_planes=spec.n_planes)
+        idx = _rem_window_index(self.plan.spmv, self.plan.leaves, one)
+        # the single-device window is [prev-tile; own; next] (origin
+        # (s-1)*L); the sharded window is [halo; own; halo] (origin
+        # s*L - halo*128): shift the coordinates by the difference
+        shift = spec.local - spec.halo
+        idx = idx.reshape(spec.P, -1).astype(np.int64)
+        idx = np.where(idx >= 0, idx - shift, -1)
+        span_ok = (idx < 0) | ((idx >= 0)
+                               & (idx < spec.local + 2 * spec.halo))
+        if not span_ok.all():
+            raise ValueError(
+                "remainder reach exceeds the halo window — the plan's "
+                "bandwidth accounting is inconsistent (recompile the "
+                "plan)")
+        return idx.astype(np.int32)
+
+    def init_state(self):
+        from flow_updating_tpu.models.sync import NodeSyncState
+
+        import jax.sharding as jsh
+
+        spec = self.spec
+        z = jax.device_put(
+            jnp.zeros((spec.num_shards, spec.local), self.cfg.jnp_dtype),
+            jsh.NamedSharding(self.mesh, P(NODE_AXIS, None)),
+        )
+        t = jax.device_put(jnp.zeros((), jnp.int32),
+                           jsh.NamedSharding(self.mesh, P()))
+        return NodeSyncState(t=t, S=z, G=z, avg_prev=z, A_prev=z)
+
+    def run(self, state, num_rounds: int):
+        return _run_sharded_banded(state, self.arrays, self.cfg,
+                                   self.mesh, num_rounds)
+
+    def round_program(self, state, num_rounds: int):
+        """``(jitted_fn, full_args, n_dynamic)`` — the AOT
+        cost-attribution + golden-ledger hook; exactly what :meth:`run`
+        dispatches."""
+        return (_run_sharded_banded,
+                (state, self.arrays, self.cfg, self.mesh, num_rounds), 2)
+
+    def _unpermute(self, padded: np.ndarray) -> np.ndarray:
+        out = np.empty(self.topo.num_nodes, padded.dtype)
+        out[self._perm] = padded[:self.topo.num_nodes]
+        return out
+
+    def estimates(self, state) -> np.ndarray:
+        """Per-node estimates in original node order (the NodeKernel
+        readback convention: value + G)."""
+        flat = np.asarray(self.arrays.value + state.G).reshape(-1)
+        return self._unpermute(flat)
+
+    def last_avg(self, state) -> np.ndarray:
+        return self._unpermute(np.asarray(state.avg_prev).reshape(-1))
+
+    def run_streamed(self, state, num_rounds: int, observe_every: int,
+                     emit):
+        """Chunked host-side observer — same emit payload as
+        sync.run_rounds_node_streamed (metrics over communicating
+        nodes)."""
+        if num_rounds % observe_every:
+            raise ValueError("num_rounds must be a multiple of "
+                             "observe_every")
+        mean = float(self.topo.true_mean)
+        deg = np.asarray(self.arrays.deg).reshape(-1)
+        real = deg > 0
+        cnt = max(int(real.sum()), 1)
+        for _ in range(num_rounds // observe_every):
+            state = self.run(state, observe_every)
+            if emit is not None:
+                est = np.asarray(
+                    self.arrays.value + state.G).reshape(-1)
+                err = np.where(real, est - mean, 0.0)
+                emit({
+                    "t": int(state.t),
+                    "rmse": float(np.sqrt((err * err).sum() / cnt)),
+                    "max_abs_err": float(np.abs(err).max()),
+                    "mass": float(np.where(real, est, 0.0).sum()),
+                    "fired_total": int(state.t) * cnt,
+                })
+        return state
+
+
+def _oracle_step(st, value_l, inv_l, deg_l, planes_l, rem_l, spec):
+    """The ppermute reference round: identical window algebra to the
+    fused kernel — halos via two ``lax.ppermute``, bands via static
+    window slices, the remainder gathered at the kernel's exact shapes
+    so the float sequences agree to the bit."""
+    S_ = spec.num_shards
+    He = spec.halo
+    avg_l = (value_l - st.S + st.A_prev) * inv_l
+    fwd = [(j, (j + 1) % S_) for j in range(S_)]
+    bwd = [(j, (j - 1) % S_) for j in range(S_)]
+    lo = jax.lax.ppermute(avg_l[-He:], NODE_AXIS, fwd)
+    hi = jax.lax.ppermute(avg_l[:He], NODE_AXIS, bwd)
+    window = jnp.concatenate([lo, avg_l, hi])
+    acc = jnp.zeros_like(avg_l)
+    L = spec.local
+    for gi, d in enumerate(spec.offsets):
+        plane = planes_l[gi // 32].reshape(-1)
+        bit = ((plane >> (gi % 32)) & 1) != 0
+        acc = acc + jnp.where(bit, jax.lax.slice(window, (He + d,),
+                                                 (He + d + L,)), 0)
+    if rem_l is not None:
+        idx = rem_l                       # (rows, 128, W)
+        gathered = window[jnp.maximum(idx, 0)]
+        rsum = jnp.sum(jnp.where(idx >= 0, gathered, 0), axis=-1)
+        acc = acc + rsum.reshape(-1)
+    S_next = -st.G - acc + deg_l * st.avg_prev
+    G_next = -st.S - deg_l * avg_l + st.A_prev
+    return st.replace(t=st.t + 1, S=S_next, G=G_next, avg_prev=avg_l,
+                      A_prev=acc)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "mesh", "num_rounds"))
+def _run_sharded_banded(state, arrays: ShardedBandedArrays,
+                        cfg: RoundConfig,  # noqa: ARG001  # jit cache key
+                        mesh, num_rounds: int):
+    spec = arrays.spec
+    exchange = arrays.exchange
+
+    def body(value_l, inv_l, deg_l, planes_l, rem_l, st):
+        value_l, inv_l, deg_l = (a[0] for a in (value_l, inv_l, deg_l))
+        planes_l = tuple(p[0] for p in planes_l)
+        rem_l = None if rem_l is None else rem_l[0]
+        st = jax.tree.map(lambda x: x[0] if x.ndim == 2 else x, st)
+
+        def step(st, _):
+            if exchange == "ppermute":
+                return _oracle_step(st, value_l, inv_l, deg_l,
+                                    planes_l, rem_l, spec), None
+            from flow_updating_tpu.ops.pallas_round import (
+                fused_sharded_round,
+            )
+
+            S_next, G_next, avg_l, acc = fused_sharded_round(
+                st.S, st.G, st.avg_prev, st.A_prev, value_l, inv_l,
+                deg_l, planes_l, rem_l, spec, axis_name=NODE_AXIS)
+            return st.replace(t=st.t + 1, S=S_next, G=G_next,
+                              avg_prev=avg_l, A_prev=acc), None
+
+        out, _ = jax.lax.scan(step, st, None, length=num_rounds)
+        return jax.tree.map(
+            lambda x: x[None] if x.ndim == 1 else x, out)
+
+    sh = P(NODE_AXIS, None)
+    plane_specs = tuple(P(NODE_AXIS, None, None) for _ in arrays.planes)
+    rem_spec = None if arrays.rem_idx is None \
+        else P(NODE_AXIS, None, None, None)
+    state_spec = jax.tree.map(lambda x: sh if x.ndim == 2 else P(),
+                              state)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(sh, sh, sh, plane_specs, rem_spec, state_spec),
+        out_specs=state_spec,
+        check_vma=False,
+    )(arrays.value, arrays.inv_depp1, arrays.deg, arrays.planes,
+      arrays.rem_idx, state)

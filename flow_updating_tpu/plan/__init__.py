@@ -21,13 +21,21 @@ arXiv:1906.11786:
 :class:`~flow_updating_tpu.plan.compile.ExecutionPlan`;
 :func:`select_plan` is the auto-mode policy (``Engine(plan='auto')``)
 choosing kernel/spmv per (topology, backend) from analytic or AOT cost
-models (``obs/profile.py``).
+models (``obs/profile.py``) — or from MEASURED on-device probes via the
+persistent autotune cache (:func:`~flow_updating_tpu.plan.select.
+autotune_fused`: band width x fused-round tile x remainder route, keyed
+by plan hash x backend x jax version).  The banded plan itself executes
+either as separate XLA ops (``spmv='banded'``) or as ONE VMEM-resident
+Pallas kernel per round (``spmv='banded_fused'``,
+``ops/pallas_round.py``; sharded form
+``parallel/banded_sharded.py`` — one remote-DMA kernel per shard).
 """
 
 from flow_updating_tpu.plan.banded import (
     BandedLeaves,
     BandedSpmvPlan,
     banded_neighbor_sum,
+    banded_remainder_sum,
 )
 from flow_updating_tpu.plan.compile import (
     ExecutionPlan,
@@ -35,7 +43,11 @@ from flow_updating_tpu.plan.compile import (
     reorder_topology_stable,
 )
 from flow_updating_tpu.plan.rcm import adjacency_bandwidth, rcm_order
-from flow_updating_tpu.plan.select import PlanDecision, select_plan
+from flow_updating_tpu.plan.select import (
+    PlanDecision,
+    autotune_fused,
+    select_plan,
+)
 
 __all__ = [
     "BandedLeaves",
@@ -43,7 +55,9 @@ __all__ = [
     "ExecutionPlan",
     "PlanDecision",
     "adjacency_bandwidth",
+    "autotune_fused",
     "banded_neighbor_sum",
+    "banded_remainder_sum",
     "compile_topology",
     "rcm_order",
     "reorder_topology_stable",

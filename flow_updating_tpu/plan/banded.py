@@ -228,17 +228,47 @@ def banded_neighbor_sum(x, plan: BandedSpmvPlan, leaves: BandedLeaves):
         contrib = jnp.roll(xv, -d, axis=0)
         m = mask.reshape(mask.shape + (1,) * len(feat))
         acc = acc + jnp.where(m, contrib, 0)
+    if plan.rem_mode in ("gather", "benes"):
+        acc = acc + _remainder_term(xv, plan, leaves)
+    if x.shape[0] == n:
+        return acc
+    pad = jnp.zeros((x.shape[0] - n,) + feat, x.dtype)
+    return jnp.concatenate([acc, pad])
+
+
+def _remainder_term(xv, plan: BandedSpmvPlan, leaves: BandedLeaves):
+    """The remainder addend for an ``(n, ...)`` plan-order vector — THE
+    one implementation both :func:`banded_neighbor_sum` and
+    :func:`banded_remainder_sum` add, so the fused round's
+    ``rem_route='lanes'`` bit-parity contract cannot drift."""
     if plan.rem_mode == "gather":
         from flow_updating_tpu.models.sync import neighbor_sum
 
-        acc = acc + neighbor_sum(xv, leaves.rem_mats)[leaves.rem_pos]
-    elif plan.rem_mode == "benes":
-        from flow_updating_tpu.ops.permute import apply_padded_perm
-        from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
+        return neighbor_sum(xv, leaves.rem_mats)[leaves.rem_pos]
+    from flow_updating_tpu.ops.permute import apply_padded_perm
+    from flow_updating_tpu.ops.spmv_benes import neighbor_sum_benes
 
-        a = neighbor_sum_benes(xv, plan.rem_ns_plan, leaves.rem_ns_masks)
-        acc = acc + apply_padded_perm(a, plan.rem_unperm_plan,
-                                      leaves.rem_unperm_masks)
+    a = neighbor_sum_benes(xv, plan.rem_ns_plan, leaves.rem_ns_masks)
+    return apply_padded_perm(a, plan.rem_unperm_plan,
+                             leaves.rem_unperm_masks)
+
+
+def banded_remainder_sum(x, plan: BandedSpmvPlan, leaves: BandedLeaves):
+    """The remainder-only addend of :func:`banded_neighbor_sum` (zeros
+    when the plan has no remainder), padded like ``x`` — the
+    ``rem_route='lanes'`` input of the one-kernel fused round
+    (``ops/pallas_round.py``)."""
+    import jax.numpy as jnp
+
+    n = plan.n
+    # a slice is emitted only when x really is padded, keeping the
+    # banded executor's own lowering (via _remainder_term) byte-stable
+    xv = x[:n] if x.shape[0] != n else x
+    feat = xv.shape[1:]
+    if plan.rem_mode in ("gather", "benes"):
+        acc = _remainder_term(xv, plan, leaves)
+    else:
+        acc = jnp.zeros_like(xv)
     if x.shape[0] == n:
         return acc
     pad = jnp.zeros((x.shape[0] - n,) + feat, x.dtype)

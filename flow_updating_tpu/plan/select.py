@@ -21,6 +21,8 @@ generator structure descriptor always selects the structured stencil
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 
 import numpy as np
 
@@ -60,6 +62,9 @@ class PlanDecision:
     backend: str
     predicted: dict             # candidate -> predicted per-round cost
     reason: str
+    fused: dict | None = None   # measured-probe autotune record (tile /
+    #                             remainder route / per-candidate rates)
+    #                             when the fused round was probed
 
     def describe(self) -> dict:
         out = {
@@ -71,6 +76,8 @@ class PlanDecision:
                                for k, v in self.predicted.items()},
             "reason": self.reason,
         }
+        if self.fused is not None:
+            out["autotune"] = self.fused
         if self.plan is not None:
             out["plan"] = self.plan.describe()
         return out
@@ -85,6 +92,19 @@ def _backend_name(backend: str | None) -> str:
         return jax.devices()[0].platform
     except Exception:
         return "cpu"
+
+
+def _remainder_cost(s, cg: float, N: float) -> float:
+    """Streamed-pass cost of a plan's out-of-band remainder — shared by
+    the banded and banded_fused candidates (both ride the same lanes)."""
+    if s.rem_mode == "gather":
+        return cg * (s.remainder_edges + N)  # + unpermute gather
+    if s.rem_mode == "benes":
+        P = float(s.rem_ns_plan.P)
+        cost = len(s.rem_ns_plan.stages.dists) * P
+        return cost + len(s.rem_unperm_plan.stages.dists) \
+            * float(s.rem_unperm_plan.stages.n)
+    return 0.0
 
 
 def _analytic_costs(topo, plan: ExecutionPlan | None, backend: str,
@@ -102,17 +122,17 @@ def _analytic_costs(topo, plan: ExecutionPlan | None, backend: str,
             out[cand] = cg * E + 6.0 * N
         elif cand == "node/banded":
             s = plan.spmv
-            lanes = len(s.offsets)
-            cost = 3.0 * lanes * N + 6.0 * N
-            if s.rem_mode == "gather":
-                cost += cg * (s.remainder_edges + N)  # + unpermute gather
-            elif s.rem_mode == "benes":
-                P = float(s.rem_ns_plan.P)
-                stages = len(s.rem_ns_plan.stages.dists)
-                cost += stages * P
-                cost += len(s.rem_unperm_plan.stages.dists) \
-                    * float(s.rem_unperm_plan.stages.n)
-            out[cand] = cost
+            out[cand] = (3.0 * len(s.offsets) * N + 6.0 * N
+                         + _remainder_cost(s, cg, N))
+        elif cand == "node/banded_fused":
+            # the one-kernel round: every band lane reads its operands
+            # from VMEM, so HBM traffic collapses to ~one read+write of
+            # the state planes plus the bitpacked masks (L/8 bytes per
+            # element-pass equivalent); the remainder rides the same
+            # lanes as node/banded
+            s = plan.spmv
+            out[cand] = ((12.0 + len(s.offsets) / 8.0) * N
+                         + _remainder_cost(s, cg, N))
         elif cand == "node/benes":
             from flow_updating_tpu.ops.permute import next_pow2
 
@@ -357,10 +377,255 @@ def select_payload_schedule(topo, *, features: int,
     }
 
 
+# ---------------------------------------------------------------------
+# measured-probe autotune cache: band width x tile shape x remainder
+# route, timed on-device, persisted keyed by (plan hash, backend, jax)
+# ---------------------------------------------------------------------
+
+#: cache file override (tests point it at a tmpdir); default lives in
+#: the user cache so TPU pods reuse probes across runs
+AUTOTUNE_CACHE_ENV = "FLOW_UPDATING_AUTOTUNE_CACHE"
+#: '0' disables measured probing entirely (analytic ranking only)
+AUTOTUNE_ENV = "FLOW_UPDATING_AUTOTUNE"
+#: plan='auto' probes only above this node count: probing costs a few
+#: candidate compiles, worth paying exactly when the round itself is
+#: expensive (CI-scale graphs keep the analytic model)
+AUTOTUNE_MIN_NODES = 4096
+
+#: on-device timing probes run since import — conformance tests pin the
+#: cache-hit contract ("second select_plan call does ZERO probes") on it
+PROBE_COUNT = 0
+
+#: rounds per timing probe (one warm compile + this many timed rounds,
+#: twice — enough to beat scheduler noise at probe scale, cheap enough
+#: that a full candidate sweep stays a few seconds)
+PROBE_ROUNDS = 16
+
+#: once a candidate's WARM run alone exceeds this, its rate is taken
+#: from that run instead of a second timed pass — a pathological
+#: candidate (e.g. the Beneš remainder replayed on a CPU proxy at
+#: ~0.06 r/s for ba100k) must cost one bounded measurement, not two
+PROBE_BUDGET_S = 20.0
+
+
+def autotune_cache_path() -> str:
+    env = os.environ.get(AUTOTUNE_CACHE_ENV)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "flow_updating_tpu", "autotune.json")
+
+
+def _autotune_key(topo, backend: str, features: int, *,
+                  max_lanes: int, min_fill, remainder: str,
+                  dtype: str) -> str:
+    """Cache key: plan content hash x backend x jax version (x x64 —
+    lowering differs) x the probe configuration — payload dtype and the
+    plan-shaping knobs the probes ran under.  Any mismatch is a STALE
+    entry that must re-probe, never silently reuse (a record tuned on
+    gather-remainder f32 plans must not steer a benes-remainder or f64
+    call)."""
+    import jax
+
+    from flow_updating_tpu.plan.compile import _topo_key
+
+    tk = _topo_key(topo)
+    x64 = bool(jax.config.read("jax_enable_x64"))
+    mf = "auto" if min_fill is None else f"{float(min_fill):g}"
+    return (f"v1|{backend}|jax{jax.__version__}|x64:{int(x64)}|"
+            f"n{tk[0]}e{tk[1]}|{tk[2][:16]}|f{int(features)}|"
+            f"ml{int(max_lanes)}|mf{mf}|rem{remainder}|dt{dtype}")
+
+
+def _load_autotune_cache(path: str) -> dict:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_autotune_entry(path: str, key: str, entry: dict) -> None:
+    cache = _load_autotune_cache(path)
+    cache[key] = entry
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(cache, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _probe_rate(kernel_factory, rounds: int) -> float:
+    """Compile + warm one candidate, then time ``rounds`` rounds —
+    rounds/s on the ambient device.  Every call counts one probe."""
+    global PROBE_COUNT
+    import time as _time
+
+    import jax
+
+    PROBE_COUNT += 1
+    kern = kernel_factory()
+    state = kern.init_state()
+    t0 = _time.perf_counter()
+    jax.block_until_ready(kern.run(state, rounds))  # compile + warm
+    warm_s = _time.perf_counter() - t0
+    if warm_s > PROBE_BUDGET_S:
+        # slow enough that compile noise is irrelevant — and a second
+        # multi-minute pass would not change the ranking
+        return rounds / warm_s
+    t0 = _time.perf_counter()
+    jax.block_until_ready(kern.run(state, rounds))
+    return rounds / max(_time.perf_counter() - t0, 1e-9)
+
+
+def _fused_tile_candidates(plan) -> list:
+    """Tile heights worth probing for one plan: the heuristic default,
+    a 4x coarser tile (fewer grid steps, more VMEM), and the whole
+    array when it differs — all validated against the bandwidth."""
+    from flow_updating_tpu.ops.pallas_round import choose_block_rows
+
+    H = max((abs(d) for d in plan.spmv.offsets), default=0)
+    base = choose_block_rows(plan.spmv.n, H)
+    cands = [base]
+    if base * 4 * 128 < plan.spmv.n * 2:
+        cands.append(base * 4)
+    return sorted(set(cands))
+
+
+def autotune_fused(topo, cfg, *, backend: str | None = None,
+                   features: int = 0, max_lanes: int = 96,
+                   min_fill: float | None = None,
+                   remainder: str = "auto",
+                   cache_path: str | None = None,
+                   force: bool = False) -> dict:
+    """Measured-probe autotune for the banded family: time the unfused
+    banded executor and the one-kernel fused round over the band-width
+    (``min_fill``) x tile x remainder-route grid, on the ambient
+    device, and persist the record keyed by (plan content hash,
+    backend, jax version).  A cache hit returns the stored record with
+    ``probes_run == 0`` — the planner learns real rates once per
+    (graph, environment).
+
+    The record's ``measured_rounds_per_sec`` block uses the candidate
+    label space of :func:`select_plan` (``node/banded``,
+    ``node/banded_fused``) so ``doctor``'s ``plan_selection`` check can
+    judge the decision offline."""
+    import dataclasses as _dc
+
+    from flow_updating_tpu.models import sync
+
+    backend = _backend_name(backend)
+    path = cache_path or autotune_cache_path()
+    cg = GATHER_COST.get(backend, DEFAULT_GATHER_COST)
+    if remainder == "auto" and cg < 100.0:
+        # gather-friendly backends: probe the CPU/small-graph remainder
+        # form.  build_banded's own 'auto' upgrades to Beneš lanes
+        # whenever the native router exists — the right TPU call, but a
+        # pathological probe on a CPU proxy (~300x slower than the
+        # gather form at ba100k; measured, this PR)
+        remainder = "gather"
+    key = _autotune_key(topo, backend, features, max_lanes=max_lanes,
+                        min_fill=min_fill, remainder=remainder,
+                        dtype=str(cfg.dtype))
+    if not force:
+        hit = _load_autotune_cache(path).get(key)
+        if isinstance(hit, dict) and "measured_rounds_per_sec" in hit:
+            return {**hit, "probes_run": 0, "cache": "hit"}
+    base_fill = min_fill if min_fill is not None \
+        else float(np.clip(3.0 / cg, 1.0 / 64.0, 0.75))
+    # band-width axis: the selector's fill plus one coarser band set
+    # (fewer lanes, fatter remainder) when it changes the plan
+    fills = sorted({round(float(base_fill), 6),
+                    round(float(min(0.75, base_fill * 8)), 6)})
+    probes = 0
+    candidates: dict = {}
+    best = None
+    cfg_b = _dc.replace(cfg, kernel="node", spmv="banded")
+    cfg_f = _dc.replace(cfg, kernel="node", spmv="banded_fused")
+    plans = {}
+    for mf in fills:
+        plan = compile_topology(topo, max_lanes=max_lanes, min_fill=mf,
+                                remainder=remainder, features=features)
+        sig = (len(plan.spmv.offsets), plan.spmv.rem_mode)
+        if sig in plans:
+            continue        # a coarser fill that changed nothing
+        plans[sig] = (mf, plan)
+    for mf, plan in plans.values():
+        label_b = f"node/banded[min_fill={mf}]"
+        rate = _probe_rate(
+            lambda plan=plan: sync.NodeKernel(topo, cfg_b, plan=plan),
+            PROBE_ROUNDS)
+        probes += 1
+        candidates[label_b] = rate
+        if best is None or rate > best[0]:
+            best = (rate, "banded", mf, None, None)
+        routes = ["lanes"]
+        if plan.spmv.rem_mode in ("gather",):
+            routes.append("inline")
+        if plan.spmv.rem_mode == "none":
+            routes = ["auto"]
+        for tile in _fused_tile_candidates(plan):
+            for route in routes:
+                label = (f"node/banded_fused[min_fill={mf},tile={tile},"
+                         f"rem={route}]")
+                try:
+                    rate = _probe_rate(
+                        lambda plan=plan, tile=tile, route=route:
+                        sync.NodeKernel(topo, cfg_f, plan=plan,
+                                        fused_tile=tile,
+                                        fused_remainder=route),
+                        PROBE_ROUNDS)
+                except (ValueError, RuntimeError) as exc:
+                    candidates[f"{label}#error"] = \
+                        f"{type(exc).__name__}: {exc}"[:160]
+                    continue
+                probes += 1
+                candidates[label] = rate
+                if rate > best[0]:
+                    best = (rate, "banded_fused", mf, tile, route)
+    rate_banded = max((v for k, v in candidates.items()
+                       if isinstance(v, (int, float))
+                       and k.startswith("node/banded[")), default=0.0)
+    rate_fused = max((v for k, v in candidates.items()
+                      if isinstance(v, (int, float))
+                      and k.startswith("node/banded_fused[")),
+                     default=0.0)
+    entry = {
+        "key": key,
+        "backend": backend,
+        # the remainder route the probe plans were COMPILED with — the
+        # consumer must ship a plan of the same family (select_plan
+        # recompiles to match before applying best.fused_remainder)
+        "remainder": remainder,
+        "probe_rounds": PROBE_ROUNDS,
+        "candidates": {k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in candidates.items()},
+        "measured_rounds_per_sec": {
+            k: round(v, 3) for k, v in
+            (("node/banded", rate_banded),
+             ("node/banded_fused", rate_fused)) if v > 0},
+        "best": {
+            "spmv": "banded_fused" if best[1] == "banded_fused"
+            else "banded",
+            "min_fill": best[2],
+            "fused_tile": best[3],
+            "fused_remainder": best[4],
+            "rounds_per_sec": round(best[0], 3),
+        },
+        "probes_run": probes,
+    }
+    _store_autotune_entry(path, key, entry)
+    return {**entry, "cache": "miss"}
+
+
 def select_plan(topo, cfg, *, backend: str | None = None,
                 features: int = 0, probe: str = "analytic",
                 max_lanes: int = 96, min_fill: float | None = None,
-                remainder: str = "auto") -> PlanDecision:
+                remainder: str = "auto",
+                autotune: bool | None = None) -> PlanDecision:
     """Choose kernel/spmv for ``(topo, cfg, backend)``.
 
     Returns a :class:`PlanDecision`; ``decision.plan`` is the compiled
@@ -407,20 +672,82 @@ def select_plan(topo, cfg, *, backend: str | None = None,
             remainder = "benes"
     plan = compile_topology(topo, max_lanes=max_lanes, min_fill=min_fill,
                             remainder=remainder, features=features)
-    candidates = ["node/banded", "node/xla", "edge/gather"]
+    candidates = ["node/banded", "node/banded_fused", "node/xla",
+                  "edge/gather"]
     if probe == "aot":
         predicted = _aot_costs(topo, cfg, plan, candidates)
     else:
         predicted = _analytic_costs(topo, plan, backend, candidates)
-    best = min((c for c in candidates if c in predicted),
-               key=lambda c: predicted[c])
+
+    # measured probes (cached): band width x tile x remainder route
+    # timed on the ambient device — real rates replace the modeled
+    # banded-family ranking when available
+    if autotune is None:
+        autotune = (os.environ.get(AUTOTUNE_ENV, "1") != "0"
+                    and topo.num_nodes >= AUTOTUNE_MIN_NODES
+                    and backend == _backend_name(None))
+    tune = None
+    if autotune:
+        tune = autotune_fused(topo, cfg, backend=backend,
+                              features=features, max_lanes=max_lanes,
+                              min_fill=min_fill, remainder=remainder)
+        rates = tune.get("measured_rounds_per_sec", {})
+        rb, rf = rates.get("node/banded"), rates.get("node/banded_fused")
+        if rb and rf and "node/banded" in predicted:
+            # re-anchor the fused candidate on the measured ratio so it
+            # stays comparable with the analytic xla/edge entries
+            predicted["node/banded_fused"] = \
+                predicted["node/banded"] * rb / rf
+            predicted["node/banded_fused#measured"] = \
+                f"{rf:.4g} r/s vs banded {rb:.4g} r/s (probed)"
+    numeric = [c for c in candidates
+               if isinstance(predicted.get(c), (int, float))]
+    best = min(numeric, key=lambda c: predicted[c])
     kernel, _, impl = best.partition("/")
     s = plan.spmv
+    fused_kw = None
+    if impl == "banded_fused":
+        fused_kw = {"fused_tile": None, "fused_remainder": "auto"}
+        if tune is not None and \
+                tune.get("best", {}).get("spmv") == "banded_fused":
+            fused_kw = {"fused_tile": tune["best"].get("fused_tile"),
+                        "fused_remainder":
+                        tune["best"].get("fused_remainder") or "auto"}
+            mf = tune["best"].get("min_fill")
+            # ship the plan the probes actually RAN: the autotuner may
+            # have probed a different remainder family (gather on CPU
+            # proxies) or band width than the ranking plan — applying
+            # its tile/route knobs to a foreign plan would mis-build
+            # (inline route on a benes plan is a ValueError)
+            probed_rem = tune.get("remainder", remainder)
+            if (mf is not None and float(mf) != float(min_fill)) \
+                    or probed_rem != remainder:
+                plan = compile_topology(
+                    topo, max_lanes=max_lanes,
+                    min_fill=float(mf) if mf is not None else min_fill,
+                    remainder=probed_rem, features=features)
+                s = plan.spmv
+    fused_doc = None
+    if tune is not None:
+        fused_doc = {k: tune[k] for k in
+                     ("backend", "remainder", "candidates",
+                      "measured_rounds_per_sec", "best", "probes_run",
+                      "probe_rounds")
+                     if k in tune}
+        fused_doc["cache"] = tune.get("cache")
+    if fused_kw is not None:
+        fused_doc = dict(fused_doc or {})
+        fused_doc["chosen"] = fused_kw
     return PlanDecision(
         kernel=kernel, spmv=impl if kernel == "node" else None,
         plan=plan,  # losers keep the plan attached: stats feed manifests
         backend=backend, predicted=predicted,
-        reason=(f"{best} predicted cheapest on {backend} "
+        fused=fused_doc,
+        reason=(f"{best} "
+                + ("measured fastest" if tune is not None
+                   and best in ("node/banded", "node/banded_fused")
+                   else "predicted cheapest")
+                + f" on {backend} "
                 f"(bands cover {100 * s.coverage:.1f}% of edges in "
                 f"{len(s.offsets)} lane(s), remainder via "
                 f"{s.rem_mode}; bandwidth "

@@ -91,8 +91,31 @@ def main() -> int:
         print("plan_smoke: Engine(plan='auto') diverged from the edge "
               "kernel", file=sys.stderr)
         return 1
+
+    # 3b. the ONE-KERNEL fused round (spmv='banded_fused', Pallas
+    # interpret mode on this CPU run) must reproduce the unfused banded
+    # executor BIT-for-bit over a multi-round evolution — the shipped
+    # kernel is the tested kernel (tier-1 gate)
+    import dataclasses
+
+    from flow_updating_tpu.models import sync
+
+    cfg_node = RoundConfig.fast(variant="collectall", dtype="float64",
+                                kernel="node", spmv="banded")
+    kb = sync.NodeKernel(topo, cfg_node, plan=plan)
+    kf = sync.NodeKernel(
+        topo, dataclasses.replace(cfg_node, spmv="banded_fused"),
+        plan=plan)
+    est_b = kb.estimates(kb.run(kb.init_state(), args.rounds))
+    est_f = kf.estimates(kf.run(kf.init_state(), args.rounds))
+    if not np.array_equal(est_b, est_f):
+        print("plan_smoke: fused round is NOT bit-exact vs the banded "
+              f"executor (max delta {np.abs(est_b - est_f).max()})",
+              file=sys.stderr)
+        return 1
     print(json.dumps({"auto": eng.plan_report(),
-                      "bit_parity": True}), file=sys.stderr)
+                      "bit_parity": True, "fused_bit_parity": True}),
+          file=sys.stderr)
 
     # 4. plan manifest + doctor verdict
     manifest = os.path.join(args.outdir, "plan_ba.json")

@@ -47,6 +47,12 @@ def small6():
 # actually collected, so a renamed test fails loudly instead of silently
 # rejoining the default path.  Base names cover all parametrizations.
 SLOW_TESTS = {
+    "test_pallas_round.py": {
+        "test_fused_round_bit_exact_benes_remainder",
+        "test_fused_round_bit_exact_vs_banded_executor",
+        "test_fused_round_matches_edge_kernel",
+        "test_fused_round_vector_payload_bit_exact",
+    },
     "test_seg_benes.py": {
         "test_rounds_with_segment_benes_match", "test_full_benes_stack",
         "test_hub_degree_fused_scan_exact",

@@ -400,3 +400,29 @@ def test_regress_gates_scaling_efficiency():
     checks = regress.gate(_ladder_doc(0.85, 0.5),
                           history_pattern="/nonexistent/NOPE_*.json")
     assert all(c.status == health.SKIP for c in checks)
+
+
+def test_compiler_params_shim_resolved_at_import():
+    """The Mosaic params class is resolved ONCE at import; a jax that
+    renamed it must fail with the version NAMED, not silently drop the
+    collective id (the PR-8 'best effort' fallback, hardened)."""
+    import jax
+
+    from flow_updating_tpu.ops import pallas_halo
+
+    # this jax exposes one of the known names — resolution succeeded
+    assert pallas_halo._COMPILER_PARAMS_CLS is not None
+    params = pallas_halo.require_compiler_params(collective_id=3)
+    assert params.collective_id == 3
+
+    # simulate the class vanishing in a future jax: the error names the
+    # running jax version and the probed attribute names
+    saved = pallas_halo._COMPILER_PARAMS_CLS
+    try:
+        pallas_halo._COMPILER_PARAMS_CLS = None
+        with pytest.raises(RuntimeError) as err:
+            pallas_halo.require_compiler_params(collective_id=0)
+        assert jax.__version__ in str(err.value)
+        assert "TPUCompilerParams" in str(err.value)
+    finally:
+        pallas_halo._COMPILER_PARAMS_CLS = saved

@@ -1,0 +1,439 @@
+"""One-kernel banded round (ops/pallas_round.py) + autotune cache.
+
+The guarantees under test:
+
+* the fused round — fire, band delivery, remainder, ledger merge in ONE
+  ``pallas_call`` (interpret mode on this CPU suite, so the SHIPPED
+  kernel is what runs) — evolves BIT-for-bit like the unfused banded
+  executor: scalar and vector payloads, every remainder mode, single
+  tile and multi-tile grids;
+* the in-kernel bucketed-gather remainder reproduces the plan's
+  neighbor sum exactly on integer-valued payloads (float addition is
+  order-independent there) and the whole fused round tracks the edge
+  kernel at the node-kernel tolerance;
+* the SHARDED fused round (one remote-DMA kernel per shard,
+  ``parallel/banded_sharded.py``) is bit-exact vs its ``ppermute``
+  oracle on the virtual CPU mesh AND vs the single-device banded
+  executor, with exactly one ``pallas_call`` per shard in the lowered
+  round body;
+* the measured-probe autotune cache: a warm cache re-ranks with ZERO
+  probes, a stale key (different jax version / backend) re-probes,
+  ``Engine(plan='auto')`` threads the measured choice with zero hand
+  flags, and ``doctor``'s ``plan_selection`` judges from the cached
+  rates.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models import sync
+from flow_updating_tpu.plan import compile_topology, select_plan
+from flow_updating_tpu.plan import select as plan_select
+from flow_updating_tpu.plan.banded import banded_neighbor_sum
+from flow_updating_tpu.topology.generators import (
+    barabasi_albert,
+    community,
+    ring,
+)
+
+
+def _pair(topo, plan, rounds=37, values=None, tile=None, rem="auto",
+          dtype="float64"):
+    cfg_b = RoundConfig.fast(kernel="node", spmv="banded", dtype=dtype)
+    cfg_f = dataclasses.replace(cfg_b, spmv="banded_fused")
+    kb = sync.NodeKernel(topo, cfg_b, plan=plan, values=values)
+    kf = sync.NodeKernel(topo, cfg_f, plan=plan, values=values,
+                         fused_tile=tile, fused_remainder=rem)
+    eb = kb.estimates(kb.run(kb.init_state(), rounds))
+    ef = kf.estimates(kf.run(kf.init_state(), rounds))
+    return eb, ef, kf
+
+
+# ---------------------------------------------------------------------
+# single-device fused round
+# ---------------------------------------------------------------------
+
+def test_fused_round_bit_exact_vs_banded_executor():
+    """Whole-round evolution parity, both dtypes, gather remainder."""
+    topo = community(200, 4, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    for dtype in ("float64", "float32"):
+        eb, ef, kf = _pair(topo, plan, rounds=21, dtype=dtype)
+        assert np.array_equal(eb, ef), (
+            f"fused round diverged from the banded executor "
+            f"({dtype}): max delta {np.abs(eb - ef).max()}")
+        assert kf.arrays.ns_fused.rem_route == "lanes"
+
+
+def test_fused_round_bit_exact_benes_remainder():
+    """The Beneš-lanes remainder route rides outside the kernel and
+    keeps bit-parity (the default plan on gather-hostile backends)."""
+    topo = community(300, 4, seed=1)
+    plan = compile_topology(topo, remainder="benes")
+    if plan.spmv.rem_mode != "benes":
+        pytest.skip("native router unavailable: no benes remainder")
+    eb, ef, _ = _pair(topo, plan)
+    assert np.array_equal(eb, ef)
+
+
+def test_fused_round_tiled_grid_bit_exact():
+    """Multi-tile grid: halo windows + clamped boundary tiles."""
+    topo = ring(6000, seed=0)
+    plan = compile_topology(topo)
+    eb, ef, kf = _pair(topo, plan, tile=8)
+    assert kf.arrays.ns_fused.grid > 1
+    assert np.array_equal(eb, ef)
+
+
+def test_fused_round_vector_payload_bit_exact():
+    topo = community(200, 4, seed=0)
+    vals = np.linspace(0.0, 3.0, topo.num_nodes * 3).reshape(-1, 3)
+    plan = compile_topology(topo, features=3)
+    eb, ef, _ = _pair(topo, plan, rounds=21, values=vals)
+    assert eb.shape == (topo.num_nodes, 3)
+    assert np.array_equal(eb, ef)
+
+
+def test_fused_inline_remainder_exact_on_integers():
+    """rem_route='inline': the in-kernel bucketed gather reproduces the
+    plan's neighbor sum bit-for-bit on an integer payload (where float
+    addition is exact regardless of order)."""
+    from flow_updating_tpu.ops.pallas_round import (
+        build_fused_leaves,
+        fused_banded_round,
+        plan_fused_round,
+    )
+
+    topo = barabasi_albert(300, 3, seed=1)
+    plan = compile_topology(topo, remainder="gather")
+    assert plan.spmv.rem_mode == "gather"
+    spec = plan_fused_round(plan.spmv, rem_route="inline")
+    leaves = build_fused_leaves(plan.spmv, plan.leaves, spec)
+    x = np.zeros(spec.P)
+    x[:topo.num_nodes] = np.arange(1, topo.num_nodes + 1)
+    z = jnp.zeros(spec.P)
+    ones = jnp.ones(spec.P)
+    # value=x, S=A_prev=0, inv=1 makes the in-kernel avg equal x, so
+    # the A output IS the fused neighbor sum of x
+    _, _, _, A = fused_banded_round(z, z, z, z, jnp.asarray(x), ones, z,
+                                    leaves, spec)
+    ref = banded_neighbor_sum(jnp.asarray(x), plan.spmv, plan.leaves)
+    got = np.asarray(A)[:topo.num_nodes]
+    assert np.array_equal(got, np.asarray(ref)[:topo.num_nodes])
+
+
+def test_fused_round_matches_edge_kernel():
+    """After unpermutation the fused trajectory tracks the general edge
+    kernel at the node-kernel tolerance (same bar as spmv='xla')."""
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+
+    topo = community(200, 4, seed=2)
+    plan = compile_topology(topo, remainder="gather")
+    cfg = RoundConfig.fast(dtype="float64")
+    est = np.asarray(node_estimates(
+        run_rounds(init_state(topo, cfg), topo.device_arrays(), cfg, 21),
+        topo.device_arrays()))
+    _, ef, _ = _pair(topo, plan, rounds=21)
+    np.testing.assert_allclose(ef, est, rtol=1e-9, atol=1e-9)
+
+
+def test_fused_spec_validation():
+    from flow_updating_tpu.ops.pallas_round import (
+        choose_block_rows,
+        plan_fused_round,
+    )
+
+    topo = community(300, 4, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    with pytest.raises(ValueError, match="power of two"):
+        choose_block_rows(300, 10, block_rows=12)
+    with pytest.raises(ValueError, match="bandwidth"):
+        choose_block_rows(100_000, 5000, block_rows=8)
+    with pytest.raises(ValueError, match="remainder"):
+        plan_fused_round(plan.spmv, rem_route="none")
+    benes_plan = compile_topology(topo, remainder="benes")
+    if benes_plan.spmv.rem_mode == "benes":
+        with pytest.raises(ValueError, match="inline"):
+            plan_fused_round(benes_plan.spmv, rem_route="inline")
+
+
+def test_fused_round_requires_remainder_addend():
+    from flow_updating_tpu.ops.pallas_round import (
+        build_fused_leaves,
+        fused_banded_round,
+        plan_fused_round,
+    )
+
+    topo = community(300, 4, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    spec = plan_fused_round(plan.spmv, rem_route="lanes")
+    leaves = build_fused_leaves(plan.spmv, plan.leaves, spec)
+    z = jnp.zeros(spec.P)
+    with pytest.raises(ValueError, match="a_rem"):
+        fused_banded_round(z, z, z, z, z, z, z, leaves, spec)
+
+
+def test_fused_round_report_attribution():
+    """plan_report embeds the fused HBM attribution; the fused program
+    claims strictly fewer passes per round than the unfused executor."""
+    from flow_updating_tpu.obs.profile import fused_round_report
+
+    topo = community(300, 4, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    cfg = RoundConfig.fast(kernel="node", spmv="banded_fused")
+    kern = sync.NodeKernel(topo, cfg, plan=plan)
+    rep = fused_round_report(kern)
+    assert rep is not None and rep["bytes_per_round"] > 0
+    assert rep["passes_per_round"] < rep["unfused_passes_per_round"]
+    # non-fused kernels report None (the caller embeds conditionally)
+    kb = sync.NodeKernel(topo, dataclasses.replace(cfg, spmv="banded"),
+                         plan=plan)
+    assert fused_round_report(kb) is None
+
+
+# ---------------------------------------------------------------------
+# sharded: one kernel per shard
+# ---------------------------------------------------------------------
+
+def _sharded_pair(topo, plan, shards=2, rounds=29):
+    from flow_updating_tpu.parallel.banded_sharded import (
+        ShardedBandedKernel,
+    )
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    cfg = RoundConfig.fast(kernel="node", spmv="banded_fused",
+                           dtype="float64")
+    mesh = make_mesh(shards)
+    kp = ShardedBandedKernel(topo, cfg, mesh, plan=plan,
+                             exchange="ppermute")
+    kk = ShardedBandedKernel(topo, cfg, mesh, plan=plan,
+                             exchange="pallas")
+    ep = kp.estimates(kp.run(kp.init_state(), rounds))
+    ek = kk.estimates(kk.run(kk.init_state(), rounds))
+    return ep, ek, kk
+
+
+def test_sharded_pallas_bit_exact_vs_ppermute():
+    """The acceptance bar: one remote-DMA kernel per shard, interpret
+    mode on the 2-shard CPU mesh, bit-exact vs the XLA oracle."""
+    topo = community(4000, 8, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    ep, ek, _ = _sharded_pair(topo, plan)
+    assert np.array_equal(ep, ek), (
+        f"sharded fused kernel diverged from ppermute oracle: "
+        f"max delta {np.abs(ep - ek).max()}")
+
+
+def test_sharded_matches_single_device_banded():
+    topo = community(4000, 8, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    ep, ek, _ = _sharded_pair(topo, plan, shards=4)
+    cfg_b = RoundConfig.fast(kernel="node", spmv="banded",
+                             dtype="float64")
+    kb = sync.NodeKernel(topo, cfg_b, plan=plan)
+    eb = kb.estimates(kb.run(kb.init_state(), 29))
+    np.testing.assert_allclose(ek, eb, rtol=1e-12, atol=1e-12)
+
+
+def test_sharded_one_pallas_call_per_shard():
+    """The lowered round body carries exactly ONE pallas_call — the
+    whole fire/exchange/delivery/merge round is a single kernel per
+    shard."""
+    from flow_updating_tpu.parallel.banded_sharded import (
+        ShardedBandedKernel,
+    )
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = community(4000, 8, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    cfg = RoundConfig.fast(kernel="node", spmv="banded_fused")
+    kern = ShardedBandedKernel(topo, cfg, make_mesh(2), plan=plan,
+                               exchange="pallas")
+    fn, args, nd = kern.round_program(kern.init_state(), 3)
+    jx = fn.trace(*args).jaxpr if hasattr(fn, "trace") else \
+        jax.make_jaxpr(fn)(*args)
+
+    def count(jaxpr, prim):
+        hits = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == prim:
+                hits += 1
+            for v in eqn.params.values():
+                for sub in jax.tree_util.tree_leaves(
+                        v, is_leaf=lambda x: hasattr(x, "eqns")):
+                    if hasattr(sub, "eqns"):
+                        hits += count(sub, prim)
+                    elif hasattr(sub, "jaxpr"):
+                        hits += count(sub.jaxpr, prim)
+        return hits
+
+    inner = jx.jaxpr if hasattr(jx, "jaxpr") else jx
+    assert count(inner, "pallas_call") == 1
+
+
+def test_sharded_validation():
+    from flow_updating_tpu.parallel.banded_sharded import (
+        ShardedBandedKernel,
+    )
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = community(4000, 8, seed=0)
+    cfg = RoundConfig.fast(kernel="node", spmv="banded_fused")
+    benes_plan = compile_topology(topo, remainder="benes")
+    if benes_plan.spmv.rem_mode == "benes":
+        with pytest.raises(ValueError, match="gather"):
+            ShardedBandedKernel(topo, cfg, make_mesh(2), plan=benes_plan)
+    with pytest.raises(ValueError, match="banded_fused"):
+        ShardedBandedKernel(
+            topo, dataclasses.replace(cfg, spmv="banded"), make_mesh(2))
+    with pytest.raises(ValueError, match="exchange"):
+        ShardedBandedKernel(topo, cfg, make_mesh(2),
+                            exchange="telepathy")
+    # the single-device NodeKernel names this class as the mesh path
+    with pytest.raises(ValueError, match="ShardedBandedKernel"):
+        sync.NodeKernel(topo, cfg, mesh=make_mesh(2))
+
+
+def test_engine_dispatches_sharded_fused():
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.parallel.banded_sharded import (
+        ShardedBandedKernel,
+    )
+    from flow_updating_tpu.parallel.mesh import make_mesh
+
+    topo = community(4000, 8, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    cfg = RoundConfig.fast(kernel="node", spmv="banded_fused",
+                           dtype="float64")
+    eng = Engine(config=cfg, mesh=make_mesh(2)).set_topology(topo)
+    eng.build()
+    assert isinstance(eng._node_kernel, ShardedBandedKernel)
+    eng.run_rounds(29)
+    ep, _, _ = _sharded_pair(topo, plan)
+    assert np.array_equal(eng.estimates(), ep)
+
+
+# ---------------------------------------------------------------------
+# autotune cache
+# ---------------------------------------------------------------------
+
+@pytest.fixture()
+def tune_cache(tmp_path, monkeypatch):
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv(plan_select.AUTOTUNE_CACHE_ENV, path)
+    # short probes: the cache/stale-key CONTRACT is under test, not the
+    # timing fidelity (gather remainder keeps candidate compiles cheap)
+    monkeypatch.setattr(plan_select, "PROBE_ROUNDS", 4)
+    plan_select.PROBE_COUNT = 0
+    return path
+
+
+def _tune_topo():
+    # small enough that every candidate compiles fast, and its directed
+    # edge count stays under the Benes-remainder auto threshold
+    return community(400, 4, seed=0)
+
+
+def test_autotune_cache_hit_zero_probes(tune_cache):
+    topo = _tune_topo()
+    cfg = RoundConfig.fast(kernel="node")
+    d1 = select_plan(topo, cfg, autotune=True, remainder="gather")
+    first = plan_select.PROBE_COUNT
+    assert first > 0
+    assert d1.fused["cache"] == "miss"
+    assert d1.fused["probes_run"] == first
+    d2 = select_plan(topo, cfg, autotune=True, remainder="gather")
+    assert plan_select.PROBE_COUNT == first, \
+        "second select_plan call must run ZERO probes (cache hit)"
+    assert d2.fused["cache"] == "hit"
+    assert d2.fused["probes_run"] == 0
+    # the persisted record carries the measured label space
+    assert set(d1.fused["measured_rounds_per_sec"]) >= {"node/banded"}
+
+
+def test_autotune_stale_key_invalidation(tune_cache):
+    topo = _tune_topo()
+    cfg = RoundConfig.fast(kernel="node")
+    select_plan(topo, cfg, autotune=True, remainder="gather")
+    # rewrite every key as if probed under a different jax: a stale
+    # entry must re-probe, never silently reuse
+    doc = json.load(open(tune_cache))
+    stale = {k.replace(f"jax{jax.__version__}", "jax0.0.0"): v
+             for k, v in doc.items()}
+    json.dump(stale, open(tune_cache, "w"))
+    before = plan_select.PROBE_COUNT
+    d = select_plan(topo, cfg, autotune=True, remainder="gather")
+    assert d.fused["cache"] == "miss"
+    assert plan_select.PROBE_COUNT > before
+
+
+def test_autotune_corrupt_cache_reprobes(tune_cache):
+    topo = _tune_topo()
+    cfg = RoundConfig.fast(kernel="node")
+    with open(tune_cache, "w") as fh:
+        fh.write("{ not json")
+    d = select_plan(topo, cfg, autotune=True, remainder="gather")
+    assert d.fused["cache"] == "miss"
+    assert plan_select.PROBE_COUNT > 0
+
+
+def test_engine_plan_auto_threads_measured_choice(tune_cache,
+                                                 monkeypatch):
+    """Engine(plan='auto') with zero hand flags: probes once, reuses
+    the cached decision, and the NodeKernel it builds carries the
+    autotuned knobs."""
+    from flow_updating_tpu.engine import Engine
+
+    monkeypatch.setattr(plan_select, "AUTOTUNE_MIN_NODES", 0)
+    topo = _tune_topo()
+    cfg = RoundConfig.fast(kernel="node", dtype="float64")
+    eng = Engine(config=cfg, plan="auto").set_topology(topo).build()
+    rep = eng.plan_report()
+    assert rep["autotune"]["probes_run"] > 0
+    first = plan_select.PROBE_COUNT
+    eng2 = Engine(config=cfg, plan="auto").set_topology(topo).build()
+    assert plan_select.PROBE_COUNT == first, \
+        "warm cache: the second engine build must probe zero times"
+    assert eng2.plan_report()["autotune"]["cache"] == "hit"
+    # dynamics untouched, estimates agree with the edge kernel
+    eng.run_rounds(20)
+    from flow_updating_tpu.models.rounds import node_estimates, run_rounds
+    from flow_updating_tpu.models.state import init_state
+
+    e_cfg = RoundConfig.fast(dtype="float64")
+    est = np.asarray(node_estimates(
+        run_rounds(init_state(topo, e_cfg), topo.device_arrays(),
+                   e_cfg, 20), topo.device_arrays()))
+    np.testing.assert_allclose(eng.estimates(), est, rtol=1e-9,
+                               atol=1e-9)
+
+
+def test_doctor_plan_selection_judges_from_autotune():
+    from flow_updating_tpu.obs.health import check_plan
+
+    plan_doc = {
+        "kernel": "node", "spmv": "banded_fused",
+        "autotune": {"measured_rounds_per_sec": {
+            "node/banded": 100.0, "node/banded_fused": 180.0}},
+    }
+    res = check_plan(plan_doc)
+    assert res.status == "pass"
+    assert "fastest measured" in res.summary
+    # the same record with the slower family chosen must WARN
+    slower = dict(plan_doc, spmv="banded")
+    res = check_plan(slower)
+    assert res.status == "warn"
+    assert "slower plan" in res.summary
+    # an analytic pick outside the probed family stays un-judged
+    outside = dict(plan_doc, spmv="xla")
+    res = check_plan(outside)
+    assert res.status == "pass"
+    assert "predicted only" in res.summary

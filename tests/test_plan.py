@@ -254,9 +254,14 @@ def test_select_banded_benes_on_irregular_graphs_for_tpu():
     cfg = RoundConfig.fast(variant="collectall")
     for _, make in IRREGULAR[:3]:   # ba / er / community
         d = select_plan(make(), cfg, backend="tpu")
-        assert (d.kernel, d.spmv) == ("node", "banded")
+        # the banded FAMILY must win on TPU; since the one-kernel fused
+        # round shipped it predicts at or below the unfused executor
+        assert d.kernel == "node"
+        assert d.spmv in ("banded", "banded_fused")
         assert d.plan.spmv.rem_mode in ("benes", "none")
-        assert d.predicted["node/banded"] <= d.predicted["node/xla"]
+        assert min(d.predicted["node/banded"],
+                   d.predicted["node/banded_fused"]) \
+            <= d.predicted["node/xla"]
 
 
 def test_select_respects_edge_only_dynamics():
@@ -447,7 +452,8 @@ def test_plan_cli_and_manifest_roundtrip(tmp_path, capsys):
                    "--report", str(report)])
     assert rc == 0
     doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert doc["kernel"] == "node" and doc["spmv"] == "banded"
+    assert doc["kernel"] == "node"
+    assert doc["spmv"] in ("banded", "banded_fused")
     manifest = json.loads(report.read_text())
     assert manifest["schema"] == PLAN_SCHEMA
     checks = health.diagnose_manifest(manifest)
