@@ -1055,6 +1055,148 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
     }
 
 
+def measure_aggregate_serve(topo, lanes: int, segment_rounds: int,
+                            rate: float, eps: float, windows: int = 3,
+                            window_segments: int = 16,
+                            cohort_frac: float = 0.25,
+                            qeps: float = 0.34) -> dict:
+    """Aggregate-algebra row: sustained mixed-kind aggregates/s of the
+    AggregateFabric (flow_updating_tpu.aggregates) under Poisson
+    arrival + lane churn.
+
+    Same closed loop as :func:`measure_query_serve` but every
+    submission cycles through the four value kinds (sum/count pair,
+    max, min, ε-quantile bracket bank), so one timed run produces a
+    per-kind completions/s breakdown on ONE compiled program
+    (``compile_count <= 2``: the plain program plus the one-time
+    extrema ``lane_modes`` install).  Two standing windowed means ride
+    the whole run as background load — pushed a fresh sample batch per
+    window — so the churn measurement includes live restreams; standing
+    lanes never retire and never count as completions.
+    """
+    import jax
+    import numpy as np
+
+    from flow_updating_tpu.aggregates import AggregateFabric
+
+    kinds = ("sum_count", "max", "min", "quantile")
+    rng = np.random.default_rng(0)
+    fab = AggregateFabric(topo, lanes=lanes, capacity=topo.num_nodes,
+                          segment_rounds=segment_rounds, conv_eps=eps)
+    members = fab.svc.live_ids()
+    m = max(1, int(round(len(members) * cohort_frac)))
+    submitted = 0
+
+    def submit(k: int) -> None:
+        nonlocal submitted
+        for _ in range(k):
+            kind = kinds[submitted % len(kinds)]
+            submitted += 1
+            cohort = np.sort(rng.choice(members, size=m, replace=False))
+            params = ({"q": 0.5, "qeps": qeps}
+                      if kind == "quantile" else {})
+            fab.submit_aggregate(kind, rng.random(m), cohort, **params)
+
+    def done_aggs() -> list:
+        return [a for a in fab._aggs.values()
+                if a["_window"] is None
+                and all(fab._queries[q]["status"] == "done"
+                        for q in a["qids"])]
+
+    # lanes per cycled 4-kind batch: 2 (sum/count) + 1 + 1 + quantile
+    # brackets; the mean feeds the warmup fill and rate calibration
+    brackets = int(np.ceil(1.0 / qeps))
+    lanes_per_agg = (2 + 1 + 1 + brackets) / len(kinds)
+    standing = [fab.submit_aggregate("windowed_mean", rng.random(m),
+                                     np.sort(rng.choice(
+                                         members, size=m,
+                                         replace=False)), window=4)
+                for _ in range(2)]
+    churn_lanes = lanes - 2          # minus the standing windowed pair
+    fill = max(len(kinds), int(churn_lanes / lanes_per_agg))
+
+    # warmup: fill the churn lanes with mixed kinds, drain to measure
+    # rounds-to-retire (also the compile pass — the extrema install
+    # lands here, so the timed windows run on the settled program)
+    t0 = time.perf_counter()
+    submit(fill)
+    warm_rounds = 0
+    while (len(done_aggs()) < fill
+           and warm_rounds < 100 * segment_rounds):
+        fab.run(segment_rounds)
+        warm_rounds += segment_rounds
+    compile_s = time.perf_counter() - t0
+    done = done_aggs()
+    mean_rounds = (sum(max(fab._queries[q]["result"]["rounds"]
+                           for q in a["qids"]) for a in done)
+                   / max(len(done), 1)) or float(segment_rounds)
+    if rate <= 0:
+        rate = 0.8 * (churn_lanes / lanes_per_agg) / mean_rounds
+
+    def window(k: int) -> tuple:
+        start_done = len(done_aggs())
+        for aid in standing:
+            fab.push(aid, rng.random(m))
+        t0 = time.perf_counter()
+        for _ in range(k):
+            submit(int(rng.poisson(rate * segment_rounds)))
+            fab.run(segment_rounds)
+        return (len(done_aggs()) - start_done,
+                time.perf_counter() - t0)
+
+    window(max(2, int(np.ceil(mean_rounds / segment_rounds))))
+    rates, completions = [], 0
+    for attempt in range(3):
+        rates, completions = [], 0
+        for _ in range(max(windows, 1)):
+            got, wall = window(window_segments)
+            completions += got
+            rates.append(got / wall)
+        mean = sum(rates) / len(rates)
+        spread = 100 * (max(rates) - min(rates)) / mean if mean else 0.0
+        if spread <= SPREAD_VALIDITY_PCT or attempt == 2:
+            break
+        window_segments *= 2
+    per_kind = {k: 0 for k in kinds}
+    for a in done_aggs():
+        per_kind[a["kind"]] += 1
+    total_done = max(sum(per_kind.values()), 1)
+    block = fab.query_block()
+    return {
+        "aggregates_per_sec": mean,
+        "aggregates_per_sec_min": min(rates),
+        "aggregates_per_sec_max": max(rates),
+        # completed-mix share scales the blended rate into per-kind
+        # rows without timing each kind in isolation (same program)
+        "per_kind_per_sec": {k: mean * per_kind[k] / total_done
+                             for k in kinds},
+        "per_kind_completed": per_kind,
+        "spread_pct": round(spread, 1),
+        "windows": len(rates),
+        "window_segments": window_segments,
+        "segment_rounds": segment_rounds,
+        "completions": completions,
+        "offered_rate_per_round": round(rate, 4),
+        "mean_rounds_to_retire": round(mean_rounds, 1),
+        "lanes": lanes,
+        "standing_lanes": 2,
+        "restreams": sum(len(fab._aggs[aid]["restreams"])
+                         for aid in standing),
+        "cohort_size": m,
+        "eps": eps,
+        "qeps": qeps,
+        "compile_count": fab.compile_count,
+        "extrema_installed": fab.extrema_installed,
+        "compile_s": round(compile_s, 3),
+        "admitted_total": fab.admitted_total,
+        "retired_total": fab.retired_total,
+        "admission_p95": block["admission_latency"].get("p95"),
+        "queued_at_end": fab.queued,
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def measure_recovery(topo, lanes: int, segment_rounds: int,
                      eps: float, repeats: int = 3) -> dict:
     """Crash-recovery row: recovery-time-to-first-read of a
@@ -1170,6 +1312,61 @@ def run_serve_bench(args) -> dict:
                                                 if base_rps else None),
                 "baseline_source": base_src,
                 "baseline_key": _baseline_key(base_key),
+            },
+        }
+    if args.aggregates:
+        sv = measure_aggregate_serve(topo, lanes, args.segment_rounds,
+                                     args.serve_rate, args.serve_eps)
+        slug = (f"{nodes // 1000}k" if nodes % 1000 == 0
+                else str(nodes))
+        # one row per kind in the disjoint agg_<kind>_* family — the
+        # mixed-kind run shares one program, so the per-kind rates are
+        # the blended rate split by completed mix; agg_* never shadows
+        # the plain-fabric qps_* records
+        gated = sv["spread_pct"] <= SPREAD_VALIDITY_PCT
+        kind_keys = {}
+        for kind, kps in sv["per_kind_per_sec"].items():
+            base_key = f"agg_{kind}_er{slug}_l{lanes}"
+            kind_keys[kind] = _baseline_key(base_key)
+            if gated:
+                record_baseline(base_key, baseline_entry(topo, {
+                    "rounds_per_sec": kps,
+                    "ticks": sv["per_kind_completed"][kind],
+                    "repeats": sv["windows"],
+                    "spread_pct": sv["spread_pct"],
+                    "note": (f"sustained {kind} aggregates/s of the "
+                             "mixed-kind aggregate fabric (Poisson "
+                             "arrival + lane churn + standing "
+                             "windowed restreams; not a DES "
+                             "measurement)"),
+                }))
+        head_key = f"agg_sum_count_er{slug}_l{lanes}"
+        base_rps = recorded_baseline(head_key)
+        base_src = "recorded" if base_rps is not None else "measured"
+        if base_rps is None:
+            base_rps = sv["per_kind_per_sec"]["sum_count"]
+        return {
+            "metric": (f"aggregate-fabric mixed-kind aggregates/sec "
+                       f"under Poisson arrival + lane churn (ER "
+                       f"{nodes} nodes, {lanes} lanes, "
+                       f"{sv['completions']} completions, "
+                       f"{sv['compile_count']} compiles)"),
+            "value": round(sv["aggregates_per_sec"], 2),
+            "unit": "aggregates/sec",
+            "backend": {"axon": "tpu"}.get(sv["platform"],
+                                           sv["platform"]),
+            "vs_baseline": (round(
+                sv["per_kind_per_sec"]["sum_count"] / base_rps, 3)
+                if base_rps else None),
+            "extra": {
+                "nodes": topo.num_nodes,
+                "directed_edges": topo.num_edges,
+                "serve": {k: (round(v, 4) if isinstance(v, float)
+                              else v) for k, v in sv.items()},
+                "baseline_sum_count_per_sec": (round(base_rps, 4)
+                                               if base_rps else None),
+                "baseline_source": base_src,
+                "baseline_keys": kind_keys,
             },
         }
     sv = measure_query_serve(topo, lanes, args.segment_rounds,
@@ -1972,6 +2169,15 @@ def parse_args(argv=None):
     ap.add_argument("--serve-eps", type=float, default=1e-4,
                     help="with --serve: per-query convergence "
                          "tolerance (relative estimate spread)")
+    ap.add_argument("--aggregates", action="store_true",
+                    help="with --serve: aggregate-algebra variant — "
+                         "mixed-kind closed loop (sum/count, max, min, "
+                         "ε-quantile cycled per submission, two "
+                         "standing windowed means restreaming as "
+                         "background load) on one AggregateFabric "
+                         "program; records per-kind completions/s "
+                         "under the disjoint 'agg_<kind>_er<N>_l<L>' "
+                         "baseline family (never shadows 'qps_*')")
     ap.add_argument("--chaos", default=None, choices=("kill",),
                     help="with --serve: crash-recovery variant — arm "
                          "the fabric's WAL + checkpoint ring, abandon "
@@ -2034,6 +2240,12 @@ def parse_args(argv=None):
     if args.chaos and not args.serve:
         ap.error("--chaos is a --serve variant (the crash-recovery "
                  "row measures the query fabric); add --serve")
+    if args.aggregates and not args.serve:
+        ap.error("--aggregates is a --serve variant (the aggregate-"
+                 "algebra row measures the lane fabric); add --serve")
+    if args.aggregates and args.chaos:
+        ap.error("--aggregates and --chaos are distinct --serve "
+                 "variants; pick one")
     if (args.serve_lanes != 256 or args.serve_nodes != 2048
             or args.serve_rate or args.serve_eps != 1e-4) \
             and not args.serve:

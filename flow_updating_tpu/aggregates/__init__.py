@@ -1,0 +1,29 @@
+"""Aggregate algebra over the query fabric: sum/count, min/max
+consensus, ε-quantiles and windowed means on ONE compiled program
+(docs/AGGREGATES.md)."""
+
+from flow_updating_tpu.aggregates.fabric import AggregateFabric
+from flow_updating_tpu.aggregates.registry import (
+    KINDS,
+    MODE_MAX,
+    MODE_MEAN,
+    MODE_MIN,
+    AggregatePlan,
+    AggregateSpec,
+    get_kind,
+    register,
+)
+from flow_updating_tpu.aggregates.scenarios import (
+    AGG_SCENARIOS,
+    AggScenario,
+    aggregate_scenario_manifest,
+    run_aggregate_scenario,
+    run_aggregate_scenarios,
+)
+
+__all__ = [
+    "AGG_SCENARIOS", "AggScenario", "AggregateFabric", "AggregatePlan",
+    "AggregateSpec", "KINDS", "MODE_MAX", "MODE_MEAN", "MODE_MIN",
+    "aggregate_scenario_manifest", "get_kind", "register",
+    "run_aggregate_scenario", "run_aggregate_scenarios",
+]

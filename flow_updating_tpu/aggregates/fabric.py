@@ -1,0 +1,397 @@
+"""The aggregate fabric: the kind algebra composed over the query fabric.
+
+:class:`AggregateFabric` subclasses :class:`~flow_updating_tpu.query.
+fabric.QueryFabric` and maps every registered aggregate kind
+(aggregates/registry.py) onto lanes of the ONE compiled service program:
+
+* ``submit_aggregate(kind, values, ...)`` encodes the submission into
+  lane columns via the kind's :class:`AggregateSpec` and submits each
+  as an ordinary fabric query (admission stays a value-column write —
+  zero recompiles for the value-side kinds);
+* lanes carrying an extrema kind set their entry in
+  ``TopoArrays.lane_modes`` — installed LAZILY on the first extrema
+  admission (pytree structure changes exactly once: ``compile_count``
+  goes from 1 to 2 and stays there; a fabric that never sees an
+  extrema kind keeps the byte-identical plain program at 1).  After
+  installation every mode change (admission, retirement scrub,
+  recycling across kinds) is an ``.at[]`` data edit;
+* ``read_aggregate`` combines the per-lane reads through the kind's
+  read contract (error bounds included); ``push`` restreams a standing
+  windowed lane between segments with a bitwise mass-neutrality assert
+  on the lane's ledger residual;
+* the per-boundary lane-probe reduction vectors are recorded into the
+  manifest (``probe_rows``) so the read-side aggregate math is
+  auditable offline — the doctor's ``aggregate_read`` checks
+  (obs/health.check_aggregate_read).
+
+Bit-exactness inherits from the fabric: the control plane is
+payload-independent and per-lane dynamics never cross lanes, so a lane
+of a mixed-kind fabric is bit-identical to the same kind running alone
+(tests/test_aggregates.py pins this under drop > 0 + churn + recycling,
+including a recycled mean lane re-admitted as a max lane).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flow_updating_tpu.aggregates.registry import (
+    KINDS,
+    MODE_MEAN,
+    get_kind,
+)
+from flow_updating_tpu.query.fabric import QueryFabric
+
+__all__ = ["AggregateFabric"]
+
+
+class AggregateFabric(QueryFabric):
+    """A multi-kind aggregation service over one compiled round program
+    (module docstring; docs/AGGREGATES.md).  Constructor parameters are
+    :class:`QueryFabric`'s; plain ``submit`` queries coexist with
+    aggregates on the same fabric."""
+
+    def __init__(self, topo, **kw):
+        kw.setdefault("probe_manifest", True)
+        super().__init__(topo, **kw)
+        self._init_aggregates()
+
+    def _init_aggregates(self) -> None:
+        self._aggs: dict = {}            # aid -> aggregate record
+        self._next_aid = 0
+        self._hold_admission = False
+        self._lane_modes_host = np.zeros(self.lanes, np.int32)
+
+    # ---- lane-mode plumbing ---------------------------------------------
+    @property
+    def extrema_installed(self) -> bool:
+        """True once ``lane_modes`` is structurally present — the one
+        extra lowering the extrema family costs (compile budget 2)."""
+        return self.svc.arrays.lane_modes is not None
+
+    @property
+    def compile_budget(self) -> int:
+        return 2 if self.extrema_installed else 1
+
+    def _sync_lane_modes(self) -> None:
+        """Reconcile ``lane_modes`` with the lane table: active lanes
+        carry their kind's reduction mode, free/mean lanes mode 0.
+        Install lazily — a fabric with no extrema lane keeps the plain
+        program's pytree structure (zero recompiles)."""
+        desired = np.zeros(self.lanes, np.int32)
+        for ln, qid in enumerate(self._lane_q):
+            if qid is not None:
+                desired[ln] = int(self._queries[qid].get("lane_mode",
+                                                         MODE_MEAN))
+        if self.svc.arrays.lane_modes is None and not desired.any():
+            return
+        if self.svc.arrays.lane_modes is not None \
+                and np.array_equal(desired, self._lane_modes_host):
+            return
+        import jax.numpy as jnp
+
+        self._lane_modes_host = desired
+        # jnp.array COPIES: the host vector stays this fabric's mirror
+        # (analysis/aliasing.py — never hand a mutable host buffer to
+        # the device)
+        self.svc.arrays = self.svc.arrays.replace(
+            lane_modes=jnp.array(desired))
+
+    def _admit_free(self) -> int:
+        if self._hold_admission:
+            return 0
+        n = super()._admit_free()
+        self._sync_lane_modes()
+        return n
+
+    def _boundary(self) -> dict:
+        row = super()._boundary()
+        # retirements/quarantines may have freed extrema lanes even when
+        # admission was deferred — reconcile before the next segment
+        self._sync_lane_modes()
+        return row
+
+    def _lane_result(self, probe: dict, q: dict) -> dict:
+        r = super()._lane_result(probe, q)
+        if q.get("kind") is not None:
+            ln = q["lane"]
+            # the extrema read + the offline-auditable error bounds
+            r["hi"] = float(probe["max"][ln])
+            r["lo"] = float(probe["min"][ln])
+            r["live"] = int(probe["live"])
+        return r
+
+    # ---- aggregate lifecycle --------------------------------------------
+    def submit_aggregate(self, kind: str, values, cohort=None, *,
+                         eps: float | None = None, tag=None,
+                         **params) -> int:
+        """Submit one aggregate of ``kind`` over ``cohort`` (member slot
+        ids; ``None`` = every live member).  ``values`` is one scalar
+        per cohort member or a scalar broadcast.  Kind parameters ride
+        ``**params`` (e.g. ``q=0.9, qeps=0.05`` for quantiles;
+        ``window=4`` or ``decay=0.5`` for windowed means).  Returns the
+        aggregate id; each lane admits like an ordinary query (lowest
+        free lane, FIFO)."""
+        spec = get_kind(kind)
+        if cohort is None:
+            cohort = self.svc.live_ids()
+        cohort = np.atleast_1d(np.asarray(cohort, np.int64))
+        vals = np.asarray(values, np.float64)
+        if vals.ndim == 0:
+            vals = np.full(cohort.shape, float(vals))
+        if vals.shape != cohort.shape:
+            raise ValueError(
+                f"submit_aggregate: values shape {vals.shape} != cohort "
+                f"shape {cohort.shape}")
+        plan = spec.encode(vals, dict(params))
+        if len(plan.columns) > self.lanes:
+            raise ValueError(
+                f"submit_aggregate: kind {kind!r} needs "
+                f"{len(plan.columns)} lanes but the fabric has "
+                f"{self.lanes} — raise lanes or qeps")
+        aid = self._next_aid
+        self._next_aid += 1
+        agg = {
+            "aid": aid,
+            "kind": kind,
+            "status": "active",
+            "qids": [],
+            "params": {k: (float(v) if isinstance(v, (int, float))
+                           else v) for k, v in params.items()},
+            "meta": plan.meta,
+            "eps": self.conv_eps if eps is None else float(eps),
+            "tag": tag,
+            "submit_round": self.clock,
+            "restreams": [],
+            "_cohort": cohort,
+            "_window": None,
+        }
+        if spec.standing:
+            if plan.meta.get("window") is not None:
+                agg["_window"] = [vals.copy()]
+            else:
+                agg["_window"] = vals.copy()
+        # hold admission until every lane record carries its kind
+        # metadata — _sync_lane_modes must see lane_mode at admission
+        self._hold_admission = True
+        try:
+            for i, col in enumerate(plan.columns):
+                qid = self.submit(col, cohort, eps=agg["eps"], tag=tag)
+                self._queries[qid].update(
+                    kind=kind, agg=aid, agg_lane_index=i,
+                    lane_mode=int(plan.modes[i]),
+                    kind_scale=float(plan.scales[i]),
+                    standing=bool(spec.standing))
+                agg["qids"].append(qid)
+        finally:
+            self._hold_admission = False
+        self._admit_free()
+        self._aggs[aid] = agg
+        return aid
+
+    def aggregate(self, aid: int) -> dict:
+        """The aggregate's current record (a copy; host window state
+        omitted)."""
+        a = self._aggs[aid]
+        return {k: v for k, v in a.items() if not k.startswith("_")}
+
+    def _agg_status(self, a: dict) -> str:
+        st = [self._queries[qid]["status"] for qid in a["qids"]]
+        if any(s == "quarantined" for s in st):
+            return "quarantined"
+        if all(s == "done" for s in st):
+            return "done"
+        if any(s == "active" for s in st):
+            return "active"
+        return "queued"
+
+    def read_aggregate(self, aid: int,
+                       max_staleness: int | None = None) -> dict:
+        """The aggregate's current answer: per-lane reads (bounded
+        staleness semantics of :meth:`QueryFabric.read`) combined
+        through the kind's read contract.  ``result`` is ``None`` while
+        any lane is still queued (or after a quarantine)."""
+        a = self._aggs[aid]
+        spec = get_kind(a["kind"])
+        reads = [self.read(qid, max_staleness) for qid in a["qids"]]
+        status = self._agg_status(a)
+        a["status"] = status
+        out = {
+            "aid": aid,
+            "kind": a["kind"],
+            "status": status,
+            "t": self.clock,
+            "lanes": [self._queries[qid].get("lane")
+                      for qid in a["qids"]],
+            "converged": all(r.get("converged") for r in reads),
+            "result": (spec.combine(reads, a["meta"], a)
+                       if status != "quarantined" else None),
+        }
+        if status == "quarantined":
+            out["quarantined"] = True
+        return out
+
+    def push(self, aid: int, values, ids=None) -> dict:
+        """Restream a standing windowed aggregate with a new sample
+        batch: the host window advances (sliding append / exponential
+        decay), the lane's value column is rewritten between segments,
+        and the fabric asserts MASS NEUTRALITY — the lane's ledger
+        residual is value-independent, so it must be bitwise identical
+        across the restream (the self-healing conservation absorbs the
+        reset).  Returns the recorded restream row."""
+        a = self._aggs[aid]
+        spec = get_kind(a["kind"])
+        if not spec.standing:
+            raise ValueError(
+                f"push: aggregate {aid} is kind {a['kind']!r} — only "
+                "standing (windowed) kinds restream")
+        if self._agg_status(a) != "active":
+            raise ValueError(
+                f"push: aggregate {aid} is {self._agg_status(a)}")
+        cohort = a["_cohort"]
+        vals = np.asarray(values, np.float64)
+        if vals.ndim == 0:
+            vals = np.full(cohort.shape, float(vals))
+        if ids is not None:
+            raise ValueError(
+                "push: partial restreams are not supported — pass one "
+                "sample per cohort member (the window state is "
+                "cohort-wide)")
+        if vals.shape != cohort.shape:
+            raise ValueError(
+                f"push: values shape {vals.shape} != cohort shape "
+                f"{cohort.shape}")
+        meta = a["meta"]
+        if meta.get("window") is not None:
+            a["_window"].append(vals.copy())
+            del a["_window"][:-int(meta["window"])]
+            col = np.mean(np.stack(a["_window"], axis=0), axis=0)
+        else:
+            lam = float(meta["decay"])
+            a["_window"] = lam * a["_window"] + (1.0 - lam) * vals
+            col = a["_window"].copy()
+        qid = a["qids"][0]
+        q = self._queries[qid]
+        lane = q["lane"]
+        # members that left the cohort since submission: update only
+        # the survivors (leave() already trimmed q["cohort"])
+        alive = np.asarray([m in set(q["cohort"]) for m in cohort], bool)
+        resid_before = self._probe_fresh()["resid"][lane].copy()
+        self.update_query(qid, cohort[alive], col[alive])
+        resid_after = self._probe_fresh()["resid"][lane]
+        neutral = bool(np.array_equal(resid_before, resid_after))
+        if not neutral:
+            raise AssertionError(
+                f"push: restream of aggregate {aid} (lane {lane}) moved "
+                f"the ledger residual {float(resid_before)!r} -> "
+                f"{float(resid_after)!r} — a value-column rewrite must "
+                "be mass-neutral bitwise")
+        row = {"t": self.clock, "lane": int(lane),
+               "resid": float(np.abs(resid_after)),
+               "neutral": neutral}
+        a["restreams"].append(row)
+        return row
+
+    def close(self, aid: int) -> dict:
+        """Release a standing aggregate: clears the standing flag so
+        the lane retires through the ordinary convergence path at the
+        next boundary it satisfies.  Returns the last read."""
+        a = self._aggs[aid]
+        for qid in a["qids"]:
+            self._queries[qid]["standing"] = False
+        return self.read_aggregate(aid)
+
+    # ---- manifest --------------------------------------------------------
+    def query_block(self) -> dict:
+        block = super().query_block()
+        block["compile_budget"] = self.compile_budget
+        return block
+
+    def aggregate_block(self) -> dict:
+        """The manifest's ``aggregates`` block — the inputs of
+        ``doctor``'s ``aggregate_read`` checks
+        (obs/health.check_aggregate_read): per-aggregate records with
+        combined results + error bounds, the kind census, and the
+        extrema compile accounting.  The per-boundary probe vectors
+        ride the query block (``probe_rows``)."""
+        kinds: dict = {}
+        recs = []
+        for a in self._aggs.values():
+            kinds[a["kind"]] = kinds.get(a["kind"], 0) + 1
+            rec = {k: v for k, v in a.items() if not k.startswith("_")}
+            if rec.get("tag") is None:
+                rec.pop("tag", None)
+            rec["read"] = self.read_aggregate(a["aid"], max_staleness=0)
+            recs.append(rec)
+        return {
+            "kinds": kinds,
+            "extrema_installed": self.extrema_installed,
+            "compile_budget": self.compile_budget,
+            "compile_count": self.compile_count,
+            "aggregates": recs,
+        }
+
+    # ---- durability ------------------------------------------------------
+    def save_checkpoint(self, path: str,
+                        extra_meta: dict | None = None) -> AggregateFabric:
+        aggs = []
+        for a in self._aggs.values():
+            rec = {k: v for k, v in a.items() if not k.startswith("_")}
+            rec["cohort"] = [int(i) for i in a["_cohort"]]
+            w = a["_window"]
+            if w is not None:
+                rec["window_state"] = ([list(map(float, s)) for s in w]
+                                       if isinstance(w, list)
+                                       else list(map(float, w)))
+            aggs.append(rec)
+        meta = {"aggregates": {
+            "aggs": aggs,
+            "next_aid": self._next_aid,
+            "lane_modes": [int(m) for m in self._lane_modes_host],
+            "extrema_installed": self.extrema_installed,
+        }}
+        super().save_checkpoint(path, extra_meta={**meta,
+                                                  **(extra_meta or {})})
+        return self
+
+    @classmethod
+    def restore_checkpoint(cls, path: str) -> AggregateFabric:
+        """Rebuild the aggregate fabric bit-exactly.  The service
+        restore rebuilds ``TopoArrays`` WITHOUT the lane-mode leaf, so
+        the modes are re-installed here from the checkpoint's own
+        record — an extrema fabric resumes on the mode-masked program,
+        not silently on the mean one."""
+        from flow_updating_tpu.utils.checkpoint import (
+            _open_archive,
+            _read_manifest,
+        )
+
+        self = super().restore_checkpoint(path)
+        self._init_aggregates()
+        self.probe_manifest = True
+        with _open_archive(path) as z:
+            manifest = _read_manifest(z, path)
+        ameta = (manifest.get("service") or {}).get("aggregates")
+        if ameta is None:
+            return self          # a plain query-fabric archive
+        for rec in ameta["aggs"]:
+            a = dict(rec)
+            a.pop("read", None)
+            a["_cohort"] = np.asarray(a.pop("cohort"), np.int64)
+            w = a.pop("window_state", None)
+            if w is None:
+                a["_window"] = None
+            elif a["meta"].get("window") is not None:
+                a["_window"] = [np.asarray(s, np.float64) for s in w]
+            else:
+                a["_window"] = np.asarray(w, np.float64)
+            self._aggs[int(a["aid"])] = a
+        self._next_aid = int(ameta["next_aid"])
+        if ameta.get("extrema_installed"):
+            import jax.numpy as jnp
+
+            modes = np.asarray(ameta["lane_modes"], np.int32)
+            self._lane_modes_host = modes
+            self.svc.arrays = self.svc.arrays.replace(
+                lane_modes=jnp.array(modes))
+        return self

@@ -74,6 +74,7 @@ _KEY_FAMILIES = (
     r"dfl_d.+",                     # model-scale DFL rows
     r"scn_.+",                      # scenario rows
     r"qps_.+",                      # query-fabric queries/s rows
+    r"agg_.+",                      # aggregate-algebra per-kind rows
     r"chaos_.+",                    # chaos-harness fault rows
     r"recovery_.+",                 # crash-recovery timing rows
     r"(er|ba)\d+k?_[a-z_0-9]+",     # named generator configs

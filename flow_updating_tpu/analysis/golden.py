@@ -496,6 +496,38 @@ def cells() -> list:
         key="query-fabric-drop/plain/robust=none/adv=none/payload=lanes4",
         mode="query", twin="plain", build=_build_query(True)))
 
+    # -- aggregate algebra: the mode-masked-write program ---------------
+    # Installing ``TopoArrays.lane_modes`` (the fabric's one-time
+    # extrema install) swaps the pytree's None placeholder for a (D,)
+    # mode vector — the ONLY other lowering an aggregate fabric can run
+    # (docs/AGGREGATES.md).  Its per-lane masked value write (extrema
+    # lanes latch hi/lo, mean lanes average) and frozen extrema flow
+    # must stay pinned, and the prover must still prove antisymmetry +
+    # mask-neutrality through the mode selects.
+    def _build_query_modes():
+        from flow_updating_tpu.aggregates import AggregateFabric
+        from flow_updating_tpu.models.rounds import run_rounds
+        from flow_updating_tpu.topology.generators import ring
+
+        cfg = RoundConfig.fast(variant="collectall")
+
+        def make():
+            fab = AggregateFabric(
+                ring(12, k=2, seed=0), lanes=4, capacity=16,
+                degree_budget=6, config=cfg,
+                segment_rounds=CELL_ROUNDS)
+            fab.submit_aggregate("max", 1.0)
+            return fab
+        fab = fx.get("aggregate_fabric_modes", make)
+        assert fab.extrema_installed
+        return (run_rounds,
+                (fab.svc.state, fab.svc.arrays, fab.svc.config,
+                 CELL_ROUNDS), {"params": fab.svc.params})
+    out.append(Cell(
+        key="query-fabric-modes/plain/robust=none/adv=none/"
+            "payload=lanes4",
+        mode="query", twin="plain", build=_build_query_modes))
+
     return out
 
 

@@ -1481,22 +1481,40 @@ def cmd_scenarios(args) -> int:
     every scenario's declared signature in-process.  Exit 1 on any
     failing clause — the same CI contract as ``doctor`` on the saved
     manifest."""
+    from flow_updating_tpu.aggregates import AGG_SCENARIOS
     from flow_updating_tpu.scenarios.registry import (
         REGISTRY,
         get_scenario,
     )
 
     if args.list:
-        print(json.dumps({
+        listing = {
             name: {
                 "summary": scn.summary,
                 "rounds": scn.rounds,
                 "rmse_threshold": scn.rmse_threshold,
                 "config": dict(scn.config),
                 "signature": [dict(c) for c in scn.signature],
-            } for name, scn in REGISTRY.items()}))
+            } for name, scn in REGISTRY.items()}
+        for name, scn in AGG_SCENARIOS.items():
+            rec = scn.describe()
+            rec.pop("name", None)
+            listing[name] = rec
+        print(json.dumps(listing))
         return 0
     names = list(args.names) or None
+    agg_names = [n for n in (names or []) if n in AGG_SCENARIOS]
+    if agg_names:
+        # the per-kind aggregate fault cases (aggregates/scenarios.py)
+        # run one mixed-kind fabric each, not a seed grid — dispatch
+        # the whole invocation to that runner rather than splicing two
+        # manifest shapes together
+        if len(agg_names) != len(names):
+            raise SystemExit(
+                "scenarios: aggregate scenarios "
+                f"({', '.join(agg_names)}) cannot mix with sweep-grid "
+                "scenarios in one invocation")
+        return _run_aggregate_scenarios_cli(args, names)
     if names:
         for n in names:
             try:
@@ -1530,6 +1548,50 @@ def cmd_scenarios(args) -> int:
         "seeds": summary["seeds"],
         "sweep_compiles": summary["sweep_compiles"],
         "wall_s": summary["wall_s"],
+        "checks": [c.to_jsonable() for c in checks],
+    }
+    if args.perturb:
+        out["perturb"] = args.perturb
+    if args.report:
+        out["report_path"] = args.report
+    print(json.dumps(out))
+    return health.exit_code(checks, strict=args.strict)
+
+
+def _run_aggregate_scenarios_cli(args, names) -> int:
+    """The ``scenarios`` subcommand body for aggregate-kind fault cases
+    (docs/AGGREGATES.md §5): run each named case's mixed-kind fabric
+    under its planted adversary, judge the per-kind ``agg_*`` signature
+    clauses, exit 1 on any failing clause (``--perturb
+    remove_adversary`` is the negative control and fails by design)."""
+    if args.perturb and args.perturb != "remove_adversary":
+        raise SystemExit(
+            f"scenarios: aggregate scenarios support --perturb "
+            f"remove_adversary only (got {args.perturb!r})")
+    _select_backend(args.backend)
+    import time as _time
+
+    from flow_updating_tpu.aggregates import (
+        aggregate_scenario_manifest,
+        run_aggregate_scenarios,
+    )
+    from flow_updating_tpu.obs import health
+
+    t0 = _time.perf_counter()
+    records, summary = run_aggregate_scenarios(
+        names, perturb=args.perturb or None)
+    manifest = aggregate_scenario_manifest(
+        records, summary, argv=getattr(args, "_argv", None))
+    if args.report:
+        from flow_updating_tpu.obs.report import write_report
+
+        write_report(args.report, manifest)
+    checks = health.check_scenario_conformance(manifest)
+    out = {
+        "overall": health.overall(checks),
+        "scenarios": summary["scenarios"],
+        "kinds": summary["kinds"],
+        "wall_s": round(_time.perf_counter() - t0, 3),
         "checks": [c.to_jsonable() for c in checks],
     }
     if args.perturb:
@@ -2344,7 +2406,8 @@ def build_parser() -> argparse.ArgumentParser:
              "correlated failures) under the sweep engine, blame the "
              "planted adversary, and assert each declared signature — "
              "flow-updating-scenario-report/v1 manifests "
-             "(flow_updating_tpu.scenarios)")
+             "(flow_updating_tpu.scenarios; agg_* names run the "
+             "per-kind aggregate fault cases, docs/AGGREGATES.md)")
     sc.add_argument("names", nargs="*", metavar="SCENARIO",
                     help="registered scenario names (default: the whole "
                          "registry; see --list)")

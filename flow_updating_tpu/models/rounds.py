@@ -344,6 +344,11 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
     # (E,) lanes); each payload reduction/broadcast instead rides the
     # generalized per-op path with its own trailing feature axis
     vec = state.flow.ndim > 1
+    if topo.lane_modes is not None and (cfg.variant != COLLECTALL or not vec):
+        raise ValueError(
+            "per-lane reduction modes (flow_updating_tpu.aggregates) ride "
+            "the collectall vector-payload round; build the fabric with "
+            "variant='collectall' and a (N, D) lane payload")
     all_heard = None
     if topo.seg_plan is not None and cfg.variant == COLLECTALL and not vec:
         from flow_updating_tpu.ops.seg_benes import seg_reduce_multi
@@ -361,6 +366,7 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
         est_sum = (_seg_sum(state.est, topo, N)
                    if cfg.variant == COLLECTALL else None)
     estimate = state.value - flows_sum
+    new_value = None
 
     if cfg.variant == COLLECTALL:
         ticks = ticks + 1
@@ -441,6 +447,37 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
                                  state.flow)
             new_est = jnp.where(fire_ex, avg_e, state.est)
             msg_est = avg_e
+        if topo.lane_modes is not None:
+            # per-lane aggregate reduction modes (aggregates/): lanes in
+            # mode 1 (max) / 2 (min) run a LATCHING consensus instead of
+            # the additive mean ledger.  Their flow never moves (so the
+            # estimate is the value column itself and the ledger residual
+            # stays exactly +-0.0); the est ledger still records the last
+            # value heard per in-edge, and a firing node latches the
+            # extremum of {its own estimate, every last-heard neighbor
+            # value} into its value column and broadcasts it.  0 is a
+            # valid identity in both directions by the aggregates layer's
+            # shifted-lattice contract (max lanes carry values >= 0, min
+            # lanes <= 0), so unheard edges, scrubbed free lanes and ghost
+            # slots all sit on the all-zero fixed point under every mode.
+            # Mode 0 lanes keep the plain writes bit-exactly (the where
+            # keeps the same elements), so mean lanes and extrema lanes
+            # coexist in this single lowering.
+            modes = topo.lane_modes
+            ext_lane = modes > 0                     # (D,) per-lane mask
+            is_max = modes == 1
+            ext_n = jnp.where(
+                is_max,
+                jnp.maximum(estimate, _seg_max(state.est, topo, N, 0)),
+                jnp.minimum(estimate, _seg_min(state.est, topo, N, 0)))
+            ext_e = _bcast(ext_n, topo)
+            new_value = jnp.where(ext_lane & _ex(fire_n, ext_n),
+                                  ext_n, state.value)
+            new_flow = jnp.where(ext_lane, state.flow, new_flow)
+            new_est = jnp.where(ext_lane,
+                                jnp.where(fire_ex, ext_e, state.est),
+                                new_est)
+            msg_est = jnp.where(ext_lane, ext_e, msg_est)
         send_mask = fire_e
         ticks = jnp.where(fire_n, 0, ticks)
         recv = recv & ~fire_e
@@ -646,6 +683,12 @@ def fire_core(state: FlowUpdatingState, topo, cfg: RoundConfig, trigger,
         fired=fired_ctr,
         key=key,
     )
+    if new_value is not None:
+        # extrema lanes latch their consensus into the value column (mode
+        # 0 lanes are kept bit-exactly by the lane mask above); the write
+        # exists only when lane_modes is structurally present, so plain
+        # runs compile the byte-identical program with no value output.
+        state = state.replace(value=new_value)
     return state, msg_est, send_mask
 
 

@@ -705,25 +705,30 @@ def check_query(query: dict | None, *, dtype: str | None = None) -> list:
     dtype = query.get("dtype", dtype)
 
     compiles = query.get("compile_count")
+    # aggregate fabrics declare budget 2 once the extrema lane-mode
+    # leaf is installed (exactly one extra lowering — docs/AGGREGATES.md);
+    # plain fabrics stay on the strict single-compile contract
+    budget = int(query.get("compile_budget", 1))
     if compiles is None:
         checks.append(CheckResult("query_compile", SKIP,
                                   "no compile count recorded"))
-    elif int(compiles) > 1:
+    elif int(compiles) > budget:
         checks.append(CheckResult(
             "query_compile", FAIL,
-            f"round program compiled {compiles}x — lane admission/"
-            "retirement and membership events must be payload-plane "
-            "edits, never a retrace",
+            f"round program compiled {compiles}x (budget {budget}) — "
+            "lane admission/retirement and membership events must be "
+            "payload-plane edits, never a retrace",
             {"compile_count": int(compiles),
+             "compile_budget": budget,
              "admitted_total": query.get("admitted_total"),
              "retired_total": query.get("retired_total")}))
     else:
         checks.append(CheckResult(
             "query_compile", PASS,
-            f"zero recompiles ({compiles} compile across "
-            f"{query.get('admitted_total', '?')} admissions / "
+            f"compiles within budget ({compiles} compile <= {budget} "
+            f"across {query.get('admitted_total', '?')} admissions / "
             f"{query.get('retired_total', '?')} retirements)",
-            {"compile_count": int(compiles)}))
+            {"compile_count": int(compiles), "compile_budget": budget}))
 
     lanes = query.get("lanes") or {}
     if lanes:
@@ -800,6 +805,179 @@ def check_query(query: dict | None, *, dtype: str | None = None) -> list:
                 f"admission latency within SLO (p95 "
                 f"{float(p95 or 0):.0f} <= {slo} rounds, "
                 f"{lat['count']} admissions)", dict(lat)))
+    return checks
+
+
+def check_aggregate_read(aggregates: dict | None, *,
+                         query: dict | None = None,
+                         dtype: str | None = None) -> list:
+    """The aggregate algebra's read-contract checks (the ``aggregates``
+    block of a query manifest; docs/AGGREGATES.md):
+
+    * **aggregate_read** — every recorded aggregate's combined read is
+      internally consistent with its kind's contract: sum/count pairing
+      (the indicator lane's count within its own error bound of the
+      live cohort, ``mean == sum / count``), quantile inversion inside
+      the proven ``qeps * (hi - lo)`` bound with a monotone CDF and the
+      value inside ``[lo, hi]``, extrema values finite with their
+      spread-derived bound;
+    * **aggregate_extrema_monotone** — per extrema lane, the
+      per-boundary probe reduction vector is monotone until the lane
+      converges (``max`` nondecreasing, ``min`` nonincreasing — the
+      latching consensus never backtracks) except across boundaries
+      where the live set changed (membership churn legitimately moves
+      the probe), and the lane's ledger residual is EXACTLY ±0.0 at
+      every boundary (extrema lanes never move flow);
+    * **aggregate_kind_census** — the kind census and the extrema
+      compile accounting agree (extrema kinds present iff the lane-mode
+      leaf was installed, i.e. iff the declared budget is 2).
+    """
+    if not aggregates:
+        return [CheckResult("aggregate_read", SKIP,
+                            "no aggregates block recorded")]
+    checks = []
+    recs = [r for r in (aggregates.get("aggregates") or [])
+            if isinstance(r, dict)]
+    if not recs:
+        return [CheckResult("aggregate_read", SKIP,
+                            "aggregates block records no aggregates")]
+
+    # ---- per-kind read contracts ----------------------------------------
+    problems = []
+    judged = 0
+    for rec in recs:
+        aid, kind = rec.get("aid"), rec.get("kind")
+        read = rec.get("read") or {}
+        res = read.get("result")
+        label = f"agg {aid} ({kind})"
+        if read.get("status") == "quarantined":
+            continue                     # watchdog casework, not a read
+        if res is None:
+            if read.get("status") == "done":
+                problems.append(f"{label}: done but combined no result")
+            continue
+        judged += 1
+        val = res.get("value")
+        if val is None or not math.isfinite(float(val)):
+            problems.append(f"{label}: non-finite value {val!r}")
+            continue
+        bound = res.get("error_bound")
+        if bound is None or not math.isfinite(float(bound)) \
+                or float(bound) < 0.0:
+            problems.append(f"{label}: bad error bound {bound!r}")
+        if kind == "sum_count":
+            count = float(res.get("count", math.nan))
+            live = res.get("cohort_live")
+            cb = float(res.get("count_error_bound", 0.0))
+            tol = cb + _float_tol(max(1.0, abs(count)), dtype, None)
+            if live is not None and not abs(count - float(live)) <= tol:
+                problems.append(
+                    f"{label}: count {count:.6g} vs {live} live cohort "
+                    f"members (|Δ| > bound {tol:.3g}) — the paired "
+                    "indicator lane disagrees with the value lane's "
+                    "denominator")
+            mean = res.get("mean")
+            if mean is not None and count and not (
+                    abs(float(mean) * count - float(res.get("sum", 0.0)))
+                    <= 1e-9 * max(1.0, abs(float(res.get("sum", 0.0))))):
+                problems.append(
+                    f"{label}: mean {mean!r} != sum/count")
+        elif kind == "quantile":
+            cdf = res.get("cdf") or []
+            if any(b < a - 1e-9 for a, b in zip(cdf, cdf[1:])):
+                problems.append(
+                    f"{label}: CDF not monotone ({cdf})")
+            lo, hi = float(res.get("lo", 0.0)), float(res.get("hi", 0.0))
+            qeps = float((rec.get("params") or {}).get("qeps", 0.05))
+            if float(bound or 0.0) > qeps * (hi - lo) + 1e-12:
+                problems.append(
+                    f"{label}: error bound {bound:.3g} exceeds the "
+                    f"declared qeps*(hi-lo) = {qeps * (hi - lo):.3g}")
+            if not lo <= float(val) <= hi:
+                problems.append(
+                    f"{label}: value {val:.6g} outside [{lo:.6g}, "
+                    f"{hi:.6g}]")
+    if problems:
+        checks.append(CheckResult(
+            "aggregate_read", FAIL,
+            f"{len(problems)} aggregate read(s) violate their kind's "
+            "contract — " + "; ".join(problems[:4])
+            + (" ..." if len(problems) > 4 else ""),
+            {"problems": problems[:10], "aggregates": len(recs)}))
+    else:
+        checks.append(CheckResult(
+            "aggregate_read", PASS,
+            f"all {judged} combined reads honor their kind contracts "
+            f"({len(recs)} aggregates, kinds: "
+            f"{sorted(aggregates.get('kinds') or ())})",
+            {"aggregates": len(recs), "judged": judged,
+             "kinds": aggregates.get("kinds")}))
+
+    # ---- extrema lane monotonicity over the probe rows -------------------
+    ext_q = [q for q in ((query or {}).get("queries") or [])
+             if isinstance(q, dict) and q.get("lane_mode") in (1, 2)]
+    probe_rows = (query or {}).get("probe_rows") or []
+    if ext_q and not probe_rows:
+        checks.append(CheckResult(
+            "aggregate_extrema_monotone", SKIP,
+            "extrema lanes ran but the manifest has no probe_rows — "
+            "record with probe_manifest=True (AggregateFabric default)"))
+    elif ext_q:
+        viol = []
+        for q in ext_q:
+            qid, is_max = q.get("qid"), q.get("lane_mode") == 1
+            prev = None                  # (t, live, value)
+            for row in probe_rows:
+                binding = row.get("lane_q") or []
+                if qid not in binding:
+                    continue
+                lane = binding.index(qid)
+                if abs(float(row["resid"][lane])) != 0.0:
+                    viol.append(
+                        f"qid {qid} lane {lane} t={row.get('t')}: "
+                        f"extrema ledger residual "
+                        f"{row['resid'][lane]!r} != ±0.0")
+                    break
+                v = float(row["max" if is_max else "min"][lane])
+                cur = (row.get("t"), row.get("live"), v)
+                if prev is not None and prev[1] == cur[1] and (
+                        v < prev[2] if is_max else v > prev[2]):
+                    viol.append(
+                        f"qid {qid} lane {lane}: probe "
+                        f"{'max' if is_max else 'min'} moved "
+                        f"{prev[2]:.6g} -> {v:.6g} between t={prev[0]} "
+                        f"and t={cur[0]} with the live set unchanged — "
+                        "a latching consensus never backtracks")
+                    break
+                prev = cur
+        checks.append(CheckResult(
+            "aggregate_extrema_monotone",
+            PASS if not viol else FAIL,
+            f"all {len(ext_q)} extrema lanes monotone over "
+            f"{len(probe_rows)} probe rows with ledger residual "
+            "exactly 0.0" if not viol else
+            f"{len(viol)} extrema lane(s) violate the latching "
+            "contract — " + "; ".join(viol[:3]),
+            {"extrema_lanes": len(ext_q), "probe_rows": len(probe_rows),
+             "violations": viol[:10]}))
+
+    # ---- kind census vs compile accounting -------------------------------
+    kinds = aggregates.get("kinds") or {}
+    has_ext = bool(kinds.get("max") or kinds.get("min"))
+    installed = bool(aggregates.get("extrema_installed"))
+    budget = aggregates.get("compile_budget")
+    ok = (installed or not has_ext) and \
+        (budget is None or int(budget) == (2 if installed else 1))
+    checks.append(CheckResult(
+        "aggregate_kind_census", PASS if ok else FAIL,
+        (f"kind census consistent with compile accounting "
+         f"(extrema_installed={installed}, budget {budget})") if ok else
+        (f"extrema kinds ran without the lane-mode leaf (or the budget "
+         f"disagrees): kinds={kinds}, extrema_installed={installed}, "
+         f"compile_budget={budget}"),
+        {"kinds": kinds, "extrema_installed": installed,
+         "compile_budget": budget,
+         "compile_count": aggregates.get("compile_count")}))
     return checks
 
 
@@ -1069,6 +1247,48 @@ def _eval_scenario_clause(rec: dict, clause: dict, by_name: dict,
     scn = rec.get("name", "?")
     kind = clause.get("check")
     name = f"scn:{scn}:{kind}#{idx}"
+
+    if kind in ("agg_err_above", "agg_err_below", "agg_latched"):
+        # aggregate scenarios (aggregates/scenarios.py) record per-kind
+        # reads instead of sweep instances — judge those directly
+        ares = rec.get("aggregate_results") or {}
+        label = clause.get("agg")
+        entry = ares.get(label)
+        if not isinstance(entry, dict):
+            return CheckResult(
+                name, FAIL,
+                f"{scn}: no aggregate result recorded for {label!r}",
+                {"clause": clause, "recorded": sorted(ares)})
+        value = entry.get("value")
+        if value is None or not math.isfinite(float(value)):
+            return CheckResult(
+                name, FAIL,
+                f"{scn}: aggregate {label!r} read no finite value "
+                f"({value!r})", {"clause": clause, "entry": entry})
+        value = float(value)
+        if kind == "agg_latched":
+            target = float(clause["value"])
+            ok = value == target
+            return CheckResult(
+                name, PASS if ok else FAIL,
+                f"{scn}: {label} consensus latched EXACTLY at the "
+                f"planted {target:g}" if ok else
+                f"{scn}: {label} read {value:g}, expected the planted "
+                f"{target:g} latched exactly",
+                {"clause": clause, "value": value,
+                 "true": entry.get("true")})
+        err = abs(value - float(entry.get("true", math.nan)))
+        bound = float(clause["value"])
+        above = kind == "agg_err_above"
+        ok = err > bound if above else err <= bound
+        word = ">" if above else "<="
+        return CheckResult(
+            name, PASS if ok else FAIL,
+            f"{scn}: {label} read error {err:.3g} {word} {bound:g}"
+            + ("" if ok else " VIOLATED"),
+            {"clause": clause, "value": value,
+             "true": entry.get("true"), "error": err})
+
     insts = _scn_instances(rec)
     if not insts:
         return CheckResult(name, FAIL,
@@ -1512,6 +1732,12 @@ def diagnose_manifest(manifest: dict) -> list:
     query = manifest.get("query")
     if isinstance(query, dict):
         checks.extend(check_query(query, dtype=dtype))
+    aggregates = manifest.get("aggregates")
+    if isinstance(aggregates, dict):
+        checks.extend(check_aggregate_read(
+            aggregates,
+            query=query if isinstance(query, dict) else None,
+            dtype=dtype))
     recovery = manifest.get("recovery")
     if isinstance(recovery, dict):
         # a flow-updating-recovery-report/v1 manifest (or any manifest

@@ -144,7 +144,8 @@ class QueryFabric:
                  config: RoundConfig | None = None,
                  segment_rounds: int = 32, seed: int = 0,
                  conv_eps: float = 1e-6,
-                 admission_slo_rounds: int | None = None):
+                 admission_slo_rounds: int | None = None,
+                 probe_manifest: bool = False):
         if lanes < 1:
             raise ValueError(f"lanes={lanes} must be >= 1")
         if conv_eps <= 0:
@@ -170,6 +171,12 @@ class QueryFabric:
         self._next_qid = 0
         self._probe = None            # boundary probe cache (dict)
         self._boundaries: list = []   # one row per segment boundary
+        # opt-in (the vectors are lanes-wide per boundary): record the
+        # probe reduction vectors into the manifest so read-side
+        # aggregate math is auditable offline (aggregates/; doctor's
+        # aggregate_read checks)
+        self.probe_manifest = bool(probe_manifest)
+        self._probe_rows: list = []
         self._latencies: list = []    # admission latencies (rounds)
         self.admitted_total = 0
         self.retired_total = 0
@@ -590,6 +597,19 @@ class QueryFabric:
                 probe = self._probe_fresh()
         mx, mn = probe["max"], probe["min"]
         resid, live = probe["resid"], probe["live"]
+        if self.probe_manifest:
+            self._probe_rows.append({
+                "t": self.clock,
+                "live": int(live),
+                "max": [float(x) for x in mx],
+                "min": [float(x) for x in mn],
+                "sum": [float(x) for x in probe["sum"]],
+                "resid": [float(x) for x in resid],
+                # lane -> qid at THIS boundary (recycling re-keys lanes
+                # between rows; the offline audit needs the binding)
+                "lane_q": [None if x is None else int(x)
+                           for x in self._lane_q],
+            })
         active = [ln for ln in range(self.lanes)
                   if self._lane_q[ln] is not None]
         free = [ln for ln in range(self.lanes)
@@ -600,7 +620,10 @@ class QueryFabric:
         for ln in active:
             q = self._queries[self._lane_q[ln]]
             r = self._lane_result(probe, q)
-            if r.pop("converged"):
+            # standing queries (aggregates/: windowed lanes restreamed
+            # between segments) serve until released — convergence does
+            # not retire them
+            if r.pop("converged") and not q.get("standing"):
                 r["rounds"] = self.clock - q["admit_round"]
                 q.update(status="done", done_round=self.clock, result=r)
                 done.append(ln)
@@ -749,7 +772,7 @@ class QueryFabric:
                 rec.pop("tag", None)
             rec.pop("cohort", None)   # ids can be 100k+ wide; keep size
             qs.append(rec)
-        return {
+        out = {
             "lanes": {
                 "capacity": self.lanes,
                 "active": self.active_lanes,
@@ -769,6 +792,9 @@ class QueryFabric:
             "service": self.svc.service_block(),
             "dtype": self.svc.config.dtype,
         }
+        if self.probe_manifest:
+            out["probe_rows"] = [dict(r) for r in self._probe_rows]
+        return out
 
     # ---- durability ------------------------------------------------------
     def save_checkpoint(self, path: str,
@@ -856,6 +882,8 @@ class QueryFabric:
         self._latencies = [int(x) for x in qmeta["latencies"]]
         self._probe = None
         self._boundaries = []
+        self.probe_manifest = False
+        self._probe_rows = []
         self._watchdog = None
         # watchdog runtime rides the archive; attach_watchdog (called
         # by recover() with the persisted config) resumes it

@@ -80,7 +80,13 @@ class Watchdog:
             return "nan", {"max": repr(mx), "min": repr(mn),
                            "resid": repr(resid)}
         scale = max(abs(mx), abs(mn))
-        ref = max(1.0, float(q.get("value_scale", 1.0)))
+        # aggregate lanes (aggregates/) declare their kind's own healthy
+        # scale — a max-consensus lane legitimately sits AT its input
+        # extremum forever, a quantile bracket at 1.0 — so the
+        # divergence reference prefers kind_scale over the generic
+        # value_scale when the kind recorded one
+        ref = max(1.0, float(q.get("kind_scale",
+                                   q.get("value_scale", 1.0))))
         if scale > self.config.diverge_factor * ref:
             return "divergence", {"estimate_scale": scale,
                                   "value_scale": ref,

@@ -500,6 +500,16 @@ class TopoArrays:
     #                                  (partition a subtree, then heal)
     adv_down_from: object = None     # () int32 — first dead round
     adv_down_until: object = None    # () int32 — first healed round
+    # per-lane aggregate reduction modes (flow_updating_tpu.aggregates):
+    # (D,) int32 over the vector-payload lane axis — 0 = additive mean
+    # ledger (the plain protocol), 1 = max consensus, 2 = min consensus.
+    # Extrema lanes keep flow ≡ 0 and latch the cohort extremum into the
+    # value column, so the all-zero free-lane fixed point holds under
+    # every mode.  None (the default everywhere) is pytree STRUCTURE —
+    # mode selection is statically absent and the compiled program is
+    # bit-identical to the plain one; installing modes is ONE extra
+    # lowering, after which mode changes are `.at[]` data edits.
+    lane_modes: object = None
 
 
 def _symmetrize(pairs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

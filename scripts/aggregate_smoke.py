@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""CI aggregate-algebra smoke: every aggregate kind concurrent on ONE
+fabric program on the CPU proxy (ISSUE 16; docs/AGGREGATES.md).
+
+1. build an ``AggregateFabric`` (10k-node ER membership by default)
+   with probe-row recording on;
+2. submit all five kinds CONCURRENTLY — a sum/count pair, max + min
+   consensus lanes, an ε-quantile bracket bank and a standing windowed
+   mean — then drive scan segments while membership churn
+   (join/add-edge/leave of non-cohort members) runs between segments,
+   pushing fresh sample batches through the standing window;
+3. admit a second mixed wave into the retired lanes (extrema lanes must
+   recycle), asserting the round program compiled at most twice: the
+   plain program plus the one-time extrema ``lane_modes`` install;
+4. check every kind's read against its host oracle (extrema near-exact,
+   quantile within ``qeps * (hi - lo)``, sum/count within its own
+   error bound);
+5. write the ``flow-updating-query-report/v1`` manifest with the
+   ``aggregates`` block + probe rows and run ``doctor`` over it —
+   per-kind read contracts, extrema monotonicity, kind census, lane
+   compile-count, per-lane mass SLO.
+
+Exit code: the doctor's (0 healthy; 1 on any failing check), or 1 on
+any assertion above.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--nodes", type=int, default=10_000,
+                    help="initial members (erdos_renyi:N:6)")
+    ap.add_argument("--capacity", type=int, default=0,
+                    help="node-slot capacity (default: nodes + 64 "
+                         "churn headroom)")
+    ap.add_argument("--lanes", type=int, default=32,
+                    help="payload lanes shared by every kind")
+    ap.add_argument("--events", type=int, default=16,
+                    help="membership/edge churn events interleaved "
+                         "between segments")
+    ap.add_argument("--segment-rounds", type=int, default=8)
+    ap.add_argument("--eps", type=float, default=1e-3,
+                    help="mean-lane retirement tolerance")
+    ap.add_argument("--qeps", type=float, default=0.34,
+                    help="quantile rank tolerance (3 bracket lanes)")
+    ap.add_argument("--max-rounds", type=int, default=4096)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from flow_updating_tpu.aggregates import AggregateFabric
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.models.rounds import run_rounds
+    from flow_updating_tpu.obs.report import (
+        build_query_manifest,
+        write_report,
+    )
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    capacity = args.capacity or args.nodes + 64
+    t0 = time.perf_counter()
+    topo = erdos_renyi(args.nodes, avg_degree=6.0, seed=0)
+    fab = AggregateFabric(topo, lanes=args.lanes, capacity=capacity,
+                          degree_budget=24,
+                          segment_rounds=args.segment_rounds, seed=0,
+                          conv_eps=args.eps, probe_manifest=True)
+    print(f"aggregate_smoke: capacity {fab.svc.capacity} nodes x "
+          f"{fab.lanes} lanes, {fab.svc.live_count} members, built in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    cache0 = run_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    members = fab.svc.live_ids()
+
+    def cohort(m: int):
+        return np.sort(rng.choice(members, size=m, replace=False))
+
+    def submit_wave(tag: str) -> dict:
+        """One of each value kind over its own cohort + values; returns
+        {label: (aid, cohort_values)} for the oracle checks."""
+        out = {}
+        for label, kind, params in (
+                ("sum_count", "sum_count", {}),
+                ("max", "max", {}),
+                ("min", "min", {}),
+                ("quantile", "quantile",
+                 {"q": 0.5, "qeps": args.qeps})):
+            c = cohort(int(rng.integers(64, 256)))
+            vals = rng.random(c.size)
+            aid = fab.submit_aggregate(kind, vals, c, tag=tag,
+                                      **params)
+            out[label] = (aid, vals)
+        return out
+
+    wave1 = submit_wave("wave1")
+    win_cohort = cohort(128)
+    win_vals = [rng.random(128)]
+    win_aid = fab.submit_aggregate("windowed_mean", win_vals[0],
+                                   win_cohort, window=4, tag="standing")
+
+    def value_kinds_done(wave: dict) -> bool:
+        return all(fab.read_aggregate(aid)["status"] == "done"
+                   for aid, _ in wave.values())
+
+    held: list = []
+    events = rounds = pushes = 0
+
+    def churn(budget: int) -> None:
+        # joins wire in FRESH slots and leaves only remove them again,
+        # so every submitted cohort keeps its host oracle valid
+        nonlocal events
+        while events < args.events and budget > 0:
+            if held and rng.random() < 0.4:
+                fab.leave([held.pop()])
+                events += 1
+                budget -= 1
+            else:
+                slot = fab.join()
+                fab.add_edges([(slot, int(rng.integers(0, args.nodes)))])
+                held.append(slot)
+                events += 2
+                budget -= 2
+
+    while not value_kinds_done(wave1) and rounds < args.max_rounds:
+        churn(6)
+        if pushes < 3 and rounds and rounds % (4 * args.segment_rounds) == 0:
+            batch = rng.random(128)
+            win_vals.append(batch)
+            fab.push(win_aid, batch)
+            pushes += 1
+        fab.run(args.segment_rounds)
+        rounds += args.segment_rounds
+
+    # second wave: the freed lanes (extrema retire in ~diameter rounds)
+    # must recycle under the SAME program — mode flips are value edits
+    wave2 = submit_wave("wave2")
+    while (not value_kinds_done(wave2) and rounds < 2 * args.max_rounds):
+        churn(6)
+        fab.run(args.segment_rounds)
+        rounds += args.segment_rounds
+
+    compiles = run_rounds._cache_size() - cache0
+    if compiles > 2 or fab.compile_count > 2:
+        print(f"aggregate_smoke: round program compiled {compiles}x "
+              f"(fabric accounting {fab.compile_count}) across 2 mixed "
+              "waves + churn (budget: plain program + one extrema "
+              "lane_modes install = 2)", file=sys.stderr)
+        return 1
+    for name, wave in (("wave1", wave1), ("wave2", wave2)):
+        if not value_kinds_done(wave):
+            print(f"aggregate_smoke: {name} not done within {rounds} "
+                  "rounds", file=sys.stderr)
+            return 1
+        for label, (aid, vals) in wave.items():
+            read = fab.read_aggregate(aid, max_staleness=None)
+            res = read["result"]
+            got = float(res["mean"] if label == "sum_count"
+                        else res["value"])
+            truth = {"sum_count": float(np.mean(vals)),
+                     "max": float(np.max(vals)),
+                     "min": float(np.min(vals)),
+                     "quantile": float(np.sort(vals)[
+                         int(np.ceil(0.5 * vals.size)) - 1])}[label]
+            if label == "sum_count":
+                bound = float(res["mean_error_bound"]) + 1e-9
+            elif label == "quantile":
+                bound = args.qeps * (float(res["hi"])
+                                     - float(res["lo"])) + 1e-9
+            else:
+                bound = 1e-6
+            if abs(got - truth) > bound:
+                print(f"aggregate_smoke: {name}/{label} read {got!r} "
+                      f"vs oracle {truth!r} exceeds bound {bound:.3g}",
+                      file=sys.stderr)
+                return 1
+    win_read = fab.read_aggregate(win_aid, max_staleness=None)
+    win_truth = float(np.mean(np.concatenate(win_vals[-4:])))
+    restreams = len(fab._aggs[win_aid]["restreams"])
+    if restreams < pushes:
+        print(f"aggregate_smoke: standing window restreamed "
+              f"{restreams}x for {pushes} pushes", file=sys.stderr)
+        return 1
+
+    kinds = fab.aggregate_block()["kinds"]
+    print(f"aggregate_smoke: {len(kinds)} kinds "
+          f"({', '.join(sorted(kinds))}) over {fab.lanes} lanes, "
+          f"{events} membership events, {rounds} rounds, {compiles} "
+          f"compile(s), window mean {float(win_read['result']['mean']):.4f} "
+          f"(host {win_truth:.4f}, {pushes} pushes), "
+          f"{time.perf_counter() - t0:.1f}s total", file=sys.stderr)
+
+    manifest_path = os.path.join(args.outdir, "aggregate_report.json")
+    write_report(manifest_path, build_query_manifest(
+        argv=sys.argv[1:], config=fab.svc.config, topo=topo,
+        query=fab.query_block(),
+        extra={"aggregates": fab.aggregate_block()}))
+    return cli_main(["doctor", manifest_path])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,530 @@
+"""Aggregate algebra: per-kind conformance suite (docs/AGGREGATES.md).
+
+Contracts pinned here:
+
+* **per-kind bit-exactness** — every kind's lanes in a MIXED fabric are
+  bit-identical to an isolated fabric running only that kind, under
+  drop > 0 + membership churn, including the mode-masked program (the
+  mean-kind lanes of an extrema-installed fabric match the plain
+  program bitwise: the lane-mode select never perturbs mode-0 lanes);
+* **recycling across kinds** — a retired mean lane re-admitted as a max
+  lane inherits NOTHING (the scrub returns it to the all-zero fixed
+  point; the isolated oracle sat idle until the second admission);
+* **one-compile pin** — mixed-kind admission compiles the round program
+  at most twice (the plain lowering + the one lane-modes lowering), and
+  only once when no extrema kind is live;
+* **read contracts** — sum/count pairing, exact extrema consensus, the
+  quantile ``qeps * (hi - lo)`` error bound on a planted distribution,
+  windowed restreams mass-neutral bitwise;
+* **watchdog kind-locality** — a poisoned max lane is quarantined while
+  a live quantile bracket next to it stays bit-exact vs an unpoisoned
+  twin;
+* **per-kind adversary scenarios** — both registered aggregate
+  scenarios pass their declared signatures, and the
+  ``remove_adversary`` negative control fails at least one clause each;
+* **doctor negative directions** — every ``aggregate_*`` check FAILs on
+  a mutated manifest (miscounted pairing, non-monotone CDF,
+  backtracking probe max, census/budget mismatch);
+* **checkpoint round-trip** — restore re-installs the lane-modes leaf
+  and resumes bit-exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.aggregates import (
+    AGG_SCENARIOS,
+    AggregateFabric,
+    aggregate_scenario_manifest,
+    get_kind,
+    run_aggregate_scenario,
+)
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.obs import health
+from flow_updating_tpu.topology.generators import erdos_renyi, grid2d, ring
+
+
+def _cfg(**kw):
+    kw.setdefault("variant", "collectall")
+    kw.setdefault("fire_policy", "every_round")
+    kw.setdefault("dtype", "float64")
+    return RoundConfig(**kw)
+
+
+def _mk(topo, lanes, cfg, **kw):
+    kw.setdefault("capacity", 20)
+    kw.setdefault("degree_budget", 8)
+    kw.setdefault("edge_capacity", 96)
+    kw.setdefault("segment_rounds", 8)
+    kw.setdefault("seed", 1)
+    kw.setdefault("conv_eps", 1e-30)      # never retire: keep lanes live
+    return AggregateFabric(topo, lanes=lanes, config=cfg, **kw)
+
+
+PAYLOAD_LEAVES = ("value", "flow", "est", "last_avg", "pending_flow",
+                  "pending_est", "buf_flow", "buf_est")
+CONTROL_LEAVES = ("ticks", "fired", "alive", "edge_ok", "recv", "stamp",
+                  "pending_valid", "buf_valid", "t", "key")
+
+
+def _assert_column_parity(fab, iso, lane_f, lane_i, label=""):
+    for name in PAYLOAD_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fab.svc.state, name))[..., lane_f],
+            np.asarray(getattr(iso.svc.state, name))[..., lane_i],
+            err_msg=f"{label}: payload leaf {name} lane {lane_f} "
+                    f"diverged from the isolated oracle's lane {lane_i}")
+
+
+def _assert_control_parity(fab, iso):
+    for name in CONTROL_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fab.svc.state, name)),
+            np.asarray(getattr(iso.svc.state, name)),
+            err_msg=f"shared control leaf {name} diverged")
+
+
+def _churn(fab):
+    fab.svc.suspend([7])
+    fab.run(16)
+    fab.svc.resume([7])
+    slot = fab.join()
+    fab.add_edges([(slot, 0)])
+    fab.run(24)
+
+
+# ---- per-kind bit-exactness ----------------------------------------------
+
+def test_mixed_kind_lanes_bitexact_vs_isolated_oracles():
+    """The tentpole theorem: each kind's lanes in one mixed fabric
+    (sum/count + max + min + quantile concurrently, extrema lane-modes
+    installed) are bit-identical to a fabric running ONLY that kind —
+    with drop > 0 and suspend/resume + join churn.  In particular the
+    mean-kind oracles run the PLAIN program (no lane-modes leaf): the
+    mode select must never perturb a mode-0 lane, bitwise."""
+    topo = ring(12, k=2, seed=3)
+    cfg = _cfg(drop_rate=0.1)
+    rng = np.random.default_rng(7)
+    vals = rng.uniform(-2.0, 5.0, 8)
+    cohort = np.arange(8)
+    subs = {
+        "sum_count": dict(),
+        "max": dict(),
+        "min": dict(),
+        "quantile": dict(q=0.5, qeps=0.34),    # K = 3 bracket lanes
+    }
+    mixed = _mk(topo, 8, cfg)
+    aids = {k: mixed.submit_aggregate(k, vals, cohort=cohort, **p)
+            for k, p in subs.items()}
+    isos = {}
+    for k, p in subs.items():
+        iso = _mk(topo, 8, cfg)
+        isos[k] = (iso, iso.submit_aggregate(k, vals, cohort=cohort, **p))
+    assert mixed.extrema_installed and mixed.compile_budget == 2
+    assert not isos["sum_count"][0].extrema_installed
+    for fab in [mixed] + [f for f, _ in isos.values()]:
+        _churn(fab)
+    for kind, (iso, aid_i) in isos.items():
+        lanes_f = [mixed._queries[q]["lane"]
+                   for q in mixed._aggs[aids[kind]]["qids"]]
+        lanes_i = [iso._queries[q]["lane"]
+                   for q in iso._aggs[aid_i]["qids"]]
+        for lf, li in zip(lanes_f, lanes_i):
+            _assert_column_parity(mixed, iso, lf, li, label=kind)
+        _assert_control_parity(mixed, iso)
+        r_f = mixed.read_aggregate(aids[kind])["result"]
+        r_i = iso.read_aggregate(aid_i)["result"]
+        assert r_f == r_i, f"{kind}: combined reads diverged"
+
+
+def test_recycled_mean_lane_readmitted_as_max_inherits_nothing():
+    """Lane recycling ACROSS kinds: a lane that served a mean query,
+    retired (scrubbed) and was re-admitted as a max-consensus lane is
+    bit-identical to an isolated fabric that sat idle until the max
+    admission — no mean-era state survives the kind flip."""
+    topo = grid2d(4, 4, seed=0)
+    cfg = _cfg()
+    fab = AggregateFabric(topo, lanes=1, capacity=20, degree_budget=8,
+                          edge_capacity=96, config=cfg, segment_rounds=8,
+                          seed=2, conv_eps=1e-9)
+    iso = AggregateFabric(topo, lanes=1, capacity=20, degree_budget=8,
+                          edge_capacity=96, config=cfg, segment_rounds=8,
+                          seed=2, conv_eps=1e-9)
+    q1 = fab.submit(1.0)             # a plain mean query occupies lane 0
+    fab.run(128)
+    iso.run(128)
+    assert fab.read(q1)["status"] == "done"
+    assert not fab.extrema_installed    # mean-only era: plain program
+    vals = np.array([3.0, -7.0, 11.0])
+    cohort = np.array([2, 9, 13])
+    a_f = fab.submit_aggregate("max", vals, cohort=cohort)
+    a_i = iso.submit_aggregate("max", vals, cohort=cohort)
+    assert fab._queries[fab._aggs[a_f]["qids"][0]]["lane"] == 0
+    assert fab.extrema_installed and iso.extrema_installed
+    fab.run(64)
+    iso.run(64)
+    _assert_column_parity(fab, iso, 0, 0, label="recycled-max")
+    _assert_control_parity(fab, iso)
+    r = fab.read_aggregate(a_f)
+    assert r["result"]["value"] == 11.0
+    assert r["status"] == "done"
+
+
+# ---- compile accounting --------------------------------------------------
+
+def test_compile_pin_across_mixed_kind_admission():
+    """Mixed-kind admission/retirement churn costs at most TWO round
+    lowerings (plain + lane-modes) and one probe lowering; value-side
+    kinds alone stay at ONE.  check_query honors the declared budget."""
+    topo = ring(16, k=2, seed=2)
+    fab = AggregateFabric(topo, lanes=8, capacity=20, degree_budget=6,
+                          # NOT 96: that would alias test_query's compile-pin
+                          # fabric in the global jit cache and zero its delta
+                          edge_capacity=112, config=_cfg(),
+                          segment_rounds=4, seed=0, conv_eps=1e9)
+    n0 = run_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    fab.submit_aggregate("sum_count", rng.random(16))
+    fab.run(8)                          # value-side only: plain program
+    assert run_rounds._cache_size() == n0 + 1
+    assert fab.compile_budget == 1
+    kinds = ("sum_count", "max", "min", "quantile")
+    for i in range(12):
+        k = kinds[i % len(kinds)]
+        m = int(rng.integers(2, 6))
+        cohort = np.sort(rng.choice(16, size=m, replace=False))
+        params = {"qeps": 0.5} if k == "quantile" else {}
+        fab.submit_aggregate(k, rng.random(m), cohort=cohort, **params)
+        fab.run(8)
+    assert fab.retired_total >= 12
+    assert fab.extrema_installed and fab.compile_budget == 2
+    assert run_rounds._cache_size() == n0 + 2, \
+        "mixed-kind admission must cost exactly one extra lowering"
+    assert fab.compile_count <= 2
+    # the probe shares the arrays pytree, so the mid-life lane-modes
+    # install re-lowers it once too — the same one-extra-lowering bill
+    assert fab.probe_compile_count <= 2
+    by_name = {c.name: c for c in
+               health.check_query(fab.query_block(), dtype="float64")}
+    assert by_name["query_compile"].status == health.PASS
+    assert by_name["query_lane_mass"].status == health.PASS
+
+
+# ---- read contracts ------------------------------------------------------
+
+def test_sum_count_pairing_and_extrema_reads_exact():
+    topo = erdos_renyi(24, avg_degree=5.0, seed=1)
+    fab = AggregateFabric(topo, lanes=8, capacity=24, config=_cfg(),
+                          segment_rounds=4, seed=0, conv_eps=1e-9)
+    rng = np.random.default_rng(3)
+    vals = rng.uniform(-4.0, 9.0, 24)
+    a_sc = fab.submit_aggregate("sum_count", vals)
+    a_mx = fab.submit_aggregate("max", vals)
+    a_mn = fab.submit_aggregate("min", vals)
+    fab.run(256)
+    r = fab.read_aggregate(a_sc)
+    assert r["status"] == "done" and r["converged"]
+    res = r["result"]
+    assert abs(res["count"] - 24.0) <= res["count_error_bound"] + 1e-9
+    assert abs(res["sum"] - vals.sum()) <= res["error_bound"] + 1e-9
+    assert abs(res["mean"] - vals.mean()) <= res["mean_error_bound"] + 1e-9
+    # the latching consensus is exact up to the shifted-lattice
+    # round trip: (v - offset) + offset costs at most a couple of ulp
+    mx = fab.read_aggregate(a_mx)["result"]["value"]
+    mn = fab.read_aggregate(a_mn)["result"]["value"]
+    assert abs(mx - vals.max()) <= 4 * np.spacing(abs(vals.max()))
+    assert abs(mn - vals.min()) <= 4 * np.spacing(abs(vals.min()))
+    # with a zero offset (one-signed values) the read IS bit-exact
+    pos = np.abs(vals) + 1.0
+    a_px = fab.submit_aggregate("max", pos)
+    fab.run(128)
+    assert fab.read_aggregate(a_px)["result"]["value"] == pos.max()
+
+
+def test_quantile_error_bound_on_planted_distribution():
+    """A planted bimodal distribution: the inverted-CDF read lands
+    within qeps * (hi - lo) of the true inverted-CDF quantile, and the
+    recorded error bound equals the bracket width."""
+    topo = erdos_renyi(32, avg_degree=5.0, seed=4)
+    fab = AggregateFabric(topo, lanes=12, capacity=32, config=_cfg(),
+                          segment_rounds=4, seed=0, conv_eps=1e-9)
+    rng = np.random.default_rng(11)
+    vals = np.concatenate([rng.uniform(0.0, 1.0, 24),
+                           rng.uniform(9.0, 10.0, 8)])
+    rng.shuffle(vals)
+    q, qeps = 0.9, 0.1
+    aid = fab.submit_aggregate("quantile", vals, q=q, qeps=qeps)
+    fab.run(256)
+    read = fab.read_aggregate(aid)
+    assert read["status"] == "done"
+    res = read["result"]
+    lo, hi = vals.min(), vals.max()
+    s = np.sort(vals)
+    true_q = s[int(np.ceil(q * len(vals))) - 1]
+    assert abs(res["value"] - true_q) <= qeps * (hi - lo) + 1e-9
+    assert res["error_bound"] == pytest.approx((hi - lo) / 10)
+    assert all(b >= a - 1e-9 for a, b in zip(res["cdf"], res["cdf"][1:]))
+    # degenerate cohort: one bracket, exact answer
+    one = fab.submit_aggregate("quantile", np.full(4, 2.5),
+                               cohort=[0, 1, 2, 3], q=0.5, qeps=0.05)
+    fab.run(64)
+    assert fab.read_aggregate(one)["result"]["value"] == 2.5
+
+
+def test_windowed_push_mass_neutral_and_close_retires():
+    topo = erdos_renyi(16, avg_degree=4.0, seed=5)
+    fab = AggregateFabric(topo, lanes=4, capacity=16, config=_cfg(),
+                          segment_rounds=4, seed=0, conv_eps=1e-9)
+    rng = np.random.default_rng(2)
+    base = rng.uniform(0.0, 1.0, 16)
+    a_w = fab.submit_aggregate("windowed_mean", base, window=2)
+    a_d = fab.submit_aggregate("windowed_mean", base, decay=0.5)
+    fab.run(64)
+    # standing lanes never retire on convergence
+    assert all(fab._queries[q]["status"] == "active"
+               for q in fab._aggs[a_w]["qids"])
+    win = [base]
+    dec = base.copy()
+    for step in range(3):
+        nxt = rng.uniform(0.0, 1.0, 16) + step
+        row_w = fab.push(a_w, nxt)
+        row_d = fab.push(a_d, nxt)
+        assert row_w["neutral"] and row_d["neutral"]
+        win = (win + [nxt])[-2:]
+        dec = 0.5 * dec + 0.5 * nxt
+        fab.run(128)
+        host_w = np.mean(np.stack(win))
+        r_w = fab.read_aggregate(a_w)["result"]
+        assert abs(r_w["value"] - host_w) <= r_w["error_bound"] + 1e-9
+        r_d = fab.read_aggregate(a_d)["result"]
+        assert abs(r_d["value"] - dec.mean()) <= r_d["error_bound"] + 1e-9
+    assert fab.read_aggregate(a_w)["result"]["restreams"] == 3
+    fab.close(a_w)
+    fab.close(a_d)
+    fab.run(64)
+    assert fab.read_aggregate(a_w)["status"] == "done"
+    assert fab.active_lanes == 0        # both standing lanes released
+    with pytest.raises(ValueError, match="done"):
+        fab.push(a_w, base)
+    with pytest.raises(ValueError, match="standing"):
+        fab.push(fab.submit_aggregate("max", base), base)
+
+
+def test_registry_validation_errors():
+    enc = get_kind("quantile").encode
+    with pytest.raises(ValueError, match="q="):
+        enc(np.ones(4), {"q": 1.5})
+    with pytest.raises(ValueError, match="qeps="):
+        enc(np.ones(4), {"qeps": 0.0})
+    enc_w = get_kind("windowed_mean").encode
+    with pytest.raises(ValueError, match="exactly one"):
+        enc_w(np.ones(4), {})
+    with pytest.raises(ValueError, match="exactly one"):
+        enc_w(np.ones(4), {"window": 2, "decay": 0.5})
+    with pytest.raises(ValueError, match="decay="):
+        enc_w(np.ones(4), {"decay": 1.0})
+    with pytest.raises(KeyError, match="registered"):
+        get_kind("median")
+    topo = ring(8, k=1, seed=0)
+    fab = AggregateFabric(topo, lanes=2, capacity=10, degree_budget=4,
+                          config=_cfg(), segment_rounds=4)
+    with pytest.raises(ValueError, match="lanes"):
+        fab.submit_aggregate("quantile", np.arange(8.0), qeps=0.05)
+    with pytest.raises(ValueError, match="shape"):
+        fab.submit_aggregate("max", [1.0, 2.0], cohort=[0])
+
+
+# ---- watchdog kind-locality (satellite: non-mean lane coverage) ----------
+
+def test_poisoned_max_lane_quarantine_leaves_quantile_bitexact():
+    """A NaN-poisoned max-consensus lane is quarantined by the watchdog
+    while the quantile brackets living next to it stay BIT-EXACT vs an
+    unpoisoned twin — quarantine of one kind never perturbs siblings of
+    another kind."""
+    import jax.numpy as jnp
+
+    topo = erdos_renyi(24, avg_degree=5.0, seed=2)
+
+    def build():
+        f = AggregateFabric(topo, lanes=4, capacity=24, config=_cfg(),
+                            segment_rounds=8, seed=0,
+                            conv_eps=1e-30).attach_watchdog()
+        rng = np.random.default_rng(9)
+        vals = rng.uniform(0.0, 4.0, 24)
+        a_mx = f.submit_aggregate("max", vals)
+        a_q = f.submit_aggregate("quantile", vals, q=0.5, qeps=0.34)
+        return f, a_mx, a_q
+
+    fab, a_mx, a_q = build()
+    ctrl, c_mx, c_q = build()
+    # poison while the consensus lane is still ACTIVE — an extrema lane
+    # converges to spread exactly 0.0, so even eps=1e-30 retires it
+    lane = fab._queries[fab._aggs[a_mx]["qids"][0]]["lane"]
+    st = fab.svc.state
+    fab.svc.state = st.replace(
+        est=st.est.at[:, lane].set(jnp.nan),
+        flow=st.flow.at[:, lane].set(jnp.nan))
+    fab.run(16)
+    ctrl.run(16)
+    # the unpoisoned twin completed the same consensus cleanly
+    assert ctrl.read_aggregate(c_mx)["status"] == "done"
+    wd = fab._watchdog.block()
+    assert wd["quarantined_total"] == 1
+    assert wd["actions"][0]["lane"] == lane
+    assert wd["actions"][0]["reason"] == "nan"
+    read = fab.read_aggregate(a_mx)
+    assert read["status"] == "quarantined" and read["result"] is None
+    # the quarantined extrema lane scrubbed back to the exact-zero
+    # fixed point — and its mode slot returned to mean
+    assert abs(float(fab.mass_residual()[lane])) == 0.0
+    assert fab._lane_modes_host[lane] == 0
+    # sibling quantile lanes: bit-exact vs the unpoisoned twin
+    for qf, qc in zip(fab._aggs[a_q]["qids"], fab._aggs[c_q]["qids"]):
+        _assert_column_parity(fab, ctrl,
+                              fab._queries[qf]["lane"],
+                              ctrl._queries[qc]["lane"],
+                              label="quantile-sibling")
+    assert (fab.read_aggregate(a_q)["result"]
+            == ctrl.read_aggregate(c_q)["result"])
+
+
+# ---- per-kind adversary scenarios ----------------------------------------
+
+def test_aggregate_scenarios_conformance_and_negative_control():
+    """Both registered aggregate scenarios pass every declared clause;
+    re-run with the adversary removed, each fails at least one — the
+    signatures detect the fault, not the configuration."""
+    shrunk = {
+        name: dataclasses.replace(scn, segments=32)
+        for name, scn in AGG_SCENARIOS.items()
+    }
+    records = [run_aggregate_scenario(s) for s in shrunk.values()]
+    perturbed = [run_aggregate_scenario(s, perturb="remove_adversary")
+                 for s in shrunk.values()]
+    m = aggregate_scenario_manifest(
+        records, {"scenarios": sorted(shrunk)})
+    checks = [c for c in health.diagnose_manifest(m)
+              if c.name.startswith("scn:")]
+    assert checks and all(c.status == health.PASS for c in checks), \
+        [(c.name, c.summary) for c in checks if c.status != health.PASS]
+    pm = aggregate_scenario_manifest(
+        perturbed, {"scenarios": sorted(shrunk),
+                    "perturb": "remove_adversary"})
+    pchecks = health.diagnose_manifest(pm)
+    for name in shrunk:
+        fails = [c for c in pchecks
+                 if c.name.startswith(f"scn:{name}:")
+                 and c.status == health.FAIL]
+        assert fails, f"{name}: the negative control failed nothing"
+    with pytest.raises(ValueError, match="perturbation"):
+        run_aggregate_scenario(next(iter(shrunk.values())),
+                               perturb="typo")
+
+
+# ---- doctor negative directions ------------------------------------------
+
+def _small_manifest():
+    from flow_updating_tpu.obs.report import build_query_manifest
+
+    topo = erdos_renyi(24, avg_degree=5.0, seed=0)
+    # boundary every round: the extrema latch takes ~diameter rounds,
+    # so several probe rows carry the live max lane (the monotone
+    # check needs a trajectory, not a single row)
+    fab = AggregateFabric(topo, lanes=12, capacity=24, config=_cfg(),
+                          segment_rounds=1, seed=0, conv_eps=1e-9)
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(0.0, 10.0, 24)
+    fab.submit_aggregate("sum_count", vals)
+    fab.submit_aggregate("max", vals)
+    fab.submit_aggregate("min", vals)
+    fab.submit_aggregate("quantile", vals, q=0.5, qeps=0.25)
+    fab.run(160)
+    return build_query_manifest(config=fab.svc.config, topo=topo,
+                                query=fab.query_block(),
+                                extra={"aggregates":
+                                       fab.aggregate_block()})
+
+
+def test_check_aggregate_read_directions():
+    import copy
+
+    manifest = _small_manifest()
+    agg_checks = [c for c in health.diagnose_manifest(manifest)
+                  if c.name.startswith("aggregate")]
+    assert {c.name for c in agg_checks} == {
+        "aggregate_read", "aggregate_extrema_monotone",
+        "aggregate_kind_census"}
+    assert all(c.status == health.PASS for c in agg_checks), \
+        [(c.name, c.summary) for c in agg_checks]
+
+    def judge(m):
+        return {c.name: c.status for c in health.check_aggregate_read(
+            m["aggregates"], query=m["query"], dtype="float64")}
+
+    bad = copy.deepcopy(manifest)
+    for r in bad["aggregates"]["aggregates"]:
+        if r["kind"] == "sum_count":
+            r["read"]["result"]["count"] += 5.0
+    assert judge(bad)["aggregate_read"] == health.FAIL
+
+    bad = copy.deepcopy(manifest)
+    for r in bad["aggregates"]["aggregates"]:
+        if r["kind"] == "quantile":
+            r["read"]["result"]["cdf"][2] = 0.0
+    assert judge(bad)["aggregate_read"] == health.FAIL
+
+    bad = copy.deepcopy(manifest)
+    rows = bad["query"]["probe_rows"]
+    mxq = next(q for q in bad["query"]["queries"]
+               if q.get("lane_mode") == 1)
+    hits = [(i, r["lane_q"].index(mxq["qid"])) for i, r in
+            enumerate(rows) if mxq["qid"] in (r["lane_q"] or [])]
+    assert len(hits) >= 2
+    i, ln = hits[-1]
+    rows[i]["max"][ln] = rows[hits[0][0]]["max"][hits[0][1]] - 5.0
+    assert judge(bad)["aggregate_extrema_monotone"] == health.FAIL
+
+    bad = copy.deepcopy(manifest)
+    i, ln = hits[-1]
+    bad["query"]["probe_rows"][i]["resid"][ln] = 1e-12
+    assert judge(bad)["aggregate_extrema_monotone"] == health.FAIL
+
+    bad = copy.deepcopy(manifest)
+    bad["aggregates"]["extrema_installed"] = False
+    bad["aggregates"]["compile_budget"] = 1
+    assert judge(bad)["aggregate_kind_census"] == health.FAIL
+
+
+# ---- durability ----------------------------------------------------------
+
+def test_checkpoint_roundtrip_reinstalls_lane_modes(tmp_path):
+    topo = erdos_renyi(16, avg_degree=4.0, seed=3)
+    fab = AggregateFabric(topo, lanes=4, capacity=16, config=_cfg(),
+                          segment_rounds=4, seed=0, conv_eps=1e-30)
+    rng = np.random.default_rng(4)
+    vals = rng.uniform(-1.0, 1.0, 16)
+    a_mx = fab.submit_aggregate("max", vals)
+    a_w = fab.submit_aggregate("windowed_mean", vals, window=3)
+    fab.run(16)
+    path = str(tmp_path / "agg.ckpt")
+    fab.save_checkpoint(path)
+    rec = AggregateFabric.restore_checkpoint(path)
+    assert rec.extrema_installed and rec.compile_budget == 2
+    assert np.array_equal(rec._lane_modes_host, fab._lane_modes_host)
+    assert rec.state_digest() == fab.state_digest()
+    fab.run(16)
+    rec.run(16)
+    assert rec.state_digest() == fab.state_digest(), \
+        "restored aggregate fabric diverged — lane modes not re-installed"
+    assert (rec.read_aggregate(a_mx)["result"]
+            == fab.read_aggregate(a_mx)["result"])
+    # the standing window restreams identically on both sides
+    nxt = rng.uniform(-1.0, 1.0, 16)
+    assert fab.push(a_w, nxt)["neutral"]
+    assert rec.push(a_w, nxt)["neutral"]
+    fab.run(8)
+    rec.run(8)
+    assert rec.state_digest() == fab.state_digest()
