@@ -1049,6 +1049,11 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
         "admitted_total": fab.admitted_total,
         "retired_total": fab.retired_total,
         "admission_p95": block["admission_latency"].get("p95"),
+        "admission_p50": block["admission_latency"].get("p50"),
+        "admission_p99": block["admission_latency"].get("p99"),
+        "convergence_p50": block["convergence_latency"].get("p50"),
+        "convergence_p95": block["convergence_latency"].get("p95"),
+        "convergence_p99": block["convergence_latency"].get("p99"),
         "queued_at_end": fab.queued,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
@@ -1191,6 +1196,11 @@ def measure_aggregate_serve(topo, lanes: int, segment_rounds: int,
         "admitted_total": fab.admitted_total,
         "retired_total": fab.retired_total,
         "admission_p95": block["admission_latency"].get("p95"),
+        "admission_p50": block["admission_latency"].get("p50"),
+        "admission_p99": block["admission_latency"].get("p99"),
+        "convergence_p50": block["convergence_latency"].get("p50"),
+        "convergence_p95": block["convergence_latency"].get("p95"),
+        "convergence_p99": block["convergence_latency"].get("p99"),
         "queued_at_end": fab.queued,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
@@ -1387,6 +1397,26 @@ def run_serve_bench(args) -> dict:
         # (the dfl-row discipline): an unstable measurement never
         # becomes the key's baseline of record
         record_baseline(base_key, baseline_entry(topo, des))
+        # SLO latency rows (disjoint slo_* family, regress-gated like
+        # every recorded key): p95 admission/convergence latencies in
+        # rounds, inverted as 1/(1+p95) so "higher is better" holds
+        # for the shared regression comparator (+1 keeps the zero-
+        # queue admission case finite)
+        for slo, p95 in (("adm", sv["admission_p95"]),
+                         ("conv", sv["convergence_p95"])):
+            if p95 is None:
+                continue
+            record_baseline(
+                f"slo_{slo}_er{slug}_l{lanes}",
+                baseline_entry(topo, {
+                    "rounds_per_sec": 1.0 / (1.0 + float(p95)),
+                    "ticks": sv["completions"],
+                    "repeats": sv["windows"],
+                    "spread_pct": sv["spread_pct"],
+                    "note": (f"inverted p95 {slo} latency "
+                             f"(1/(1+rounds)) of the query fabric's "
+                             f"serve row; not a DES measurement"),
+                }))
     base_rps = recorded_baseline(base_key)
     base_src = "recorded" if base_rps is not None else "measured"
     if base_rps is None:

@@ -182,6 +182,12 @@ class AggregateFabric(QueryFabric):
                     lane_mode=int(plan.modes[i]),
                     kind_scale=float(plan.scales[i]),
                     standing=bool(spec.standing))
+                if self.spans is not None:
+                    # the trace names the algebra: an aggregate lane's
+                    # chain opens with its kind/aid (obs export-trace
+                    # titles the slice with it)
+                    self.spans.annotate(qid, kind=kind, aid=aid,
+                                        agg_lane_index=i)
                 agg["qids"].append(qid)
         finally:
             self._hold_admission = False

@@ -864,17 +864,34 @@ def cmd_serve(args) -> int:
             "wal_seq": (resil.get("wal") or {}).get("last_seq"),
             "recovered": svc._recovery is not None,
         }
+    # the flight recorder (obs/metrics.py): built AFTER the resilience
+    # block so doctor's metrics_consistency compares same-moment facts
+    trace = svc.serving_trace_block()
+    if getattr(args, "metrics", None):
+        if svc.metrics is None:
+            raise SystemExit(
+                "serve: --metrics needs the flight recorder (it is on "
+                "by default; this engine was restored from an archive "
+                "written without it)")
+        with open(args.metrics, "w") as f:
+            f.write(svc.metrics.to_prometheus())
+        out["metrics_path"] = args.metrics
     if args.report:
         from flow_updating_tpu.obs.report import (
             build_service_manifest,
             write_report,
         )
 
+        extra = {}
+        if resil is not None:
+            extra["recovery"] = resil
+        if trace is not None:
+            extra["serving_trace"] = trace
         write_report(args.report, build_service_manifest(
             argv=getattr(args, "_argv", None), config=svc.config,
             topo=topo, service=svc.service_block(),
             series=svc.boundary_series(), report=report,
-            extra={"recovery": resil} if resil is not None else None))
+            extra=extra or None))
         out["report_path"] = args.report
     print(json.dumps(out))
     return 0
@@ -930,7 +947,8 @@ def cmd_query(args) -> int:
                 edge_capacity=args.edge_capacity or None,
                 config=cfg, segment_rounds=args.segment_rounds,
                 seed=args.seed, conv_eps=args.eps,
-                admission_slo_rounds=args.admission_slo or None)
+                admission_slo_rounds=args.admission_slo or None,
+                convergence_slo_rounds=args.convergence_slo or None)
         except ValueError as err:
             raise SystemExit(f"invalid query configuration: {err}") from err
     if args.watchdog and fab._watchdog is None:
@@ -992,17 +1010,34 @@ def cmd_query(args) -> int:
             "recovered": fab._recovery is not None,
             "quarantined": fab.quarantined_total,
         }
+    # the flight recorder (obs/metrics.py): built AFTER the resilience
+    # block so doctor's metrics_consistency compares same-moment facts
+    trace = fab.serving_trace_block()
+    if getattr(args, "metrics", None):
+        if fab.metrics is None:
+            raise SystemExit(
+                "query: --metrics needs the flight recorder (it is on "
+                "by default; this fabric was restored from an archive "
+                "written without it)")
+        with open(args.metrics, "w") as f:
+            f.write(fab.metrics.to_prometheus())
+        out["metrics_path"] = args.metrics
     if args.report:
         from flow_updating_tpu.obs.report import (
             build_query_manifest,
             write_report,
         )
 
+        extra = {}
+        if resil is not None:
+            extra["recovery"] = resil
+        if trace is not None:
+            extra["serving_trace"] = trace
         write_report(args.report, build_query_manifest(
             argv=getattr(args, "_argv", None), config=fab.svc.config,
             topo=topo, query=block,
             timings={"wall_s": round(wall_s, 6)},
-            extra={"recovery": resil} if resil is not None else None))
+            extra=extra or None))
         out["report_path"] = args.report
     print(json.dumps(out))
     return 0
@@ -1121,10 +1156,14 @@ def cmd_oracle(args) -> int:
 
 def cmd_obs_export_trace(args) -> int:
     """``obs export-trace``: EventLog JSONL -> Chrome trace-event /
-    Perfetto JSON (open in chrome://tracing or ui.perfetto.dev)."""
+    Perfetto JSON (open in chrome://tracing or ui.perfetto.dev).
+    Serving manifests (serve/query/chaos runs carrying a
+    ``serving_trace`` block) render as lane tracks with query spans
+    plus metric counter tracks instead."""
     from flow_updating_tpu.obs.trace import (
         eventlog_to_chrome_trace,
         read_eventlog,
+        serving_manifest_to_chrome_trace,
     )
 
     if not os.path.exists(args.eventlog):
@@ -1140,11 +1179,29 @@ def cmd_obs_export_trace(args) -> int:
     if isinstance(doc, dict) and "schema" in doc:
         # a one-record JSONL event log also parses as a single JSON
         # object; only the schema key marks a manifest
+        if isinstance(doc.get("serving_trace"), dict) \
+                or isinstance(doc.get("query"), dict):
+            # a serving manifest with a flight-recorder block: the lane
+            # timeline IS the trace — render it
+            trace_doc = serving_manifest_to_chrome_trace(doc)
+            out = args.output or (args.eventlog + ".trace.json")
+            if out == "-":
+                json.dump(trace_doc, sys.stdout)
+                sys.stdout.write("\n")
+            else:
+                with open(out, "w") as f:
+                    json.dump(trace_doc, f)
+                print(json.dumps({
+                    "trace": out, "source": doc["schema"],
+                    "trace_events": len(trace_doc["traceEvents"]),
+                }))
+            return 0
         raise SystemExit(
             f"{args.eventlog}: this is a {doc['schema']} manifest, not "
             "an event log — export-trace consumes the JSONL file "
             "written by `run --event-log PATH` (manifests are judged "
-            "by `doctor`, field manifests by `inspect`)")
+            "by `doctor`, field manifests by `inspect`; serve/query "
+            "manifests with a serving_trace block DO render here)")
     records = read_eventlog(args.eventlog)
     if not records:
         raise SystemExit(
@@ -2120,6 +2177,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the flow-updating-service-report/v1 "
                          "manifest (capacity accounting, per-epoch mass "
                          "history, compile count) to PATH")
+    sv.add_argument("--metrics", metavar="PATH",
+                    help="write the flight recorder's streaming metrics "
+                         "as Prometheus text exposition to PATH at exit "
+                         "(obs/metrics.py; docs/OBSERVABILITY.md §8)")
     sv.set_defaults(fn=cmd_serve)
 
     qr = sub.add_parser(
@@ -2163,6 +2224,9 @@ def build_parser() -> argparse.ArgumentParser:
     qr.add_argument("--admission-slo", type=int, default=0,
                     help="admission-latency SLO in rounds (doctor's "
                          "query_admission budget; default: 2 segments)")
+    qr.add_argument("--convergence-slo", type=int, default=0,
+                    help="convergence-latency SLO in rounds (doctor's "
+                         "slo_latency p95 target; default: undeclared)")
     qr.add_argument("--fire-policy", default="every_round",
                     choices=("every_round", "reference"),
                     help="collect-all firing rule")
@@ -2189,6 +2253,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "manifest (lane/compile accounting, admission "
                          "latency vs SLO, per-boundary lane-mass rows) "
                          "to PATH")
+    qr.add_argument("--metrics", metavar="PATH",
+                    help="write the flight recorder's streaming metrics "
+                         "as Prometheus text exposition to PATH at exit "
+                         "(obs/metrics.py; docs/OBSERVABILITY.md §8)")
     qr.set_defaults(fn=cmd_query)
 
     ch = sub.add_parser(

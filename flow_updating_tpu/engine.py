@@ -38,23 +38,26 @@ logger = logging.getLogger("flow_updating_tpu.engine")
 TICK_INTERVAL = 1.0  # simulated seconds per round
 
 
-def _aot_timed(runner, state, arrays, *, cfg, num_rounds, spec, true_mean):
+def _aot_timed(runner, state, arrays, *, cfg, num_rounds, spec, true_mean,
+               **static_kw):
     """Run a jitted telemetry runner with the compile wall time measured
     separately via AOT lowering (``.lower().compile()``); falls back to a
     plain call (compile time folded into execution) when the runner or
     backend does not support AOT.  Returns ``(state, series, compile_s)``.
+    Extra keyword arguments must be static argnames of the runner (they
+    are omitted from the compiled call).
     """
     import time as _time
 
     try:
         lowered = runner.lower(state, arrays, cfg, num_rounds, spec,
-                               true_mean)
+                               true_mean, **static_kw)
         t0 = _time.perf_counter()
         compiled = lowered.compile()
         compile_s = _time.perf_counter() - t0
     except (AttributeError, TypeError, NotImplementedError):
         out_state, series = runner(state, arrays, cfg, num_rounds, spec,
-                                   true_mean)
+                                   true_mean, **static_kw)
         return out_state, series, None
     # the compiled call stays OUTSIDE the fallback: an execution-time
     # error must surface, not silently re-run the whole scan
@@ -1268,10 +1271,16 @@ class Engine:
                     f"{type(self._node_kernel).__name__} yet — use the "
                     "plain NodeKernel (spmv='xla'|'pallas'|'benes'|"
                     "'structured'), the pod kernel, or the edge kernel")
+            # tile-padded layouts (banded_fused) reduce over the
+            # real-node prefix so the series is bit-exact vs the
+            # unpadded twin; unpadded kernels trace unchanged
+            nn = self.topology.num_nodes
+            pad = getattr(self._node_kernel, "padded_size", nn)
             state, series, compile_s = _aot_timed(
                 sync.run_rounds_node_telemetry, self.state,
                 self._node_kernel.arrays,
-                cfg=self.config, num_rounds=n, spec=spec, true_mean=mean)
+                cfg=self.config, num_rounds=n, spec=spec, true_mean=mean,
+                n_live=nn if pad != nn else None)
         else:
             from flow_updating_tpu.models.rounds import run_rounds_telemetry
 

@@ -416,9 +416,11 @@ class NodeKernel:
     def run_telemetry(self, state: NodeSyncState, num_rounds: int, spec):
         """Device-resident per-round series (see
         :func:`run_rounds_node_telemetry`); returns ``(state, series)``."""
+        n = self.topo.num_nodes
         return run_rounds_node_telemetry(
             state, self.arrays, self.cfg, num_rounds, spec,
             self.topo.true_mean,
+            n_live=n if self.padded_size != n else None,
         )
 
     def run_fields(self, state: NodeSyncState, num_rounds: int, spec):
@@ -552,20 +554,30 @@ def run_rounds_node(
 
 
 def node_telemetry_sample(s: NodeSyncState, arrs: NodeSyncArrays, spec,
-                          mean) -> dict:
+                          mean, n_live: int | None = None) -> dict:
     """One round's metric row for the node-collapsed kernel (device-side).
     Same masking as :func:`_node_sample`: communicating rows only (deg > 0
     — padding has degree 0).  In fast sync mode every communicating node
     fires every round, so ``fired_total = t * active`` (accumulated in the
-    wide dtype — see models.rounds._fired_acc)."""
+    wide dtype — see models.rounds._fired_acc).
+
+    ``n_live`` (static) slices the reductions to the real-node prefix so
+    a tile-padded layout (``spmv='banded_fused'``) reproduces the
+    unpadded kernel's sums BIT-exactly — masking alone keeps the padding
+    out of the value but not out of the summation tree.  None (the
+    default, every unpadded kernel) traces the historical program
+    unchanged."""
     from flow_updating_tpu.models.rounds import _fired_acc
 
+    value, G = arrs.value, s.G
     real = arrs.inv_depp1 < 1.0
+    if n_live is not None:
+        value, G, real = value[:n_live], G[:n_live], real[:n_live]
     out = {"t": s.t}
     need_est = any(spec.has(m) for m in
                    ("rmse", "max_abs_err", "mass", "mass_residual"))
     if need_est:
-        est = arrs.value + s.G
+        est = value + G
         r_ex = _ex(real, est)
         if spec.has("rmse") or spec.has("max_abs_err"):
             err = jnp.where(r_ex, est - mean, 0)
@@ -581,7 +593,7 @@ def node_telemetry_sample(s: NodeSyncState, arrs: NodeSyncArrays, spec,
                 out["mass"] = mass
             if spec.has("mass_residual"):
                 out["mass_residual"] = mass - jnp.sum(
-                    jnp.where(_ex(real, arrs.value), arrs.value, 0),
+                    jnp.where(_ex(real, value), value, 0),
                     axis=0)
     active = jnp.sum(real.astype(jnp.int32))
     if spec.has("fired_total"):
@@ -592,14 +604,17 @@ def node_telemetry_sample(s: NodeSyncState, arrs: NodeSyncArrays, spec,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds", "spec"))
+@functools.partial(jax.jit, static_argnames=("cfg", "num_rounds", "spec",
+                                             "n_live"))
 def run_rounds_node_telemetry(
     state: NodeSyncState, arrs: NodeSyncArrays, cfg: RoundConfig,
-    num_rounds: int, spec, true_mean,
+    num_rounds: int, spec, true_mean, n_live: int | None = None,
 ):
     """Node-kernel twin of
     :func:`flow_updating_tpu.models.rounds.run_rounds_telemetry`: one
-    compiled scan, per-round series as scan ``ys``, one bulk transfer."""
+    compiled scan, per-round series as scan ``ys``, one bulk transfer.
+    ``n_live`` (static) is the real-node prefix for tile-padded layouts
+    — see :func:`node_telemetry_sample`."""
     if not spec.enabled:
         raise ValueError(
             "telemetry spec is disabled; run run_rounds_node() instead")
@@ -607,7 +622,7 @@ def run_rounds_node_telemetry(
 
     def body(s, _):
         s = node_round_step(s, arrs, cfg)
-        return s, node_telemetry_sample(s, arrs, spec, mean)
+        return s, node_telemetry_sample(s, arrs, spec, mean, n_live)
 
     state, series = jax.lax.scan(body, state, None, length=num_rounds)
     return state, series

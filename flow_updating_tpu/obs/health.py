@@ -808,6 +808,246 @@ def check_query(query: dict | None, *, dtype: str | None = None) -> list:
     return checks
 
 
+def _span_chain_gap(chain: list, t_end: int) -> str | None:
+    """Why one terminated query's span chain is NOT gap-free (None when
+    it is): ``submitted`` opens it, exactly one admission instant, and
+    the ``segment`` spans tile ``[admit, terminal]`` contiguously."""
+    if not chain or chain[0].get("name") != "submitted":
+        return "chain does not open with a submitted span"
+    admits = [c for c in chain
+              if str(c.get("name", "")).startswith("admitted@lane")]
+    if len(admits) != 1:
+        return (f"{len(admits)} admission instants (a query is admitted "
+                "exactly once)")
+    t_admit = int(admits[0]["t0"])
+    if int(chain[0]["t1"]) != t_admit:
+        return (f"submitted span ends at {chain[0]['t1']} but admission "
+                f"is at {t_admit} (queue time unaccounted)")
+    segs = sorted((c for c in chain if c.get("name") == "segment"),
+                  key=lambda c: int(c["t0"]))
+    if not segs:
+        return "no segment spans between admission and the terminal"
+    if int(segs[0]["t0"]) != t_admit:
+        return (f"first segment starts at {segs[0]['t0']}, admission "
+                f"was at {t_admit}")
+    for a, b in zip(segs, segs[1:]):
+        if int(b["t0"]) != int(a["t1"]):
+            return (f"segment gap: [{a['t0']},{a['t1']}] then "
+                    f"[{b['t0']},{b['t1']}]")
+    if int(segs[-1]["t1"]) != int(t_end):
+        return (f"last segment ends at {segs[-1]['t1']} but the "
+                f"terminal is at {t_end}")
+    return None
+
+
+def check_serving_trace(trace: dict | None, *,
+                        query: dict | None = None,
+                        recovery: dict | None = None) -> list:
+    """The serving flight recorder's checks
+    (``flow-updating-serving-trace/v1`` blocks; docs/OBSERVABILITY.md §8):
+
+    * **slo_latency** — every DECLARED latency target (admission /
+      convergence p95 rounds) against the measured windowed p95 of the
+      corresponding streaming histogram;
+    * **span_complete** — every terminated query has a gap-free span
+      chain (submitted → one admission → contiguous segments tiling
+      ``[admit, terminal]``), and a manifest that records a crash
+      recovery carries a ``recovery`` engine span whose replayed-record
+      count covers the WAL gap — a replay-disabled control FAILS here,
+      it does not skip;
+    * **metrics_consistency** — the streaming counters against the
+      manifest ground truth (query census totals, WAL sequence): the
+      black box must agree with the engine it recorded, *including*
+      across a SIGKILL + ``recover()`` (counters ride ring checkpoints,
+      WAL replay re-fires the increments).
+    """
+    if not trace:
+        return [CheckResult(
+            "serving_trace", SKIP,
+            "no serving_trace block recorded — the flight recorder was "
+            "off (construct the engine with observe=True, or pass "
+            "--metrics to serve/query)")]
+    checks = []
+    metrics = trace.get("metrics") or {}
+    hists = metrics.get("histograms") or {}
+    counters = metrics.get("counters") or {}
+    gauges = metrics.get("gauges") or {}
+    slo = trace.get("slo") or {}
+
+    # -- slo_latency -------------------------------------------------------
+    judged, unmeasured = [], []
+    for key, hist_name, label in (
+            ("admission_p95_rounds", "admission_latency_rounds",
+             "admission"),
+            ("convergence_p95_rounds", "convergence_latency_rounds",
+             "convergence")):
+        target = slo.get(key)
+        if target is None:
+            continue
+        h = hists.get(hist_name)
+        if not h or not h.get("count"):
+            unmeasured.append(label)
+            continue
+        p95 = float(h.get("p95", float("nan")))
+        judged.append({"slo": label, "target_rounds": float(target),
+                       "p95_rounds": p95,
+                       "ok": bool(math.isfinite(p95)
+                                  and p95 <= float(target))})
+    if not judged:
+        checks.append(CheckResult(
+            "slo_latency", SKIP,
+            "no declared latency SLO with measured observations"
+            + (f" (declared but unmeasured: {', '.join(unmeasured)})"
+               if unmeasured else ""),
+            {"declared": dict(slo)}))
+    else:
+        bad = [t for t in judged if not t["ok"]]
+        if bad:
+            worst = bad[0]
+            checks.append(CheckResult(
+                "slo_latency", FAIL,
+                f"{worst['slo']} latency SLO violated: measured p95 "
+                f"{worst['p95_rounds']:.0f} rounds > declared target "
+                f"{worst['target_rounds']:.0f}",
+                {"targets": judged, "unmeasured": unmeasured}))
+        else:
+            checks.append(CheckResult(
+                "slo_latency", PASS,
+                "measured p95 within every declared target ("
+                + ", ".join(f"{t['slo']} {t['p95_rounds']:.0f} <= "
+                            f"{t['target_rounds']:.0f} rounds"
+                            for t in judged) + ")",
+                {"targets": judged, "unmeasured": unmeasured}))
+
+    # -- span_complete -----------------------------------------------------
+    spans = trace.get("spans")
+    if not isinstance(spans, dict):
+        checks.append(CheckResult(
+            "span_complete", SKIP, "no span chains recorded"))
+    else:
+        chains = spans.get("queries") or {}
+        engine_spans = spans.get("engine") or []
+        terminal = ("retired", "quarantined")
+        bad_chains, n_terminated = [], 0
+        for qid, chain in chains.items():
+            terms = [c for c in chain if c.get("name") in terminal]
+            if not terms:
+                continue          # in-flight/queued: judged when done
+            n_terminated += 1
+            gap = _span_chain_gap(chain, int(terms[0]["t0"]))
+            if gap is not None:
+                bad_chains.append({"qid": qid, "problem": gap})
+        recovery_problem = None
+        replay = (recovery or {}).get("replay") or {}
+        if "records_pending" in replay:
+            pending = int(replay.get("records_pending", 0))
+            rspans = [s for s in engine_spans
+                      if s.get("name") == "recovery"]
+            if not rspans:
+                recovery_problem = (
+                    f"manifest records a recovery with {pending} WAL "
+                    "records pending but the trace has no recovery span "
+                    "— the trace is not continuous across the crash")
+            else:
+                s = rspans[-1]
+                replayed = int(s.get("records_replayed", 0))
+                if replayed != pending or (pending > 0
+                                           and not s.get("replay_enabled",
+                                                         False)):
+                    recovery_problem = (
+                        f"recovery span replayed {replayed} of {pending} "
+                        "pending WAL records (replay_enabled="
+                        f"{s.get('replay_enabled')}) — the span chains "
+                        "after the restored checkpoint were never "
+                        "regenerated")
+        if bad_chains or recovery_problem:
+            problems = ([recovery_problem] if recovery_problem else []) \
+                + [f"qid {b['qid']}: {b['problem']}"
+                   for b in bad_chains[:3]]
+            checks.append(CheckResult(
+                "span_complete", FAIL,
+                f"trace not gap-free: {problems[0]}"
+                + (f" (+{len(bad_chains) - 1} more chains)"
+                   if len(bad_chains) > 1 else ""),
+                {"bad_chains": bad_chains,
+                 "recovery_problem": recovery_problem,
+                 "terminated": n_terminated}))
+        elif n_terminated == 0 and "records_pending" not in replay:
+            checks.append(CheckResult(
+                "span_complete", SKIP,
+                "no terminated query to judge (all chains in flight)",
+                {"chains": len(chains)}))
+        else:
+            checks.append(CheckResult(
+                "span_complete", PASS,
+                f"all {n_terminated} terminated chains gap-free"
+                + (" incl. continuity across a recorded recovery "
+                   f"({int(replay.get('records_pending', 0))} WAL "
+                   "records replayed)"
+                   if "records_pending" in replay else ""),
+                {"terminated": n_terminated, "chains": len(chains),
+                 "engine_spans": len(engine_spans)}))
+
+    # -- metrics_consistency -----------------------------------------------
+    if not counters and not gauges:
+        checks.append(CheckResult(
+            "metrics_consistency", SKIP, "no counters recorded"))
+    else:
+        mismatches, compared = [], []
+
+        def _cmp(counter_name, truth, source):
+            if truth is None:
+                return
+            got = float(counters.get(counter_name, 0.0))
+            compared.append({"counter": counter_name, "value": got,
+                             "truth": float(truth), "source": source})
+            if got != float(truth):
+                mismatches.append(compared[-1])
+
+        if query:
+            qs = query.get("queries")
+            _cmp("queries_submitted_total",
+                 len(qs) if isinstance(qs, list) else None,
+                 "len(query.queries)")
+            _cmp("queries_admitted_total", query.get("admitted_total"),
+                 "query.admitted_total")
+            _cmp("queries_retired_total", query.get("retired_total"),
+                 "query.retired_total")
+            _cmp("queries_quarantined_total",
+                 query.get("quarantined_total"),
+                 "query.quarantined_total")
+        wal = (recovery or {}).get("wal") or {}
+        if wal.get("last_seq") is not None \
+                and gauges.get("wal_last_seq") is not None:
+            got = float(gauges["wal_last_seq"])
+            truth = float(wal["last_seq"])
+            compared.append({"counter": "wal_last_seq (gauge)",
+                             "value": got, "truth": truth,
+                             "source": "recovery.wal.last_seq"})
+            if got != truth:
+                mismatches.append(compared[-1])
+        if not compared:
+            checks.append(CheckResult(
+                "metrics_consistency", SKIP,
+                "no manifest ground truth to compare the counters "
+                "against (no query/recovery block)"))
+        elif mismatches:
+            m = mismatches[0]
+            checks.append(CheckResult(
+                "metrics_consistency", FAIL,
+                f"counter {m['counter']} = {m['value']:g} but "
+                f"{m['source']} = {m['truth']:g} — the black box "
+                "disagrees with the engine it recorded",
+                {"mismatches": mismatches, "compared": compared}))
+        else:
+            checks.append(CheckResult(
+                "metrics_consistency", PASS,
+                f"all {len(compared)} counters match the manifest "
+                "ground truth",
+                {"compared": compared}))
+    return checks
+
+
 def check_aggregate_read(aggregates: dict | None, *,
                          query: dict | None = None,
                          dtype: str | None = None) -> list:
@@ -1743,6 +1983,15 @@ def diagnose_manifest(manifest: dict) -> list:
         # a flow-updating-recovery-report/v1 manifest (or any manifest
         # from a durability-armed engine): the crash-safety SLOs
         checks.extend(check_recovery(recovery))
+    trace = manifest.get("serving_trace")
+    if isinstance(trace, dict):
+        # the serving flight recorder's block rides serve/query/recovery
+        # manifests: latency SLOs, span-chain continuity (incl. across
+        # a recorded crash recovery), counter-vs-ground-truth agreement
+        checks.extend(check_serving_trace(
+            trace,
+            query=query if isinstance(query, dict) else None,
+            recovery=recovery if isinstance(recovery, dict) else None))
     results = manifest.get("results")
     if (isinstance(results, list) and results
             and isinstance(results[0], dict)

@@ -37,6 +37,12 @@ AUDIT_SCHEMA = "flow-updating-audit-report/v1"
 QUERY_SCHEMA = "flow-updating-query-report/v1"
 RECOVERY_SCHEMA = "flow-updating-recovery-report/v1"
 BUDGET_SCHEMA = "flow-updating-budget-report/v1"
+#: The serving flight recorder's embedded block (NOT a top-level
+#: manifest schema): serve/query/recovery manifests carry it under the
+#: ``serving_trace`` key — declared SLO targets + streaming metrics +
+#: span chains (obs/metrics.py, obs/spans.py; doctor's ``slo_latency``
+#: / ``span_complete`` / ``metrics_consistency`` checks judge it).
+SERVING_TRACE_SCHEMA = "flow-updating-serving-trace/v1"
 
 
 def environment_info() -> dict:

@@ -1,0 +1,173 @@
+"""Per-query span tracing for the serving stack.
+
+Every query admitted into the fabric carries a *span chain* — the
+time-resolved record of its life on the lane plane::
+
+    submitted ── admitted@lane ── segment ── ... ── segment ── converged
+                                                          └─ retired | quarantined
+
+recorded host-side at the segment boundaries the fabric already owns
+(query/fabric.py ``_boundary``): zero new device work, zero extra
+compiles.  Span timestamps are *round clocks* (the fabric's logical
+time), not wall time — the chain is therefore deterministic and
+bit-reproducible across a WAL replay, which is what makes the trace
+crash-surviving:
+
+* the recorder's state rides ring checkpoints (``state_dict()`` under
+  the checkpoint's ``obs`` meta key, next to the lane tables);
+* spans between the restored checkpoint and the crash are regenerated
+  by WAL replay — the replayed ``submit``/``run`` records re-fire the
+  same hooks at the same round clocks;
+* ``recover()`` (resilience/recover.py) appends an explicit engine-level
+  ``recovery`` span covering ``[base_clock, recovered_clock]`` with the
+  replay evidence, so a recovered trace is *continuous* and says so.
+
+Doctor's ``span_complete`` check (obs/health.py) judges the result: every
+completed query must have a gap-free chain — contiguous segment spans
+from admission to retirement — and a manifest that records a crash
+recovery must carry a ``recovery`` span whose replayed-record count
+covers the WAL gap (a recovery-disabled control FAILS, not skips).
+
+Chain vocabulary (docs/OBSERVABILITY.md §8):
+
+* ``submitted`` — span ``[submit_round, admit_round]``: time in the
+  admission queue (zero-length when a free lane was available);
+* ``admitted@lane{L}`` — instant at admission, naming the lane;
+* ``segment`` — one span per compiled scan segment the query was live
+  for, ``[boundary, next boundary]``, contiguous by construction;
+* ``converged`` — instant at the boundary whose probe verdict retired
+  the lane;
+* ``read`` — instant at the first successful ``read()`` (bounded: one
+  per query, re-reads are not re-recorded);
+* ``retired`` / ``quarantined`` — the terminal instant (quarantines
+  carry the watchdog's reason).
+
+Engine-level spans (not tied to one query) live on a separate track:
+``recovery`` (above) and the watchdog's ``degraded`` backoff episodes
+(resilience/watchdog.py), each ``[start_t, end_t]`` with evidence args.
+"""
+
+from __future__ import annotations
+
+
+class SpanRecorder:
+    """Host-side span chains, keyed by query id, plus engine-level spans.
+
+    All timestamps are round clocks; memory is bounded by the query
+    census the fabric already keeps (a handful of spans per query, one
+    open-segment cursor per active lane).
+    """
+
+    def __init__(self):
+        # qid (str keys: JSON round-trips through checkpoint meta)
+        self._chains: dict[str, list] = {}
+        self._engine: list = []
+        #: qid -> start clock of the currently open segment span
+        self._open_seg: dict[str, int] = {}
+
+    # ---- recording hooks (called by the serving engines) ----------------
+
+    def span(self, qid, name: str, t0, t1, **attrs) -> None:
+        rec = {"name": name, "t0": int(t0), "t1": int(t1)}
+        if attrs:
+            rec.update(attrs)
+        self._chains.setdefault(str(qid), []).append(rec)
+
+    def engine_span(self, name: str, t0, t1, **attrs) -> None:
+        rec = {"name": name, "t0": int(t0), "t1": int(t1)}
+        if attrs:
+            rec.update(attrs)
+        self._engine.append(rec)
+
+    def submitted(self, qid, t) -> None:
+        """Open the chain: the ``submitted`` span starts in the queue
+        (t1 back-filled at admission; an unadmitted query keeps
+        ``t1 == t0`` so partial chains still render)."""
+        self.span(qid, "submitted", t, t)
+
+    def admitted(self, qid, lane: int, t) -> None:
+        chain = self._chains.get(str(qid))
+        if chain and chain[0]["name"] == "submitted":
+            chain[0]["t1"] = int(t)       # queue time now known
+        self.span(qid, f"admitted@lane{int(lane)}", t, t, lane=int(lane))
+        self._open_seg[str(qid)] = int(t)
+
+    def boundary(self, t) -> None:
+        """Close one ``segment`` span per active query at a segment
+        boundary (called at the top of the fabric's ``_boundary``,
+        before the watchdog/retire verdicts stamp terminals at ``t``)."""
+        t = int(t)
+        for qid, start in self._open_seg.items():
+            if t > start:
+                chain = self._chains.get(qid)
+                lane = None
+                if chain:
+                    for rec in chain:
+                        if "lane" in rec:
+                            lane = rec["lane"]
+                self.span(qid, "segment", start, t,
+                          **({"lane": lane} if lane is not None else {}))
+                self._open_seg[qid] = t
+
+    def converged(self, qid, t) -> None:
+        self.span(qid, "converged", t, t)
+
+    def retired(self, qid, t) -> None:
+        self.span(qid, "retired", t, t)
+        self._open_seg.pop(str(qid), None)
+
+    def quarantined(self, qid, t, reason: str | None = None) -> None:
+        self.span(qid, "quarantined", t, t,
+                  **({"reason": reason} if reason else {}))
+        self._open_seg.pop(str(qid), None)
+
+    def read(self, qid, t) -> None:
+        """First-read instant; bounded to one per query (aggregate
+        fabrics re-read every lane per ``aggregate_block``)."""
+        chain = self._chains.get(str(qid))
+        if chain is not None and not any(r["name"] == "read"
+                                         for r in chain):
+            self.span(qid, "read", t, t)
+
+    def annotate(self, qid, **attrs) -> None:
+        """Attach attributes (aggregate kind, tag, ...) to the chain's
+        opening span."""
+        chain = self._chains.get(str(qid))
+        if chain:
+            chain[0].update(attrs)
+
+    # ---- read path -------------------------------------------------------
+
+    def chain(self, qid) -> list:
+        return list(self._chains.get(str(qid), ()))
+
+    def block(self) -> dict:
+        """The manifest-embeddable JSON block (serving-trace schema)."""
+        return {
+            "queries": {qid: list(chain)
+                        for qid, chain in sorted(self._chains.items(),
+                                                 key=lambda kv: kv[0])},
+            "engine": list(self._engine),
+            "total": (sum(len(c) for c in self._chains.values())
+                      + len(self._engine)),
+        }
+
+    # ---- checkpoint ride -------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "chains": {qid: list(chain)
+                       for qid, chain in self._chains.items()},
+            "engine": list(self._engine),
+            "open_seg": dict(self._open_seg),
+        }
+
+    @classmethod
+    def load_state(cls, state: dict) -> SpanRecorder:
+        rec = cls()
+        rec._chains = {str(k): list(v)
+                       for k, v in (state.get("chains") or {}).items()}
+        rec._engine = list(state.get("engine") or ())
+        rec._open_seg = {str(k): int(v)
+                         for k, v in (state.get("open_seg") or {}).items()}
+        return rec

@@ -179,3 +179,135 @@ def eventlog_to_chrome_trace(records) -> dict:
             "dur": max(last_ts - start, 1.0), "args": {"open": True},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---- serving manifests (the flight recorder's span chains) ---------------
+
+def _chain_parts(chain: list) -> dict:
+    """Decompose one query's span chain: admission lane/time, terminal,
+    segments, instants, and the opening span's attributes."""
+    out: dict = {"lane": None, "t_submit": None, "t_admit": None,
+                 "t_end": None, "terminal": None, "segments": [],
+                 "instants": [], "attrs": {}}
+    for rec in chain:
+        name = str(rec.get("name", ""))
+        if name == "submitted":
+            out["t_submit"] = int(rec["t0"])
+            out["attrs"] = {k: v for k, v in rec.items()
+                            if k not in ("name", "t0", "t1")}
+        elif name.startswith("admitted@lane"):
+            out["lane"] = int(rec.get("lane", 0))
+            out["t_admit"] = int(rec["t0"])
+        elif name == "segment":
+            out["segments"].append((int(rec["t0"]), int(rec["t1"])))
+        elif name in ("retired", "quarantined"):
+            out["terminal"] = name
+            out["t_end"] = int(rec["t0"])
+            if name == "quarantined" and rec.get("reason"):
+                out["attrs"]["reason"] = rec["reason"]
+        elif name in ("converged", "read"):
+            out["instants"].append((name, int(rec["t0"])))
+    if out["t_end"] is None:       # still active: close at last segment
+        if out["segments"]:
+            out["t_end"] = out["segments"][-1][1]
+        elif out["t_admit"] is not None:
+            out["t_end"] = out["t_admit"]
+    return out
+
+
+def serving_manifest_to_chrome_trace(manifest: dict) -> dict:
+    """Render a serve/query/recovery manifest carrying a
+    ``serving_trace`` block (obs/metrics.py + obs/spans.py) as a Chrome
+    trace-event document: one thread lane per fabric lane with each
+    query's life as a complete slice (its segment spans nested inside),
+    a ``queue`` lane for pre-admission waits, an ``engine`` lane for
+    recovery/degraded spans, and counter tracks from the per-boundary
+    metric samples (lane occupancy, queue depth, WAL/checkpoint
+    accounting).  Timestamps are round clocks scaled like the event-log
+    path (1 round == 1 simulated second)."""
+    trace = manifest.get("serving_trace") or {}
+    spans = trace.get("spans") or {}
+    chains = spans.get("queries") or {}
+    engine_spans = spans.get("engine") or []
+    samples = (trace.get("metrics") or {}).get("samples") or []
+    if not chains and not engine_spans and not samples:
+        raise ValueError(
+            "manifest has no serving_trace span chains or metric "
+            "samples to render — run serve/query with the flight "
+            "recorder on (observe=True, the default) and --report")
+    events: list = [
+        {"ph": "M", "name": "process_name", "pid": PID_SIM,
+         "args": {"name": "lanes"}},
+        {"ph": "M", "name": "process_name", "pid": PID_METRICS,
+         "args": {"name": "metrics"}},
+        {"ph": "M", "name": "thread_name", "pid": PID_METRICS, "tid": 0,
+         "args": {"name": "boundary samples"}},
+    ]
+    lanes = _Lanes(events)
+    queue_tid = lanes.tid("queue")
+    engine_tid = lanes.tid("engine")
+
+    def _qname(qid, attrs) -> str:
+        kind = attrs.get("kind")
+        return f"q{qid}" + (f" [{kind}]" if kind else "")
+
+    for qid in sorted(chains, key=lambda q: (len(q), q)):
+        p = _chain_parts(chains[qid])
+        name = _qname(qid, p["attrs"])
+        if p["t_submit"] is not None and p["t_admit"] is not None \
+                and p["t_admit"] > p["t_submit"]:
+            events.append({
+                "ph": "X", "name": f"{name} queued", "cat": "queue",
+                "pid": PID_SIM, "tid": queue_tid,
+                "ts": p["t_submit"] * _US,
+                "dur": (p["t_admit"] - p["t_submit"]) * _US,
+            })
+        if p["lane"] is None:
+            continue               # never admitted: queue slice only
+        tid = lanes.tid(f"lane {p['lane']}")
+        events.append({
+            "ph": "X", "name": name, "cat": "query", "pid": PID_SIM,
+            "tid": tid, "ts": p["t_admit"] * _US,
+            "dur": max((p["t_end"] - p["t_admit"]) * _US, 1.0),
+            "args": {**p["attrs"], "qid": qid,
+                     "terminal": p["terminal"],
+                     "segments": len(p["segments"])},
+        })
+        for t0, t1 in p["segments"]:
+            events.append({
+                "ph": "X", "name": "seg", "cat": "segment",
+                "pid": PID_SIM, "tid": tid, "ts": t0 * _US,
+                "dur": max((t1 - t0) * _US, 1.0),
+            })
+        for iname, t in p["instants"]:
+            events.append({
+                "ph": "i", "name": f"{name} {iname}", "cat": "query",
+                "pid": PID_SIM, "tid": tid, "ts": t * _US, "s": "t",
+            })
+        if p["terminal"] == "quarantined":
+            events.append({
+                "ph": "i", "name": f"{name} quarantined",
+                "cat": "query", "pid": PID_SIM, "tid": tid,
+                "ts": p["t_end"] * _US, "s": "p",
+                "args": dict(p["attrs"]),
+            })
+    for rec in engine_spans:
+        t0, t1 = int(rec["t0"]), int(rec["t1"])
+        events.append({
+            "ph": "X", "name": str(rec.get("name", "?")),
+            "cat": "engine", "pid": PID_SIM, "tid": engine_tid,
+            "ts": t0 * _US, "dur": max((t1 - t0) * _US, 1.0),
+            "args": {k: v for k, v in rec.items()
+                     if k not in ("name", "t0", "t1")},
+        })
+    for row in samples:
+        ts = float(row.get("t", 0)) * _US
+        for field, value in row.items():
+            if field == "t" or not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue
+            events.append({
+                "ph": "C", "name": field, "pid": PID_METRICS, "tid": 0,
+                "ts": ts, "args": {field: value},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -71,6 +71,8 @@ import heapq
 import numpy as np
 
 from flow_updating_tpu.models.config import COLLECTALL, RoundConfig
+from flow_updating_tpu.obs.metrics import MetricsRegistry
+from flow_updating_tpu.obs.spans import SpanRecorder
 from flow_updating_tpu.service import ServiceEngine
 from flow_updating_tpu.topology.padding import masked_values
 
@@ -145,7 +147,9 @@ class QueryFabric:
                  segment_rounds: int = 32, seed: int = 0,
                  conv_eps: float = 1e-6,
                  admission_slo_rounds: int | None = None,
-                 probe_manifest: bool = False):
+                 probe_manifest: bool = False,
+                 convergence_slo_rounds: int | None = None,
+                 observe: bool = True):
         if lanes < 1:
             raise ValueError(f"lanes={lanes} must be >= 1")
         if conv_eps <= 0:
@@ -157,12 +161,19 @@ class QueryFabric:
             edge_capacity=edge_capacity, config=cfg,
             segment_rounds=segment_rounds, seed=seed,
             values=np.zeros((topo.num_nodes, int(lanes))),
-            boundary_samples=False)
+            # the fabric owns the single flight recorder for the whole
+            # stack; the inner service records nothing of its own
+            boundary_samples=False, observe=False)
         self.lanes = int(lanes)
         self.conv_eps = float(conv_eps)
         self.admission_slo_rounds = (2 * self.svc.segment_rounds
                                      if admission_slo_rounds is None
                                      else int(admission_slo_rounds))
+        # an OPTIONAL declared convergence-latency p95 target (rounds
+        # admit->retire); doctor's slo_latency judges it when declared
+        self.convergence_slo_rounds = (None if convergence_slo_rounds
+                                       is None
+                                       else int(convergence_slo_rounds))
         self._free_lanes = list(range(self.lanes))
         heapq.heapify(self._free_lanes)
         self._lane_q: list = [None] * self.lanes    # lane -> active qid
@@ -182,6 +193,15 @@ class QueryFabric:
         self.retired_total = 0
         self.peak_active = 0
         self.quarantined_total = 0
+        # the serving flight recorder (obs/metrics.py, obs/spans.py):
+        # host-side streaming metrics + per-query span chains, sampled
+        # at the boundaries this class already owns — zero device work.
+        # ``observe=False`` turns the whole plane off (the purity twin:
+        # tests pin the lowered program and state evolution identical)
+        self.metrics = MetricsRegistry() if observe else None
+        self.spans = SpanRecorder() if observe else None
+        self._conv_latencies: list = []   # admit->retire rounds
+        self._degraded_spanned = 0        # closed episodes span-recorded
         self._watchdog = None
         self._watchdog_pending_state = None
         self._init_resilience()
@@ -200,6 +220,9 @@ class QueryFabric:
         if self._wal is not None and not self._replaying:
             self._wal_applied_seq = self._wal.append(kind, args,
                                                      self.clock)
+            if self.metrics is not None:
+                self.metrics.observe("wal_fsync_seconds",
+                                     self._wal.last_fsync_s)
 
     def enable_durability(self, directory: str, *,
                           checkpoint_every: int = 8, retain: int = 3,
@@ -281,7 +304,13 @@ class QueryFabric:
         if self._recovery is not None:
             out.update(self._recovery)
         if self._wal is not None:
-            out.setdefault("wal", self._wal.block())
+            # live accounting wins over the recovery-time scan (the
+            # scan's extra evidence keys survive; the pre-replay seq is
+            # kept as replay.base_wal_seq) so doctor's
+            # metrics_consistency compares same-moment figures
+            wal = dict(out.get("wal") or {})
+            wal.update(self._wal.block())
+            out["wal"] = wal
         if self._ring is not None:
             ring = dict(out.get("ring") or {})
             ring.update(self._ring.block())
@@ -432,6 +461,10 @@ class QueryFabric:
             "_values": vals,
         }
         self._queue.append(qid)
+        if self.spans is not None:
+            self.spans.submitted(qid, self.clock)
+        if self.metrics is not None:
+            self.metrics.inc("queries_submitted_total")
         self._admit_free()
         return qid
 
@@ -492,6 +525,11 @@ class QueryFabric:
             q["_values"] = None
             self._lane_q[lane] = qid
             self._latencies.append(self.clock - q["submit_round"])
+            if self.spans is not None:
+                self.spans.admitted(qid, lane, self.clock)
+            if self.metrics is not None:
+                self.metrics.observe("admission_latency_rounds",
+                                     self.clock - q["submit_round"])
             lanes.append(lane)
         st = self.svc.state
         li = jnp.asarray(np.asarray(lanes, np.int32))
@@ -499,6 +537,8 @@ class QueryFabric:
             value=st.value.at[:, li].set(
                 jnp.asarray(np.stack(cols, axis=1), st.value.dtype)))
         self.admitted_total += len(lanes)
+        if self.metrics is not None:
+            self.metrics.inc("queries_admitted_total", len(lanes))
         self.peak_active = max(self.peak_active, self.active_lanes)
         self._probe = None
         return len(lanes)
@@ -536,13 +576,17 @@ class QueryFabric:
         doctor's ``quarantine_mass`` evidence)."""
         lanes = [lane for lane, *_ in items]
         self._scrub_lanes(lanes)
-        for lane, qid, _reason, _ev in items:
+        for lane, qid, reason, _ev in items:
             q = self._queries[qid]
             q.update(status="quarantined", done_round=self.clock,
                      result=None)
             self._lane_q[lane] = None
             heapq.heappush(self._free_lanes, lane)
+            if self.spans is not None:
+                self.spans.quarantined(qid, self.clock, reason=reason)
         self.quarantined_total += len(items)
+        if self.metrics is not None:
+            self.metrics.inc("queries_quarantined_total", len(items))
         self._probe = None
         probe = self._probe_fresh()
         return [{
@@ -583,11 +627,20 @@ class QueryFabric:
             self._boundary()
             svc._pending_events = []
         if self._ring is not None and rounds:
-            self._ring.tick(self, self._wal_applied_seq,
-                            segments=rounds // seg)
+            wrote = self._ring.tick(self, self._wal_applied_seq,
+                                    segments=rounds // seg)
+            if wrote is not None and self.metrics is not None:
+                self.metrics.inc("checkpoints_written_total")
+                self.metrics.observe("checkpoint_write_seconds",
+                                     self._ring.last_write_s)
         return self
 
     def _boundary(self) -> dict:
+        if self.spans is not None:
+            # close one segment span per active query BEFORE the
+            # watchdog/retire verdicts stamp terminals at this clock —
+            # the chain stays gap-free up to the terminal
+            self.spans.boundary(self.clock)
         probe = self._probe_fresh()
         if self._watchdog is not None:
             # the watchdog rides THIS probe (zero extra compiles); a
@@ -627,6 +680,13 @@ class QueryFabric:
                 r["rounds"] = self.clock - q["admit_round"]
                 q.update(status="done", done_round=self.clock, result=r)
                 done.append(ln)
+                self._conv_latencies.append(int(r["rounds"]))
+                if self.spans is not None:
+                    self.spans.converged(q["qid"], self.clock)
+                    self.spans.retired(q["qid"], self.clock)
+                if self.metrics is not None:
+                    self.metrics.observe("convergence_latency_rounds",
+                                         r["rounds"])
         if done:
             self._scrub_lanes(done)
             for ln in done:
@@ -637,6 +697,8 @@ class QueryFabric:
                     # query's stall window
                     self._watchdog._lane_trend.pop(ln, None)
             self.retired_total += len(done)
+            if self.metrics is not None:
+                self.metrics.inc("queries_retired_total", len(done))
             self._probe = None   # lane planes changed under the probe
         if self._watchdog is not None \
                 and not self._watchdog.admission_allowed(self):
@@ -645,6 +707,27 @@ class QueryFabric:
             admitted = self._admit_free()
         if self._watchdog is not None:
             self._watchdog.after_admission(self)
+            if self.spans is not None:
+                # closed lane-exhaustion episodes become engine-level
+                # ``degraded`` spans (watchdog state rides checkpoints,
+                # so the cursor below does too — no double recording
+                # across a recovery)
+                closed = [e for e in self._watchdog.degraded
+                          if e.get("end_t") is not None]
+                for ep in closed[self._degraded_spanned:]:
+                    self.spans.engine_span(
+                        "degraded", ep["start_t"], ep["end_t"],
+                        boundaries=ep["boundaries"],
+                        max_backoff=ep["max_backoff"],
+                        peak_queued=ep["peak_queued"])
+                self._degraded_spanned = len(closed)
+            if self.metrics is not None:
+                self.metrics.set_counter(
+                    "watchdog_backoff_episodes_total",
+                    len(self._watchdog.degraded))
+                self.metrics.set_counter(
+                    "watchdog_deferred_admissions_total",
+                    self._watchdog.deferred_admissions)
         act_idx = np.asarray(active, np.int64)
         spread_a = (mx[act_idx] - mn[act_idx]) if active else \
             np.zeros(0)
@@ -667,6 +750,23 @@ class QueryFabric:
             "admitted": admitted,
         }
         self._boundaries.append(row)
+        if self.metrics is not None:
+            self.metrics.inc("boundaries_total")
+            gauges = {
+                "lanes_active": self.active_lanes,
+                "lanes_free": len(self._free_lanes),
+                "queue_depth": len(self._queue),
+                "live_members": int(live),
+            }
+            if self._wal is not None:
+                gauges["wal_last_seq"] = self._wal.last_seq
+                gauges["wal_fsync_seconds_total"] = \
+                    self._wal.fsync_seconds_total
+            if self._ring is not None:
+                gauges["checkpoint_writes"] = self._ring.written_total
+                gauges["checkpoint_write_seconds_total"] = \
+                    self._ring.write_seconds_total
+            self.metrics.sample_row(self.clock, **gauges)
         return row
 
     # ---- reads -----------------------------------------------------------
@@ -722,6 +822,8 @@ class QueryFabric:
         q = self._queries[qid]
         base = {"qid": qid, "status": q["status"], "t": self.clock}
         if q["status"] == "done":
+            if self.spans is not None:
+                self.spans.read(qid, self.clock)
             return {**base, "t": q["done_round"], "staleness": 0,
                     "converged": True, **q["result"]}
         if q["status"] == "quarantined":
@@ -763,7 +865,18 @@ class QueryFabric:
             latency.update({
                 "p50": float(np.percentile(lat, 50)),
                 "p95": float(np.percentile(lat, 95)),
+                "p99": float(np.percentile(lat, 99)),
                 "max": float(lat.max()),
+            })
+        conv = np.asarray(self._conv_latencies, np.float64)
+        conv_latency = {"count": int(conv.size), "slo_rounds":
+                        self.convergence_slo_rounds}
+        if conv.size:
+            conv_latency.update({
+                "p50": float(np.percentile(conv, 50)),
+                "p95": float(np.percentile(conv, 95)),
+                "p99": float(np.percentile(conv, 99)),
+                "max": float(conv.max()),
             })
         qs = []
         for q in self._queries.values():
@@ -787,6 +900,7 @@ class QueryFabric:
             "retired_total": self.retired_total,
             "quarantined_total": self.quarantined_total,
             "admission_latency": latency,
+            "convergence_latency": conv_latency,
             "boundaries": [dict(b) for b in self._boundaries],
             "queries": qs,
             "service": self.svc.service_block(),
@@ -795,6 +909,54 @@ class QueryFabric:
         if self.probe_manifest:
             out["probe_rows"] = [dict(r) for r in self._probe_rows]
         return out
+
+    # ---- serving flight recorder (obs/metrics.py, obs/spans.py) ----------
+    def _refresh_obs_gauges(self) -> None:
+        """Point-in-time gauges refreshed when the trace block is built
+        (boundary sampling records the history; the block's gauges must
+        reflect NOW — doctor's ``metrics_consistency`` compares them to
+        the manifest ground truth written at the same moment)."""
+        m = self.metrics
+        m.set_gauge("lanes_active", self.active_lanes)
+        m.set_gauge("lanes_free", len(self._free_lanes))
+        m.set_gauge("queue_depth", len(self._queue))
+        m.set_gauge("compile_count", self.compile_count)
+        m.set_gauge("probe_compile_count", self.probe_compile_count)
+        if self._wal is not None:
+            m.set_gauge("wal_last_seq", self._wal.last_seq)
+            m.set_gauge("wal_fsync_seconds_total",
+                        self._wal.fsync_seconds_total)
+        if self._ring is not None:
+            m.set_gauge("checkpoint_writes", self._ring.written_total)
+            m.set_gauge("checkpoint_write_seconds_total",
+                        self._ring.write_seconds_total)
+        if self._watchdog is not None:
+            m.set_counter("watchdog_backoff_episodes_total",
+                          len(self._watchdog.degraded))
+            m.set_counter("watchdog_deferred_admissions_total",
+                          self._watchdog.deferred_admissions)
+
+    def serving_trace_block(self) -> dict | None:
+        """The manifest's ``serving_trace`` block
+        (``flow-updating-serving-trace/v1``): declared SLO targets, the
+        streaming metrics registry, and every span chain — the inputs
+        of doctor's ``slo_latency`` / ``span_complete`` /
+        ``metrics_consistency`` checks.  None with ``observe=False``."""
+        if self.metrics is None:
+            return None
+        from flow_updating_tpu.obs.report import SERVING_TRACE_SCHEMA
+
+        self._refresh_obs_gauges()
+        return {
+            "schema": SERVING_TRACE_SCHEMA,
+            "slo": {
+                "admission_p95_rounds": self.admission_slo_rounds,
+                "convergence_p95_rounds": self.convergence_slo_rounds,
+            },
+            "metrics": self.metrics.block(),
+            "spans": (self.spans.block()
+                      if self.spans is not None else None),
+        }
 
     # ---- durability ------------------------------------------------------
     def save_checkpoint(self, path: str,
@@ -826,9 +988,23 @@ class QueryFabric:
             "latencies": [int(x) for x in self._latencies],
             "quarantined_total": self.quarantined_total,
             "queries": queries,
+            "convergence_slo_rounds": self.convergence_slo_rounds,
+            "conv_latencies": [int(x) for x in self._conv_latencies],
+            "observe": self.metrics is not None,
         }
         if self._watchdog is not None:
             qmeta["watchdog_state"] = self._watchdog.state_dict()
+        if self.metrics is not None:
+            # the flight recorder's black box: metrics + span chains
+            # ride every ring archive, so a recovered fabric's trace is
+            # continuous with the pre-crash one (WAL replay regenerates
+            # the spans after this checkpoint at the same round clocks)
+            qmeta["obs"] = {
+                "metrics": self.metrics.state_dict(),
+                "spans": (self.spans.state_dict()
+                          if self.spans is not None else None),
+                "degraded_spanned": self._degraded_spanned,
+            }
         self.svc.save_checkpoint(
             path, extra_meta={"query": qmeta, **(extra_meta or {})})
         return self
@@ -880,6 +1056,24 @@ class QueryFabric:
         self.peak_active = int(qmeta["peak_active"])
         self.quarantined_total = int(qmeta.get("quarantined_total", 0))
         self._latencies = [int(x) for x in qmeta["latencies"]]
+        self.convergence_slo_rounds = qmeta.get("convergence_slo_rounds")
+        if self.convergence_slo_rounds is not None:
+            self.convergence_slo_rounds = int(self.convergence_slo_rounds)
+        self._conv_latencies = [int(x) for x in
+                                qmeta.get("conv_latencies", [])]
+        obs = qmeta.get("obs")
+        if obs is not None:
+            self.metrics = MetricsRegistry.load_state(obs["metrics"])
+            self.spans = (SpanRecorder.load_state(obs["spans"])
+                          if obs.get("spans") is not None else None)
+            self._degraded_spanned = int(obs.get("degraded_spanned", 0))
+        else:
+            # pre-flight-recorder archives (or observe=False fabrics)
+            # restore with the plane in the state the saver had it
+            on = bool(qmeta.get("observe", False))
+            self.metrics = MetricsRegistry() if on else None
+            self.spans = SpanRecorder() if on else None
+            self._degraded_spanned = 0
         self._probe = None
         self._boundaries = []
         self.probe_manifest = False

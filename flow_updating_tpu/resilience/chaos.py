@@ -356,6 +356,7 @@ def _child_main(argv=None) -> int:
         "digest": engine.state_digest(),
         "clock": int(engine.clock),
         "recovery": engine.resilience_block(),
+        "serving_trace": engine.serving_trace_block(),
     }
     if args.kind == "query":
         result["query"] = engine.query_block()
@@ -543,6 +544,7 @@ def run_chaos(name: str, *, nodes: int = 128, lanes: int = 8,
 
     recovery_block: dict
     service_block = query_block = None
+    serving_trace = None
     verify = None
     timings: dict = {}
 
@@ -616,6 +618,11 @@ def run_chaos(name: str, *, nodes: int = 128, lanes: int = 8,
                 query_block = engine.query_block()
             else:
                 service_block = engine.service_block()
+            # the flight recorder survived the SIGKILL with the engine:
+            # its spans/metrics rode the ring checkpoint and the replay
+            # re-fired the rest — doctor's span_complete judges the
+            # continuity (and FAILS the replay-disabled perturbation)
+            serving_trace = engine.serving_trace_block()
     else:
         # inject faults: the child survived and wrote its own blocks
         with open(result_path) as f:
@@ -626,6 +633,7 @@ def run_chaos(name: str, *, nodes: int = 128, lanes: int = 8,
         recovery_block["ground_truth"] = ground_truth
         query_block = child.get("query")
         service_block = child.get("service")
+        serving_trace = child.get("serving_trace")
         if fault.inject == "nan_lane" and not perturb:
             from flow_updating_tpu.query import QueryFabric
 
@@ -644,10 +652,18 @@ def run_chaos(name: str, *, nodes: int = 128, lanes: int = 8,
     manifest = build_recovery_manifest(
         argv=["chaos", name] + (["--perturb"] if perturb else []),
         recovery=recovery_block, service=service_block,
-        query=query_block, timings=timings or None)
+        query=query_block, timings=timings or None,
+        extra=({"serving_trace": serving_trace}
+               if serving_trace else None))
     write_report(manifest_path, manifest)
 
     checks = health.check_recovery(recovery_block)
+    if serving_trace:
+        # the flight recorder rides the same gate: a recovery whose
+        # span chains have gaps (or whose counters disagree with the
+        # census) fails the conformance loop, not just the doctor CLI
+        checks = checks + health.check_serving_trace(
+            serving_trace, query=query_block, recovery=recovery_block)
     blame = blame_recovery(manifest)
     return {
         "fault": name,

@@ -234,6 +234,27 @@ def recover(directory: str, *, kind: str | None = None,
             "last_seq": wal.last_seq,
         },
     }
+    # the flight recorder's continuity marker (obs/spans.py): the span
+    # state restored from the checkpoint ends at base_clock, the replay
+    # above re-fired the boundary hooks up to recovered_clock — one
+    # explicit engine-level span covers the gap and carries the replay
+    # evidence, so doctor's ``span_complete`` can PROVE the trace is
+    # continuous (and FAIL a replay-disabled control)
+    spans = getattr(engine, "spans", None)
+    if spans is not None:
+        spans.engine_span(
+            "recovery", int(meta.get("clock", 0)), int(engine.clock),
+            records_pending=len(to_apply),
+            records_replayed=len(to_apply) if replay else 0,
+            events_replayed=events, rounds_replayed=rounds,
+            replay_enabled=bool(replay),
+            wal_last_seq=int(wal.last_seq),
+            ring_index=int(used["index"]))
+    metrics = getattr(engine, "metrics", None)
+    if metrics is not None:
+        metrics.inc("recoveries_total")
+        if replay:
+            metrics.inc("wal_records_replayed_total", len(to_apply))
     # post-replay aliasing probe (analysis/aliasing.py): replayed
     # events edit host mirrors in place, so a zero-copy restored leaf
     # would have raced the replay itself — assert the recovered engine

@@ -31,6 +31,7 @@ import hashlib
 import json
 import os
 import re
+import time
 
 _CKPT_RE = re.compile(r"^ckpt-(\d{8})\.npz$")
 
@@ -55,6 +56,10 @@ class CheckpointRing:
         self.retain = int(retain)
         self._segments_since = 0
         self.written_total = 0
+        #: Wall-time accounting for the serving metrics plane
+        #: (obs/metrics.py): total/last archive write seconds.
+        self.write_seconds_total = 0.0
+        self.last_write_s = 0.0
         os.makedirs(directory, exist_ok=True)
 
     # ---- paths -----------------------------------------------------------
@@ -90,6 +95,7 @@ class CheckpointRing:
         extra_meta=)`` and ``clock``)."""
         idx = (self.indices() or [-1])[-1] + 1
         path = self._path(idx)
+        t0 = time.perf_counter()
         owner.save_checkpoint(path, extra_meta={"resilience": {
             "wal_seq": int(wal_seq),
             "ring_index": idx,
@@ -101,6 +107,8 @@ class CheckpointRing:
         with open(tmp, "w") as f:
             json.dump(side, f)
         os.replace(tmp, self._sidecar(path))
+        self.last_write_s = time.perf_counter() - t0
+        self.write_seconds_total += self.last_write_s
         self._segments_since = 0
         self.written_total += 1
         for old in self.indices()[:-self.retain]:
@@ -150,4 +158,5 @@ class CheckpointRing:
             "retain": self.retain,
             "written_total": self.written_total,
             "kept": len(self.indices()),
+            "write_seconds_total": self.write_seconds_total,
         }

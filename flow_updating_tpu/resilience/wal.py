@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import time
 import zlib
 
 MAGIC = b"FUWAL001"
@@ -86,6 +87,12 @@ class WriteAheadLog:
         self.path = path
         self.fsync = bool(fsync)
         self.torn_bytes = 0
+        #: Wall-time accounting for the serving metrics plane
+        #: (obs/metrics.py samples these at segment boundaries): total
+        #: appends this process, total/last flush+fsync seconds.
+        self.appends_total = 0
+        self.fsync_seconds_total = 0.0
+        self.last_fsync_s = 0.0
         #: The intact records found at open — populated only under
         #: ``keep_records`` (recovery replays them; a plain writer has
         #: no reason to hold the whole journal in memory).
@@ -126,9 +133,13 @@ class WriteAheadLog:
             separators=(",", ":")).encode()
         self._f.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
         self._f.write(payload)
+        t0 = time.perf_counter()
         self._f.flush()
         if self.fsync:
             os.fsync(self._f.fileno())
+        self.last_fsync_s = time.perf_counter() - t0
+        self.fsync_seconds_total += self.last_fsync_s
+        self.appends_total += 1
         self.last_seq = seq
         return seq
 
@@ -144,4 +155,6 @@ class WriteAheadLog:
             "last_seq": self.last_seq,
             "torn_bytes_truncated": self.torn_bytes,
             "fsync": self.fsync,
+            "appends_total": self.appends_total,
+            "fsync_seconds_total": self.fsync_seconds_total,
         }

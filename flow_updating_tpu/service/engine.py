@@ -57,6 +57,8 @@ from flow_updating_tpu.models.config import (
     RoundConfig,
     RoundParams,
 )
+from flow_updating_tpu.obs.metrics import MetricsRegistry
+from flow_updating_tpu.obs.spans import SpanRecorder
 from flow_updating_tpu.topology.padding import (
     bucket_ceil,
     edge_rows,
@@ -142,7 +144,7 @@ class ServiceEngine:
                  = None, edge_capacity: int | None = None,
                  config: RoundConfig | None = None,
                  segment_rounds: int = 32, seed: int = 0, values=None,
-                 boundary_samples: bool = True):
+                 boundary_samples: bool = True, observe: bool = True):
         import jax.numpy as jnp
 
         from flow_updating_tpu.models.state import (
@@ -227,6 +229,12 @@ class ServiceEngine:
         self.history: list = []         # one record per epoch (run call)
         self._samples: list = []        # boundary telemetry rows
         self._est_cache = None          # (t, est (n_cap,)+F, alive)
+        # the flight recorder (obs/metrics.py, obs/spans.py): host-side
+        # event/latency accounting plus engine-level spans (recovery,
+        # degraded episodes); the query fabric turns this off on its
+        # inner service and owns ONE registry for the whole stack
+        self.metrics = MetricsRegistry() if observe else None
+        self.spans = SpanRecorder() if observe else None
         self._init_resilience()
         self._capture_cache_floor()
         if boundary_samples:
@@ -250,6 +258,9 @@ class ServiceEngine:
         if self._wal is not None and not self._replaying:
             self._wal_applied_seq = self._wal.append(kind, args,
                                                      self.clock)
+            if self.metrics is not None:
+                self.metrics.observe("wal_fsync_seconds",
+                                     self._wal.last_fsync_s)
 
     def enable_durability(self, directory: str, *,
                           checkpoint_every: int = 8, retain: int = 3,
@@ -309,7 +320,13 @@ class ServiceEngine:
         if self._recovery is not None:
             out.update(self._recovery)
         if self._wal is not None:
-            out.setdefault("wal", self._wal.block())
+            # live accounting wins over the recovery-time scan (its
+            # extra evidence keys survive; the pre-replay seq is kept
+            # as replay.base_wal_seq): doctor's metrics_consistency
+            # compares the gauge against same-moment figures
+            wal = dict(out.get("wal") or {})
+            wal.update(self._wal.block())
+            out["wal"] = wal
         if self._ring is not None:
             ring = dict(out.get("ring") or {})
             ring.update(self._ring.block())
@@ -374,6 +391,9 @@ class ServiceEngine:
         detail["kind"] = kind
         self._pending_events.append(detail)
         self._est_cache = None   # membership changed: staleness resets
+        if self.metrics is not None:
+            self.metrics.inc("events_total")
+            self.metrics.inc(f"events_{kind}_total")
 
     def _check_member(self, ids, verb: str) -> np.ndarray:
         ids = membership.as_id_array(ids)
@@ -723,6 +743,20 @@ class ServiceEngine:
         }
         self._samples.append(row)
         self._est_cache = (self.clock, est, alive)
+        if self.metrics is not None:
+            self.metrics.inc("boundary_samples_total")
+            gauges = {"live_members": live,
+                      "rmse": row["rmse"],
+                      "max_abs_err": row["max_abs_err"]}
+            if self._wal is not None:
+                gauges["wal_last_seq"] = self._wal.last_seq
+                gauges["wal_fsync_seconds_total"] = \
+                    self._wal.fsync_seconds_total
+            if self._ring is not None:
+                gauges["checkpoint_writes"] = self._ring.written_total
+                gauges["checkpoint_write_seconds_total"] = \
+                    self._ring.write_seconds_total
+            self.metrics.sample_row(self.clock, **gauges)
         return row
 
     def run(self, rounds: int, telemetry=None):
@@ -800,12 +834,20 @@ class ServiceEngine:
                        "active")},
         })
         self._epoch += 1
+        if self.metrics is not None and rounds:
+            self.metrics.inc("runs_total")
+            self.metrics.inc("segments_total",
+                             rounds // self.segment_rounds)
         if self._ring is not None and rounds:
             # the archive reflects every journaled record up to
             # _wal_applied_seq (this run's record included) — recovery
             # replays only what came after
-            self._ring.tick(self, self._wal_applied_seq,
-                            segments=rounds // self.segment_rounds)
+            wrote = self._ring.tick(self, self._wal_applied_seq,
+                                    segments=rounds // self.segment_rounds)
+            if wrote and self.metrics is not None:
+                self.metrics.inc("checkpoints_written_total")
+                self.metrics.observe("checkpoint_write_seconds",
+                                     self._ring.last_write_s)
         if series_rows is not None:
             from flow_updating_tpu.obs.telemetry import TelemetrySeries
 
@@ -895,6 +937,44 @@ class ServiceEngine:
             "mirror_probe": _mirror_probe(self),
         }
 
+    def _refresh_obs_gauges(self) -> None:
+        """Point-in-time gauges ahead of an export/embed (the sampled
+        rows carry the history; these carry *now*)."""
+        if self.metrics is None:
+            return
+        m = self.metrics
+        m.set_gauge("live_members", self.live_count)
+        m.set_gauge("member_count", self.member_count)
+        m.set_gauge("free_node_slots", len(self._free_nodes))
+        m.set_gauge("free_edge_slots", len(self._free_edges))
+        m.set_gauge("compile_count", self.compile_count)
+        if self._wal is not None:
+            m.set_gauge("wal_last_seq", self._wal.last_seq)
+            m.set_gauge("wal_fsync_seconds_total",
+                        self._wal.fsync_seconds_total)
+        if self._ring is not None:
+            m.set_gauge("checkpoint_writes", self._ring.written_total)
+            m.set_gauge("checkpoint_write_seconds_total",
+                        self._ring.write_seconds_total)
+
+    def serving_trace_block(self) -> dict | None:
+        """The manifest's ``serving_trace`` block
+        (``flow-updating-serving-trace/v1``): the flight recorder's
+        metrics plane + engine-level spans.  None when observation is
+        off (a disabled recorder embeds nothing — purity)."""
+        if self.metrics is None:
+            return None
+        from flow_updating_tpu.obs.report import SERVING_TRACE_SCHEMA
+
+        self._refresh_obs_gauges()
+        return {
+            "schema": SERVING_TRACE_SCHEMA,
+            "slo": {},
+            "metrics": self.metrics.block(),
+            "spans": (self.spans.block()
+                      if self.spans is not None else None),
+        }
+
     def boundary_series(self) -> dict:
         """The boundary samples as a telemetry-shaped series dict (one
         row per segment boundary) — doctor's standard series checks run
@@ -934,7 +1014,16 @@ class ServiceEngine:
             "segment_rounds": self.segment_rounds,
             "epoch": self._epoch,
             "event_counts": dict(self._event_counts),
+            "observe": self.metrics is not None,
         }
+        if self.metrics is not None:
+            # the black box rides the archive: a recovered engine's
+            # metrics/span planes are continuous with the pre-crash ones
+            meta["obs"] = {
+                "metrics": self.metrics.state_dict(),
+                "spans": (self.spans.state_dict()
+                          if self.spans is not None else None),
+            }
         if extra_meta:
             meta.update(extra_meta)
         save_service_checkpoint(path, self.state, self.config,
@@ -995,6 +1084,16 @@ class ServiceEngine:
         self.history = []
         self._samples = []
         self._est_cache = None
+        if bool(meta.get("observe", False)):
+            obs = meta.get("obs") or {}
+            self.metrics = MetricsRegistry.load_state(
+                obs.get("metrics") or {})
+            sp = obs.get("spans")
+            self.spans = (SpanRecorder.load_state(sp)
+                          if sp is not None else SpanRecorder())
+        else:
+            self.metrics = None
+            self.spans = None
         self._init_resilience()
         self._capture_cache_floor()
         # the PR-13 regression probe: a restored engine must never hold

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""CI serving-observability smoke: the flight recorder's acceptance
+scenario on the CPU proxy (ISSUE 17; docs/OBSERVABILITY.md §8).
+
+1. drive a 10k-node ``QueryFabric`` (flight recorder on, latency SLOs
+   declared) through >= 32 cohort queries under membership churn;
+   every terminated query must leave a GAP-FREE span chain and the
+   streaming counters must match the census exactly;
+2. write the ``flow-updating-query-report/v1`` manifest with its
+   embedded ``flow-updating-serving-trace/v1`` block plus the
+   Prometheus text export, and pass ``doctor --strict`` over it
+   (slo_latency / span_complete / metrics_consistency included);
+3. render the manifest as a Perfetto trace (``obs export-trace``
+   path) — per-lane tracks + counter samples must come out non-empty;
+4. SIGKILL a mid-flight fabric for real (the chaos harness's
+   subprocess kill) and recover: the conformance gate — which now
+   includes the serving-trace checks — must pass, and the trace must
+   carry the explicit ``recovery`` span;
+5. the NEGATIVE control — same fault, replay disabled — must FAIL
+   ``span_complete`` specifically: the black box can tell a real
+   recovery from a lobotomized one.
+
+Exit code: 0 only if every assertion above holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--nodes", type=int, default=10_000,
+                    help="fabric member count (acceptance floor: 10k)")
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--queries", type=int, default=36,
+                    help="queries to offer (acceptance floor: 32)")
+    ap.add_argument("--events", type=int, default=16,
+                    help="membership churn events between segments")
+    ap.add_argument("--segment-rounds", type=int, default=8)
+    ap.add_argument("--eps", type=float, default=1e-2)
+    ap.add_argument("--max-rounds", type=int, default=4096)
+    ap.add_argument("--chaos-ops", type=int, default=20,
+                    help="scripted ops for the SIGKILL leg")
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.obs import health
+    from flow_updating_tpu.obs.report import (
+        build_query_manifest,
+        write_report,
+    )
+    from flow_updating_tpu.query import QueryFabric
+    from flow_updating_tpu.resilience.chaos import run_chaos
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    # -- 1: the churn run with the recorder on ----------------------------
+    t0 = time.perf_counter()
+    topo = erdos_renyi(args.nodes, avg_degree=6.0, seed=0)
+    fab = QueryFabric(topo, lanes=args.lanes, capacity=args.nodes + 64,
+                      degree_budget=24,
+                      segment_rounds=args.segment_rounds, seed=0,
+                      conv_eps=args.eps,
+                      admission_slo_rounds=64 * args.segment_rounds,
+                      convergence_slo_rounds=64 * args.segment_rounds)
+    rng = np.random.default_rng(0)
+    members = fab.svc.live_ids()
+    held: list = []
+    submitted = events = rounds = 0
+    while (submitted < args.queries or fab.active_lanes or fab.queued) \
+            and rounds < args.max_rounds:
+        arrivals = min(int(rng.poisson(0.5 * args.lanes)),
+                       args.queries - submitted)
+        for _ in range(arrivals):
+            m = int(rng.integers(8, 64))
+            cohort = rng.choice(members, size=m, replace=False)
+            fab.submit(rng.random(m), cohort=np.sort(cohort))
+            submitted += 1
+        if events < args.events:
+            if held and rng.random() < 0.4:
+                fab.leave([held.pop()])
+            else:
+                slot = fab.join()
+                fab.add_edges([(slot, int(rng.integers(0, args.nodes)))])
+                held.append(slot)
+            events += 1
+        fab.run(args.segment_rounds)
+        rounds += args.segment_rounds
+    if fab.retired_total < args.queries:
+        print(f"serving_obs_smoke: only {fab.retired_total}/"
+              f"{args.queries} queries retired in {rounds} rounds",
+              file=sys.stderr)
+        return 1
+
+    # every terminated chain gap-free, counters exact — asserted here
+    # AND re-judged by doctor below (belt and braces)
+    chains = fab.spans.block()["queries"]
+    for qid, chain in chains.items():
+        terms = [c for c in chain
+                 if c["name"] in ("retired", "quarantined")]
+        gap = health._span_chain_gap(chain, terms[0]["t0"]) \
+            if terms else "never terminated"
+        if gap is not None:
+            print(f"serving_obs_smoke: qid {qid} chain not gap-free: "
+                  f"{gap}", file=sys.stderr)
+            return 1
+    if fab.metrics.counter("queries_retired_total") != fab.retired_total:
+        print("serving_obs_smoke: retired counter disagrees with the "
+              "fabric census", file=sys.stderr)
+        return 1
+    print(f"serving_obs_smoke: {submitted} queries / {args.lanes} lanes "
+          f"at {args.nodes} nodes, {events} churn events, {rounds} "
+          f"rounds, {len(chains)} gap-free chains, "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # -- 2: manifest + Prometheus + doctor --strict -----------------------
+    manifest_path = os.path.join(args.outdir, "serving_obs_report.json")
+    write_report(manifest_path, build_query_manifest(
+        argv=sys.argv[1:], config=fab.svc.config, topo=topo,
+        query=fab.query_block(),
+        extra={"serving_trace": fab.serving_trace_block()}))
+    with open(os.path.join(args.outdir, "serving_obs_metrics.prom"),
+              "w") as f:
+        f.write(fab.metrics.to_prometheus())
+    rc = cli_main(["doctor", manifest_path, "--strict"])
+    if rc != 0:
+        print("serving_obs_smoke: doctor --strict FAILED on the "
+              "serving-trace manifest", file=sys.stderr)
+        return 1
+
+    # -- 3: the Perfetto rendering ----------------------------------------
+    trace_path = os.path.join(args.outdir, "serving_obs.trace.json")
+    rc = cli_main(["obs", "export-trace", manifest_path,
+                   "--output", trace_path])
+    if rc != 0:
+        return 1
+    with open(trace_path) as f:
+        doc = json.load(f)
+    slices = [e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e.get("cat") == "query"]
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    if len(slices) < args.queries or not counters:
+        print(f"serving_obs_smoke: trace rendered {len(slices)} query "
+              f"slices / {len(counters)} counter events (expected "
+              f">= {args.queries} and > 0)", file=sys.stderr)
+        return 1
+
+    # -- 4: the real SIGKILL ------------------------------------------------
+    t1 = time.perf_counter()
+    out = run_chaos("kill_at_segment", nodes=args.nodes,
+                    lanes=args.lanes, segment_rounds=args.segment_rounds,
+                    n_ops=args.chaos_ops, seed=0, outdir=args.outdir)
+    by = {c["name"]: c["status"] for c in out["checks"]}
+    print(f"serving_obs_smoke: SIGKILL leg overall={out['overall']} "
+          f"({time.perf_counter() - t1:.1f}s) checks={by}",
+          file=sys.stderr)
+    if out["exit_code"] != 0 or by.get("span_complete") != "pass" \
+            or by.get("metrics_consistency") != "pass":
+        print("serving_obs_smoke: the recovered fabric's trace did not "
+              "pass the serving checks", file=sys.stderr)
+        return 1
+    with open(out["manifest_path"]) as f:
+        m = json.load(f)
+    rspans = [s for s in m["serving_trace"]["spans"]["engine"]
+              if s["name"] == "recovery"]
+    if not rspans or not rspans[-1]["replay_enabled"]:
+        print("serving_obs_smoke: no replay-enabled recovery span in "
+              "the recovered trace", file=sys.stderr)
+        return 1
+
+    # -- 5: the negative control ------------------------------------------
+    bad = run_chaos("kill_at_segment", nodes=max(256, args.nodes // 16),
+                    lanes=args.lanes, segment_rounds=args.segment_rounds,
+                    n_ops=args.chaos_ops, seed=0, outdir=args.outdir,
+                    perturb=True)
+    bad_by = {c["name"]: c["status"] for c in bad["checks"]}
+    if bad["exit_code"] == 0 or bad_by.get("span_complete") != "fail":
+        print(f"serving_obs_smoke: replay-DISABLED control did not fail "
+              f"span_complete: exit={bad['exit_code']} checks={bad_by}",
+              file=sys.stderr)
+        return 1
+    print("serving_obs_smoke: negative control failed span_complete as "
+          "designed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

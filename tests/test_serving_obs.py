@@ -1,0 +1,474 @@
+"""Serving flight recorder: per-query span tracing, streaming SLO
+metrics, and the crash-surviving black box (obs/metrics.py +
+obs/spans.py + the doctor's serving-trace checks).
+
+The contract under test:
+
+* every terminated query leaves a GAP-FREE span chain (submitted →
+  one admission → contiguous segments tiling [admit, terminal] →
+  retired/quarantined), judged by the same ``_span_chain_gap``
+  predicate doctor runs;
+* the recorder is PURE: ``observe=False`` evolves state bit-exactly
+  like the recording twin with an unchanged compile count (all
+  recording is host-side Python at existing segment boundaries — the
+  lowered programs never see the flag; the golden ledger pins their
+  bytes independently);
+* the black box SURVIVES the crash: spans/metrics ride the ring
+  checkpoints, WAL replay re-fires the same hooks, and ``recover()``
+  stamps an explicit ``recovery`` engine span whose evidence the
+  ``span_complete`` check audits — a replay-disabled control FAILS,
+  it does not skip;
+* watchdog quarantines and degraded-mode episodes surface as BOTH
+  engine spans and counters;
+* the counters agree with the manifest ground truth
+  (``metrics_consistency``) and render as Prometheus text;
+* ROADMAP item 5's fused × telemetry cell: ``Engine.run_telemetry``
+  with ``spmv='banded_fused'`` is bit-exact vs the unfused banded
+  telemetry twin.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.obs import health
+from flow_updating_tpu.obs.metrics import MetricsRegistry
+from flow_updating_tpu.obs.spans import SpanRecorder
+from flow_updating_tpu.topology.generators import erdos_renyi
+
+
+def _fabric(seed=0, lanes=4, eps=1e-3, **kw):
+    from flow_updating_tpu.query import QueryFabric
+
+    topo = erdos_renyi(48, avg_degree=8.0, seed=2)
+    cfg = RoundConfig.fast(variant="collectall", drop_rate=0.05)
+    return QueryFabric(topo, lanes=lanes, capacity=48, config=cfg,
+                       segment_rounds=8, seed=seed, conv_eps=eps, **kw)
+
+
+def _drive(fab, rng, n=6):
+    for _ in range(n):
+        fab.submit(rng.random(3), cohort=[1, 5, 9])
+    for _ in range(24):
+        fab.run(8)
+        if fab.retired_total >= n and fab.active_lanes == 0:
+            break
+
+
+# ---- unit: registry + recorder -------------------------------------------
+
+def test_metrics_registry_counters_gauges_histograms():
+    m = MetricsRegistry()
+    m.inc("a_total")
+    m.inc("a_total", 4)
+    m.set_counter("episodes_total", 3)
+    m.set_counter("episodes_total", 2)        # max-mirror: never rewinds
+    m.set_gauge("depth", 7)
+    for v in range(1, 101):
+        m.observe("lat_rounds", float(v))
+    assert m.counter("a_total") == 5
+    assert m.counter("episodes_total") == 3
+    assert m.gauge("depth") == 7
+    h = m.histogram("lat_rounds")
+    assert h["count"] == 100 and h["max"] == 100.0
+    assert h["p50"] == 50.0 and h["p95"] == 95.0 and h["p99"] == 99.0
+
+    text = m.to_prometheus()
+    assert "# TYPE fu_a_total counter" in text
+    assert "fu_a_total 5" in text
+    assert "# TYPE fu_depth gauge" in text
+    assert 'fu_lat_rounds{quantile="0.95"} 95' in text
+    assert "fu_lat_rounds_count 100" in text
+
+    clone = MetricsRegistry.load_state(m.state_dict())
+    assert clone.block() == m.block()
+
+
+def test_metrics_histogram_window_is_bounded():
+    m = MetricsRegistry(window=16)
+    for v in range(1000):
+        m.observe("h", float(v))
+    h = m.histogram("h")
+    assert h["count"] == 1000          # lifetime count survives
+    assert h["window_n"] == 16         # quantile window is bounded
+    assert h["p50"] >= 984.0           # quantiles come from the tail
+
+
+def test_span_recorder_chain_shape_and_roundtrip():
+    s = SpanRecorder()
+    s.submitted(7, t=0)
+    s.admitted(7, lane=2, t=8)
+    s.boundary(16)
+    s.boundary(24)
+    s.converged(7, t=24)
+    s.retired(7, t=24)
+    s.read(7, t=24)
+    s.read(7, t=30)                    # bounded: only the first records
+    chain = s.chain(7)
+    names = [c["name"] for c in chain]
+    assert names == ["submitted", "admitted@lane2", "segment",
+                     "segment", "converged", "retired", "read"]
+    assert chain[0]["t1"] == 8         # admission back-fills queue time
+    assert health._span_chain_gap(chain, 24) is None
+    clone = SpanRecorder.load_state(s.state_dict())
+    assert clone.block() == s.block()
+
+
+def test_span_chain_gap_detects_each_defect():
+    def chain(segs, t_sub=0, t_adm=8, admits=1):
+        c = [{"name": "submitted", "t0": t_sub, "t1": t_adm}]
+        c += [{"name": f"admitted@lane0", "t0": t_adm, "t1": t_adm,
+               "lane": 0}] * admits
+        c += [{"name": "segment", "t0": a, "t1": b} for a, b in segs]
+        return c
+
+    assert health._span_chain_gap(chain([(8, 16), (16, 24)]), 24) is None
+    assert "gap" in health._span_chain_gap(
+        chain([(8, 16), (20, 24)]), 24)             # hole in the tiling
+    assert "first segment" in health._span_chain_gap(
+        chain([(12, 24)]), 24)                      # missed admission
+    assert "terminal" in health._span_chain_gap(
+        chain([(8, 16)]), 24)                       # stops short
+    assert "admitted exactly once" in health._span_chain_gap(
+        chain([(8, 24)], admits=2), 24)
+    assert "queue time" in health._span_chain_gap(
+        [{"name": "submitted", "t0": 0, "t1": 0},
+         {"name": "admitted@lane0", "t0": 8, "t1": 8, "lane": 0},
+         {"name": "segment", "t0": 8, "t1": 24}], 24)
+
+
+# ---- fabric end-to-end ---------------------------------------------------
+
+def test_fabric_records_gap_free_chains_and_exact_counters():
+    fab = _fabric(convergence_slo_rounds=400,
+                  admission_slo_rounds=64)     # the burst queues 2 of 6
+    _drive(fab, np.random.default_rng(3))
+    assert fab.retired_total >= 6
+    for qid, chain in fab.spans.block()["queries"].items():
+        terms = [c for c in chain
+                 if c["name"] in ("retired", "quarantined")]
+        assert terms, f"qid {qid} never terminated"
+        assert health._span_chain_gap(chain, terms[0]["t0"]) is None
+    m = fab.metrics
+    assert m.counter("queries_submitted_total") == 6
+    assert m.counter("queries_retired_total") == fab.retired_total
+    conv = fab.query_block()["convergence_latency"]
+    assert conv["count"] == fab.retired_total
+    assert conv["slo_rounds"] == 400
+    assert conv["p95"] >= conv["p50"] > 0
+
+    trace = fab.serving_trace_block()
+    checks = {c.name: c for c in health.check_serving_trace(
+        trace, query=fab.query_block())}
+    assert checks["span_complete"].status == health.PASS
+    assert checks["metrics_consistency"].status == health.PASS
+    assert checks["slo_latency"].status == health.PASS
+
+
+def test_observe_off_is_bit_pure_and_recorder_free():
+    fab = _fabric(observe=True)
+    twin = _fabric(observe=False)
+    _drive(fab, np.random.default_rng(3))
+    _drive(twin, np.random.default_rng(3))
+    assert twin.metrics is None and twin.spans is None
+    assert twin.serving_trace_block() is None
+    # the recorder is pure host-side bookkeeping: bit-exact evolution,
+    # same compile count (the lowered programs never see the flag)
+    assert fab.state_digest() == twin.state_digest()
+    assert fab.compile_count == twin.compile_count
+
+
+def test_service_engine_observe_off_is_bit_pure():
+    from flow_updating_tpu.service import ServiceEngine
+
+    topo = erdos_renyi(48, avg_degree=8.0, seed=2)
+    cfg = RoundConfig.fast(variant="collectall", drop_rate=0.05)
+
+    def run(observe):
+        svc = ServiceEngine(topo, capacity=60, config=cfg,
+                            segment_rounds=8, seed=0, observe=observe)
+        svc.run(16)
+        svc.suspend([3])
+        svc.run(16)
+        return svc
+
+    b = run(False)                     # pays any cold compile
+    a = run(True)
+    assert b.metrics is None and b.serving_trace_block() is None
+    assert a.state_digest() == b.state_digest()
+    # enabling the recorder adds ZERO compiles: on the warm cache the
+    # observing twin compiles nothing at all
+    assert a.compile_count == 0
+    assert a.metrics.counter("segments_total") == 4
+    assert a.metrics.counter("events_suspend_total") == 1
+    assert "fu_segments_total 4" in a.metrics.to_prometheus()
+
+
+def test_fabric_inner_service_does_not_double_record():
+    fab = _fabric()
+    assert fab.svc.metrics is None, (
+        "the fabric owns the single flight recorder; the inner service "
+        "must not keep a second one")
+
+
+# ---- crash continuity ----------------------------------------------------
+
+def test_black_box_survives_sigkill_and_stamps_recovery_span(tmp_path):
+    from flow_updating_tpu.query import QueryFabric
+
+    d = str(tmp_path / "dur")
+    fab = _fabric().enable_durability(d, checkpoint_every=2, retain=3)
+    ctrl = _fabric()
+    rng_a, rng_b = (np.random.default_rng(3) for _ in range(2))
+    _drive(fab, rng_a, n=4)
+    _drive(ctrl, rng_b, n=4)
+    pre_chains = {q: [c["name"] for c in ch]
+                  for q, ch in fab.spans.block()["queries"].items()}
+    del fab                            # SIGKILL stand-in
+
+    rec = QueryFabric.recover(d)
+    # the trace is CONTINUOUS: every pre-crash chain is still there
+    post = rec.spans.block()["queries"]
+    for qid, names in pre_chains.items():
+        assert [c["name"] for c in post[qid]] == names
+    # ... and the crash itself is an explicit engine span with evidence
+    rspans = [s for s in rec.spans.block()["engine"]
+              if s["name"] == "recovery"]
+    assert len(rspans) == 1
+    assert rspans[0]["replay_enabled"]
+    assert rspans[0]["records_replayed"] == rspans[0]["records_pending"]
+    assert rec.metrics.counter("recoveries_total") == 1
+
+    # counters kept counting through the crash: drive both twins on and
+    # the black box still matches the ground truth exactly
+    rec.run(16)
+    ctrl.run(16)
+    assert rec.state_digest() == ctrl.state_digest()
+    checks = {c.name: c for c in health.check_serving_trace(
+        rec.serving_trace_block(), query=rec.query_block(),
+        recovery=rec.resilience_block())}
+    assert checks["metrics_consistency"].status == health.PASS
+    assert checks["span_complete"].status == health.PASS
+
+
+def test_check_serving_trace_fails_replay_disabled_recovery():
+    trace = {"slo": {}, "metrics": {"counters": {"x": 1}},
+             "spans": {"queries": {}, "engine": [
+                 {"name": "recovery", "t0": 0, "t1": 16,
+                  "records_pending": 5, "records_replayed": 0,
+                  "replay_enabled": False}]}}
+    recovery = {"replay": {"records_pending": 5, "enabled": False}}
+    by = {c.name: c for c in health.check_serving_trace(
+        trace, recovery=recovery)}
+    assert by["span_complete"].status == health.FAIL
+    assert "replayed 0 of 5" in by["span_complete"].summary
+    # no recovery span at all is just as loud
+    trace["spans"]["engine"] = []
+    by = {c.name: c for c in health.check_serving_trace(
+        trace, recovery=recovery)}
+    assert by["span_complete"].status == health.FAIL
+    assert "no recovery span" in by["span_complete"].summary
+
+
+def test_check_serving_trace_slo_and_consistency_negatives():
+    trace = {"slo": {"admission_p95_rounds": 8},
+             "metrics": {
+                 "counters": {"queries_submitted_total": 3,
+                              "queries_admitted_total": 2,
+                              "queries_retired_total": 2,
+                              "queries_quarantined_total": 0},
+                 "histograms": {"admission_latency_rounds": {
+                     "count": 10, "sum": 200.0, "max": 40.0,
+                     "window_n": 10, "p50": 16.0, "p95": 40.0,
+                     "p99": 40.0}}},
+             "spans": {"queries": {}, "engine": []}}
+    query = {"queries": [1, 2], "admitted_total": 2,
+             "retired_total": 2, "quarantined_total": 0}
+    by = {c.name: c for c in health.check_serving_trace(
+        trace, query=query)}
+    assert by["slo_latency"].status == health.FAIL       # 40 > 8
+    assert by["metrics_consistency"].status == health.FAIL  # 3 != 2
+    assert by["span_complete"].status == health.SKIP     # nothing done
+    assert health.check_serving_trace(None)[0].status == health.SKIP
+
+
+# ---- watchdog episodes as spans + counters -------------------------------
+
+def test_watchdog_quarantine_and_backoff_surface_in_the_black_box():
+    import jax.numpy as jnp
+
+    fab = _fabric(lanes=2, eps=1e-2).attach_watchdog()
+    rng = np.random.default_rng(5)
+    for _ in range(10):                # storm: queue >> lanes
+        fab.submit([float(rng.random())],
+                   cohort=[int(rng.integers(0, 48))])
+    for _ in range(40):
+        fab.run(8)
+        if fab.queued == 0 and fab.active_lanes == 0:
+            break
+    wd = fab._watchdog.block()
+    assert wd["degraded"], "storm never entered degraded mode"
+    m, spans = fab.metrics, fab.spans.block()
+    assert m.counter("watchdog_backoff_episodes_total") == \
+        len(wd["degraded"])
+    assert m.counter("watchdog_deferred_admissions_total") == \
+        wd["deferred_admissions"]
+    degraded = [s for s in spans["engine"] if s["name"] == "degraded"]
+    closed = [e for e in wd["degraded"] if e["end_t"] is not None]
+    assert len(degraded) == len(closed)
+    for s, e in zip(degraded, closed):
+        assert (s["t0"], s["t1"]) == (e["start_t"], e["end_t"])
+
+    # a NaN quarantine lands as terminal span + reason + counter
+    fab2 = _fabric(lanes=2).attach_watchdog()
+    fab2.submit([1.0], cohort=[4])
+    fab2.run(8)
+    lane = next(ln for ln, q in enumerate(fab2._lane_q)
+                if q is not None)
+    qid = fab2._lane_q[lane]
+    st = fab2.svc.state
+    fab2.svc.state = st.replace(
+        est=st.est.at[:, lane].set(jnp.nan))
+    fab2.run(8)
+    chain = fab2.spans.chain(qid)
+    quar = [c for c in chain if c["name"] == "quarantined"]
+    assert len(quar) == 1 and quar[0]["reason"]
+    assert fab2.metrics.counter("queries_quarantined_total") == 1
+    assert health._span_chain_gap(chain, quar[0]["t0"]) is None
+
+
+# ---- manifest + export-trace + CLI ---------------------------------------
+
+def test_serving_manifest_renders_as_chrome_trace():
+    from flow_updating_tpu.obs.report import build_query_manifest
+    from flow_updating_tpu.obs.trace import (
+        serving_manifest_to_chrome_trace,
+    )
+
+    fab = _fabric()
+    _drive(fab, np.random.default_rng(3))
+    manifest = build_query_manifest(
+        argv=["test"], query=fab.query_block(),
+        extra={"serving_trace": fab.serving_trace_block()})
+    doc = serving_manifest_to_chrome_trace(manifest)
+    assert doc["displayTimeUnit"] == "ms"
+    ev = doc["traceEvents"]
+    by_ph = {}
+    for e in ev:
+        by_ph.setdefault(e["ph"], []).append(e)
+    lanes = {e["args"]["name"] for e in by_ph["M"]
+             if e["name"] == "thread_name"}
+    assert any(n.startswith("lane ") for n in lanes)
+    queries = [e for e in by_ph["X"] if e.get("cat") == "query"]
+    segs = [e for e in by_ph["X"] if e.get("cat") == "segment"]
+    assert len(queries) == fab.retired_total
+    assert segs and all(s["dur"] > 0 for s in segs)
+    assert by_ph.get("C"), "no counter samples rendered"
+    # an empty manifest is a loud error, not an empty file
+    with pytest.raises(ValueError, match="no serving_trace"):
+        serving_manifest_to_chrome_trace({"schema": "x"})
+
+
+def test_cli_query_report_embeds_trace_and_doctor_judges_it(tmp_path):
+    from flow_updating_tpu.cli import main as cli_main
+
+    report = str(tmp_path / "q.json")
+    prom = str(tmp_path / "q.prom")
+    rc = cli_main(["query", "--generator", "erdos_renyi:48:8",
+                   "--seed", "3", "--lanes", "4", "--segment-rounds",
+                   "8", "--queries", "4", "--eps", "1e-3",
+                   "--rounds", "400", "--admission-slo", "64",
+                   "--convergence-slo", "400",
+                   "--metrics", prom, "--report", report])
+    assert rc == 0
+    with open(report) as f:
+        manifest = json.load(f)
+    trace = manifest["serving_trace"]
+    assert trace["schema"] == "flow-updating-serving-trace/v1"
+    assert trace["slo"]["convergence_p95_rounds"] == 400
+    assert "fu_queries_retired_total" in open(prom).read()
+    assert cli_main(["doctor", report, "--strict"]) == 0
+
+    out = str(tmp_path / "q.trace.json")
+    rc = cli_main(["obs", "export-trace", report, "--output", out])
+    assert rc == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+
+
+# ---- the chaos bar (subprocess SIGKILL) ----------------------------------
+
+@pytest.mark.slow
+def test_chaos_kill_trace_is_continuous_and_control_fails(tmp_path):
+    """The acceptance bar: a real mid-flight SIGKILL leaves a manifest
+    whose span chains are gap-free ACROSS the crash (span_complete
+    passes, recovery span audited), and the replay-disabled control
+    FAILS span_complete specifically — the black box can tell a real
+    recovery from a lobotomized one."""
+    from flow_updating_tpu.resilience.chaos import run_chaos
+
+    out = run_chaos("kill_at_segment", nodes=48, lanes=4,
+                    segment_rounds=8, n_ops=16, seed=0,
+                    outdir=str(tmp_path))
+    assert out["overall"] == "pass"
+    with open(out["manifest_path"]) as f:
+        manifest = json.load(f)
+    trace = manifest["serving_trace"]
+    assert trace["schema"] == "flow-updating-serving-trace/v1"
+    by = {c["name"]: c for c in out["checks"]}
+    assert by["span_complete"]["status"] == "pass"
+    assert by["metrics_consistency"]["status"] == "pass"
+    rspans = [s for s in trace["spans"]["engine"]
+              if s["name"] == "recovery"]
+    assert rspans and rspans[-1]["replay_enabled"]
+
+    bad = run_chaos("kill_at_segment", nodes=48, lanes=4,
+                    segment_rounds=8, n_ops=16, seed=0,
+                    outdir=str(tmp_path), perturb=True)
+    assert bad["exit_code"] == 1
+    bad_by = {c["name"]: c for c in bad["checks"]}
+    assert bad_by["span_complete"]["status"] == "fail"
+
+
+# ---- ROADMAP item 5: fused × telemetry -----------------------------------
+
+def test_engine_fused_telemetry_bit_exact_vs_banded_twin():
+    """The fused-round cross-product: ``Engine.run_telemetry`` over the
+    one-kernel banded_fused program reproduces the unfused banded
+    executor's telemetry series AND final state bit-for-bit (same
+    plan, same spec, single device)."""
+    from flow_updating_tpu.engine import Engine
+    from flow_updating_tpu.obs.telemetry import TelemetrySpec
+    from flow_updating_tpu.plan import compile_topology
+    from flow_updating_tpu.plan.select import PlanDecision
+    from flow_updating_tpu.topology.generators import community
+
+    topo = community(200, 4, seed=0)
+    plan = compile_topology(topo, remainder="gather")
+    cfg = RoundConfig.fast(kernel="node", spmv="banded",
+                           dtype="float64")
+
+    def series(spmv):
+        decision = PlanDecision(
+            kernel="node", spmv=spmv, plan=plan, backend="explicit",
+            predicted={}, reason="fused-telemetry parity test",
+            fused=({"chosen": {"fused_tile": None,
+                               "fused_remainder": "auto"}}
+                   if spmv == "banded_fused" else None))
+        e = Engine(config=cfg, plan=decision).set_topology(topo).build()
+        s = e.run_telemetry(37, TelemetrySpec.default())
+        return e, s
+
+    eb, sb = series("banded")
+    ef, sf = series("banded_fused")
+    assert ef.config.spmv == "banded_fused"
+    assert sb.metrics == sf.metrics and len(sb) == len(sf) == 37
+    for name in sb.metrics:
+        assert np.array_equal(sb[name], sf[name]), (
+            f"fused telemetry diverged from the banded twin on "
+            f"{name!r}")
+    np.testing.assert_array_equal(np.asarray(eb.estimates()),
+                                  np.asarray(ef.estimates()))
