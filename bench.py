@@ -198,6 +198,7 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
     import numpy as np
 
     from flow_updating_tpu.utils.metrics import rmse
+    from flow_updating_tpu.utils.trace import annotate
 
     t0 = time.perf_counter()
     vals = vector_values(topo, features) if features else None
@@ -221,11 +222,16 @@ def measure_tpu(topo, rounds: int, kernel: str = "node",
     while True:
         run(rounds)      # warm both scan lengths (jit keys on num_rounds,
         run(2 * rounds)  # so a grown `rounds` needs a fresh compile)
+        # the annotations are no-op TraceMes unless --trace-dir has a
+        # profiler recording; then the two timed windows land as named
+        # spans on the captured timeline (obs.timeline.annotation_spans)
         t0 = time.perf_counter()
-        out = run(rounds)
+        with annotate("fu.bench_window_r"):
+            out = run(rounds)
         t_r = time.perf_counter() - t0
         t0 = time.perf_counter()
-        out2 = run(2 * rounds)
+        with annotate("fu.bench_window_2r"):
+            out2 = run(2 * rounds)
         t_2r = time.perf_counter() - t0
         if (t_2r - t_r > 0.05 or rounds >= 262144
                 or t_2r * 8 > MAX_LAUNCH_S):
@@ -956,7 +962,8 @@ def run_service_bench(args) -> dict:
 def measure_query_serve(topo, lanes: int, segment_rounds: int,
                         rate: float, eps: float, windows: int = 3,
                         window_segments: int = 16,
-                        cohort_frac: float = 0.25) -> dict:
+                        cohort_frac: float = 0.25,
+                        roofline: bool = False) -> dict:
     """Query-fabric row: sustained queries/s of the multi-tenant fabric
     under Poisson arrival + lane churn (flow_updating_tpu.query).
 
@@ -1013,13 +1020,14 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
     # before timing: a window started on idle lanes under-counts its
     # tail and blows the spread-validity gate
     window(max(2, int(np.ceil(mean_rounds / segment_rounds))))
-    rates, completions = [], 0
+    rates, completions, walls = [], 0, []
     for attempt in range(3):
-        rates, completions = [], 0
+        rates, completions, walls = [], 0, []
         for _ in range(max(windows, 1)):
             got, wall = window(window_segments)
             completions += got
             rates.append(got / wall)
+            walls.append(wall)
         mean = sum(rates) / len(rates)
         spread = 100 * (max(rates) - min(rates)) / mean if mean else 0.0
         if spread <= SPREAD_VALIDITY_PCT or attempt == 2:
@@ -1030,7 +1038,13 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
         # returned window_segments must be what was actually measured
         window_segments *= 2
     block = fab.query_block()
-    return {
+    # the fabric's device throughput behind the qps number: total
+    # compiled rounds over total timed wall — the rate the roofline
+    # ceiling is compared against (queries/s depends on retire luck;
+    # rounds/s is the physical quantity the hardware bounds)
+    fabric_rps = (len(rates) * window_segments * segment_rounds
+                  / max(sum(walls), 1e-9))
+    out = {
         "queries_per_sec": mean,
         "queries_per_sec_min": min(rates),
         "queries_per_sec_max": max(rates),
@@ -1055,9 +1069,37 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
         "convergence_p95": block["convergence_latency"].get("p95"),
         "convergence_p99": block["convergence_latency"].get("p99"),
         "queued_at_end": fab.queued,
+        "fabric_rounds_per_sec": round(fabric_rps, 3),
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }
+    if roofline:
+        # opt-in, contained: reconcile the measured fabric rounds/s
+        # against the ceiling of the exact segment program the fabric
+        # dispatches (models.rounds.run_rounds on the service state) —
+        # execute=False, so the lens adds zero device time
+        try:
+            from flow_updating_tpu.models.rounds import run_rounds
+            from flow_updating_tpu.obs import roofline as _roof
+            from flow_updating_tpu.obs.profile import profile_program
+
+            svc = fab.svc
+            rec = profile_program(
+                run_rounds,
+                (svc.state, svc.arrays, svc.config, segment_rounds,
+                 svc.params),
+                n_dynamic=2, execute=False, label="serve:segment")
+            model = _roof.resolve_model()
+            rl = _roof.reconcile(
+                _roof.analyze(rec, model, rounds=segment_rounds,
+                              mode=f"serve/fabric_l{lanes}"),
+                fabric_rps)
+            out["roofline"] = _roof.perf_lens_block([rl], model)
+            out["roofline_frac"] = rl.get("roofline_frac")
+        except Exception as exc:
+            out["roofline"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]}
+    return out
 
 
 def measure_aggregate_serve(topo, lanes: int, segment_rounds: int,
@@ -1380,7 +1422,8 @@ def run_serve_bench(args) -> dict:
             },
         }
     sv = measure_query_serve(topo, lanes, args.segment_rounds,
-                             args.serve_rate, args.serve_eps)
+                             args.serve_rate, args.serve_eps,
+                             roofline=args.roofline)
 
     slug = f"{nodes // 1000}k" if nodes % 1000 == 0 else str(nodes)
     base_key = f"qps_er{slug}_l{lanes}"
@@ -1422,6 +1465,23 @@ def run_serve_bench(args) -> dict:
     if base_rps is None:
         base_rps = sv["queries_per_sec"]
 
+    frac = sv.get("roofline_frac")
+    if (isinstance(frac, (int, float)) and frac > 0
+            and sv["spread_pct"] <= SPREAD_VALIDITY_PCT):
+        # the serve row's roofline frac rides the same disjoint
+        # roofline_* family the headline uses — regress/flowlint gate it
+        record_baseline(f"roofline_qps_er{slug}_l{lanes}",
+                        baseline_entry(topo, {
+                            "rounds_per_sec": frac,
+                            "ticks": sv["completions"],
+                            "repeats": sv["windows"],
+                            "spread_pct": sv["spread_pct"],
+                            "note": ("roofline_frac measured/ceiling of "
+                                     "the fabric segment program "
+                                     "(higher is better; not a DES "
+                                     "measurement)"),
+                        }))
+
     return {
         "metric": (f"query-fabric queries/sec under Poisson arrival + "
                    f"lane churn (ER {nodes} nodes, {lanes} lanes, "
@@ -1431,6 +1491,8 @@ def run_serve_bench(args) -> dict:
         "backend": {"axon": "tpu"}.get(sv["platform"], sv["platform"]),
         "vs_baseline": (round(sv["queries_per_sec"] / base_rps, 3)
                         if base_rps else None),
+        **({"roofline_frac": frac}
+           if isinstance(frac, (int, float)) else {}),
         "extra": {
             "nodes": topo.num_nodes,
             "directed_edges": topo.num_edges,
@@ -2252,6 +2314,21 @@ def parse_args(argv=None):
                          "obs/profile.py) written as a flow-updating-"
                          "profile-report/v1 manifest to PATH; a copy "
                          "rides in the result's extra.profile")
+    ap.add_argument("--roofline", action="store_true",
+                    help="reconcile the measured rate against the "
+                         "ambient backend's roofline ceiling "
+                         "(obs/roofline.py): the result gains "
+                         "roofline_frac, extra.roofline carries the "
+                         "flow-updating-perf-lens/v1 block, and the "
+                         "frac is banked as a roofline_* baseline row "
+                         "(regress/flowlint-gated like every recorded "
+                         "key).  Works on the headline and --serve rows")
+    ap.add_argument("--trace-dir", metavar="DIR",
+                    help="capture a JAX/XLA profiler trace of the "
+                         "measured windows into DIR (utils/trace.py "
+                         "wraps the whole child-side measurement; view "
+                         "in TensorBoard/Perfetto or parse the device "
+                         "timeline with obs.timeline)")
     args = ap.parse_args(argv)
     if args.fat_tree_k is None:
         args.fat_tree_k = 16 if (args.sweep or args.service) else 160
@@ -2529,12 +2606,54 @@ def run_bench(args) -> dict:
             "baseline_source": base_src,
         },
     }
-    if args.profile:
+    prof = None
+    if args.profile or args.roofline:
         # contained like the spmv alternatives: an attribution failure
         # (plan OOM, tunnel wedge) must never discard the headline
         try:
             prof = profile_attribution(topo, args, tpu,
                                        rounds=min(args.rounds, 64))
+        except Exception as exc:
+            result["extra"]["profile"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]}
+    lens = None
+    if args.roofline and prof is not None:
+        # reconcile the measured headline rate against the ambient
+        # backend's roofline ceiling; the frac is banked under the
+        # disjoint roofline_* family so regress/flowlint gate it
+        try:
+            from flow_updating_tpu.obs import roofline as _roof
+
+            mode = tpu.get("kernel") or args.kernel
+            if mode == "node" and tpu.get("spmv"):
+                mode += f"/{tpu['spmv']}"
+            model = _roof.resolve_model()
+            rl = _roof.reconcile(
+                _roof.analyze(prof, model, rounds=prof["rounds"],
+                              mode=mode),
+                tpu["rounds_per_sec"])
+            lens = _roof.perf_lens_block([rl], model)
+            result["roofline_frac"] = rl.get("roofline_frac")
+            result["extra"]["roofline"] = lens
+            frac = rl.get("roofline_frac")
+            if isinstance(frac, (int, float)) and frac > 0:
+                record_baseline(
+                    f"roofline_{base_key}",
+                    baseline_entry(topo, {
+                        "rounds_per_sec": frac,
+                        "ticks": tpu.get("rounds", prof["rounds"]),
+                        "repeats": 1,
+                        "spread_pct": 0.0,
+                        "note": (f"roofline_frac measured/ceiling for "
+                                 f"mode {mode} on {model.name} "
+                                 "(higher is better; not a DES "
+                                 "measurement)"),
+                    }))
+        except Exception as exc:
+            result["extra"]["roofline"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]}
+    if args.profile and prof is not None:
+        try:
             result["extra"]["profile"] = prof
             from flow_updating_tpu.obs.report import (
                 build_profile_manifest,
@@ -2548,7 +2667,8 @@ def run_bench(args) -> dict:
                 extra={"bench": {"metric": result["metric"],
                                  "value": result["value"],
                                  "unit": result["unit"],
-                                 "backend": result["backend"]}},
+                                 "backend": result["backend"]},
+                       **({"perf_lens": lens} if lens else {})},
             ))
             result["extra"]["profile_report"] = args.profile
         except Exception as exc:
@@ -2739,7 +2859,17 @@ def main():
 
             pin_cpu()
         try:
-            result = run_bench(args)
+            if args.trace_dir:
+                # capture the whole settled-backend measurement: the
+                # XLA device timeline plus the fu.* annotation spans
+                # land in DIR for obs.timeline / TensorBoard.  Child-
+                # side only — the parent must stay jax-free.
+                from flow_updating_tpu.utils.trace import trace as _trace
+
+                with _trace(args.trace_dir):
+                    result = run_bench(args)
+            else:
+                result = run_bench(args)
         except ValueError as err:
             raise SystemExit(f"invalid flag combination: {err}") from err
         if args.report:

@@ -78,6 +78,7 @@ _KEY_FAMILIES = (
     r"chaos_.+",                    # chaos-harness fault rows
     r"recovery_.+",                 # crash-recovery timing rows
     r"slo_.+",                      # serving-SLO latency rows
+    r"roofline_.+",                 # perf-lens measured/ceiling fracs
     r"(er|ba)\d+k?_[a-z_0-9]+",     # named generator configs
 )
 _KEY_FAMILY_RES = tuple(re.compile(p) for p in _KEY_FAMILIES)

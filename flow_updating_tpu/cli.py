@@ -359,7 +359,9 @@ def cmd_run(args) -> int:
     until_rmse_result = None
     telemetry_series = None
     t_run0 = _time.perf_counter()
-    with trace(args.profile):
+    # --trace-dir and --profile are the same capture (utils/trace.py);
+    # --trace-dir wins when both are given
+    with trace(getattr(args, "trace_dir", None) or args.profile):
         if telemetry_spec is not None:
             # device-resident series: one compiled scan, bulk readback
             every = max(1, int(args.observe_every))
@@ -800,6 +802,19 @@ def cmd_serve(args) -> int:
     else:
         events = []
 
+    # --trace-dir captures the whole serving body (event script +
+    # trailing rounds) as one device-timeline trace; entered manually so
+    # the existing flow stays un-indented.  The fu.segment annotation
+    # spans (service.engine) land inside it.
+    _tracer = None
+    if getattr(args, "trace_dir", None):
+        import contextlib as _ctxlib
+
+        from flow_updating_tpu.utils.trace import trace as _trace
+
+        _tracer = _ctxlib.ExitStack()
+        _tracer.enter_context(_trace(args.trace_dir))
+
     joined = []
     for lineno, verb, a in events:
         try:
@@ -840,6 +855,8 @@ def cmd_serve(args) -> int:
             svc.run(args.rounds)
         except ValueError as err:
             raise SystemExit(f"serve: {err}") from err
+    if _tracer is not None:
+        _tracer.close()
 
     report = svc.convergence_report()
     if args.checkpoint:
@@ -1260,7 +1277,9 @@ def cmd_profile(args) -> int:
     _select_backend(args.backend, n_virtual_devices=args.shards or None)
     engine = _engine_from_args(args)
     try:
-        prof = engine.profile(args.rounds, execute=not args.no_execute)
+        prof = engine.profile(args.rounds, execute=not args.no_execute,
+                              trace_dir=getattr(args, "trace_dir", None),
+                              roofline=getattr(args, "roofline", False))
     except (ValueError, NotImplementedError) as err:
         raise SystemExit(f"profile: {err}") from err
     if args.report:
@@ -1269,9 +1288,18 @@ def cmd_profile(args) -> int:
             write_report,
         )
 
+        extra = None
+        rl = prof.get("roofline")
+        if isinstance(rl, dict):
+            # lift the reconciled record into the manifest's perf-lens
+            # block so `doctor` can judge roofline_sane/roofline_floor
+            from flow_updating_tpu.obs import roofline as _roof
+
+            extra = {"perf_lens": _roof.perf_lens_block(
+                [rl], _roof.resolve_model())}
         write_report(args.report, build_profile_manifest(
             argv=getattr(args, "_argv", None), config=engine.config,
-            topo=engine.topology, profile=prof,
+            topo=engine.topology, profile=prof, extra=extra,
         ))
         prof["report_path"] = args.report
     print(json.dumps(prof))
@@ -1494,6 +1522,14 @@ def cmd_plan(args) -> int:
     doc = decision.describe()
     doc["nodes"] = topo.num_nodes
     doc["directed_edges"] = topo.num_edges
+    if args.autotune:
+        # the measured-probe cache's hit/miss counters for THIS
+        # invocation — a hit means zero probes ran (the cache-hit
+        # contract the smoke test asserts); the same counters feed
+        # plan.select.autotune_metrics' Prometheus export
+        from flow_updating_tpu.plan.select import AUTOTUNE_CACHE_STATS
+
+        doc["autotune_cache"] = dict(AUTOTUNE_CACHE_STATS)
     if args.explain:
         lines = [f"# decision: {doc['kernel']}"
                  + (f"/{doc['spmv']}" if doc.get("spmv") else "")
@@ -1988,6 +2024,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "run start/end) to PATH")
     run.add_argument("--profile", metavar="DIR",
                      help="capture a JAX/XLA profiler trace into DIR")
+    run.add_argument("--trace-dir", metavar="DIR",
+                     help="alias of --profile (the bench/serve flag "
+                          "name): capture the run's device timeline "
+                          "into DIR; parse it with obs.timeline or "
+                          "view in TensorBoard/Perfetto")
     run.add_argument("--save-checkpoint", metavar="PATH",
                      help="write the final state pytree + config to PATH")
     run.add_argument("--resume", metavar="PATH",
@@ -2181,6 +2222,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the flight recorder's streaming metrics "
                          "as Prometheus text exposition to PATH at exit "
                          "(obs/metrics.py; docs/OBSERVABILITY.md §8)")
+    sv.add_argument("--trace-dir", metavar="DIR",
+                    help="capture the serving body (event script + "
+                         "trailing rounds, with fu.segment spans at "
+                         "segment boundaries) as a JAX/XLA profiler "
+                         "trace into DIR (utils/trace.py; parse with "
+                         "obs.timeline)")
     sv.set_defaults(fn=cmd_serve)
 
     qr = sub.add_parser(
@@ -2351,6 +2398,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the flow-updating-profile-report/v1 "
                          "manifest (argv, config, topology fingerprint, "
                          "environment, attribution) to PATH")
+    pr.add_argument("--roofline", action="store_true",
+                    help="compose the cost record with the ambient "
+                         "backend's hardware model (obs/roofline.py): "
+                         "arithmetic intensity, binding resource, "
+                         "predicted ceiling and the measured-vs-ceiling "
+                         "roofline_frac ride the record (and the "
+                         "manifest's flow-updating-perf-lens/v1 block "
+                         "with --report)")
+    pr.add_argument("--trace-dir", metavar="DIR",
+                    help="also capture one round-program execution as a "
+                         "device-timeline trace into DIR and measure "
+                         "overlap_ratio from the actual wire/compute "
+                         "slices (sharded halo paths; obs/timeline.py)")
     pr.set_defaults(fn=cmd_profile)
 
     ins = sub.add_parser(

@@ -1448,7 +1448,9 @@ class Engine:
             topk_idx=topk_idx, spec=spec, edges=edges,
             coords=node_coordinates(self.topology))
 
-    def profile(self, n: int, *, execute: bool = True) -> dict:
+    def profile(self, n: int, *, execute: bool = True,
+                trace_dir: str | None = None,
+                roofline: bool = False) -> dict:
         """AOT cost attribution of the configured kernel's plain
         ``n``-round program: XLA's own ``cost_analysis()`` (flops, bytes
         accessed) and ``memory_analysis()`` (argument/output/temp/peak
@@ -1464,6 +1466,13 @@ class Engine:
         from the current state and its result is discarded
         (tests/test_profile.py asserts program identity and
         state-untouched).
+
+        ``trace_dir`` additionally captures a ``jax.profiler`` device
+        timeline of the overlap schedule (halo mode) so the overlap
+        ratio is measured from real timeline slices
+        (obs/timeline.py); ``roofline`` attaches the perf lens'
+        predicted-vs-measured record (obs/roofline.py) — both pure
+        host-side observers: lens off lowers byte-identically.
         """
         from flow_updating_tpu.obs import profile as _prof
 
@@ -1523,11 +1532,30 @@ class Engine:
             if self._halo_wire in ("overlap", "overlap_pallas"):
                 # overlap-mode manifests carry the measured overlap
                 # ratio (fraction of exchange time hidden behind the
-                # interior pass)
+                # interior pass); trace_dir upgrades it from the
+                # three-schedule inference to real device-timeline
+                # slices
                 record["overlap"] = _prof.overlap_report(
                     self.state, self._halo_plan, self.config, self.mesh,
                     n, arrays=self._halo_arrays, execute=execute,
-                    mode=self._halo_wire)
+                    mode=self._halo_wire, trace_dir=trace_dir)
+        if roofline:
+            from flow_updating_tpu.obs import roofline as _roof
+
+            mode = kind
+            if kind in ("node", "pod") and self.config.spmv:
+                mode = f"{kind}/{self.config.spmv}"
+            shards = (int(self.mesh.devices.size)
+                      if self.mesh is not None else 0)
+            if shards:
+                mode += f"@s{shards}"
+            model = _roof.resolve_model()
+            exec_s = record["timings"].get("execute_s")
+            measured = (n / exec_s if isinstance(exec_s, (int, float))
+                        and exec_s > 0 else None)
+            record["roofline"] = _roof.reconcile(
+                _roof.analyze(record, model, rounds=n, mode=mode),
+                measured)
         return record
 
     def run_until_rmse(

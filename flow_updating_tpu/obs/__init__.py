@@ -1,7 +1,7 @@
 """Observability subsystem: device-resident telemetry, run manifests,
 the DES trace exporter, and the measurement-to-verdict layer.
 
-Seven pillars (docs/OBSERVABILITY.md):
+The pillars (docs/OBSERVABILITY.md):
 
 * :mod:`~flow_updating_tpu.obs.fields` +
   :mod:`~flow_updating_tpu.obs.inspect` — TOPOLOGY-RESOLVED
@@ -32,6 +32,14 @@ Seven pillars (docs/OBSERVABILITY.md):
 * :mod:`~flow_updating_tpu.obs.regress` — fresh bench/profile reports
   gated against the artifact history and recorded spreads (the
   ``regress`` subcommand; CI-consumable exit codes).
+* :mod:`~flow_updating_tpu.obs.roofline` +
+  :mod:`~flow_updating_tpu.obs.timeline` — the PERF LENS: per-backend
+  hardware models (declared TPU generations, measured CPU-proxy
+  calibration), predicted-vs-measured reconciliation (``roofline_frac``
+  on every banked rate; doctor clauses ``roofline_sane`` /
+  ``roofline_floor``), and measured device timelines (captured profiler
+  traces parsed into wire/compute slices and a *measured*
+  ``overlap_ratio`` — ``profile --roofline --trace-dir``).
 
 ``observer_sample`` is re-exported here as the ONE watch-record shape:
 every streamed-observer emit site and :meth:`TelemetrySeries.
@@ -61,6 +69,12 @@ from flow_updating_tpu.obs.report import (
     build_profile_manifest,
     write_report,
 )
+from flow_updating_tpu.obs.roofline import (
+    HardwareModel,
+    calibrate_cpu,
+    resolve_model,
+)
+from flow_updating_tpu.obs.timeline import measured_overlap
 from flow_updating_tpu.obs.trace import eventlog_to_chrome_trace, read_eventlog
 from flow_updating_tpu.utils.metrics import observer_sample
 
@@ -73,6 +87,10 @@ __all__ = [
     "CheckResult",
     "FieldSeries",
     "FieldSpec",
+    "HardwareModel",
+    "calibrate_cpu",
+    "measured_overlap",
+    "resolve_model",
     "TelemetrySeries",
     "TelemetrySpec",
     "ascii_heatmap",

@@ -522,6 +522,104 @@ def check_scaling_efficiency(doc: dict, *, threshold_pct: float = 50.0
         + (f" ({noisy} noisy rows quarantined)" if noisy else ""), ev)
 
 
+def check_perf_lens(block: dict | None) -> list:
+    """The perf lens' doctor clauses over a
+    ``flow-updating-perf-lens/v1`` block (obs/roofline.py):
+
+    * **roofline_sane** — every program's ``roofline_frac`` must land
+      in (0, 1]: the predicted ceiling is a physical bound, so a frac
+      above 1 means the hardware model or the measurement is lying
+      (and a non-positive frac means a degenerate measurement);
+    * **roofline_floor** — a frac below the per-mode declared floor
+      fails unless the mode is pinned as a KNOWN discrepancy
+      (obs.roofline.KNOWN_DISCREPANCIES — e.g. the sharded banded
+      round's post-DMA-wait full-band recompute), in which case it is
+      reported as KNOWN instead of silently passing or spuriously
+      failing.
+    """
+    if not isinstance(block, dict):
+        return [CheckResult("roofline_sane", SKIP,
+                            "no perf-lens block to judge — produce one "
+                            "with `profile --roofline` or "
+                            "`bench.py --roofline`")]
+    programs = [p for p in (block.get("programs") or [])
+                if isinstance(p, dict)]
+    judged = [p for p in programs
+              if isinstance(p.get("roofline_frac"), (int, float))]
+    checks = []
+    if not judged:
+        checks.append(CheckResult(
+            "roofline_sane", SKIP,
+            "perf-lens block carries no reconciled roofline_frac "
+            "(programs were analyzed but never measured?)",
+            {"programs": len(programs)}))
+        return checks
+    insane = [{"mode": p.get("mode"), "frac": p["roofline_frac"],
+               "ceiling_rounds_per_sec": p.get("ceiling_rounds_per_sec"),
+               "measured_rounds_per_sec":
+               p.get("measured_rounds_per_sec")}
+              for p in judged
+              if not 0.0 < float(p["roofline_frac"]) <= 1.0]
+    ev = {"programs": len(judged),
+          "fracs": {str(p.get("mode")): p["roofline_frac"]
+                    for p in judged},
+          "model": (block.get("model") or {}).get("name"),
+          "violations": insane}
+    if insane:
+        worst = max(insane, key=lambda v: abs(float(v["frac"])))
+        checks.append(CheckResult(
+            "roofline_sane", FAIL,
+            f"roofline_frac outside (0, 1] on {len(insane)} "
+            f"program(s) — worst {worst['mode']}: "
+            f"{worst['frac']:g} (frac > 1 means the hardware model or "
+            "the measurement is lying; re-calibrate or re-measure)",
+            ev))
+    else:
+        checks.append(CheckResult(
+            "roofline_sane", PASS,
+            f"all {len(judged)} measured programs land in (0, 1] of "
+            f"the {ev['model'] or 'declared'} roofline", ev))
+    below, known = [], []
+    for p in judged:
+        frac = float(p["roofline_frac"])
+        floor = p.get("floor_frac")
+        if not isinstance(floor, (int, float)):
+            from flow_updating_tpu.obs import roofline as _rl
+
+            floor = _rl.floor_frac(p.get("mode"))
+        if frac >= float(floor) or frac <= 0.0:
+            continue        # non-positive fracs are roofline_sane's case
+        rec = {"mode": p.get("mode"), "frac": frac,
+               "floor_frac": float(floor),
+               "known_discrepancy": p.get("known_discrepancy")}
+        (known if p.get("known_discrepancy") else below).append(rec)
+    ev2 = {"programs": len(judged), "below_floor": below,
+           "known": known}
+    if below:
+        worst = min(below, key=lambda v: v["frac"])
+        checks.append(CheckResult(
+            "roofline_floor", FAIL,
+            f"{len(below)} program(s) below their declared roofline "
+            f"floor with no pinned discrepancy — worst "
+            f"{worst['mode']}: {worst['frac']:g} < "
+            f"{worst['floor_frac']:g} (pin it in "
+            "obs.roofline.KNOWN_DISCREPANCIES or fix the kernel)",
+            ev2))
+    elif known:
+        names = sorted({k["known_discrepancy"] for k in known})
+        checks.append(CheckResult(
+            "roofline_floor", PASS,
+            f"{len(judged) - len(known)} program(s) at or above their "
+            f"floor; {len(known)} below-floor mode(s) KNOWN "
+            f"({', '.join(names)})", ev2))
+    else:
+        checks.append(CheckResult(
+            "roofline_floor", PASS,
+            f"all {len(judged)} measured programs at or above their "
+            "declared roofline floor", ev2))
+    return checks
+
+
 def _epoch_tol(sample: dict, scale: float, dtype: str | None,
                inflight_factor: float = 2.0) -> float:
     """Per-epoch mass tolerance: float roundoff at the mass magnitude
@@ -1992,6 +2090,11 @@ def diagnose_manifest(manifest: dict) -> list:
             trace,
             query=query if isinstance(query, dict) else None,
             recovery=recovery if isinstance(recovery, dict) else None))
+    lens = manifest.get("perf_lens")
+    if isinstance(lens, dict):
+        # the perf lens' predicted-vs-measured block rides profile /
+        # plan / bench manifests: roofline sanity + per-mode floors
+        checks.extend(check_perf_lens(lens))
     results = manifest.get("results")
     if (isinstance(results, list) and results
             and isinstance(results[0], dict)

@@ -43,6 +43,12 @@ BUDGET_SCHEMA = "flow-updating-budget-report/v1"
 #: span chains (obs/metrics.py, obs/spans.py; doctor's ``slo_latency``
 #: / ``span_complete`` / ``metrics_consistency`` checks judge it).
 SERVING_TRACE_SCHEMA = "flow-updating-serving-trace/v1"
+#: The perf lens' embedded block (NOT a top-level manifest schema):
+#: profile/plan/bench manifests carry it under the ``perf_lens`` key —
+#: the backend hardware model, per-program roofline records and their
+#: ``roofline_frac`` reconciliation (obs/roofline.py; doctor's
+#: ``roofline_sane`` / ``roofline_floor`` checks judge it).
+PERF_LENS_SCHEMA = "flow-updating-perf-lens/v1"
 
 
 def environment_info() -> dict:

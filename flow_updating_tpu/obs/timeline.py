@@ -1,0 +1,213 @@
+"""Measured device timelines: parse ``jax.profiler`` captures.
+
+``utils/trace.py`` wraps ``jax.profiler.trace`` (the ``--trace-dir``
+flag on bench / run / serve / profile); this module reads what the
+capture wrote.  The profiler drops a Chrome trace-event file
+(``*.trace.json.gz``) under ``LOGDIR/plugins/profile/<run>/`` whose
+device rows are per-op thunk slices — name, start, duration, and an
+``args.hlo_op`` tag (XLA:CPU thunk runtime and TPU device rows both
+carry it).  From those slices the overlap ratio of a sharded schedule
+is *measured*: the fraction of wall time the wire ops (collective-
+permute / all-reduce / remote DMA) spend concurrent with compute
+slices, rather than inferred from the three-schedule wall-clock
+arithmetic in :func:`obs.profile.overlap_report` (which stays as the
+cross-check).
+
+Everything here is host-side JSON parsing — stdlib only, importable
+without jax.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+
+#: device slices that ARE the wire: XLA collective ops and the Pallas
+#: remote-DMA copies
+WIRE_RE = re.compile(
+    r"collective-permute|all-reduce|all-gather|reduce-scatter|"
+    r"all-to-all|ppermute|remote_copy|copy-start|copy-done|send|recv",
+    re.IGNORECASE)
+
+#: executor scaffolding rows that are neither wire nor compute
+_INFRA_RE = re.compile(
+    r"ThunkExecutor|Executable::|ExecuteHelper|buffer|allocat|"
+    r"infeed|outfeed|tuple|parameter",
+    re.IGNORECASE)
+
+#: thread names that host device-op slices even when an event misses
+#: the args.hlo_op tag (the XLA:CPU client threads)
+_DEVICE_THREAD_RE = re.compile(
+    r"XLATfrtCpuClient|TFRT|/device:|XLA Launch|Stream #",
+    re.IGNORECASE)
+
+
+def latest_trace_file(log_dir: str) -> str | None:
+    """The newest ``*.trace.json.gz`` under ``log_dir`` (the profiler
+    nests captures as ``plugins/profile/<timestamp>/<host>...``)."""
+    hits = glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
+                     recursive=True)
+    if not hits:
+        return None
+    return max(hits, key=os.path.getmtime)
+
+
+def load_trace_events(path: str) -> tuple[list, dict]:
+    """``(trace events, thread names)`` from one Chrome trace file;
+    thread names key on ``(pid, tid)``."""
+    with gzip.open(path, "rt") as fh:
+        doc = json.load(fh)
+    events = doc.get("traceEvents") or []
+    threads = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = \
+                (e.get("args") or {}).get("name", "")
+    return events, threads
+
+
+def device_slices(events: list, threads: dict, *,
+                  module: str | None = None) -> list:
+    """Per-op device slices: complete ('X') events that carry an
+    ``args.hlo_op`` tag or sit on a device-executor thread, with the
+    scaffolding rows dropped.  ``module`` filters on the
+    ``args.hlo_module`` tag (e.g. 'jit_run_rounds')."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        name = str(e.get("name", ""))
+        on_device_thread = bool(_DEVICE_THREAD_RE.search(
+            threads.get((e.get("pid"), e.get("tid")), "")))
+        if "hlo_op" not in args and not on_device_thread:
+            continue
+        if _INFRA_RE.search(name):
+            continue
+        if module is not None \
+                and module not in str(args.get("hlo_module", "")):
+            continue
+        dur = e.get("dur")
+        ts = e.get("ts")
+        if not isinstance(dur, (int, float)) \
+                or not isinstance(ts, (int, float)) or dur <= 0:
+            continue
+        out.append({"name": name, "ts_us": float(ts),
+                    "dur_us": float(dur),
+                    "hlo_op": args.get("hlo_op"),
+                    "hlo_module": args.get("hlo_module"),
+                    "lane": (e.get("pid"), e.get("tid"))})
+    return out
+
+
+def annotation_spans(events: list, name: str) -> list:
+    """Spans of one ``utils.trace.annotate`` marker (TraceMe splits a
+    ``prefix:name`` at the colon, so span names here use dots —
+    ``fu.segment``)."""
+    return [{"ts_us": float(e["ts"]), "dur_us": float(e["dur"])}
+            for e in events
+            if e.get("ph") == "X" and e.get("name") == name
+            and isinstance(e.get("ts"), (int, float))
+            and isinstance(e.get("dur"), (int, float))]
+
+
+def _union(intervals: list) -> list:
+    """Merged ``(start, end)`` union of possibly-overlapping
+    intervals."""
+    merged: list = []
+    for start, end in sorted(intervals):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def _overlap_with(interval: tuple, union: list) -> float:
+    """Length of ``interval``'s intersection with a sorted disjoint
+    union."""
+    start, end = interval
+    total = 0.0
+    for a, b in union:
+        if b <= start:
+            continue
+        if a >= end:
+            break
+        total += min(end, b) - max(start, a)
+    return total
+
+
+def measured_overlap(log_dir: str, *,
+                     module: str | None = None) -> dict | None:
+    """Measure the wire/compute overlap ratio from a captured device
+    timeline: the fraction of total wire-slice time during which a
+    compute slice is simultaneously active *on the same lane* (device
+    row / executor thread).
+
+    Same-lane is the quantity the split schedule buys: a shard's own
+    compute hiding its own wire wait.  (Cross-lane concurrency is
+    trivially ~1 on any multi-shard run — while one shard sits in a
+    collective rendezvous its peer is computing — and says nothing
+    about hiding.)  On a TPU the DMA engine runs beside the shard's
+    compute, so a working overlap schedule pushes this toward 1.  Note
+    the measured and inferred (:func:`obs.profile.overlap_report`)
+    ratios answer different questions and may legitimately differ: the
+    wall-clock arithmetic asks how much the *schedule split* saved over
+    the serialized oracle, while the timeline asks how much of the wire
+    time had concurrent compute — on XLA:CPU the thunk executor
+    dispatches independent thunks out of order, so a collective
+    rendezvous can overlap same-lane compute even under the serialized
+    schedule.  Returns None when ``log_dir`` holds no capture; returns
+    a record with ``overlap_ratio_measured=None`` when the capture has
+    no wire slices (a single-device program)."""
+    path = latest_trace_file(log_dir)
+    if path is None:
+        return None
+    events, threads = load_trace_events(path)
+    slices = device_slices(events, threads, module=module)
+    wire = [s for s in slices if WIRE_RE.search(s["name"])]
+    compute = [s for s in slices if not WIRE_RE.search(s["name"])]
+    out = {
+        "trace_file": path,
+        "device_slices": len(slices),
+        "wire_ops": len(wire),
+        "compute_ops": len(compute),
+        "lanes": len({s["lane"] for s in slices}),
+        "module": module,
+    }
+    compute_by_lane: dict = {}
+    for s in compute:
+        compute_by_lane.setdefault(s["lane"], []).append(
+            (s["ts_us"], s["ts_us"] + s["dur_us"]))
+    compute_busy = sum(
+        b - a for lane in compute_by_lane.values()
+        for a, b in _union(lane))
+    if not wire:
+        out.update({
+            "wire_busy_s": 0.0,
+            "compute_busy_s": round(compute_busy / 1e6, 6),
+            "overlapped_s": 0.0,
+            "overlap_ratio_measured": None,
+            "note": "capture holds no wire slices (single-device "
+                    "program?) — nothing to overlap",
+        })
+        return out
+    lane_unions = {lane: _union(iv)
+                   for lane, iv in compute_by_lane.items()}
+    wire_busy = sum(s["dur_us"] for s in wire)
+    overlapped = sum(
+        _overlap_with((s["ts_us"], s["ts_us"] + s["dur_us"]),
+                      lane_unions.get(s["lane"], []))
+        for s in wire)
+    out.update({
+        "wire_busy_s": round(wire_busy / 1e6, 6),
+        "compute_busy_s": round(compute_busy / 1e6, 6),
+        "overlapped_s": round(overlapped / 1e6, 6),
+        "overlap_ratio_measured": round(
+            min(overlapped / wire_busy, 1.0), 4) if wire_busy > 0
+        else None,
+    })
+    return out

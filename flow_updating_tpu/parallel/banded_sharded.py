@@ -54,6 +54,25 @@ P = jax.sharding.PartitionSpec
 LANE = 128
 _TILE = 8 * LANE  # per-shard length multiple (f32 min tile rows x lanes)
 
+#: The perf lens' pinned predicted-vs-measured discrepancy for this
+#: kernel (step 4 above re-runs the FULL band pass after the remote-DMA
+#: wait instead of re-accumulating only the boundary rows — ~2x the VPU
+#: work of the single-device fused round; ROADMAP item "needless
+#: recompute").  ``doctor``'s ``roofline_floor`` clause reports a
+#: below-floor frac on a matching mode as KNOWN instead of failing.
+#: Mirrors ``obs.roofline.KNOWN_DISCREPANCIES[0]`` — duplicated, not
+#: imported, so the obs layer stays importable without jax;
+#: tests/test_perf_lens.py pins the two equal.
+ROOFLINE_KNOWN_DISCREPANCY = {
+    "name": "banded_sharded_recompute",
+    "mode_re": r"banded_fused.*@s(?:[2-9]|\d{2,})",
+    "factor": 2.0,
+    "reason": ("sharded fused banded round recomputes the full band "
+               "pass after the remote-DMA wait (~2x VPU work) "
+               "instead of re-accumulating only boundary rows — "
+               "parallel/banded_sharded.py, ROADMAP item 1"),
+}
+
 
 @struct.dataclass
 class ShardedBandedArrays:

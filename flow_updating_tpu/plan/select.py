@@ -396,6 +396,13 @@ AUTOTUNE_MIN_NODES = 4096
 #: cache-hit contract ("second select_plan call does ZERO probes") on it
 PROBE_COUNT = 0
 
+#: persisted-cache traffic since import, the observable twin of the
+#: probe-count contract: a cache hit must show here AND as
+#: ``probes_run == 0``.  :func:`autotune_metrics` exports both counters
+#: (plus per-probe measured rates) onto a MetricsRegistry, which is how
+#: they reach the Prometheus text output and the plan manifest.
+AUTOTUNE_CACHE_STATS = {"hits": 0, "misses": 0}
+
 #: rounds per timing probe (one warm compile + this many timed rounds,
 #: twice — enough to beat scheduler noise at probe scale, cheap enough
 #: that a full candidate sweep stays a few seconds)
@@ -533,7 +540,9 @@ def autotune_fused(topo, cfg, *, backend: str | None = None,
     if not force:
         hit = _load_autotune_cache(path).get(key)
         if isinstance(hit, dict) and "measured_rounds_per_sec" in hit:
+            AUTOTUNE_CACHE_STATS["hits"] += 1
             return {**hit, "probes_run": 0, "cache": "hit"}
+    AUTOTUNE_CACHE_STATS["misses"] += 1
     base_fill = min_fill if min_fill is not None \
         else float(np.clip(3.0 / cg, 1.0 / 64.0, 0.75))
     # band-width axis: the selector's fill plus one coarser band set
@@ -543,6 +552,7 @@ def autotune_fused(topo, cfg, *, backend: str | None = None,
     probes = 0
     candidates: dict = {}
     best = None
+    fam_best: dict = {}     # family -> (rate, plan, mf, tile, route)
     cfg_b = _dc.replace(cfg, kernel="node", spmv="banded")
     cfg_f = _dc.replace(cfg, kernel="node", spmv="banded_fused")
     plans = {}
@@ -562,6 +572,8 @@ def autotune_fused(topo, cfg, *, backend: str | None = None,
         candidates[label_b] = rate
         if best is None or rate > best[0]:
             best = (rate, "banded", mf, None, None)
+        if "banded" not in fam_best or rate > fam_best["banded"][0]:
+            fam_best["banded"] = (rate, plan, mf, None, None)
         routes = ["lanes"]
         if plan.spmv.rem_mode in ("gather",):
             routes.append("inline")
@@ -586,6 +598,9 @@ def autotune_fused(topo, cfg, *, backend: str | None = None,
                 candidates[label] = rate
                 if rate > best[0]:
                     best = (rate, "banded_fused", mf, tile, route)
+                if ("banded_fused" not in fam_best
+                        or rate > fam_best["banded_fused"][0]):
+                    fam_best["banded_fused"] = (rate, plan, mf, tile, route)
     rate_banded = max((v for k, v in candidates.items()
                        if isinstance(v, (int, float))
                        and k.startswith("node/banded[")), default=0.0)
@@ -617,8 +632,84 @@ def autotune_fused(topo, cfg, *, backend: str | None = None,
         },
         "probes_run": probes,
     }
+    _annotate_roofline(entry, fam_best, topo, cfg_b, cfg_f)
     _store_autotune_entry(path, key, entry)
     return {**entry, "cache": "miss"}
+
+
+def _annotate_roofline(entry: dict, fam_best: dict, topo,
+                       cfg_b, cfg_f) -> None:
+    """Attach a perf-lens block to a fresh autotune record: each probe
+    family's best candidate is lowered once more (``execute=False`` —
+    cost/memory only, no extra device time) and its measured probe rate
+    reconciled against the ambient backend's roofline ceiling.  Opt-in
+    via ``FLOW_UPDATING_ROOFLINE`` and fully contained — a lens failure
+    never loses the probe record."""
+    from flow_updating_tpu.obs import roofline as _roof
+
+    if not _roof.enabled() or not fam_best:
+        return
+    try:
+        from flow_updating_tpu.models import sync
+        from flow_updating_tpu.obs.profile import profile_program
+
+        model = _roof.resolve_model()
+        programs = []
+        fracs = {}
+        for fam in sorted(fam_best):
+            rate, plan, mf, tile, route = fam_best[fam]
+            if fam == "banded_fused":
+                kern = sync.NodeKernel(topo, cfg_f, plan=plan,
+                                       fused_tile=tile,
+                                       fused_remainder=route)
+            else:
+                kern = sync.NodeKernel(topo, cfg_b, plan=plan)
+            fn, fargs, nd = kern.round_program(kern.init_state(),
+                                               PROBE_ROUNDS)
+            rec = profile_program(fn, fargs, n_dynamic=nd,
+                                  execute=False,
+                                  label=f"autotune/{fam}")
+            mode = f"autotune/node/{fam}"
+            rl = _roof.reconcile(
+                _roof.analyze(rec, model, rounds=PROBE_ROUNDS,
+                              mode=mode),
+                rate)
+            programs.append(rl)
+            if rl.get("roofline_frac") is not None:
+                fracs[f"node/{fam}"] = rl["roofline_frac"]
+        if programs:
+            entry["roofline"] = _roof.perf_lens_block(programs, model)
+        if fracs:
+            entry["roofline_frac"] = fracs
+    except Exception as exc:      # noqa: BLE001 — lens must not break probes
+        entry["roofline_error"] = f"{type(exc).__name__}: {exc}"[:160]
+
+
+def autotune_metrics(registry, record: dict | None = None) -> None:
+    """Export the autotune cache counters (and, when a record is given,
+    its per-family measured rates and roofline fracs) into a
+    :class:`~flow_updating_tpu.obs.metrics.MetricsRegistry` — the
+    Prometheus face of the measured-probe cache."""
+    registry.set_counter("autotune_cache_hits_total",
+                         AUTOTUNE_CACHE_STATS["hits"])
+    registry.set_counter("autotune_cache_misses_total",
+                         AUTOTUNE_CACHE_STATS["misses"])
+    registry.set_counter("autotune_probes_total", PROBE_COUNT)
+    if not isinstance(record, dict):
+        return
+
+    def _slug(s: str) -> str:
+        return "".join(c if c.isalnum() else "_" for c in s).strip("_")
+
+    for label, rate in (record.get("measured_rounds_per_sec")
+                        or {}).items():
+        if isinstance(rate, (int, float)):
+            registry.set_gauge(f"autotune_rate_{_slug(label)}",
+                               float(rate))
+    for label, frac in (record.get("roofline_frac") or {}).items():
+        if isinstance(frac, (int, float)):
+            registry.set_gauge(f"autotune_roofline_frac_{_slug(label)}",
+                               float(frac))
 
 
 def select_plan(topo, cfg, *, backend: str | None = None,
@@ -732,7 +823,8 @@ def select_plan(topo, cfg, *, backend: str | None = None,
         fused_doc = {k: tune[k] for k in
                      ("backend", "remainder", "candidates",
                       "measured_rounds_per_sec", "best", "probes_run",
-                      "probe_rounds")
+                      "probe_rounds", "roofline", "roofline_frac",
+                      "roofline_error")
                      if k in tune}
         fused_doc["cache"] = tune.get("cache")
     if fused_kw is not None:

@@ -776,6 +776,7 @@ class ServiceEngine:
             run_rounds,
             run_rounds_telemetry,
         )
+        from flow_updating_tpu.utils.trace import annotate
 
         if rounds < 0:
             raise ValueError("rounds must be >= 0")
@@ -799,19 +800,24 @@ class ServiceEngine:
         if telemetry is not None and not telemetry.enabled:
             telemetry = None
         for _ in range(rounds // self.segment_rounds):
+            # a segment-boundary span for `--trace-dir` captures: a
+            # no-op TraceMe when no profiler is recording, so the
+            # zero-recompile hot loop stays untouched
             if telemetry is None:
-                self.state = run_rounds(
-                    self.state, self.arrays, self.config,
-                    self.segment_rounds, params=self.params)
+                with annotate("fu.segment"):
+                    self.state = run_rounds(
+                        self.state, self.arrays, self.config,
+                        self.segment_rounds, params=self.params)
             else:
                 import jax.numpy as jnp
 
                 mean = jnp.asarray(self._live_mean(),
                                    self.config.jnp_dtype)
-                self.state, seg = run_rounds_telemetry(
-                    self.state, self.arrays, self.config,
-                    self.segment_rounds, telemetry, mean,
-                    params=self.params)
+                with annotate("fu.segment"):
+                    self.state, seg = run_rounds_telemetry(
+                        self.state, self.arrays, self.config,
+                        self.segment_rounds, telemetry, mean,
+                        params=self.params)
                 seg = {k: np.asarray(v) for k, v in seg.items()}
                 if series_rows is None:
                     series_rows = {k: [v] for k, v in seg.items()}

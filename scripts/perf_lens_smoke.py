@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""CI perf-lens smoke: calibration + roofline reports + measured timeline.
+
+Runs the perf lens (docs/OBSERVABILITY.md; obs/roofline.py +
+obs/timeline.py) end to end on CPU and leaves the manifests in
+``--outdir`` (the tier1 workflow uploads them as build artifacts):
+
+1. **calibration** — the CPU-proxy hardware model is force-probed
+   (STREAM triad + chained FMA) with the record persisted into the
+   outdir, so the artifact shows exactly what ceiling CI reconciled
+   against;
+2. **roofline reports** — ``profile --roofline --report`` across THREE
+   dispatch modes (edge, node, 2-shard halo on the virtual CPU mesh):
+   every manifest must carry a ``flow-updating-perf-lens/v1`` block
+   whose ``roofline_frac`` lands in (0, 1];
+3. **measured timeline** — the halo run captures a real
+   ``jax.profiler`` device trace (``--trace-dir``) and its overlap
+   ratio must be MEASURED from the timeline slices (``wire_ops > 0``,
+   a numeric ``overlap_ratio_measured``, source ``device-trace``) —
+   not just inferred from the three-schedule wall-clock arithmetic;
+4. **doctor gates** — every manifest must pass ``doctor --strict``
+   (``roofline_sane`` + ``roofline_floor`` among the clauses), and the
+   NEGATIVE control — the same manifest with a frac forged above 1 —
+   must FAIL it (a gate that cannot fail is not a gate).
+
+Exit code: 0 healthy; 1 on any failed step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fail(msg: str) -> int:
+    print(f"perf_lens_smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def _check_lens(path: str) -> str | None:
+    """The in-script assert on one manifest's perf-lens block; returns
+    an error string or None."""
+    from flow_updating_tpu.obs.report import PERF_LENS_SCHEMA
+
+    with open(path) as f:
+        manifest = json.load(f)
+    lens = manifest.get("perf_lens")
+    if not isinstance(lens, dict):
+        return f"{path}: no perf_lens block"
+    if lens.get("schema") != PERF_LENS_SCHEMA:
+        return f"{path}: wrong schema {lens.get('schema')!r}"
+    fracs = {p.get("mode"): p.get("roofline_frac")
+             for p in lens.get("programs") or []}
+    if not fracs:
+        return f"{path}: perf_lens block carries no programs"
+    for mode, frac in fracs.items():
+        if not isinstance(frac, (int, float)) or not 0.0 < frac <= 1.0:
+            return f"{path}: mode {mode!r} frac {frac!r} outside (0, 1]"
+    print(f"perf_lens_smoke: {os.path.basename(path)} fracs "
+          + ", ".join(f"{m}={f:g}" for m, f in fracs.items()))
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    args = ap.parse_args()
+    os.makedirs(args.outdir, exist_ok=True)
+
+    # 1. calibrate the CPU proxy with the record IN the artifact dir —
+    # the probe must produce positive ceilings and persist its record
+    cache = os.path.join(args.outdir, "roofline_cpu.json")
+    os.environ["FLOW_UPDATING_ROOFLINE_CACHE"] = cache
+    from flow_updating_tpu.obs import roofline
+
+    model = roofline.calibrate_cpu(force=True)
+    if model.hbm_gbps <= 0 or model.vpu_gflops <= 0:
+        return _fail(f"degenerate calibration: {model.to_dict()}")
+    if not os.path.exists(cache):
+        return _fail("calibration record did not persist")
+    print(f"perf_lens_smoke: calibrated {model.name}: "
+          f"{model.hbm_gbps:.1f} GB/s, {model.vpu_gflops:.1f} GFLOP/s "
+          f"({model.notes})")
+
+    # 2. roofline reports across three dispatch modes; the halo run
+    # also captures the device timeline for the measured overlap ratio.
+    # Each profile runs in a CHILD process: the virtual 2-device mesh
+    # (--shards 2) needs its host-device count settled before jax
+    # initializes, which one shared process cannot re-do per run.
+    import subprocess
+
+    trace_dir = os.path.join(args.outdir, "perf_lens_trace")
+    runs = {
+        "perf_lens_edge.json": [
+            "profile", "--backend", "cpu", "--generator", "ring:256:2",
+            "--rounds", "64", "--roofline"],
+        "perf_lens_node.json": [
+            "profile", "--backend", "cpu", "--generator",
+            "erdos_renyi:2048", "--kernel", "node", "--fire-policy",
+            "every_round", "--rounds", "64", "--roofline"],
+        "perf_lens_halo.json": [
+            "profile", "--backend", "cpu", "--generator",
+            "erdos_renyi:512", "--shards", "2", "--multichip", "halo",
+            "--halo", "overlap", "--rounds", "8", "--roofline",
+            "--trace-dir", trace_dir],
+    }
+    manifests = []
+    for name, argv in runs.items():
+        path = os.path.join(args.outdir, name)
+        proc = subprocess.run(
+            [sys.executable, "-m", "flow_updating_tpu",
+             *argv, "--report", path],
+            cwd=REPO, env=dict(os.environ), capture_output=True,
+            text=True)
+        if proc.returncode != 0:
+            print(proc.stdout[-2000:], file=sys.stderr)
+            print(proc.stderr[-2000:], file=sys.stderr)
+            return _fail(f"{name}: profile failed "
+                         f"(rc={proc.returncode})")
+        err = _check_lens(path)
+        if err:
+            return _fail(err)
+        manifests.append(path)
+
+    # 3. the halo manifest's overlap ratio must be MEASURED from the
+    # captured device timeline, not only inferred from wall clocks
+    with open(manifests[-1]) as f:
+        halo = json.load(f)
+    overlap = (halo.get("profile") or {}).get("overlap") or {}
+    measured = overlap.get("measured") or {}
+    if measured.get("error"):
+        return _fail(f"trace capture errored: {measured['error']}")
+    if not isinstance(measured.get("wire_ops"), int) \
+            or measured["wire_ops"] <= 0:
+        return _fail(f"no wire slices in the captured timeline: "
+                     f"{measured}")
+    ratio = measured.get("overlap_ratio_measured")
+    if not isinstance(ratio, (int, float)):
+        return _fail(f"overlap_ratio_measured is not numeric: {ratio!r}")
+    if overlap.get("overlap_ratio_source") != "device-trace":
+        return _fail("overlap ratio was not sourced from the device "
+                     f"trace: {overlap.get('overlap_ratio_source')!r}")
+    print(f"perf_lens_smoke: measured overlap_ratio={ratio:g} from "
+          f"{measured['wire_ops']} wire / {measured['compute_ops']} "
+          f"compute slices on {measured['lanes']} lanes "
+          f"(inferred three-schedule ratio: "
+          f"{overlap.get('overlap_ratio')})")
+
+    # 4a. every manifest passes the strict doctor (roofline_sane +
+    # roofline_floor among the judged clauses)
+    from flow_updating_tpu.cli import main as cli_main
+
+    rc = cli_main(["doctor", "--strict", *manifests])
+    if rc != 0:
+        return _fail(f"doctor --strict failed on honest manifests "
+                     f"(rc={rc})")
+
+    # 4b. the NEGATIVE control: forge a frac above 1 — the physical
+    # bound — and the same gate must FAIL
+    with open(manifests[0]) as f:
+        forged = json.load(f)
+    prog = forged["perf_lens"]["programs"][0]
+    prog["roofline_frac"] = 1.5
+    prog["measured_rounds_per_sec"] = (
+        1.5 * prog["ceiling_rounds_per_sec"])
+    neg = os.path.join(args.outdir, "perf_lens_negative_control.json")
+    with open(neg, "w") as f:
+        json.dump(forged, f, indent=1)
+    rc = cli_main(["doctor", "--strict", neg])
+    if rc == 0:
+        return _fail("NEGATIVE CONTROL PASSED: doctor accepted a "
+                     "roofline_frac of 1.5 — the roofline_sane gate "
+                     "cannot fail")
+    print("perf_lens_smoke: negative control correctly failed "
+          f"(rc={rc})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

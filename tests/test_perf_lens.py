@@ -1,0 +1,534 @@
+"""Perf lens (obs/roofline.py + obs/timeline.py): backend roofline
+models, measured device timelines, predicted-vs-measured reconciliation.
+
+The contract under test:
+
+* the hardware-model registry resolves jax ``device_kind`` strings with
+  longest-substring-wins, and the CPU proxy calibrates ONCE per machine
+  (persisted beside the autotune cache, re-probed only on ``force`` or
+  a version bump);
+* the roofline math is the arithmetic it claims: per-round intensity,
+  per-resource floors, binding resource, ceiling — hand-checked on a
+  synthetic model — and degrades to an error record (never a crash)
+  when the profile carries no cost analysis;
+* ``reconcile`` stamps every measured rate with ``roofline_frac``, the
+  per-mode floor and any pinned KNOWN discrepancy, and the
+  ``roofline_sane`` / ``roofline_floor`` doctor clauses judge the
+  manifest block in BOTH directions (honest pass, frac>1 fail,
+  below-floor fail, below-floor-but-KNOWN pass);
+* the discrepancy record pinned beside the sharded banded kernel
+  mirrors the registry entry exactly (the two must not drift);
+* bench / autotune / serve rows all carry the frac: ``Engine.profile
+  (roofline=True)``, the env-gated autotune probe annotation (plus the
+  cache hit/miss counters), ``bench.py --roofline`` and the serve row's
+  fabric reconciliation — and the banked ``roofline_*`` baseline keys
+  belong to a registered flowlint key family;
+* the lens off is byte-identical lowering + bit-exact state: the
+  canonical program text is unchanged by the env switch and state
+  evolution is unchanged by an interleaved roofline profile;
+* measured timelines: the Chrome-trace parser unions/intersects
+  correctly, and ``measured_overlap`` computes the SAME-LANE
+  wire/compute overlap ratio from a synthetic capture (cross-lane
+  concurrency must NOT count as hiding).
+"""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.engine import Engine
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.models.state import init_state
+from flow_updating_tpu.obs import roofline, timeline
+from flow_updating_tpu.obs.health import check_perf_lens, diagnose_manifest
+from flow_updating_tpu.obs.metrics import MetricsRegistry
+from flow_updating_tpu.obs.report import PERF_LENS_SCHEMA
+from flow_updating_tpu.topology.generators import community, erdos_renyi, ring
+
+
+@pytest.fixture()
+def fast_calibration(tmp_path, monkeypatch):
+    """Point the calibration record at a tmpdir and replace the timed
+    probes with canned GENEROUS per-thread rates (the ceiling-bias
+    discipline: a too-low canned ceiling could push honest fracs past
+    1 and flake the (0, 1] asserts)."""
+    path = str(tmp_path / "roofline_cpu.json")
+    monkeypatch.setenv(roofline.ROOFLINE_CACHE_ENV, path)
+    calls = {"n": 0}
+
+    def fake_measure(seconds: float = 0.12) -> dict:
+        calls["n"] += 1
+        return {"stream_gbps_1t": 50.0, "fma_gflops_1t": 50.0,
+                "triad_elems": 1 << 22, "fma_elems": 1 << 16}
+
+    monkeypatch.setattr(roofline, "_measure_cpu", fake_measure)
+    return {"path": path, "calls": calls}
+
+
+# ---- model registry ------------------------------------------------------
+
+def test_model_registry_longest_match_wins():
+    assert roofline.model_for_device_kind("TPU v5 lite").name == "tpu-v5e"
+    assert roofline.model_for_device_kind("TPU v5p chip").name == "tpu-v5p"
+    assert roofline.model_for_device_kind("TPU v4").name == "tpu-v4"
+    assert roofline.model_for_device_kind("TPU v6 lite").name == "tpu-v6e"
+    assert roofline.model_for_device_kind("Radeon VII") is None
+    for model in roofline.TPU_MODELS.values():
+        assert model.hbm_gbps > 0 and model.vpu_gflops > 0
+        assert model.mxu_gflops >= model.vpu_gflops
+        assert model.source == "declared"
+        json.dumps(model.to_dict())
+
+
+def test_cpu_calibration_persists_and_reloads(fast_calibration):
+    m1 = roofline.calibrate_cpu(threads=4)
+    assert fast_calibration["calls"]["n"] == 1
+    assert os.path.exists(fast_calibration["path"])
+    assert m1.source == "measured"
+    assert m1.hbm_gbps == pytest.approx(50.0 * 4)
+    assert m1.vpu_gflops == pytest.approx(50.0 * 4)
+    # second call reloads the persisted record: zero re-probes (the
+    # autotune cache-hit discipline)
+    m2 = roofline.calibrate_cpu(threads=4)
+    assert fast_calibration["calls"]["n"] == 1
+    assert m2 == m1
+    # force re-probes; a stale version re-probes too
+    roofline.calibrate_cpu(force=True, threads=4)
+    assert fast_calibration["calls"]["n"] == 2
+    doc = json.load(open(fast_calibration["path"]))
+    doc["version"] = -1
+    json.dump(doc, open(fast_calibration["path"], "w"))
+    roofline.calibrate_cpu(threads=4)
+    assert fast_calibration["calls"]["n"] == 3
+
+
+def test_calibration_lives_beside_the_autotune_cache(monkeypatch):
+    """The path logic is duplicated (roofline stays importable without
+    jax), so pin the directories equal — they must not drift."""
+    from flow_updating_tpu.plan import select as plan_select
+
+    monkeypatch.delenv(roofline.ROOFLINE_CACHE_ENV, raising=False)
+    monkeypatch.delenv(plan_select.AUTOTUNE_CACHE_ENV, raising=False)
+    assert (os.path.dirname(roofline.roofline_cache_path())
+            == os.path.dirname(plan_select.autotune_cache_path()))
+
+
+# ---- roofline math -------------------------------------------------------
+
+def _toy_model(**kw) -> roofline.HardwareModel:
+    base = dict(name="toy", hbm_gbps=100.0, vpu_gflops=10.0,
+                mxu_gflops=1000.0, ici_gbps=50.0)
+    base.update(kw)
+    return roofline.HardwareModel(**base)
+
+
+def test_analyze_hand_computed():
+    rec = {"cost": {"flops": 1e9, "bytes_accessed": 1e9}}
+    out = roofline.analyze(rec, _toy_model(), rounds=10, mode="node/xla")
+    assert out["flops_per_round"] == pytest.approx(1e8)
+    assert out["bytes_per_round"] == pytest.approx(1e8)
+    assert out["arithmetic_intensity"] == pytest.approx(1.0)
+    # 1e8 B / 100 GB/s = 1 ms; 1e8 FLOP / 10 GFLOP/s = 10 ms
+    assert out["t_hbm_s"] == pytest.approx(1e-3)
+    assert out["t_compute_s"] == pytest.approx(1e-2)
+    assert out["binding"] == "compute"
+    assert out["floor_s_per_round"] == pytest.approx(1e-2)
+    assert out["ceiling_rounds_per_sec"] == pytest.approx(100.0)
+    # the mxu roof applies only when asked for (dense spmv oracle)
+    dense = roofline.analyze(rec, _toy_model(), rounds=10,
+                             compute_unit="mxu")
+    assert dense["t_compute_s"] == pytest.approx(1e-4)
+    assert dense["binding"] == "hbm"
+    # wire term: 1e10 B / 50 GB/s = 0.2 s dominates everything
+    wired = roofline.analyze(rec, _toy_model(), rounds=10,
+                             wire_bytes_per_round=1e10)
+    assert wired["t_wire_s"] == pytest.approx(0.2)
+    assert wired["binding"] == "wire"
+    assert wired["ceiling_rounds_per_sec"] == pytest.approx(5.0)
+
+
+def test_analyze_degrades_without_cost():
+    out = roofline.analyze({"cost": {}}, _toy_model(), rounds=4,
+                           mode="edge")
+    assert "error" in out
+    assert out["floor_s_per_round"] is None
+    assert out["ceiling_rounds_per_sec"] is None
+    rl = roofline.reconcile(out, 123.0)
+    assert rl["roofline_frac"] is None
+    json.dumps(rl)
+
+
+def test_reconcile_frac_floor_and_known_discrepancy():
+    rec = {"cost": {"flops": 1e9, "bytes_accessed": 1e9}}
+    base = roofline.analyze(rec, _toy_model(), rounds=10, mode="node/xla")
+    rl = roofline.reconcile(base, 50.0)
+    assert rl["roofline_frac"] == pytest.approx(0.5)
+    assert rl["floor_frac"] == pytest.approx(2e-3)
+    assert rl["known_discrepancy"] is None
+    # mode-dependent floors: serve and autotune rows ride host
+    # orchestration, so their floors are looser
+    assert roofline.floor_frac("serve/fabric_l8") == pytest.approx(5e-4)
+    assert roofline.floor_frac("autotune/node/banded") \
+        == pytest.approx(5e-4)
+    assert roofline.floor_frac("halo@s2") == pytest.approx(5e-4)
+    assert roofline.floor_frac("edge") == pytest.approx(1e-3)
+    assert roofline.floor_frac("node/banded_fused@s2") \
+        == pytest.approx(2e-3)
+    # the sharded fused banded round is pinned; unsharded is NOT
+    kd = roofline.known_discrepancy("node/banded_fused@s2")
+    assert kd is not None and kd["name"] == "banded_sharded_recompute"
+    assert roofline.known_discrepancy("node/banded_fused") is None
+    assert roofline.known_discrepancy("node/banded_fused@s16") is not None
+    sharded = roofline.reconcile(
+        roofline.analyze(rec, _toy_model(), rounds=10,
+                         mode="node/banded_fused@s2"), 50.0)
+    assert sharded["known_discrepancy"] == "banded_sharded_recompute"
+
+
+def test_known_discrepancy_mirrors_the_kernel_module():
+    """obs.roofline must stay importable without jax, so the sharded
+    banded kernel pins its OWN copy of the discrepancy record — the two
+    must be field-for-field identical."""
+    from flow_updating_tpu.parallel import banded_sharded
+
+    assert dict(roofline.KNOWN_DISCREPANCIES[0]) \
+        == dict(banded_sharded.ROOFLINE_KNOWN_DISCREPANCY)
+
+
+# ---- doctor clauses ------------------------------------------------------
+
+def _lens_block(frac_by_mode: dict) -> dict:
+    """A perf-lens block whose programs measured the given fracs,
+    built through the real analyze/reconcile path."""
+    model = _toy_model()
+    rec = {"cost": {"flops": 1e9, "bytes_accessed": 1e9}}
+    programs = []
+    for mode, frac in frac_by_mode.items():
+        base = roofline.analyze(rec, model, rounds=10, mode=mode)
+        programs.append(roofline.reconcile(
+            base, frac * base["ceiling_rounds_per_sec"]))
+    return roofline.perf_lens_block(programs, model)
+
+
+def _by_name(checks: list) -> dict:
+    return {c.name: c for c in checks}
+
+
+def test_doctor_skips_without_a_block():
+    (only,) = check_perf_lens(None)
+    assert only.name == "roofline_sane" and only.status == "skip"
+    block = roofline.perf_lens_block(
+        [roofline.analyze({"cost": {}}, _toy_model(), mode="edge")],
+        _toy_model())
+    (only,) = check_perf_lens(block)
+    assert only.status == "skip"       # analyzed but never measured
+
+
+def test_doctor_passes_honest_fracs():
+    got = _by_name(check_perf_lens(_lens_block(
+        {"node/xla": 0.3, "edge": 0.05, "serve/fabric_l8": 0.001})))
+    assert got["roofline_sane"].status == "pass"
+    assert got["roofline_floor"].status == "pass"
+    assert got["roofline_sane"].evidence["fracs"]["node/xla"] \
+        == pytest.approx(0.3)
+
+
+def test_doctor_fails_frac_above_one():
+    got = _by_name(check_perf_lens(_lens_block(
+        {"node/xla": 1.5, "edge": 0.05})))
+    assert got["roofline_sane"].status == "fail"
+    assert "node/xla" in got["roofline_sane"].summary
+    viol = got["roofline_sane"].evidence["violations"]
+    assert len(viol) == 1 and viol[0]["mode"] == "node/xla"
+
+
+def test_doctor_fails_below_floor_unpinned():
+    # node/xla floor is 2e-3; 1e-5 with no pinned discrepancy = FAIL
+    got = _by_name(check_perf_lens(_lens_block({"node/xla": 1e-5})))
+    assert got["roofline_sane"].status == "pass"
+    assert got["roofline_floor"].status == "fail"
+    assert "no pinned discrepancy" in got["roofline_floor"].summary
+
+
+def test_doctor_reports_known_discrepancy_instead_of_failing():
+    got = _by_name(check_perf_lens(_lens_block(
+        {"node/banded_fused@s2": 1e-5, "node/xla": 0.3})))
+    assert got["roofline_floor"].status == "pass"
+    assert "banded_sharded_recompute" in got["roofline_floor"].summary
+    known = got["roofline_floor"].evidence["known"]
+    assert len(known) == 1 \
+        and known[0]["mode"] == "node/banded_fused@s2"
+    assert got["roofline_floor"].evidence["below_floor"] == []
+
+
+def test_diagnose_manifest_dispatches_perf_lens():
+    bad = {"perf_lens": _lens_block({"node/xla": 2.0})}
+    names = {c.name: c.status for c in diagnose_manifest(bad)}
+    assert names.get("roofline_sane") == "fail"
+    ok = {"perf_lens": _lens_block({"node/xla": 0.3})}
+    names = {c.name: c.status for c in diagnose_manifest(ok)}
+    assert names.get("roofline_sane") == "pass"
+    assert names.get("roofline_floor") == "pass"
+
+
+def test_export_metrics_prometheus_gauges():
+    reg = MetricsRegistry()
+    roofline.export_metrics(reg, _lens_block({"node/xla@s2": 0.25}))
+    assert reg.gauge("roofline_frac_node_xla_s2") == pytest.approx(0.25)
+    text = reg.to_prometheus()
+    assert "fu_roofline_frac_node_xla_s2 0.25" in text
+    assert "fu_roofline_ceiling_rps_node_xla_s2" in text
+
+
+def test_banked_roofline_keys_belong_to_a_flowlint_family(tmp_path,
+                                                          monkeypatch):
+    import bench
+    from flow_updating_tpu.analysis.flowlint import _KEY_FAMILY_RES
+
+    for key in ("roofline_16", "roofline_qps_er2048_l256",
+                "roofline_4_pairwise"):
+        assert any(r.fullmatch(key) for r in _KEY_FAMILY_RES), key
+    # and the bench writer path accepts the alpha-leading key verbatim
+    path = str(tmp_path / "baseline.json")
+    monkeypatch.setattr(bench, "MEASURED_PATH", path)
+    topo = ring(16, k=2, seed=0)
+    bench.record_baseline("roofline_16", bench.baseline_entry(topo, {
+        "rounds_per_sec": 0.0123, "ticks": 64, "repeats": 1,
+        "spread_pct": 0.0, "note": "frac, higher is better"}))
+    data = json.load(open(path))
+    assert set(data) == {"roofline_16"}
+    assert bench.recorded_baseline("roofline_16") \
+        == pytest.approx(0.0123)
+
+
+# ---- the rows: engine profile / autotune / serve -------------------------
+
+def test_engine_profile_attaches_roofline(fast_calibration):
+    e = Engine(config=RoundConfig.fast(kernel="node", dtype="float64")) \
+        .set_topology(ring(32, k=2, seed=0)).build()
+    plain = e.profile(6)
+    assert "roofline" not in plain
+    rec = e.profile(6, roofline=True)
+    rl = rec["roofline"]
+    assert rl["mode"].startswith("node")
+    assert rl["model"] == "cpu-proxy"
+    assert rl["model_source"] == "measured"
+    assert isinstance(rl["roofline_frac"], float)
+    assert 0.0 < rl["roofline_frac"] <= 1.0
+    assert rl["binding"] in ("hbm", "compute", "wire")
+    # still a pure observer: state never advanced
+    assert int(np.asarray(e.state.t).ravel()[0]) == 0
+    json.dumps(rec)
+
+
+@pytest.fixture()
+def tune_cache(tmp_path, monkeypatch):
+    from flow_updating_tpu.plan import select as plan_select
+
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv(plan_select.AUTOTUNE_CACHE_ENV, path)
+    monkeypatch.setattr(plan_select, "PROBE_ROUNDS", 4)
+    plan_select.PROBE_COUNT = 0
+    monkeypatch.setitem(plan_select.AUTOTUNE_CACHE_STATS, "hits", 0)
+    monkeypatch.setitem(plan_select.AUTOTUNE_CACHE_STATS, "misses", 0)
+    return path
+
+
+def test_autotune_roofline_annotation_and_cache_counters(
+        tune_cache, fast_calibration, monkeypatch):
+    from flow_updating_tpu.plan import select as plan_select
+    from flow_updating_tpu.plan import select_plan
+
+    monkeypatch.setenv(roofline.ROOFLINE_ENV, "1")
+    topo = community(400, 4, seed=0)
+    cfg = RoundConfig.fast(kernel="node")
+    d1 = select_plan(topo, cfg, autotune=True, remainder="gather")
+    assert plan_select.AUTOTUNE_CACHE_STATS == {"hits": 0, "misses": 1}
+    assert d1.fused["cache"] == "miss"
+    # the env-gated annotation landed: a per-family frac dict plus the
+    # full perf-lens block, with zero extra probes charged
+    assert "roofline_error" not in d1.fused
+    fracs = d1.fused["roofline_frac"]
+    assert fracs and all(k.startswith("node/") for k in fracs)
+    assert all(0.0 < v <= 1.0 for v in fracs.values())
+    assert d1.fused["roofline"]["schema"] == PERF_LENS_SCHEMA
+    modes = {p["mode"] for p in d1.fused["roofline"]["programs"]}
+    assert all(m.startswith("autotune/node/") for m in modes)
+    # warm cache: a hit returns the SAME annotation without re-lowering
+    probes_before = plan_select.PROBE_COUNT
+    d2 = select_plan(topo, cfg, autotune=True, remainder="gather")
+    assert plan_select.AUTOTUNE_CACHE_STATS == {"hits": 1, "misses": 1}
+    assert d2.fused["cache"] == "hit"
+    assert d2.fused["probes_run"] == 0
+    assert plan_select.PROBE_COUNT == probes_before
+    assert d2.fused["roofline_frac"] == fracs
+    # the Prometheus face: counters + per-family rates and fracs
+    reg = MetricsRegistry()
+    plan_select.autotune_metrics(reg, d2.fused)
+    assert reg.counter("autotune_cache_hits_total") == 1
+    assert reg.counter("autotune_cache_misses_total") == 1
+    assert reg.counter("autotune_probes_total") == probes_before
+    text = reg.to_prometheus()
+    assert "fu_autotune_cache_hits_total 1" in text
+    slug = sorted(fracs)[0].replace("/", "_")
+    assert f"fu_autotune_roofline_frac_{slug} " in text
+    assert f"fu_autotune_rate_{slug} " in text
+
+
+def test_autotune_roofline_off_by_default(tune_cache, monkeypatch):
+    from flow_updating_tpu.plan import select_plan
+
+    monkeypatch.delenv(roofline.ROOFLINE_ENV, raising=False)
+    d = select_plan(community(400, 4, seed=0),
+                    RoundConfig.fast(kernel="node"),
+                    autotune=True, remainder="gather")
+    assert d.fused["cache"] == "miss"
+    assert "roofline" not in d.fused
+    assert "roofline_frac" not in d.fused
+
+
+def test_serve_row_reconciles_the_fabric_segment(fast_calibration):
+    import bench
+
+    topo = erdos_renyi(64, avg_degree=4.0, seed=0)
+    out = bench.measure_query_serve(topo, lanes=4, segment_rounds=4,
+                                    rate=1.0, eps=1e-2, windows=1,
+                                    window_segments=2, roofline=True)
+    assert out["fabric_rounds_per_sec"] > 0
+    assert out["roofline"]["schema"] == PERF_LENS_SCHEMA
+    (prog,) = out["roofline"]["programs"]
+    assert prog["mode"] == "serve/fabric_l4"
+    # the banked row is rounded to 3dp; the program carries full precision
+    assert prog["measured_rounds_per_sec"] \
+        == pytest.approx(out["fabric_rounds_per_sec"], rel=1e-4)
+    assert isinstance(out["roofline_frac"], float)
+    assert 0.0 < out["roofline_frac"] <= 1.0
+
+
+# ---- lens off = byte-identical lowering, bit-exact state -----------------
+
+def test_lens_off_is_byte_identical_and_bit_exact(monkeypatch,
+                                                  fast_calibration):
+    from flow_updating_tpu.analysis import golden
+
+    topo = ring(24, k=2, seed=0)
+    cfg = RoundConfig.fast(dtype="float64")
+    arrays = topo.device_arrays()
+    state = init_state(topo, cfg)
+    monkeypatch.delenv(roofline.ROOFLINE_ENV, raising=False)
+    text_off = golden.canonical_program(run_rounds, state, arrays,
+                                        cfg, 12)
+    monkeypatch.setenv(roofline.ROOFLINE_ENV, "1")
+    text_on = golden.canonical_program(run_rounds, state, arrays,
+                                       cfg, 12)
+    assert text_off == text_on
+
+    # an interleaved roofline profile changes nothing about evolution
+    e1 = Engine(config=cfg).set_topology(topo).build()
+    e1.profile(12, roofline=True)
+    text_after = golden.canonical_program(run_rounds, state, arrays,
+                                          cfg, 12)
+    assert text_after == text_off
+    e1.run_rounds(30)
+    e2 = Engine(config=cfg).set_topology(topo).build()
+    e2.run_rounds(30)
+    np.testing.assert_array_equal(np.asarray(e1.state.flow),
+                                  np.asarray(e2.state.flow))
+    np.testing.assert_array_equal(np.asarray(e1.state.value),
+                                  np.asarray(e2.state.value))
+
+
+# ---- measured timelines --------------------------------------------------
+
+def test_interval_union_and_overlap_math():
+    assert timeline._union([(5, 15), (0, 10), (20, 30)]) \
+        == [(0, 15), (20, 30)]
+    assert timeline._union([]) == []
+    assert timeline._overlap_with((8, 25), [(0, 15), (20, 30)]) \
+        == pytest.approx(12.0)          # 8..15 plus 20..25
+    assert timeline._overlap_with((16, 19), [(0, 15), (20, 30)]) == 0.0
+
+
+def _write_trace(tmp_path, events: list) -> str:
+    """A synthetic profiler capture in the directory layout
+    jax.profiler actually writes."""
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / "host.trace.json.gz"
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return str(tmp_path)
+
+
+def _meta(pid, tid, name):
+    return {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": name}}
+
+
+def _op(name, ts, dur, *, pid=1, tid=1, module="jit_run"):
+    return {"ph": "X", "name": name, "ts": ts, "dur": dur,
+            "pid": pid, "tid": tid,
+            "args": {"hlo_op": name, "hlo_module": module}}
+
+
+def test_measured_overlap_same_lane_semantics(tmp_path):
+    log_dir = _write_trace(tmp_path, [
+        _meta(1, 1, "tf_XLATfrtCpuClient/0"),
+        _meta(1, 2, "tf_XLATfrtCpuClient/1"),
+        # lane (1,1): wire 0..10, same-lane compute 5..15 -> 5 of 10
+        _op("collective-permute.1", 0, 10, tid=1),
+        _op("add.2", 5, 10, tid=1),
+        # lane (1,2): compute fully covering the wire span — CROSS-lane,
+        # must NOT count as hiding
+        _op("multiply.3", 0, 20, tid=2),
+        # scaffolding rows are dropped entirely
+        {"ph": "X", "name": "ThunkExecutor::Execute", "ts": 0,
+         "dur": 100, "pid": 1, "tid": 1, "args": {}},
+    ])
+    out = timeline.measured_overlap(log_dir)
+    assert out["wire_ops"] == 1
+    assert out["compute_ops"] == 2
+    assert out["lanes"] == 2
+    assert out["overlap_ratio_measured"] == pytest.approx(0.5)
+    assert out["wire_busy_s"] == pytest.approx(10 / 1e6)
+    # module filter drops everything from other modules
+    filtered = timeline.measured_overlap(log_dir, module="jit_other")
+    assert filtered["device_slices"] == 0
+
+
+def test_measured_overlap_degrades_gracefully(tmp_path):
+    # no capture at all
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert timeline.measured_overlap(str(empty)) is None
+    # a capture with compute but no wire: ratio None plus a note
+    log_dir = _write_trace(tmp_path, [
+        _meta(1, 1, "tf_XLATfrtCpuClient/0"),
+        _op("add.1", 0, 10),
+        _op("multiply.2", 5, 10),
+    ])
+    out = timeline.measured_overlap(log_dir)
+    assert out["wire_ops"] == 0
+    assert out["overlap_ratio_measured"] is None
+    assert "no wire slices" in out["note"]
+    assert out["compute_busy_s"] == pytest.approx(15 / 1e6)
+
+
+def test_annotation_spans_extracts_trace_markers(tmp_path):
+    log_dir = _write_trace(tmp_path, [
+        {"ph": "X", "name": "fu.segment", "ts": 100, "dur": 50,
+         "pid": 9, "tid": 9},
+        {"ph": "X", "name": "fu.segment", "ts": 200, "dur": 60,
+         "pid": 9, "tid": 9},
+        {"ph": "X", "name": "other", "ts": 0, "dur": 5,
+         "pid": 9, "tid": 9},
+    ])
+    events, _ = timeline.load_trace_events(
+        timeline.latest_trace_file(log_dir))
+    spans = timeline.annotation_spans(events, "fu.segment")
+    assert [s["ts_us"] for s in spans] == [100.0, 200.0]
+    assert [s["dur_us"] for s in spans] == [50.0, 60.0]
