@@ -1073,6 +1073,15 @@ def measure_query_serve(topo, lanes: int, segment_rounds: int,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }
+    fore = block.get("forecast") or {}
+    if fore.get("enabled"):
+        # forecast calibration under real churn: p90 |log ratio| of the
+        # banked first-warm-forecast ETAs vs measured rounds — the
+        # regress-gated forecast_* family banks its inverse so LOWER
+        # miscalibration reads as HIGHER rounds_per_sec (docs/ANALYSIS.md)
+        out["forecast_ratios"] = len(fore.get("ratios") or ())
+        out["forecast_p90_abs_log_ratio"] = fore.get("p90_abs_log_ratio")
+        out["forecast_in_band_frac"] = fore.get("in_band_frac")
     if roofline:
         # opt-in, contained: reconcile the measured fabric rounds/s
         # against the ceiling of the exact segment program the fabric
@@ -1459,6 +1468,23 @@ def run_serve_bench(args) -> dict:
                     "note": (f"inverted p95 {slo} latency "
                              f"(1/(1+rounds)) of the query fabric's "
                              f"serve row; not a DES measurement"),
+                }))
+        p90 = sv.get("forecast_p90_abs_log_ratio")
+        if p90 is not None:
+            # forecast-calibration row (disjoint forecast_* family):
+            # inverted p90 |log forecast_ratio| so better-calibrated
+            # ETAs read as higher rounds_per_sec under the shared
+            # regression comparator (perfect calibration -> 1.0)
+            record_baseline(
+                f"forecast_er{slug}_l{lanes}",
+                baseline_entry(topo, {
+                    "rounds_per_sec": 1.0 / (1.0 + float(p90)),
+                    "ticks": sv["forecast_ratios"],
+                    "repeats": sv["windows"],
+                    "spread_pct": sv["spread_pct"],
+                    "note": ("inverted p90 |log forecast_ratio| "
+                             "(1/(1+x)) of the lane forecaster under "
+                             "serve churn; not a DES measurement"),
                 }))
     base_rps = recorded_baseline(base_key)
     base_src = "recorded" if base_rps is not None else "measured"

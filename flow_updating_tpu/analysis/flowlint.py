@@ -78,6 +78,7 @@ _KEY_FAMILIES = (
     r"chaos_.+",                    # chaos-harness fault rows
     r"recovery_.+",                 # crash-recovery timing rows
     r"slo_.+",                      # serving-SLO latency rows
+    r"forecast_.+",                 # forecast-calibration rows
     r"roofline_.+",                 # perf-lens measured/ceiling fracs
     r"(er|ba)\d+k?_[a-z_0-9]+",     # named generator configs
 )

@@ -44,6 +44,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import math
 import os
 import sys
 
@@ -955,6 +956,14 @@ def cmd_query(args) -> int:
             kw["timeout"] = args.timeout
         if args.fire_policy == "reference":
             kw["pending_depth"] = 1
+        mix = None
+        if getattr(args, "mixing", False):
+            # a-priori spectral gap for forecast-aware admission (and
+            # the manifest's mixing block) — cached, so repeat serves
+            # of one topology probe once (obs/spectral.py)
+            from flow_updating_tpu.obs.spectral import mixing_report
+
+            mix = mixing_report(topo, eps=args.eps)
         try:
             cfg = maker(**kw)
             fab = QueryFabric(
@@ -965,7 +974,11 @@ def cmd_query(args) -> int:
                 config=cfg, segment_rounds=args.segment_rounds,
                 seed=args.seed, conv_eps=args.eps,
                 admission_slo_rounds=args.admission_slo or None,
-                convergence_slo_rounds=args.convergence_slo or None)
+                convergence_slo_rounds=args.convergence_slo or None,
+                forecast=(False if getattr(args, "no_forecast", False)
+                          else None),
+                admit_policy=getattr(args, "admit_policy", "observe"),
+                mixing=mix)
         except ValueError as err:
             raise SystemExit(f"invalid query configuration: {err}") from err
     if args.watchdog and fab._watchdog is None:
@@ -1017,6 +1030,12 @@ def cmd_query(args) -> int:
         "admission_p95": block["admission_latency"].get("p95"),
         "wall_s": round(wall_s, 3),
     }
+    fb = block.get("forecast")
+    if isinstance(fb, dict) and fb.get("enabled"):
+        out["at_risk"] = fb["at_risk_total"]
+        out["deferred"] = fb["deferred_total"]
+        if fb.get("p90_abs_log_ratio") is not None:
+            out["forecast_p90_abs_log_ratio"] = fb["p90_abs_log_ratio"]
     if args.checkpoint:
         fab.save_checkpoint(args.checkpoint)
     resil = fab.resilience_block()
@@ -1530,6 +1549,15 @@ def cmd_plan(args) -> int:
         from flow_updating_tpu.plan.select import AUTOTUNE_CACHE_STATS
 
         doc["autotune_cache"] = dict(AUTOTUNE_CACHE_STATS)
+    if getattr(args, "mixing", False):
+        # a-priori convergence budget: the diffusion operator's
+        # spectral gap, both provenances, persisted in the autotune
+        # cache (obs/spectral.py; doctor's mixing_sane judges it)
+        from flow_updating_tpu.obs.spectral import mixing_report
+
+        doc["mixing"] = mixing_report(
+            topo, plan=decision.plan
+            if decision.spmv in ("banded", "banded_fused") else None)
     if args.explain:
         lines = [f"# decision: {doc['kernel']}"
                  + (f"/{doc['spmv']}" if doc.get("spmv") else "")
@@ -1551,6 +1579,27 @@ def cmd_plan(args) -> int:
                 lines.append(
                     f"# {mark} offset {int(d):+6d}: {int(c):8d} edges "
                     f"({100.0 * c / max(topo.num_nodes, 1):5.1f}% fill)")
+        mix = doc.get("mixing")
+        if isinstance(mix, dict):
+            pr = mix.get("predicted_rounds")
+            lines.append(
+                f"# mixing: gap {mix['gap']:.4g} ({mix['provenance']}) "
+                f"-> ~{pr:,.0f} rounds to eps={mix['eps']:g}"
+                if pr is not None and math.isfinite(pr)
+                else f"# mixing: gap {mix.get('gap')!r} "
+                     f"({mix.get('provenance')})")
+            st, me = mix.get("structural") or {}, mix.get("measured") or {}
+            if st.get("gap") is not None and me.get("gap") is not None:
+                lines.append(
+                    f"#   structural {st['gap']:.4g} "
+                    f"(|lambda2| {st.get('lambda2', 0):.4g}, "
+                    f"{st.get('iters', '?')} iters) vs measured "
+                    f"{me['gap']:.4g} ({me.get('rounds', '?')} probe "
+                    "rounds)")
+            cache = mix.get("cache") or {}
+            lines.append(
+                f"#   cache {'hit' if cache.get('hit') else 'miss'}"
+                f" ({cache.get('path')})")
         print("\n".join(lines), file=sys.stderr)
     if args.report:
         from flow_updating_tpu.obs.report import (
@@ -2274,6 +2323,23 @@ def build_parser() -> argparse.ArgumentParser:
     qr.add_argument("--convergence-slo", type=int, default=0,
                     help="convergence-latency SLO in rounds (doctor's "
                          "slo_latency p95 target; default: undeclared)")
+    qr.add_argument("--no-forecast", action="store_true",
+                    help="disable the per-lane convergence forecaster "
+                         "(on by default with the flight recorder; the "
+                         "off-fabric lowers byte-identically — "
+                         "docs/OBSERVABILITY.md §10)")
+    qr.add_argument("--admit-policy", default="observe",
+                    choices=("observe", "strict"),
+                    help="forecast-aware admission: 'observe' flags "
+                         "provably-over-SLO queries at_risk but admits "
+                         "them; 'strict' defers them at the door "
+                         "(needs --mixing and --convergence-slo)")
+    qr.add_argument("--mixing", action="store_true",
+                    help="estimate the topology's spectral gap up "
+                         "front (obs/spectral.py, autotune-cached) and "
+                         "price admissions against it — the manifest "
+                         "gains a mixing block doctor's mixing_sane "
+                         "judges")
     qr.add_argument("--fire-policy", default="every_round",
                     choices=("every_round", "reference"),
                     help="collect-all firing rule")
@@ -2522,6 +2588,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print the human-readable decision breakdown "
                          "(band occupancy table, predicted costs) to "
                          "stderr alongside the JSON")
+    pl.add_argument("--mixing", action="store_true",
+                    help="estimate the diffusion operator's spectral "
+                         "gap (power iteration + decay probe riding "
+                         "the selected lowering, autotune-cached) and "
+                         "embed the mixing block: gap, provenance, "
+                         "predicted rounds-to-eps "
+                         "(docs/OBSERVABILITY.md §10)")
     pl.add_argument("--report", metavar="PATH",
                     help="write the flow-updating-plan-report/v1 "
                          "manifest to PATH")

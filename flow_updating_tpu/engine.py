@@ -741,17 +741,30 @@ class Engine:
         self.plan_decision = decision
         logger.info("plan: %s", decision.reason)
 
-    def plan_report(self) -> dict | None:
+    def plan_report(self, mixing: bool = False) -> dict | None:
         """JSON-ready record of the plan decision (None when planning
         was off or fell back) — the ``plan`` block of run and plan
         manifests (``flow-updating-plan-report/v1``).  Vector-payload
         engines additionally carry the payload-schedule ranking (the
         chunked-vs-monolithic payload-bytes term of plan='auto',
         plan/select.select_payload_schedule) so manifests record how
-        the DFL schedules would rank on this topology/backend."""
+        the DFL schedules would rank on this topology/backend.
+
+        ``mixing=True`` additionally estimates the topology's spectral
+        gap (obs/spectral.mixing_report — both provenances, persisted
+        in the autotune cache) and embeds it as the ``mixing`` block,
+        the a-priori convergence budget doctor's ``mixing_sane``
+        judges and forecast-aware admission prices against."""
         if self.plan_decision is None:
             return None
         out = self.plan_decision.describe()
+        if mixing and self.topology is not None:
+            from flow_updating_tpu.obs.spectral import mixing_report
+
+            out["mixing"] = mixing_report(
+                self.topology,
+                plan=self._plan if self.plan_decision.spmv
+                in ("banded", "banded_fused") else None)
         vals = self.topology.values if self.topology is not None else None
         if vals is not None and getattr(vals, "ndim", 1) > 1:
             from flow_updating_tpu.plan.select import (

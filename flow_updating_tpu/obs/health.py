@@ -938,6 +938,26 @@ def _span_chain_gap(chain: list, t_end: int) -> str | None:
     return None
 
 
+def _deferred_chain_gap(chain: list, t_end: int) -> str | None:
+    """Why one DEFERRED query's chain is malformed (None when sound):
+    strict admission turns the query away at the door, so the chain is
+    ``submitted -> deferred`` — it must NOT carry an admission instant
+    or segment spans (a deferral never held a lane), and the submitted
+    span must account the full queue time up to the deferral."""
+    if not chain or chain[0].get("name") != "submitted":
+        return "chain does not open with a submitted span"
+    if any(str(c.get("name", "")).startswith("admitted@lane")
+           for c in chain):
+        return ("deferred chain carries an admission instant (a "
+                "deferral never holds a lane)")
+    if any(c.get("name") == "segment" for c in chain):
+        return "deferred chain carries segment spans"
+    if int(chain[0]["t1"]) != int(t_end):
+        return (f"submitted span ends at {chain[0]['t1']} but the "
+                f"deferral is at {t_end} (queue time unaccounted)")
+    return None
+
+
 def check_serving_trace(trace: dict | None, *,
                         query: dict | None = None,
                         recovery: dict | None = None) -> list:
@@ -1025,14 +1045,19 @@ def check_serving_trace(trace: dict | None, *,
     else:
         chains = spans.get("queries") or {}
         engine_spans = spans.get("engine") or []
-        terminal = ("retired", "quarantined")
+        terminal = ("retired", "quarantined", "deferred")
         bad_chains, n_terminated = [], 0
         for qid, chain in chains.items():
             terms = [c for c in chain if c.get("name") in terminal]
             if not terms:
                 continue          # in-flight/queued: judged when done
             n_terminated += 1
-            gap = _span_chain_gap(chain, int(terms[0]["t0"]))
+            if terms[0].get("name") == "deferred":
+                # the forecast-aware admission terminal: no lane, no
+                # segments — its own gap rules
+                gap = _deferred_chain_gap(chain, int(terms[0]["t0"]))
+            else:
+                gap = _span_chain_gap(chain, int(terms[0]["t0"]))
             if gap is not None:
                 bad_chains.append({"qid": qid, "problem": gap})
         recovery_problem = None
@@ -1114,6 +1139,14 @@ def check_serving_trace(trace: dict | None, *,
             _cmp("queries_quarantined_total",
                  query.get("quarantined_total"),
                  "query.quarantined_total")
+            fore = query.get("forecast") or {}
+            if fore.get("enabled"):
+                _cmp("queries_at_risk_total",
+                     fore.get("at_risk_total"),
+                     "query.forecast.at_risk_total")
+                _cmp("queries_deferred_total",
+                     fore.get("deferred_total"),
+                     "query.forecast.deferred_total")
         wal = (recovery or {}).get("wal") or {}
         if wal.get("last_seq") is not None \
                 and gauges.get("wal_last_seq") is not None:
@@ -1144,6 +1177,225 @@ def check_serving_trace(trace: dict | None, *,
                 "ground truth",
                 {"compared": compared}))
     return checks
+
+
+#: structural-vs-measured gap estimates farther apart than this factor
+#: mean one provenance is lying (mixing_sane; obs/spectral.py — the
+#: measured fit sees the transient, so modest disagreement is expected)
+MIXING_AGREE_FACTOR = 4.0
+
+#: a single forecast_ratio beyond band x this factor fails
+#: forecast_calibrated outright, p90 notwithstanding: the p90 clause
+#: tolerates a 10% tail of noisy fits, but an ETA off by 8x the band
+#: (16x at the default band of 2) is a broken — or forged — banking
+#: path, not fit noise (the smoke test's single-ratio negative control)
+FORECAST_OUTLIER_FACTOR = 8.0
+
+
+def check_forecast(query: dict | None) -> list:
+    """The convergence observatory's reconciliation checks (the
+    ``forecast`` sub-block of a query manifest; docs/OBSERVABILITY.md
+    §10):
+
+    * **forecast_calibrated** — the banked ``forecast_ratio``
+      distribution (first-warm-forecast ETA / measured rounds, one per
+      converged forecasted lane) against the fabric's declared band:
+      p90 of ``|log ratio|`` must be within ``log(band)`` — i.e. 90%
+      of ratios inside ``[1/band, band]`` — and no single ratio may
+      exceed :data:`FORECAST_OUTLIER_FACTOR` x the band.  A forged
+      ``forecast_ratio = 25`` FAILS even in an otherwise-honest
+      population (the negative control of scripts/forecast_smoke.py);
+    * **slo_admission** — forecast-aware admission accounting: the
+      ``at_risk``/``deferred`` counters must agree with the query
+      census, deferrals require the strict policy AND imply at-risk,
+      and under ``admit_policy='strict'`` every at-risk query must
+      actually have been deferred (none slipped onto a lane).
+    """
+    fore = (query or {}).get("forecast")
+    if not isinstance(fore, dict) or not fore.get("enabled"):
+        return [CheckResult(
+            "forecast_calibrated", SKIP,
+            "no forecast block recorded — the convergence forecaster "
+            "was off (construct the fabric with forecast=True, the "
+            "default with the flight recorder on)")]
+    checks = []
+    band = float(fore.get("band", 2.0))
+    ratios = [float(r) for r in fore.get("ratios") or ()
+              if isinstance(r, (int, float)) and math.isfinite(r)
+              and r > 0]
+    if not ratios:
+        checks.append(CheckResult(
+            "forecast_calibrated", SKIP,
+            "no converged lane banked a forecast_ratio (queries "
+            "retired before the fit window warmed — lengthen runs or "
+            "shrink segment_rounds)", {"band": band}))
+    else:
+        logs = sorted(abs(math.log(r)) for r in ratios)
+        p90 = float(np.percentile(np.asarray(logs), 90))
+        in_band = sum(1 for v in logs if v <= math.log(band))
+        worst = max(ratios, key=lambda r: abs(math.log(r)))
+        ev = {"ratios": len(ratios), "band": band,
+              "p90_abs_log_ratio": round(p90, 6),
+              "in_band_frac": round(in_band / len(ratios), 4),
+              "worst_ratio": worst}
+        if p90 > math.log(band):
+            checks.append(CheckResult(
+                "forecast_calibrated", FAIL,
+                f"forecasts MIScalibrated: p90 |log forecast_ratio| "
+                f"{p90:.3f} > log(band {band:g}) — predicted ETAs "
+                f"disagree with measured convergence rounds (worst "
+                f"ratio {worst:.3g})", ev))
+        elif logs[-1] > math.log(band * FORECAST_OUTLIER_FACTOR):
+            # the p90 clause tolerates a noisy tail; an individual
+            # ratio this far out is a broken or forged banking path
+            checks.append(CheckResult(
+                "forecast_calibrated", FAIL,
+                f"forecast_ratio {worst:.3g} is beyond "
+                f"{FORECAST_OUTLIER_FACTOR:g}x the declared band "
+                f"{band:g} — not fit noise; the ETA banking for that "
+                "lane is broken (or the record was forged)", ev))
+        else:
+            checks.append(CheckResult(
+                "forecast_calibrated", PASS,
+                f"forecasts calibrated: p90 |log forecast_ratio| "
+                f"{p90:.3f} <= log(band {band:g}) over {len(ratios)} "
+                f"converged lanes ({in_band}/{len(ratios)} in band)",
+                ev))
+
+    at_risk = int(fore.get("at_risk_total", 0))
+    deferred = int(fore.get("deferred_total", 0))
+    policy = str(fore.get("admit_policy", "observe"))
+    qs = (query or {}).get("queries") or []
+    flagged = sum(1 for q in qs if q.get("at_risk"))
+    deferred_census = sum(1 for q in qs
+                          if q.get("status") == "deferred")
+    at_risk_admitted = sum(1 for q in qs if q.get("at_risk")
+                           and q.get("status") != "deferred")
+    problems = []
+    if qs and flagged != at_risk:
+        problems.append(f"{at_risk} at_risk counted but {flagged} "
+                        "queries carry the flag")
+    if qs and deferred_census != deferred:
+        problems.append(f"{deferred} deferrals counted but "
+                        f"{deferred_census} queries are deferred")
+    if deferred > at_risk:
+        problems.append(f"{deferred} deferrals exceed {at_risk} "
+                        "at-risk flags (only at-risk queries defer)")
+    if policy != "strict" and deferred:
+        problems.append(f"{deferred} deferrals under "
+                        f"admit_policy={policy!r} (only strict defers)")
+    if policy == "strict" and at_risk_admitted:
+        problems.append(f"{at_risk_admitted} at-risk queries were "
+                        "admitted under admit_policy='strict' (all "
+                        "must defer)")
+    ev = {"admit_policy": policy, "at_risk_total": at_risk,
+          "deferred_total": deferred, "flagged": flagged,
+          "deferred_census": deferred_census}
+    slo = ((query or {}).get("convergence_latency") or {}).get(
+        "slo_rounds")
+    if problems:
+        checks.append(CheckResult(
+            "slo_admission", FAIL,
+            f"forecast-aware admission inconsistent: {problems[0]}"
+            + (f" (+{len(problems) - 1} more)"
+               if len(problems) > 1 else ""),
+            {**ev, "problems": problems}))
+    elif slo is None and not at_risk:
+        checks.append(CheckResult(
+            "slo_admission", SKIP,
+            "no convergence SLO declared — admission had nothing to "
+            "price queries against (pass convergence_slo_rounds / "
+            "--convergence-slo)", ev))
+    else:
+        checks.append(CheckResult(
+            "slo_admission", PASS,
+            f"admission accounting consistent under "
+            f"admit_policy={policy!r} ({at_risk} at-risk, {deferred} "
+            "deferred)", ev))
+    return checks
+
+
+def check_mixing(mixing: dict | None) -> list:
+    """Sanity of an a-priori mixing record (obs/spectral.py
+    ``mixing_report``; the ``mixing`` block of plan/query manifests):
+
+    * every reported spectral gap must land in ``(0, 1]`` (the
+      diffusion operator is aperiodic and row-stochastic — anything
+      else is an estimator bug);
+    * the structural (power-iteration) and measured (decay-fit)
+      provenances must agree within :data:`MIXING_AGREE_FACTOR`;
+    * when the record carries a ``control`` block (the scenario pair:
+      ``bridge_bottleneck`` judged against ``expander_relief``), the
+      record's predicted rounds must exceed the control's by the
+      declared ``min_factor`` (default 2.0) — the ROADMAP item-4
+      baseline, asserted, not eyeballed.
+    """
+    if not isinstance(mixing, dict):
+        return [CheckResult("mixing_sane", SKIP,
+                            "no mixing block recorded")]
+    problems = []
+    gaps = {}
+    for name in ("structural", "measured"):
+        rec = mixing.get(name)
+        if isinstance(rec, dict) and rec.get("gap") is not None:
+            g = float(rec["gap"])
+            gaps[name] = g
+            if not (0.0 < g <= 1.0):
+                problems.append(
+                    f"{name} gap {g:g} outside (0, 1] — the diffusion "
+                    "operator is aperiodic row-stochastic; this is an "
+                    "estimator bug, not a slow graph")
+    head = mixing.get("gap")
+    if head is not None and not (0.0 < float(head) <= 1.0):
+        problems.append(f"headline gap {float(head):g} outside (0, 1]")
+    if len(gaps) == 2 and all(g > 0 for g in gaps.values()):
+        factor = max(gaps["structural"] / gaps["measured"],
+                     gaps["measured"] / gaps["structural"])
+        if factor > MIXING_AGREE_FACTOR:
+            problems.append(
+                f"provenances disagree {factor:.1f}x (structural gap "
+                f"{gaps['structural']:.4g} vs measured "
+                f"{gaps['measured']:.4g}; allowed "
+                f"{MIXING_AGREE_FACTOR:g}x)")
+    ctrl = mixing.get("control")
+    ctrl_ev = None
+    if isinstance(ctrl, dict) and ctrl.get("gap") and head:
+        # predicted rounds scale as 1/gap at fixed eps, so the ratio
+        # of gaps IS the predicted slowdown of the record vs control
+        min_factor = float(ctrl.get("min_factor", 2.0))
+        ratio = float(ctrl["gap"]) / float(head)
+        ctrl_ev = {"control": ctrl.get("name"),
+                   "control_gap": float(ctrl["gap"]),
+                   "predicted_slowdown": round(ratio, 3),
+                   "min_factor": min_factor}
+        if ratio < min_factor:
+            problems.append(
+                f"gap predicts only {ratio:.2f}x the "
+                f"{ctrl.get('name', 'control')} rounds (declared "
+                f">= {min_factor:g}x) — the bottleneck's conductance "
+                "penalty is not visible in the estimate")
+    ev = {"gaps": gaps, "headline_gap": head,
+          "provenance": mixing.get("provenance"),
+          "family": mixing.get("family")}
+    if ctrl_ev:
+        ev["control"] = ctrl_ev
+    if problems:
+        return [CheckResult(
+            "mixing_sane", FAIL,
+            f"mixing record unsound: {problems[0]}"
+            + (f" (+{len(problems) - 1} more)"
+               if len(problems) > 1 else ""),
+            {**ev, "problems": problems})]
+    if not gaps and head is None:
+        return [CheckResult("mixing_sane", SKIP,
+                            "mixing block carries no gap estimates")]
+    return [CheckResult(
+        "mixing_sane", PASS,
+        "mixing estimates sound ("
+        + ", ".join(f"{n} gap {g:.4g}" for n, g in sorted(gaps.items()))
+        + (f"; predicts {ctrl_ev['predicted_slowdown']:g}x the "
+           f"{ctrl_ev['control']} rounds" if ctrl_ev else "") + ")",
+        ev)]
 
 
 def check_aggregate_read(aggregates: dict | None, *,
@@ -2070,6 +2322,17 @@ def diagnose_manifest(manifest: dict) -> list:
     query = manifest.get("query")
     if isinstance(query, dict):
         checks.extend(check_query(query, dtype=dtype))
+        if isinstance(query.get("forecast"), dict):
+            checks.extend(check_forecast(query))
+    mixing = manifest.get("mixing")
+    if not isinstance(mixing, dict) and isinstance(plan_block, dict):
+        mixing = plan_block.get("mixing")
+    if not isinstance(mixing, dict) and isinstance(query, dict):
+        fq = query.get("forecast")
+        if isinstance(fq, dict):
+            mixing = fq.get("mixing")
+    if isinstance(mixing, dict):
+        checks.extend(check_mixing(mixing))
     aggregates = manifest.get("aggregates")
     if isinstance(aggregates, dict):
         checks.extend(check_aggregate_read(

@@ -40,7 +40,11 @@ Chain vocabulary (docs/OBSERVABILITY.md §8):
 * ``read`` — instant at the first successful ``read()`` (bounded: one
   per query, re-reads are not re-recorded);
 * ``retired`` / ``quarantined`` — the terminal instant (quarantines
-  carry the watchdog's reason).
+  carry the watchdog's reason);
+* ``deferred`` — the terminal instant of a strict-admission turn-away
+  (forecast-aware admission, docs/OBSERVABILITY.md §10): the query
+  never held a lane, so its chain is ``submitted -> deferred`` with no
+  admission instant and no segments.
 
 Engine-level spans (not tied to one query) live on a separate track:
 ``recovery`` (above) and the watchdog's ``degraded`` backoff episodes
@@ -119,6 +123,18 @@ class SpanRecorder:
     def quarantined(self, qid, t, reason: str | None = None) -> None:
         self.span(qid, "quarantined", t, t,
                   **({"reason": reason} if reason else {}))
+        self._open_seg.pop(str(qid), None)
+
+    def deferred(self, qid, t, **attrs) -> None:
+        """Terminal instant for a strict-admission deferral (the
+        forecast-aware admission path, query/fabric.py): the query
+        never held a lane, so the chain is ``submitted -> deferred`` —
+        no admission instant, no segments.  ``attrs`` carry the ETA
+        evidence (``eta_rounds``, ``slo_rounds``)."""
+        chain = self._chains.get(str(qid))
+        if chain and chain[0]["name"] == "submitted":
+            chain[0]["t1"] = int(t)       # queue time now known
+        self.span(qid, "deferred", t, t, **attrs)
         self._open_seg.pop(str(qid), None)
 
     def read(self, qid, t) -> None:

@@ -1,0 +1,275 @@
+"""A-priori mixing estimation for Flow-Updating's round operator.
+
+The protocol's averaging step applies the diffusion operator
+
+    ``P = diag(1 / (deg + 1)) (I + A)``
+
+(models/sync.py ``_fused_round_step``: ``avg = (...) * inv_depp1``) —
+a row-stochastic matrix whose second eigenvalue ``lambda2`` sets how
+fast the estimate spread contracts, so ``gap = 1 - |lambda2|`` is the
+topology's convergence budget: a lane reaches relative tolerance
+``eps`` in roughly ``ln(1/eps) / gap`` rounds.  The paper's bottleneck
+graphs (scenarios/registry.py ``bridge_bottleneck``) converge ~5x
+slower than their expander controls precisely because their gap is
+~5x smaller — this module makes that number observable BEFORE a run.
+
+Two provenances, the predict/measure shape the perf lens (PR 18)
+established for throughput:
+
+* **structural** — deflated power iteration for ``|lambda2|``, riding
+  the EXISTING spmv lowerings as the matvec (``plan/banded.
+  banded_neighbor_sum`` when an :class:`ExecutionPlan` is given — the
+  probe then measures the operator the plan actually runs — or the
+  edge-rows scatter-add otherwise).  Deterministic: the start vector
+  comes from a seeded host RNG, never wall-clock entropy.
+* **measured** — a short probe run of the diffusion itself from a
+  seeded random value vector, fitting the log-spread slope
+  (obs/forecast.py ``fit_log_decay`` — the same fit the online lane
+  forecaster uses, so the two provenances disagree only when the
+  model does, not the estimator).
+
+Both are persisted in the PR-15 autotune cache (plan/select.py: same
+file, same atomic writer, ``FLOW_UPDATING_AUTOTUNE_CACHE`` honored)
+keyed by plan content hash — version-gated ``mixing-v1`` keys, so a
+stale record re-probes instead of silently steering.
+
+Math notes: ``P`` has right eigenvector ``1`` (row-stochastic) and
+left stationary vector ``pi = (deg+1) / sum(deg+1)``; power iteration
+deflates the stationary component by subtracting ``(pi . x) 1`` each
+step.  The ``I`` term makes ``P`` aperiodic, so ``|lambda2| < 1`` on
+any connected graph and the gap lands in ``(0, 1]``.  Closed forms
+pinned by tests/test_forecast.py: cycle ``C_n`` has ``lambda2 = (1 +
+2 cos(2 pi / n)) / 3``; the complete graph ``K_n`` has ``lambda2 = 0``
+(gap exactly 1).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from flow_updating_tpu.obs.forecast import fit_log_decay
+
+#: bump to invalidate every persisted mixing record (estimator change)
+MIXING_VERSION = "mixing-v1"
+
+#: persisted-record traffic since import — the observable twin of the
+#: probe-cost contract (a hit must recompute NOTHING); mirrors
+#: plan/select.AUTOTUNE_CACHE_STATS
+MIXING_CACHE_STATS = {"hits": 0, "misses": 0}
+
+DEFAULT_POWER_ITERS = 128
+DEFAULT_DECAY_ROUNDS = 64
+
+#: successive |lambda2| estimates within this stop the power iteration
+#: early (the Rayleigh sequence has converged)
+_POWER_TOL = 1e-9
+
+
+def predicted_rounds_to_eps(gap: float, eps: float) -> float:
+    """``ln(1/eps) / gap`` — the a-priori rounds-to-tolerance estimate
+    (inf on a non-positive gap; 0 when eps >= 1)."""
+    if not (gap > 0.0):
+        return float("inf")
+    return max(0.0, math.log(1.0 / float(eps))) / float(gap)
+
+
+def _diffusion_operator(topo, plan=None):
+    """``(step, n, family)``: one application of ``P`` in the lowering
+    family the caller runs — banded rolls + remainder when a compiled
+    plan is given (plan-order vectors), edge-rows scatter-add
+    otherwise.  ``step`` maps a device vector to a device vector."""
+    import jax.numpy as jnp
+
+    if plan is not None:
+        from flow_updating_tpu.plan.compile import _topo_key
+
+        if plan.source_key and plan.source_key != _topo_key(topo):
+            raise ValueError(
+                "mixing probe: the plan was compiled from a different "
+                "topology (source_key mismatch) — its banded masks "
+                "would compute a different operator's gap")
+        from flow_updating_tpu.plan.banded import banded_neighbor_sum
+
+        t = plan.topo               # RCM order — P's spectrum is
+        n = t.num_nodes             # permutation-invariant
+        deg = np.bincount(np.asarray(t.src), minlength=n)
+        inv = jnp.asarray(1.0 / (deg + 1.0))
+
+        def step(x):
+            return (x + banded_neighbor_sum(x, plan.spmv,
+                                            plan.leaves)) * inv
+
+        return step, n, "banded"
+    n = topo.num_nodes
+    src = jnp.asarray(np.asarray(topo.src))
+    dst = jnp.asarray(np.asarray(topo.dst))
+    deg = np.bincount(np.asarray(topo.src), minlength=n)
+    inv = jnp.asarray(1.0 / (deg + 1.0))
+
+    def step(x):
+        return (x + jnp.zeros_like(x).at[dst].add(x[src])) * inv
+
+    return step, n, "edge"
+
+
+def estimate_gap_structural(topo, *, plan=None,
+                            iters: int = DEFAULT_POWER_ITERS,
+                            seed: int = 0) -> dict:
+    """Deflated power iteration for ``|lambda2|`` of the diffusion
+    operator — the structural provenance."""
+    import jax.numpy as jnp
+
+    step, n, family = _diffusion_operator(topo, plan)
+    if n < 2:
+        return {"provenance": "structural", "family": family,
+                "lambda2": 0.0, "gap": 1.0, "iters": 0,
+                "seed": int(seed)}
+    deg = (np.bincount(np.asarray((plan.topo if plan is not None
+                                   else topo).src), minlength=n))
+    pi = jnp.asarray((deg + 1.0) / float(np.sum(deg + 1.0)))
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(n))
+    x = x - jnp.sum(pi * x)                 # deflate the stationary mode
+    x = x / jnp.linalg.norm(x)
+    lam = prev = 0.0
+    used = 0
+    for used in range(1, int(iters) + 1):
+        y = step(x)
+        y = y - jnp.sum(pi * y)             # re-deflate (roundoff drift)
+        norm = float(jnp.linalg.norm(y))
+        if norm <= 0.0 or not math.isfinite(norm):
+            lam = 0.0
+            break
+        lam = norm                          # ||P x|| / ||x||, ||x|| = 1
+        x = y / norm
+        if used > 8 and abs(lam - prev) < _POWER_TOL:
+            break
+        prev = lam
+    lam = min(max(float(lam), 0.0), 1.0)
+    return {
+        "provenance": "structural",
+        "family": family,
+        "lambda2": lam,
+        "gap": 1.0 - lam,
+        "iters": int(used),
+        "seed": int(seed),
+    }
+
+
+def estimate_gap_measured(topo, *, plan=None,
+                          rounds: int = DEFAULT_DECAY_ROUNDS,
+                          seed: int = 0) -> dict:
+    """Short probe run of the diffusion from a seeded random value
+    vector, fitting the log-spread slope — the measured provenance
+    (``rate = exp(slope)``, ``gap = 1 - rate``)."""
+    import jax.numpy as jnp
+
+    step, n, family = _diffusion_operator(topo, plan)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.random(n))
+    # stop fitting at the dtype's roundoff floor: past it the spread
+    # hovers on accumulation noise and a flat tail would wreck the
+    # slope (float32 runs hit it after ~15 decades less than float64)
+    floor = 100.0 * float(np.finfo(np.asarray(x).dtype).eps)
+    ts, spreads = [], []
+    for t in range(1, int(rounds) + 1):
+        x = step(x)
+        spread = float(jnp.max(x) - jnp.min(x))
+        if not math.isfinite(spread) or spread <= floor:
+            break
+        ts.append(t)
+        spreads.append(spread)
+    fit = fit_log_decay(ts, spreads)
+    if fit is None:
+        # converged inside one step (complete-graph-like): the decay is
+        # too fast to fit — report the open gap the data witnessed
+        return {"provenance": "measured", "family": family,
+                "rate": 0.0, "gap": 1.0, "rounds": len(ts),
+                "seed": int(seed), "fit": None}
+    rate = min(max(math.exp(fit["slope"]), 0.0), 1.0)
+    return {
+        "provenance": "measured",
+        "family": family,
+        "rate": rate,
+        "gap": 1.0 - rate,
+        "rounds": len(ts),
+        "seed": int(seed),
+        "fit": {k: float(v) for k, v in fit.items()},
+    }
+
+
+def _mixing_key(topo, family: str, *, power_iters: int,
+                decay_rounds: int, seed: int) -> str:
+    """Cache key: version x plan content hash x backend x jax version x
+    x64 x the probe configuration — any mismatch is a STALE entry that
+    re-probes (the autotune-cache discipline, plan/select.py)."""
+    import jax
+
+    from flow_updating_tpu.plan.compile import _topo_key
+
+    tk = _topo_key(topo)
+    x64 = bool(jax.config.read("jax_enable_x64"))
+    return (f"{MIXING_VERSION}|{jax.default_backend()}|"
+            f"jax{jax.__version__}|x64:{int(x64)}|"
+            f"n{tk[0]}e{tk[1]}|{tk[2][:16]}|fam{family}|"
+            f"pi{int(power_iters)}|dr{int(decay_rounds)}|s{int(seed)}")
+
+
+def mixing_report(topo, *, plan=None, eps: float = 1e-6,
+                  power_iters: int = DEFAULT_POWER_ITERS,
+                  decay_rounds: int = DEFAULT_DECAY_ROUNDS,
+                  seed: int = 0, cache_path: str | None = None,
+                  refresh: bool = False) -> dict:
+    """The ``mixing`` block of plan/query manifests: both provenances,
+    a headline gap, and the predicted rounds-to-``eps`` — persisted in
+    the PR-15 autotune cache keyed by plan content hash.
+
+    The headline ``gap`` prefers the measured provenance when its fit
+    produced an in-range gap (it sees the transient the structural
+    eigenvalue cannot), falling back to structural.  ``refresh=True``
+    forces a re-probe; a version or configuration mismatch re-probes
+    implicitly (stale keys never steer).
+    """
+    from flow_updating_tpu.plan.select import (
+        _load_autotune_cache,
+        _store_autotune_entry,
+        autotune_cache_path,
+    )
+
+    family = "banded" if plan is not None else "edge"
+    path = cache_path or autotune_cache_path()
+    key = _mixing_key(topo, family, power_iters=power_iters,
+                      decay_rounds=decay_rounds, seed=seed)
+    entry = _load_autotune_cache(path).get(key)
+    hit = (isinstance(entry, dict)
+           and entry.get("version") == MIXING_VERSION
+           and not refresh)
+    if hit:
+        MIXING_CACHE_STATS["hits"] += 1
+    else:
+        MIXING_CACHE_STATS["misses"] += 1
+        entry = {
+            "version": MIXING_VERSION,
+            "structural": estimate_gap_structural(
+                topo, plan=plan, iters=power_iters, seed=seed),
+            "measured": estimate_gap_measured(
+                topo, plan=plan, rounds=decay_rounds, seed=seed),
+        }
+        _store_autotune_entry(path, key, entry)
+    st, me = entry["structural"], entry["measured"]
+    if me.get("fit") is not None and 0.0 < float(me["gap"]) <= 1.0:
+        gap, provenance = float(me["gap"]), "measured"
+    else:
+        gap, provenance = float(st["gap"]), "structural"
+    return {
+        "gap": gap,
+        "provenance": provenance,
+        "eps": float(eps),
+        "predicted_rounds": predicted_rounds_to_eps(gap, eps),
+        "family": family,
+        "structural": dict(st),
+        "measured": dict(me),
+        "cache": {"path": path, "key": key, "hit": bool(hit)},
+    }

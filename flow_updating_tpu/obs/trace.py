@@ -200,11 +200,16 @@ def _chain_parts(chain: list) -> dict:
             out["t_admit"] = int(rec["t0"])
         elif name == "segment":
             out["segments"].append((int(rec["t0"]), int(rec["t1"])))
-        elif name in ("retired", "quarantined"):
+        elif name in ("retired", "quarantined", "deferred"):
             out["terminal"] = name
             out["t_end"] = int(rec["t0"])
             if name == "quarantined" and rec.get("reason"):
                 out["attrs"]["reason"] = rec["reason"]
+            if name == "deferred":
+                # strict-admission turn-away: the ETA evidence rides
+                # the terminal span (spans.deferred attrs)
+                out["attrs"].update({k: v for k, v in rec.items()
+                                     if k not in ("name", "t0", "t1")})
         elif name in ("converged", "read"):
             out["instants"].append((name, int(rec["t0"])))
     if out["t_end"] is None:       # still active: close at last segment
@@ -262,9 +267,29 @@ def serving_manifest_to_chrome_trace(manifest: dict) -> dict:
                 "ts": p["t_submit"] * _US,
                 "dur": (p["t_admit"] - p["t_submit"]) * _US,
             })
+        if p["terminal"] == "deferred":
+            # never held a lane: the forecast-aware turn-away renders
+            # on the queue track with its ETA-vs-SLO evidence
+            events.append({
+                "ph": "i", "name": f"{name} deferred", "cat": "queue",
+                "pid": PID_SIM, "tid": queue_tid,
+                "ts": p["t_end"] * _US, "s": "p",
+                "args": dict(p["attrs"]),
+            })
+            continue
         if p["lane"] is None:
             continue               # never admitted: queue slice only
         tid = lanes.tid(f"lane {p['lane']}")
+        if p["attrs"].get("at_risk") and p["t_admit"] is not None:
+            # admitted over-SLO (observe policy): flag the admission
+            # instant so the at-risk population pops in Perfetto
+            events.append({
+                "ph": "i", "name": f"{name} at_risk", "cat": "query",
+                "pid": PID_SIM, "tid": tid,
+                "ts": p["t_admit"] * _US, "s": "p",
+                "args": {"eta_admission":
+                         p["attrs"].get("eta_admission")},
+            })
         events.append({
             "ph": "X", "name": name, "cat": "query", "pid": PID_SIM,
             "tid": tid, "ts": p["t_admit"] * _US,

@@ -67,10 +67,12 @@ the denominators shrink with it.
 from __future__ import annotations
 
 import heapq
+import math
 
 import numpy as np
 
 from flow_updating_tpu.models.config import COLLECTALL, RoundConfig
+from flow_updating_tpu.obs.forecast import FORECAST_BAND, LaneForecaster
 from flow_updating_tpu.obs.metrics import MetricsRegistry
 from flow_updating_tpu.obs.spans import SpanRecorder
 from flow_updating_tpu.service import ServiceEngine
@@ -149,11 +151,20 @@ class QueryFabric:
                  admission_slo_rounds: int | None = None,
                  probe_manifest: bool = False,
                  convergence_slo_rounds: int | None = None,
-                 observe: bool = True):
+                 observe: bool = True,
+                 forecast: bool | None = None,
+                 admit_policy: str = "observe",
+                 mixing: dict | None = None,
+                 forecast_window: int = 8):
         if lanes < 1:
             raise ValueError(f"lanes={lanes} must be >= 1")
         if conv_eps <= 0:
             raise ValueError(f"conv_eps={conv_eps} must be > 0")
+        if admit_policy not in ("observe", "strict"):
+            raise ValueError(
+                f"admit_policy={admit_policy!r} must be 'observe' "
+                "(flag at-risk queries and admit them anyway) or "
+                "'strict' (defer them)")
         cfg = config or RoundConfig.fast(variant=COLLECTALL)
         cap = topo.num_nodes if capacity is None else int(capacity)
         self.svc = ServiceEngine(
@@ -200,6 +211,22 @@ class QueryFabric:
         # tests pin the lowered program and state evolution identical)
         self.metrics = MetricsRegistry() if observe else None
         self.spans = SpanRecorder() if observe else None
+        # the convergence observatory (obs/forecast.py, obs/spectral.py):
+        # host-side ETA forecasting over the SAME lane-probe vectors the
+        # boundary already reduces — zero device work, zero new
+        # compiles, and with the forecaster off the fabric lowers
+        # byte-identically and evolves bit-exactly (the observer-purity
+        # contract; tests/test_forecast.py).  Default: on with the
+        # flight recorder.
+        self.admit_policy = admit_policy
+        self._forecaster = (LaneForecaster(window=forecast_window)
+                            if (observe if forecast is None
+                                else bool(forecast)) else None)
+        self._mixing = dict(mixing) if mixing else None
+        self._lane_eta: dict = {}         # lane -> latest forecast
+        self._forecast_ratios: list = []  # eta_predicted/rounds_actual
+        self.at_risk_total = 0
+        self.deferred_total = 0
         self._conv_latencies: list = []   # admit->retire rounds
         self._degraded_spanned = 0        # closed episodes span-recorded
         self._watchdog = None
@@ -279,6 +306,28 @@ class QueryFabric:
             doc["watchdog"] = config.to_jsonable()
             _write_config(self._resil_dir, doc)
         return self
+
+    def attach_mixing(self, record: dict | None) -> QueryFabric:
+        """Attach an a-priori mixing record (obs/spectral.py
+        ``mixing_report``): its spectral gap prices admissions BEFORE a
+        lane has probe history — a query whose predicted rounds-to-eps
+        (``ln(1/eps)/gap``) provably exceeds the declared convergence
+        SLO is flagged ``at_risk`` at admission (and deferred under
+        ``admit_policy='strict'``)."""
+        self._mixing = dict(record) if record else None
+        return self
+
+    def _admission_eta(self, q: dict) -> float | None:
+        """The a-priori rounds-to-eps estimate for one query at
+        admission time (None without forecasting + a mixing record —
+        admission control only acts on *provable* misses)."""
+        if self._forecaster is None or self._mixing is None:
+            return None
+        gap = self._mixing.get("gap")
+        if not isinstance(gap, (int, float)) or not gap > 0:
+            return None
+        return (max(0.0, math.log(1.0 / q["eps"]))
+                / float(gap)) if q["eps"] < 1.0 else 0.0
 
     def state_digest(self) -> str:
         """sha256 over the service digest + the lane tables — the
@@ -507,17 +556,48 @@ class QueryFabric:
     def _admit_free(self) -> int:
         """Bind waiting queries to free lanes — one batched column write
         of unchanged shape/dtype (never a retrace).  Runs at submit time
-        and at every segment boundary (after retirements)."""
+        and at every segment boundary (after retirements).
+
+        Forecast-aware admission (docs/OBSERVABILITY.md §10): with an
+        attached mixing record, a query whose a-priori ETA exceeds the
+        declared convergence SLO is flagged ``at_risk`` (span-annotated
+        + counted) and, under ``admit_policy='strict'``, DEFERRED — a
+        terminal state that never holds a lane, so the chain checks
+        extend to it (submitted -> deferred)."""
         import jax.numpy as jnp
 
         if not self._queue or not self._free_lanes:
             return 0
+        slo = self.convergence_slo_rounds
         n_cap = self.svc._n_cap
         lanes, cols = [], []
         while self._queue and self._free_lanes:
             qid = self._queue.pop(0)
-            lane = heapq.heappop(self._free_lanes)
             q = self._queries[qid]
+            eta0 = self._admission_eta(q)
+            if slo is not None and eta0 is not None and eta0 > slo:
+                q["at_risk"] = True
+                q["eta_admission"] = round(float(eta0), 3)
+                self.at_risk_total += 1
+                if self.metrics is not None:
+                    self.metrics.inc("queries_at_risk_total")
+                if self.spans is not None:
+                    self.spans.annotate(
+                        qid, at_risk=True,
+                        eta_admission=round(float(eta0), 3))
+                if self.admit_policy == "strict":
+                    q.update(status="deferred", done_round=self.clock)
+                    q["_values"] = None
+                    self.deferred_total += 1
+                    if self.spans is not None:
+                        self.spans.deferred(
+                            qid, self.clock,
+                            eta_rounds=round(float(eta0), 3),
+                            slo_rounds=int(slo))
+                    if self.metrics is not None:
+                        self.metrics.inc("queries_deferred_total")
+                    continue
+            lane = heapq.heappop(self._free_lanes)
             cohort = np.asarray(q["cohort"], np.int64)
             cols.append(masked_values(q["_values"], n_cap, cohort))
             q.update(status="active", lane=lane,
@@ -531,6 +611,8 @@ class QueryFabric:
                 self.metrics.observe("admission_latency_rounds",
                                      self.clock - q["submit_round"])
             lanes.append(lane)
+        if not lanes:
+            return 0          # every candidate deferred: no device work
         st = self.svc.state
         li = jnp.asarray(np.asarray(lanes, np.int32))
         self.svc.state = st.replace(
@@ -580,8 +662,12 @@ class QueryFabric:
             q = self._queries[qid]
             q.update(status="quarantined", done_round=self.clock,
                      result=None)
+            q.pop("_forecast_total", None)
             self._lane_q[lane] = None
             heapq.heappush(self._free_lanes, lane)
+            if self._forecaster is not None:
+                self._forecaster.clear(lane)
+                self._lane_eta.pop(lane, None)
             if self.spans is not None:
                 self.spans.quarantined(qid, self.clock, reason=reason)
         self.quarantined_total += len(items)
@@ -667,6 +753,28 @@ class QueryFabric:
                   if self._lane_q[ln] is not None]
         free = [ln for ln in range(self.lanes)
                 if self._lane_q[ln] is None]
+        if self._forecaster is not None:
+            # feed every active lane's trailing window off THIS probe
+            # (zero extra device work) and refresh its ETA — the first
+            # warm forecast banks the query's predicted total, the
+            # reconciliation input of doctor's forecast_calibrated
+            for ln in active:
+                q = self._queries[self._lane_q[ln]]
+                self._forecaster.observe(
+                    ln, self.clock,
+                    spread=float(mx[ln] - mn[ln]),
+                    scale=max(1.0, abs(float(mx[ln])),
+                              abs(float(mn[ln]))),
+                    resid=float(resid[ln]),
+                    mass=float(probe["sum"][ln]))
+                fc = self._forecaster.forecast(ln, q["eps"],
+                                               now=self.clock)
+                self._lane_eta[ln] = fc
+                if fc["status"] == "ok" \
+                        and q.get("_forecast_total") is None:
+                    q["_forecast_total"] = (
+                        (self.clock - q["admit_round"])
+                        + fc["eta_rounds"])
         # retire converged lanes (admitted lanes are only probed after
         # their first full segment: admission runs AFTER this step)
         done = []
@@ -681,6 +789,20 @@ class QueryFabric:
                 q.update(status="done", done_round=self.clock, result=r)
                 done.append(ln)
                 self._conv_latencies.append(int(r["rounds"]))
+                if self._forecaster is not None:
+                    pred = q.pop("_forecast_total", None)
+                    if pred is not None and r["rounds"] > 0:
+                        ratio = float(pred) / float(r["rounds"])
+                        self._forecast_ratios.append(ratio)
+                        q["forecast_ratio"] = round(ratio, 6)
+                        if self.metrics is not None:
+                            self.metrics.observe(
+                                "forecast_abs_log_ratio",
+                                abs(math.log(max(ratio, 1e-12))))
+                    if self.metrics is not None:
+                        self.metrics.observe(
+                            f"lane{ln}_convergence_rounds",
+                            r["rounds"])
                 if self.spans is not None:
                     self.spans.converged(q["qid"], self.clock)
                     self.spans.retired(q["qid"], self.clock)
@@ -696,6 +818,10 @@ class QueryFabric:
                     # a recycled lane must not inherit the retired
                     # query's stall window
                     self._watchdog._lane_trend.pop(ln, None)
+                if self._forecaster is not None:
+                    # ... nor the retired query's decay history
+                    self._forecaster.clear(ln)
+                    self._lane_eta.pop(ln, None)
             self.retired_total += len(done)
             if self.metrics is not None:
                 self.metrics.inc("queries_retired_total", len(done))
@@ -824,13 +950,25 @@ class QueryFabric:
         if q["status"] == "done":
             if self.spans is not None:
                 self.spans.read(qid, self.clock)
-            return {**base, "t": q["done_round"], "staleness": 0,
-                    "converged": True, **q["result"]}
+            out = {**base, "t": q["done_round"], "staleness": 0,
+                   "converged": True, **q["result"]}
+            if "forecast_ratio" in q:
+                out["forecast_ratio"] = q["forecast_ratio"]
+            if q.get("at_risk"):
+                out["at_risk"] = True   # admitted over-SLO (observe policy)
+            return out
         if q["status"] == "quarantined":
             # the lane was scrubbed by the watchdog: no result, and the
             # read says so instead of probing a lane it no longer owns
             return {**base, "t": q["done_round"], "converged": False,
                     "quarantined": True}
+        if q["status"] == "deferred":
+            # strict admission turned it away at the door: the a-priori
+            # ETA that priced it out is the read's answer
+            return {**base, "t": q["done_round"], "converged": False,
+                    "deferred": True, "at_risk": True,
+                    "eta_rounds": q.get("eta_admission"),
+                    "slo_rounds": self.convergence_slo_rounds}
         if q["status"] == "queued":
             return {**base, "queue_position":
                     self._queue.index(qid),
@@ -839,12 +977,28 @@ class QueryFabric:
         if (max_staleness is None or probe is None
                 or self.clock - probe["t"] > max_staleness):
             probe = self._probe_fresh()
-        return {
+        out = {
             **base,
             "t": probe["t"],
             "staleness": self.clock - probe["t"],
             **self._lane_result(probe, q),
         }
+        if self._forecaster is not None:
+            # the per-lane ETA off the latest boundary forecast (the
+            # read itself never refits — the forecast is as stale as
+            # the last boundary, which the chain clocks make explicit)
+            fc = self._lane_eta.get(q["lane"])
+            if fc is None:
+                out["forecast_status"] = "warming"
+            else:
+                out["forecast_status"] = fc["status"]
+                if fc["status"] == "ok":
+                    out["eta_rounds"] = fc["eta_rounds"]
+                    out["eta_lo"] = fc["eta_lo"]
+                    out["eta_hi"] = fc["eta_hi"]
+            if q.get("at_risk"):
+                out["at_risk"] = True
+        return out
 
     def mass_residual(self) -> np.ndarray:
         """(lanes,) per-lane live-mass residual in the ledger form (the
@@ -908,7 +1062,36 @@ class QueryFabric:
         }
         if self.probe_manifest:
             out["probe_rows"] = [dict(r) for r in self._probe_rows]
+        if self._forecaster is not None:
+            out["forecast"] = self._forecast_block()
         return out
+
+    def _forecast_block(self) -> dict:
+        """The ``forecast`` sub-block of the query manifest — doctor's
+        ``forecast_calibrated`` / ``slo_admission`` inputs: the banked
+        ``forecast_ratio`` distribution against the declared band, the
+        admission-control counters, and the mixing record that priced
+        admissions (when attached)."""
+        ratios = [float(r) for r in self._forecast_ratios]
+        fore = {
+            "enabled": True,
+            "admit_policy": self.admit_policy,
+            "window": self._forecaster.window,
+            "min_points": self._forecaster.min_points,
+            "band": FORECAST_BAND,
+            "ratios": [round(r, 6) for r in ratios],
+            "at_risk_total": self.at_risk_total,
+            "deferred_total": self.deferred_total,
+        }
+        pos = [r for r in ratios if r > 0 and math.isfinite(r)]
+        if pos:
+            logs = np.abs(np.log(np.asarray(pos)))
+            fore["p90_abs_log_ratio"] = float(np.percentile(logs, 90))
+            fore["in_band_frac"] = float(
+                np.mean(logs <= math.log(FORECAST_BAND)))
+        if self._mixing is not None:
+            fore["mixing"] = dict(self._mixing)
+        return fore
 
     # ---- serving flight recorder (obs/metrics.py, obs/spans.py) ----------
     def _refresh_obs_gauges(self) -> None:
@@ -922,6 +1105,11 @@ class QueryFabric:
         m.set_gauge("queue_depth", len(self._queue))
         m.set_gauge("compile_count", self.compile_count)
         m.set_gauge("probe_compile_count", self.probe_compile_count)
+        if self._forecaster is not None:
+            for ln, fc in sorted(self._lane_eta.items()):
+                if fc.get("status") == "ok":
+                    m.set_gauge(f"lane{ln}_eta_rounds",
+                                float(fc["eta_rounds"]))
         if self._wal is not None:
             m.set_gauge("wal_last_seq", self._wal.last_seq)
             m.set_gauge("wal_fsync_seconds_total",
@@ -991,6 +1179,23 @@ class QueryFabric:
             "convergence_slo_rounds": self.convergence_slo_rounds,
             "conv_latencies": [int(x) for x in self._conv_latencies],
             "observe": self.metrics is not None,
+            "admit_policy": self.admit_policy,
+            # the forecasting config + banked reconciliations persist
+            # so WAL replay re-derives the SAME admission decisions
+            # (strict deferral depends on the mixing gap); the trailing
+            # fit windows are transient and re-warm from live probes
+            "forecast": {
+                "enabled": self._forecaster is not None,
+                "window": (self._forecaster.window
+                           if self._forecaster is not None else None),
+                "min_points": (self._forecaster.min_points
+                               if self._forecaster is not None
+                               else None),
+                "ratios": [float(r) for r in self._forecast_ratios],
+                "at_risk_total": self.at_risk_total,
+                "deferred_total": self.deferred_total,
+                "mixing": self._mixing,
+            },
         }
         if self._watchdog is not None:
             qmeta["watchdog_state"] = self._watchdog.state_dict()
@@ -1061,6 +1266,21 @@ class QueryFabric:
             self.convergence_slo_rounds = int(self.convergence_slo_rounds)
         self._conv_latencies = [int(x) for x in
                                 qmeta.get("conv_latencies", [])]
+        self.admit_policy = str(qmeta.get("admit_policy", "observe"))
+        fq = qmeta.get("forecast") or {}
+        on = (bool(fq["enabled"]) if "enabled" in fq
+              else bool(qmeta.get("observe", False)))
+        self._forecaster = (LaneForecaster(
+            window=int(fq.get("window") or 8),
+            min_points=int(fq.get("min_points") or 3))
+            if on else None)
+        self._mixing = (dict(fq["mixing"])
+                        if fq.get("mixing") else None)
+        self._forecast_ratios = [float(r)
+                                 for r in fq.get("ratios") or ()]
+        self.at_risk_total = int(fq.get("at_risk_total", 0))
+        self.deferred_total = int(fq.get("deferred_total", 0))
+        self._lane_eta = {}
         obs = qmeta.get("obs")
         if obs is not None:
             self.metrics = MetricsRegistry.load_state(obs["metrics"])

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""CI convergence-observatory smoke: mixing estimation, per-query ETA
+forecasts and forecast-aware admission on the CPU proxy (ISSUE 20;
+docs/OBSERVABILITY.md §10).
+
+1. estimate the er2048 graph's spectral gap (both provenances, autotune
+   cached — a second report must be a pure cache hit), then drive a
+   forecasting ``QueryFabric`` through >= 16 cohort queries under
+   membership churn: every active read past the warmup window must
+   carry an ETA, the round program must compile exactly once, and the
+   banked ``forecast_ratio`` population must be >= 90% inside the
+   declared [1/band, band];
+2. write the ``flow-updating-query-report/v1`` manifest (forecast block
+   + mixing block embedded) and pass ``doctor --strict`` over it —
+   ``forecast_calibrated``, ``slo_admission``, ``mixing_sane``,
+   ``span_complete`` and ``metrics_consistency`` included;
+3. the NEGATIVE control — the same manifest with a forged
+   ``forecast_ratio = 25`` planted in the ratio bank — must FAIL
+   ``forecast_calibrated`` specifically: doctor can tell a calibrated
+   forecaster from a lying one;
+4. the scenario pair: ``bridge_bottleneck``'s community graph must
+   carry a spectral gap predicting >= 2x the rounds of its
+   expander-augmented ``expander_relief`` control, doctor-asserted
+   from the persisted mixing records (ROADMAP item 4, now a gate);
+5. strict admission: against the bridge graph's own mixing record and
+   an SLO it provably cannot meet, every query is DEFERRED at the door
+   (``submitted -> deferred`` chains, zero lanes held, zero compiles
+   wasted) and the Perfetto export renders the deferrals.
+
+Exit code: 0 only if every assertion above holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="obs-artifacts",
+                    help="manifest output directory (uploaded by CI)")
+    ap.add_argument("--nodes", type=int, default=2048,
+                    help="er fabric member count (acceptance: 2048)")
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--queries", type=int, default=20,
+                    help="queries to offer (acceptance floor: 16)")
+    ap.add_argument("--events", type=int, default=12,
+                    help="membership churn events between segments")
+    ap.add_argument("--segment-rounds", type=int, default=4)
+    ap.add_argument("--eps", type=float, default=1e-4)
+    ap.add_argument("--max-rounds", type=int, default=4096)
+    args = ap.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    cache = os.path.join(args.outdir, "forecast_autotune_cache.json")
+    os.environ["FLOW_UPDATING_AUTOTUNE_CACHE"] = cache
+
+    import numpy as np
+
+    from flow_updating_tpu.cli import main as cli_main
+    from flow_updating_tpu.obs import health
+    from flow_updating_tpu.obs.forecast import FORECAST_BAND
+    from flow_updating_tpu.obs.report import (
+        build_query_manifest,
+        write_report,
+    )
+    from flow_updating_tpu.obs.spectral import mixing_report
+    from flow_updating_tpu.query import QueryFabric
+    from flow_updating_tpu.scenarios.registry import (
+        _community,
+        _expander,
+    )
+    from flow_updating_tpu.topology.generators import erdos_renyi
+
+    # -- 1: mixing estimate + the forecasting churn run -------------------
+    t0 = time.perf_counter()
+    topo = erdos_renyi(args.nodes, avg_degree=6.0, seed=0)
+    mix = mixing_report(topo, eps=args.eps)
+    if mix["cache"]["hit"] or not mixing_report(
+            topo, eps=args.eps)["cache"]["hit"]:
+        print("forecast_smoke: mixing cache discipline broken (first "
+              "report must miss, second must hit)", file=sys.stderr)
+        return 1
+    if not (0.0 < mix["gap"] <= 1.0):
+        print(f"forecast_smoke: er{args.nodes} gap {mix['gap']} out of "
+              "range", file=sys.stderr)
+        return 1
+    print(f"forecast_smoke: er{args.nodes} gap {mix['gap']:.4f} "
+          f"({mix['provenance']}) -> ~{mix['predicted_rounds']:.0f} "
+          f"rounds to eps={args.eps:g}", file=sys.stderr)
+
+    fab = QueryFabric(topo, lanes=args.lanes, capacity=args.nodes + 64,
+                      degree_budget=24,
+                      segment_rounds=args.segment_rounds, seed=0,
+                      conv_eps=args.eps, mixing=mix,
+                      admission_slo_rounds=64 * args.segment_rounds,
+                      convergence_slo_rounds=64 * args.segment_rounds)
+    rng = np.random.default_rng(0)
+    members = fab.svc.live_ids()
+    held: list = []
+    submitted = events = rounds = eta_reads = 0
+    while (submitted < args.queries or fab.active_lanes or fab.queued) \
+            and rounds < args.max_rounds:
+        arrivals = min(int(rng.poisson(0.5 * args.lanes)),
+                       args.queries - submitted)
+        for _ in range(arrivals):
+            m = int(rng.integers(8, 64))
+            cohort = rng.choice(members, size=m, replace=False)
+            fab.submit(rng.random(m), cohort=np.sort(cohort))
+            submitted += 1
+        if events < args.events:
+            if held and rng.random() < 0.4:
+                fab.leave([held.pop()])
+            else:
+                slot = fab.join()
+                fab.add_edges([(slot, int(rng.integers(0, args.nodes)))])
+                held.append(slot)
+            events += 1
+        fab.run(args.segment_rounds)
+        rounds += args.segment_rounds
+        # the ETA read contract, live: every active query's read names
+        # a forecast status, and a warm one prices the remaining rounds
+        for qid, q in fab._queries.items():
+            if q["status"] != "active":
+                continue
+            r = fab.read(qid, max_staleness=0)
+            if "forecast_status" not in r:
+                print(f"forecast_smoke: active read of qid {qid} has "
+                      "no forecast_status", file=sys.stderr)
+                return 1
+            if r["forecast_status"] == "ok":
+                if not (r["eta_rounds"] >= 0.0
+                        and r["eta_lo"] <= r["eta_hi"]):
+                    print(f"forecast_smoke: malformed ETA on qid "
+                          f"{qid}: {r}", file=sys.stderr)
+                    return 1
+                eta_reads += 1
+    if fab.retired_total < args.queries:
+        print(f"forecast_smoke: only {fab.retired_total}/"
+              f"{args.queries} queries retired in {rounds} rounds",
+              file=sys.stderr)
+        return 1
+    if eta_reads == 0:
+        print("forecast_smoke: no warm ETA was ever served",
+              file=sys.stderr)
+        return 1
+    if fab.compile_count > 1:
+        print(f"forecast_smoke: forecasting broke the compile budget "
+              f"({fab.compile_count} > 1)", file=sys.stderr)
+        return 1
+    fore = fab.query_block()["forecast"]
+    ratios = fore["ratios"]
+    in_band = fore["in_band_frac"]
+    print(f"forecast_smoke: {submitted} queries / {events} churn "
+          f"events / {rounds} rounds, {eta_reads} warm ETA reads, "
+          f"{len(ratios)} ratios (p90 |log| "
+          f"{fore['p90_abs_log_ratio']:.3f}, {100 * in_band:.0f}% in "
+          f"band), {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    if len(ratios) < args.queries // 2 or in_band is None \
+            or in_band < 0.9:
+        print(f"forecast_smoke: calibration floor missed — need >= 90% "
+              f"of ratios in [1/{FORECAST_BAND:g}, {FORECAST_BAND:g}]",
+              file=sys.stderr)
+        return 1
+
+    # -- 2: manifest + doctor --strict -------------------------------------
+    manifest_path = os.path.join(args.outdir, "forecast_report.json")
+    write_report(manifest_path, build_query_manifest(
+        argv=sys.argv[1:], config=fab.svc.config, topo=topo,
+        query=fab.query_block(),
+        extra={"serving_trace": fab.serving_trace_block(),
+               "mixing": mix}))
+    rc = cli_main(["doctor", manifest_path, "--strict"])
+    if rc != 0:
+        print("forecast_smoke: doctor --strict FAILED on the honest "
+              "forecast manifest", file=sys.stderr)
+        return 1
+
+    # -- 3: the forged-ratio negative control ------------------------------
+    with open(manifest_path) as f:
+        forged = json.load(f)
+    forged["query"]["forecast"]["ratios"] = (
+        list(forged["query"]["forecast"]["ratios"])[:-1] + [25.0])
+    forged_path = os.path.join(args.outdir,
+                               "forecast_forged_report.json")
+    with open(forged_path, "w") as f:
+        json.dump(forged, f)
+    by = {c.name: c.status
+          for c in health.diagnose_manifest(forged)}
+    if cli_main(["doctor", forged_path]) == 0 \
+            or by.get("forecast_calibrated") != health.FAIL:
+        print(f"forecast_smoke: forged forecast_ratio=25 did not fail "
+              f"forecast_calibrated: {by}", file=sys.stderr)
+        return 1
+    print("forecast_smoke: forged ratio failed forecast_calibrated as "
+          "designed", file=sys.stderr)
+
+    # -- 4: the scenario pair, doctor-asserted -----------------------------
+    t1 = time.perf_counter()
+    bridge_topo = _community(0)
+    bridge = mixing_report(bridge_topo, eps=args.eps)
+    relief = mixing_report(_expander(0), eps=args.eps)
+    slowdown = bridge["predicted_rounds"] / relief["predicted_rounds"]
+    bridge["control"] = {"name": "expander_relief",
+                         "gap": relief["gap"], "min_factor": 2.0}
+    verdicts = health.check_mixing(bridge)
+    print(f"forecast_smoke: bridge gap {bridge['gap']:.4f} vs relief "
+          f"{relief['gap']:.4f} -> {slowdown:.1f}x predicted slowdown "
+          f"({time.perf_counter() - t1:.1f}s)", file=sys.stderr)
+    if slowdown < 2.0 or verdicts[0].status != health.PASS:
+        print(f"forecast_smoke: scenario-pair assertion failed: "
+              f"{verdicts[0].summary}", file=sys.stderr)
+        return 1
+
+    # -- 5: strict admission against an unmeetable SLO ---------------------
+    slo = max(1, int(bridge["predicted_rounds"] / 4))
+    strict = QueryFabric(bridge_topo, lanes=4,
+                         capacity=bridge_topo.num_nodes + 8,
+                         segment_rounds=args.segment_rounds, seed=0,
+                         conv_eps=args.eps, mixing=bridge,
+                         admit_policy="strict",
+                         convergence_slo_rounds=slo)
+    for k in range(4):
+        strict.submit(float(k + 1))
+    strict.run(args.segment_rounds)
+    if strict.deferred_total != 4 or strict.active_lanes \
+            or strict.compile_count > 1:
+        print(f"forecast_smoke: strict admission leg: "
+              f"{strict.deferred_total}/4 deferred, "
+              f"{strict.active_lanes} lanes held, "
+              f"{strict.compile_count} compiles", file=sys.stderr)
+        return 1
+    strict_path = os.path.join(args.outdir,
+                               "forecast_strict_report.json")
+    write_report(strict_path, build_query_manifest(
+        argv=sys.argv[1:], config=strict.svc.config, topo=bridge_topo,
+        query=strict.query_block(),
+        extra={"serving_trace": strict.serving_trace_block(),
+               "mixing": bridge}))
+    if cli_main(["doctor", strict_path, "--strict"]) != 0:
+        print("forecast_smoke: doctor --strict FAILED on the strict-"
+              "admission manifest", file=sys.stderr)
+        return 1
+    trace_path = os.path.join(args.outdir, "forecast_strict.trace.json")
+    if cli_main(["obs", "export-trace", strict_path,
+                 "--output", trace_path]) != 0:
+        return 1
+    with open(trace_path) as f:
+        doc = json.load(f)
+    deferred = [e for e in doc["traceEvents"]
+                if e.get("ph") == "i" and "deferred" in e.get("name", "")]
+    if len(deferred) != 4:
+        print(f"forecast_smoke: Perfetto export rendered "
+              f"{len(deferred)}/4 deferred instants", file=sys.stderr)
+        return 1
+    print("forecast_smoke: strict admission deferred 4/4 at the door "
+          "and the trace shows it", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

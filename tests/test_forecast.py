@@ -1,0 +1,450 @@
+"""Convergence observatory conformance suite (docs/OBSERVABILITY.md §10).
+
+Contracts pinned here:
+
+* **closed-form spectral gaps** — the structural estimator
+  (obs/spectral.py, deflated power iteration on the diffusion operator
+  ``P = diag(1/(deg+1))(I+A)``) reproduces the cycle's
+  ``lambda2 = (1 + 2 cos(2 pi / n)) / 3`` and the complete graph's
+  ``lambda2 = 0`` (gap exactly 1), and the measured decay-fit
+  provenance agrees on graphs where the transient expresses the
+  asymptotic rate;
+* **fit math** — ``fit_log_decay`` recovers slope/intercept of an
+  exact geometric decay and refuses degenerate inputs;
+* **ETA read contract** — with the forecaster on, active reads carry
+  ``forecast_status`` and (once warm) ``eta_rounds`` with a confidence
+  band; retired reads carry the banked ``forecast_ratio``;
+* **forecast-aware admission** — ``observe`` flags provably-over-SLO
+  queries ``at_risk`` but admits them; ``strict`` defers them at the
+  door (terminal ``submitted -> deferred`` chain, no lane ever held);
+* **zero new compiles** — forecasting rides the existing boundary
+  probe: the round program compiles once, forecaster on or off;
+* **observer purity** — the forecast-off twin lowers a byte-identical
+  program and evolves bit-exactly;
+* **doctor clauses both directions** — ``forecast_calibrated``,
+  ``slo_admission`` and ``mixing_sane`` each PASS on honest records
+  and FAIL on forged ones (the smoke test's negative control);
+* **mixing cache** — records round-trip through the PR-15 autotune
+  cache (``FLOW_UPDATING_AUTOTUNE_CACHE`` honored) and a stale version
+  re-probes instead of steering;
+* **scenario pair** — ``bridge_bottleneck``'s community graph has a
+  spectral gap predicting >= 2x the rounds of its expander-augmented
+  control, and doctor asserts it (the ROADMAP item-4 baseline).
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from flow_updating_tpu.models.config import RoundConfig
+from flow_updating_tpu.models.rounds import run_rounds
+from flow_updating_tpu.obs import health
+from flow_updating_tpu.obs.forecast import (
+    FORECAST_BAND,
+    LaneForecaster,
+    fit_log_decay,
+)
+from flow_updating_tpu.obs.spectral import (
+    MIXING_CACHE_STATS,
+    MIXING_VERSION,
+    estimate_gap_measured,
+    estimate_gap_structural,
+    mixing_report,
+    predicted_rounds_to_eps,
+)
+from flow_updating_tpu.query import QueryFabric
+from flow_updating_tpu.topology.generators import complete, ring
+
+
+def _cfg(**kw):
+    kw.setdefault("variant", "collectall")
+    kw.setdefault("fire_policy", "every_round")
+    kw.setdefault("dtype", "float64")
+    return RoundConfig(**kw)
+
+
+def _mk(topo, lanes, cfg, **kw):
+    kw.setdefault("capacity", 20)
+    kw.setdefault("degree_budget", 8)
+    kw.setdefault("edge_capacity", 96)
+    kw.setdefault("segment_rounds", 4)
+    kw.setdefault("seed", 1)
+    kw.setdefault("conv_eps", 1e-9)
+    return QueryFabric(topo, lanes=lanes, config=cfg, **kw)
+
+
+# ---- closed-form spectral gaps ------------------------------------------
+
+def test_structural_gap_matches_cycle_closed_form():
+    n = 24
+    rec = estimate_gap_structural(ring(n, k=1))
+    lam_exact = (1.0 + 2.0 * math.cos(2.0 * math.pi / n)) / 3.0
+    assert rec["provenance"] == "structural" and rec["family"] == "edge"
+    assert abs(rec["lambda2"] - lam_exact) < 1e-5
+    assert abs(rec["gap"] - (1.0 - lam_exact)) < 1e-5
+
+
+def test_structural_gap_complete_graph_is_one():
+    rec = estimate_gap_structural(complete(16))
+    assert rec["lambda2"] < 1e-6
+    assert abs(rec["gap"] - 1.0) < 1e-6
+
+
+def test_measured_gap_agrees_with_structural_on_cycle():
+    topo = ring(24, k=1)
+    st = estimate_gap_structural(topo)
+    me = estimate_gap_measured(topo, rounds=96)
+    assert me["provenance"] == "measured" and me["fit"] is not None
+    # the probe's transient steepens the early slope; the two
+    # provenances must still land within doctor's agreement factor
+    ratio = max(st["gap"] / me["gap"], me["gap"] / st["gap"])
+    assert ratio < health.MIXING_AGREE_FACTOR
+
+
+def test_measured_gap_complete_graph_degenerates_to_open_gap():
+    # K_n converges inside one diffusion step: nothing to fit, and the
+    # record says so instead of inventing a rate
+    rec = estimate_gap_measured(complete(16))
+    assert rec["fit"] is None and rec["gap"] == 1.0
+
+
+def test_predicted_rounds_closed_form():
+    assert predicted_rounds_to_eps(0.5, 1e-6) == pytest.approx(
+        math.log(1e6) / 0.5)
+    assert predicted_rounds_to_eps(0.0, 1e-6) == float("inf")
+    assert predicted_rounds_to_eps(0.5, 2.0) == 0.0
+
+
+# ---- fit math ------------------------------------------------------------
+
+def test_fit_log_decay_recovers_exact_geometric_decay():
+    rate = 0.8
+    ts = list(range(1, 11))
+    ys = [5.0 * rate ** t for t in ts]
+    fit = fit_log_decay(ts, ys)
+    assert fit["slope"] == pytest.approx(math.log(rate), abs=1e-12)
+    assert fit["intercept"] == pytest.approx(math.log(5.0), abs=1e-9)
+    assert fit["stderr"] == pytest.approx(0.0, abs=1e-9)
+    assert fit["points"] == 10
+
+
+def test_fit_log_decay_refuses_degenerate_inputs():
+    assert fit_log_decay([1], [0.5]) is None            # one point
+    assert fit_log_decay([1, 2], [0.0, -1.0]) is None   # no positive ys
+    assert fit_log_decay([3, 3], [0.5, 0.4]) is None    # zero time spread
+
+
+def test_forecaster_eta_on_synthetic_decay():
+    fc = LaneForecaster(window=8, min_points=3)
+    rate, eps = 0.5, 1e-6
+    assert fc.forecast(0, eps, now=0)["status"] == "warming"
+    for t in range(1, 6):
+        fc.observe(0, t, spread=rate ** t, scale=1.0,
+                   resid=rate ** t, mass=1.0)
+    out = fc.forecast(0, eps, now=5)
+    # exact decay: spread hits eps at t = ln(eps)/ln(rate)
+    t_star = math.log(eps) / math.log(rate)
+    assert out["status"] == "ok"
+    assert out["eta_rounds"] == pytest.approx(t_star - 5, rel=1e-6)
+    assert out["rate"] == pytest.approx(rate, rel=1e-9)
+    # an exact fit has zero slope stderr: the band collapses onto eta
+    assert out["eta_lo"] == pytest.approx(out["eta_rounds"], rel=1e-6)
+    assert out["eta_hi"] == pytest.approx(out["eta_rounds"], rel=1e-6)
+    # non-decaying window -> flat, never an extrapolation
+    for t in range(1, 5):
+        fc.observe(1, t, spread=1.0, scale=1.0, resid=1.0, mass=1.0)
+    assert fc.forecast(1, eps, now=4)["status"] == "flat"
+    fc.clear(0)
+    assert fc.points(0) == 0
+
+
+# ---- ETA read contract ---------------------------------------------------
+
+def test_active_read_carries_eta_and_done_read_carries_ratio():
+    topo = ring(16, k=2)
+    fab = _mk(topo, 1, _cfg(), observe=True, conv_eps=1e-9)
+    qid = fab.submit(1.0)
+    fab.run(8)                      # 2 boundaries: still warming
+    r = fab.read(qid)
+    assert r["status"] == "active" and r["forecast_status"] == "warming"
+    assert "eta_rounds" not in r
+    fab.run(8)                      # 4 boundaries: window warm
+    r = fab.read(qid)
+    assert r["forecast_status"] == "ok"
+    assert r["eta_rounds"] > 0.0
+    assert 0.0 < r["eta_lo"] <= r["eta_rounds"] <= r["eta_hi"]
+    fab.run(248)
+    r = fab.read(qid)
+    assert r["status"] == "done" and r["converged"]
+    assert 0.0 < r["forecast_ratio"]
+    # the warm forecast was honest: within the declared band
+    assert abs(math.log(r["forecast_ratio"])) <= math.log(FORECAST_BAND)
+    blk = fab.query_block()["forecast"]
+    assert blk["enabled"] and blk["ratios"] == [r["forecast_ratio"]]
+    assert blk["p90_abs_log_ratio"] == pytest.approx(
+        abs(math.log(r["forecast_ratio"])), abs=1e-6)
+
+
+# ---- forecast-aware admission -------------------------------------------
+
+_MIX_SLOW = {"gap": 0.01, "provenance": "structural", "eps": 1e-9}
+
+
+def test_observe_policy_flags_at_risk_but_admits():
+    topo = ring(16, k=2)
+    fab = _mk(topo, 1, _cfg(), observe=True, conv_eps=1e-6,
+              mixing=_MIX_SLOW, convergence_slo_rounds=10,
+              admit_policy="observe")
+    qid = fab.submit(1.0)
+    assert fab.read(qid)["status"] == "active"      # admitted anyway
+    assert fab.at_risk_total == 1 and fab.deferred_total == 0
+    assert fab.metrics.counter("queries_at_risk_total") == 1
+    fab.run(128)
+    r = fab.read(qid)
+    assert r["status"] == "done" and r["at_risk"] is True
+    checks = {c.name: c for c in health.check_forecast(fab.query_block())}
+    assert checks["slo_admission"].status == health.PASS
+
+
+def test_strict_policy_defers_at_the_door():
+    topo = ring(16, k=2)
+    fab = _mk(topo, 2, _cfg(), observe=True, conv_eps=1e-6,
+              mixing=_MIX_SLOW, convergence_slo_rounds=10,
+              admit_policy="strict")
+    qid = fab.submit(1.0)
+    r = fab.read(qid)
+    assert r["status"] == "deferred" and r["at_risk"]
+    assert r["eta_rounds"] == pytest.approx(
+        math.log(1e6) / 0.01, rel=1e-3)
+    assert r["slo_rounds"] == 10
+    # never held a lane: no admission instant, no segments, free lanes
+    assert fab.active_lanes == 0 and fab.deferred_total == 1
+    assert [s["name"] for s in fab.spans.chain(qid)] == [
+        "submitted", "deferred"]
+    assert fab.metrics.counter("queries_deferred_total") == 1
+    # the full doctor chain judges the deferred terminal gap-free
+    checks = {c.name: c for c in health.check_serving_trace(
+        fab.serving_trace_block(), query=fab.query_block())}
+    assert checks["span_complete"].status == health.PASS
+    assert checks["metrics_consistency"].status == health.PASS
+    checks = {c.name: c for c in health.check_forecast(fab.query_block())}
+    assert checks["slo_admission"].status == health.PASS
+
+
+def test_admission_needs_mixing_and_slo_to_price_queries():
+    topo = ring(16, k=2)
+    # no mixing record: nothing provable, nothing flagged
+    fab = _mk(topo, 1, _cfg(), observe=True,
+              convergence_slo_rounds=10, admit_policy="strict")
+    fab.submit(1.0)
+    assert fab.at_risk_total == 0 and fab.deferred_total == 0
+    # mixing but no SLO: same
+    fab = _mk(topo, 1, _cfg(), observe=True, mixing=_MIX_SLOW,
+              admit_policy="strict")
+    fab.submit(1.0)
+    assert fab.at_risk_total == 0 and fab.deferred_total == 0
+    with pytest.raises(ValueError, match="admit_policy"):
+        _mk(topo, 1, _cfg(), admit_policy="aggressive")
+
+
+# ---- compile-count pin + observer purity --------------------------------
+
+def test_forecasting_adds_zero_compiles():
+    topo = ring(20, k=2)            # distinct shape: owns its compile
+    fab = _mk(topo, 2, _cfg(), capacity=24, observe=True,
+              conv_eps=1e-6, mixing=_MIX_SLOW,
+              convergence_slo_rounds=10_000)
+    n0 = run_rounds._cache_size()
+    rng = np.random.default_rng(0)
+    while fab.retired_total < 6:
+        if fab.active_lanes + fab.queued < 2:
+            m = int(rng.integers(2, 6))
+            fab.submit(rng.random(m),
+                       cohort=np.sort(rng.choice(20, m, replace=False)))
+        fab.run(4)
+    assert run_rounds._cache_size() <= n0 + 1
+    assert fab.compile_count <= 1
+    blk = fab.query_block()["forecast"]
+    assert len(blk["ratios"]) >= 1
+
+
+def test_forecast_off_is_byte_identical_and_bit_exact():
+    topo = ring(16, k=2)
+    kw = dict(capacity=20, degree_budget=8, edge_capacity=96,
+              segment_rounds=4, seed=1, conv_eps=1e-9)
+    on = QueryFabric(topo, lanes=2, config=_cfg(), observe=True,
+                     forecast=True, mixing=_MIX_SLOW,
+                     convergence_slo_rounds=10, admit_policy="observe",
+                     **kw)
+    off = QueryFabric(topo, lanes=2, config=_cfg(), observe=False,
+                      forecast=False, **kw)
+    for fab in (on, off):
+        fab.submit(1.0)
+        fab.submit(2.0, cohort=[1, 3, 5])
+        fab.run(64)
+    assert on.state_digest() == off.state_digest()
+    assert on.read(1)["mean"] == off.read(1)["mean"]
+    # the lowered program never sees the forecaster: byte-identical
+    texts = [run_rounds.lower(f.svc.state, f.svc.arrays, f.svc.config,
+                              f.svc.segment_rounds,
+                              params=f.svc.params).as_text()
+             for f in (on, off)]
+    assert texts[0] == texts[1]
+    assert off.query_block().get("forecast") is None
+
+
+# ---- doctor clauses, both directions ------------------------------------
+
+def _qblock(*, ratios=(), policy="observe", at_risk=0, deferred=0,
+            queries=(), band=FORECAST_BAND, slo=None):
+    blk = {"forecast": {"enabled": True, "admit_policy": policy,
+                        "band": band, "ratios": list(ratios),
+                        "at_risk_total": at_risk,
+                        "deferred_total": deferred},
+           "queries": list(queries)}
+    if slo is not None:
+        blk["convergence_latency"] = {"slo_rounds": slo}
+    return blk
+
+
+def test_forecast_calibrated_passes_in_band_and_fails_forged():
+    ok = {c.name: c for c in health.check_forecast(
+        _qblock(ratios=[0.8, 1.1, 1.3, 0.9]))}
+    assert ok["forecast_calibrated"].status == health.PASS
+    # the smoke test's negative control: one forged ratio of 25 in a
+    # small population drags the p90 far outside the band
+    forged = {c.name: c for c in health.check_forecast(
+        _qblock(ratios=[1.0, 1.1, 25.0]))}
+    assert forged["forecast_calibrated"].status == health.FAIL
+    assert "25" in forged["forecast_calibrated"].summary
+    # one forged ratio hidden in a large honest population still fails:
+    # the p90 clause tolerates a 10% noisy tail, the outlier clause
+    # does not tolerate a single impossible record
+    hidden = {c.name: c for c in health.check_forecast(
+        _qblock(ratios=[1.0] * 19 + [25.0]))}
+    assert hidden["forecast_calibrated"].status == health.FAIL
+    assert "forged" in hidden["forecast_calibrated"].summary
+    # an honest noisy tail inside the outlier cap stays a PASS
+    noisy = {c.name: c for c in health.check_forecast(
+        _qblock(ratios=[1.0] * 19 + [3.0]))}
+    assert noisy["forecast_calibrated"].status == health.PASS
+    skip = health.check_forecast({"forecast": {"enabled": False}})
+    assert skip[0].status == health.SKIP
+    empty = {c.name: c for c in health.check_forecast(_qblock())}
+    assert empty["forecast_calibrated"].status == health.SKIP
+
+
+def test_slo_admission_catches_every_inconsistency():
+    good = {c.name: c for c in health.check_forecast(_qblock(
+        policy="strict", at_risk=1, deferred=1, slo=10,
+        queries=[{"at_risk": True, "status": "deferred"}]))}
+    assert good["slo_admission"].status == health.PASS
+    # deferral under observe policy: only strict defers
+    bad = {c.name: c for c in health.check_forecast(_qblock(
+        policy="observe", at_risk=1, deferred=1,
+        queries=[{"at_risk": True, "status": "deferred"}]))}
+    assert bad["slo_admission"].status == health.FAIL
+    # strict policy let an at-risk query onto a lane
+    bad = {c.name: c for c in health.check_forecast(_qblock(
+        policy="strict", at_risk=1, deferred=0, slo=10,
+        queries=[{"at_risk": True, "status": "done"}]))}
+    assert bad["slo_admission"].status == health.FAIL
+    # counter disagrees with the query census
+    bad = {c.name: c for c in health.check_forecast(_qblock(
+        at_risk=2, queries=[{"at_risk": True, "status": "done"}]))}
+    assert bad["slo_admission"].status == health.FAIL
+    # nothing declared, nothing flagged: explicit skip
+    skip = {c.name: c for c in health.check_forecast(_qblock(
+        queries=[{"status": "done"}]))}
+    assert skip["slo_admission"].status == health.SKIP
+
+
+def test_mixing_sane_judges_range_agreement_and_control():
+    ok = health.check_mixing({
+        "gap": 0.32, "provenance": "measured",
+        "structural": {"gap": 0.30}, "measured": {"gap": 0.32}})
+    assert ok[0].status == health.PASS
+    bad = health.check_mixing({"gap": 1.5, "provenance": "structural",
+                               "structural": {"gap": 1.5}})
+    assert bad[0].status == health.FAIL
+    bad = health.check_mixing({
+        "gap": 0.05, "provenance": "measured",
+        "structural": {"gap": 0.4}, "measured": {"gap": 0.05}})
+    assert bad[0].status == health.FAIL
+    assert "disagree" in bad[0].summary
+    # the scenario-pair control: record's gap must predict >= min_factor
+    # x the control's rounds (gap ratio == predicted-rounds ratio)
+    base = {"gap": 0.05, "provenance": "structural",
+            "structural": {"gap": 0.05},
+            "control": {"name": "expander_relief", "gap": 0.2,
+                        "min_factor": 2.0}}
+    assert health.check_mixing(base)[0].status == health.PASS
+    tight = json.loads(json.dumps(base))
+    tight["control"]["min_factor"] = 5.0
+    assert health.check_mixing(tight)[0].status == health.FAIL
+    assert health.check_mixing(None)[0].status == health.SKIP
+
+
+def test_diagnose_manifest_dispatches_forecast_and_mixing():
+    man = {"schema": "flow-updating-query-report/v1",
+           "query": _qblock(ratios=[1.0]),
+           "mixing": {"gap": 0.3, "provenance": "structural",
+                      "structural": {"gap": 0.3}}}
+    names = {c.name for c in health.diagnose_manifest(man)}
+    assert {"forecast_calibrated", "slo_admission",
+            "mixing_sane"} <= names
+
+
+# ---- mixing cache --------------------------------------------------------
+
+def test_mixing_cache_round_trip_and_stale_reprobe(tmp_path, monkeypatch):
+    cache = tmp_path / "autotune.json"
+    monkeypatch.setenv("FLOW_UPDATING_AUTOTUNE_CACHE", str(cache))
+    topo = ring(24, k=1)
+    before = dict(MIXING_CACHE_STATS)
+    rep = mixing_report(topo, eps=1e-6)         # env-routed path
+    assert rep["cache"]["path"] == str(cache)
+    assert rep["cache"]["hit"] is False
+    again = mixing_report(topo, eps=1e-6)
+    assert again["cache"]["hit"] is True
+    assert again["gap"] == rep["gap"]           # recompute NOTHING
+    assert MIXING_CACHE_STATS["hits"] == before["hits"] + 1
+    assert MIXING_CACHE_STATS["misses"] == before["misses"] + 1
+    # a stale version never steers: the entry re-probes
+    blob = json.loads(cache.read_text())
+    key = rep["cache"]["key"]
+    assert blob[key]["version"] == MIXING_VERSION
+    blob[key]["version"] = "mixing-v0"
+    blob[key]["structural"]["gap"] = 0.999      # poison: must not leak
+    cache.write_text(json.dumps(blob))
+    fresh = mixing_report(topo, eps=1e-6)
+    assert fresh["cache"]["hit"] is False
+    assert fresh["gap"] == rep["gap"]
+    # refresh=True forces a re-probe even on a valid entry
+    assert mixing_report(topo, eps=1e-6,
+                         refresh=True)["cache"]["hit"] is False
+
+
+# ---- the scenario pair (ROADMAP item 4, doctor-asserted) ----------------
+
+@pytest.mark.slow
+def test_bridge_bottleneck_gap_predicts_2x_expander_relief(tmp_path):
+    from flow_updating_tpu.scenarios.registry import (
+        _community,
+        _expander,
+    )
+
+    cache = str(tmp_path / "mix.json")
+    bridge = mixing_report(_community(0), eps=1e-6, cache_path=cache)
+    relief = mixing_report(_expander(0), eps=1e-6, cache_path=cache)
+    slowdown = (bridge["predicted_rounds"] / relief["predicted_rounds"])
+    assert slowdown >= 2.0, (bridge["gap"], relief["gap"])
+    # doctor asserts the same claim from the persisted records
+    rec = dict(bridge)
+    rec["control"] = {"name": "expander_relief", "gap": relief["gap"],
+                      "min_factor": 2.0}
+    checks = health.check_mixing(rec)
+    assert checks[0].status == health.PASS
+    assert "expander_relief" in checks[0].summary
